@@ -1,0 +1,46 @@
+"""bigdl_tpu — a TPU-native deep-learning framework with BigDL's capabilities.
+
+A from-scratch rebuild of the capability surface of BigDL (reference:
+frankfzw/BigDL, Scala/Spark/MKL) as an idiomatic JAX/XLA framework:
+
+- ``bigdl_tpu.nn``       — module/criterion library (BigDL ``nn``: layers are
+  declarative objects with a pure-functional ``init``/``apply`` core; autodiff
+  replaces hand-written backward passes).
+- ``bigdl_tpu.optim``    — OptimMethods (SGD + LR schedules, Adam, ...),
+  Triggers, ValidationMethods, Local/Distri optimizers (BigDL ``optim``).
+- ``bigdl_tpu.dataset``  — DataSet/Transformer/Sample/MiniBatch data pipeline
+  (BigDL ``dataset``).
+- ``bigdl_tpu.parallel`` — Engine (mesh/topology config) + the distributed
+  training runtime: sharded sync-SGD over a ``jax.sharding.Mesh`` with XLA
+  collectives, replacing BigDL's AllReduceParameter/BlockManager PS.
+- ``bigdl_tpu.models``   — model zoo (LeNet, VGG, ResNet, Inception, RNN LM,
+  Autoencoder) mirroring BigDL's ``models/``.
+- ``bigdl_tpu.utils``    — Table (the pytree of the system), RandomGenerator,
+  DirectedGraph, File I/O, logging.
+- ``bigdl_tpu.ops``      — pallas TPU kernels for ops XLA fusion can't cover
+  (int8 quantized GEMM — the BigQuant equivalent) and collective primitives.
+
+Design notes (vs the reference, /root/reference):
+- BigDL ``Tensor[T]`` (tensor/Tensor.scala:36) -> ``jax.Array``; the 104-method
+  TensorMath surface is jnp/lax.
+- ``AbstractModule.forward/backward`` (nn/abstractnn/AbstractModule.scala:56)
+  -> pure ``apply`` + ``jax.grad``; the stateful convenience API is kept for
+  parity (``module.forward(x)``, ``module.backward(x, grad)``).
+- ``Engine``'s two thread pools (utils/Engine.scala:139-143) -> XLA; intra-node
+  sub-model clones (DistriOptimizer.scala:116-118) -> per-chip batch dim.
+- ``AllReduceParameter`` reduce-scatter/all-gather over Spark BlockManager
+  (parameters/AllReduceParameter.scala) -> ``lax.psum``/``psum_scatter`` +
+  ``all_gather`` over the ICI mesh, with ZeRO-1-style sharded optimizer state.
+"""
+
+from bigdl_tpu.utils.table import Table, T
+from bigdl_tpu.utils.random import RandomGenerator
+from bigdl_tpu.utils.engine import Engine
+from bigdl_tpu import nn, optim, dataset, parallel, utils
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Table", "T", "RandomGenerator", "Engine",
+    "nn", "optim", "dataset", "parallel", "utils",
+]

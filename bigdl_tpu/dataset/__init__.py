@@ -1,0 +1,7 @@
+from bigdl_tpu.dataset.sample import (
+    Sample, MiniBatch, PaddingParam, samples_to_minibatch)
+from bigdl_tpu.dataset.transformer import (
+    Transformer, ChainedTransformer, SampleToMiniBatch, Lambda)
+from bigdl_tpu.dataset.dataset import (
+    AbstractDataSet, LocalDataSet, TransformedDataSet, ShardedDataSet,
+    DataSet, array_to_samples)

@@ -1,0 +1,131 @@
+"""DataSet abstractions (BigDL dataset/DataSet.scala:46).
+
+``LocalDataSet`` mirrors the reference's iterator contract: ``data(train)``
+yields elements (looping forever when train=True, one pass when False),
+``shuffle()`` reshuffles, ``size()`` reports element count. The distributed
+variant (``ShardedDataSet``) replaces the RDD-backed ``DistributedDataSet``:
+each host reads its own shard (reader-sharding by process index), and the
+per-step MiniBatch is laid out across the device mesh by the optimizer.
+"""
+from __future__ import annotations
+
+from typing import Callable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.dataset.sample import MiniBatch, Sample
+from bigdl_tpu.dataset.transformer import Transformer
+from bigdl_tpu.utils.random import RandomGenerator
+
+
+class AbstractDataSet:
+    def data(self, train: bool) -> Iterator:
+        raise NotImplementedError
+
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def shuffle(self):
+        return self
+
+    def transform(self, transformer: Transformer) -> "TransformedDataSet":
+        return TransformedDataSet(self, transformer)
+
+    # reference sugar: dataset -> transformer
+    def __rshift__(self, transformer: Transformer):
+        return self.transform(transformer)
+
+
+class LocalDataSet(AbstractDataSet):
+    """In-memory dataset over a list/array of elements
+    (DataSet.scala LocalArrayDataSet:110)."""
+
+    def __init__(self, elements: Sequence):
+        self.elements = list(elements)
+        self._perm = np.arange(len(self.elements))
+
+    def size(self) -> int:
+        return len(self.elements)
+
+    def shuffle(self):
+        RandomGenerator.numpy().shuffle(self._perm)
+        return self
+
+    def data(self, train: bool) -> Iterator:
+        if train:
+            while True:
+                for i in self._perm:
+                    yield self.elements[i]
+        else:
+            for i in range(len(self.elements)):
+                yield self.elements[i]
+
+
+class TransformedDataSet(AbstractDataSet):
+    def __init__(self, base: AbstractDataSet, transformer: Transformer):
+        self.base = base
+        self.transformer = transformer
+
+    def size(self) -> int:
+        return self.base.size()
+
+    def shuffle(self):
+        self.base.shuffle()
+        return self
+
+    def data(self, train: bool) -> Iterator:
+        return self.transformer.apply(self.base.data(train))
+
+
+class ShardedDataSet(AbstractDataSet):
+    """Multi-host sharding (replaces DistributedDataSet/CachedDistriDataSet,
+    DataSet.scala:164,240): host ``process_index`` of ``process_count`` sees
+    elements[i] with i % count == index. On a single host it is LocalDataSet.
+    """
+
+    def __init__(self, elements: Sequence, process_index: int = 0,
+                 process_count: int = 1):
+        self.all_elements = list(elements)
+        self.process_index = process_index
+        self.process_count = process_count
+        shard = self.all_elements[process_index::process_count]
+        self.local = LocalDataSet(shard)
+
+    def size(self) -> int:
+        return len(self.all_elements)
+
+    def local_size(self) -> int:
+        return self.local.size()
+
+    def shuffle(self):
+        self.local.shuffle()
+        return self
+
+    def data(self, train: bool) -> Iterator:
+        return self.local.data(train)
+
+
+def array_to_samples(features: np.ndarray, labels: Optional[np.ndarray] = None
+                     ) -> List[Sample]:
+    """Convenience: rows of (features, labels) arrays -> Samples."""
+    out = []
+    for i in range(len(features)):
+        out.append(Sample(features[i],
+                          None if labels is None else labels[i]))
+    return out
+
+
+class DataSet:
+    """Factory namespace mirroring ``object DataSet`` (DataSet.scala:319)."""
+
+    @staticmethod
+    def array(elements, labels=None) -> LocalDataSet:
+        if labels is not None:
+            return LocalDataSet(array_to_samples(np.asarray(elements),
+                                                 np.asarray(labels)))
+        return LocalDataSet(list(elements))
+
+    @staticmethod
+    def sharded(elements, process_index: int = 0, process_count: int = 1
+                ) -> ShardedDataSet:
+        return ShardedDataSet(elements, process_index, process_count)

@@ -1,0 +1,113 @@
+"""Sample & MiniBatch (BigDL dataset/Sample.scala:32, MiniBatch.scala:33).
+
+Host-side numpy containers: the pipeline assembles batches on CPU and the
+optimizer transfers one MiniBatch per step to device (ideally overlapped).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+
+class Sample:
+    """A feature/label pair; features and labels may each be one array or a
+    list of arrays (multi-input models), like ArraySample in the reference."""
+
+    def __init__(self, feature, label=None):
+        self.features = [np.asarray(f) for f in
+                         (feature if isinstance(feature, (list, tuple))
+                          else [feature])]
+        if label is None:
+            self.labels = []
+        else:
+            self.labels = [np.asarray(l) for l in
+                           (label if isinstance(label, (list, tuple))
+                            else [label])]
+
+    def feature(self, i: int = 0):
+        return self.features[i]
+
+    def label(self, i: int = 0):
+        return self.labels[i] if self.labels else None
+
+    def __repr__(self):
+        fs = ",".join(str(f.shape) for f in self.features)
+        ls = ",".join(str(l.shape) for l in self.labels)
+        return f"Sample(features=[{fs}], labels=[{ls}])"
+
+
+class MiniBatch:
+    """A stacked batch (dataset/MiniBatch.scala ArrayTensorMiniBatch:110).
+
+    ``input``/``target`` are numpy arrays (or lists for multi-IO models).
+    """
+
+    def __init__(self, input, target=None):
+        self.input = input
+        self.target = target
+
+    def size(self) -> int:
+        x = self.input[0] if isinstance(self.input, (list, tuple)) \
+            else self.input
+        return x.shape[0]
+
+    def slice(self, offset: int, length: int) -> "MiniBatch":
+        """1-based offset, like MiniBatch.slice in the reference."""
+        sl = slice(offset - 1, offset - 1 + length)
+
+        def cut(x):
+            if isinstance(x, (list, tuple)):
+                return [xx[sl] for xx in x]
+            return x[sl] if x is not None else None
+
+        return MiniBatch(cut(self.input), cut(self.target))
+
+    def get_input(self):
+        return self.input
+
+    def get_target(self):
+        return self.target
+
+
+class PaddingParam:
+    """Padding strategy (MiniBatch.scala:522-585): pad variable-length
+    features to the batch max (or fixed length) with a padding value."""
+
+    def __init__(self, padding_value: float = 0.0,
+                 fixed_length: Optional[int] = None):
+        self.padding_value = padding_value
+        self.fixed_length = fixed_length
+
+
+def _stack(arrays: List[np.ndarray], padding: Optional[PaddingParam] = None):
+    shapes = {a.shape for a in arrays}
+    if len(shapes) == 1 and padding is None:
+        return np.stack(arrays)
+    # variable-size: pad every dim to max (or fixed length for dim 0)
+    nd = arrays[0].ndim
+    maxs = [max(a.shape[d] for a in arrays) for d in range(nd)]
+    if padding is not None and padding.fixed_length is not None:
+        maxs[0] = max(maxs[0], padding.fixed_length)
+    val = padding.padding_value if padding is not None else 0.0
+    out = np.full([len(arrays)] + maxs, val, dtype=arrays[0].dtype)
+    for i, a in enumerate(arrays):
+        out[(i,) + tuple(slice(0, s) for s in a.shape)] = a
+    return out
+
+
+def samples_to_minibatch(samples: Sequence[Sample],
+                         feature_padding: Optional[PaddingParam] = None,
+                         label_padding: Optional[PaddingParam] = None
+                         ) -> MiniBatch:
+    """Stack samples into one MiniBatch (SampleToMiniBatch transformer,
+    dataset/Transformer.scala:309)."""
+    n_feat = len(samples[0].features)
+    n_lab = len(samples[0].labels)
+    feats = [_stack([s.features[i] for s in samples], feature_padding)
+             for i in range(n_feat)]
+    labs = [_stack([s.labels[i] for s in samples], label_padding)
+            for i in range(n_lab)]
+    input = feats[0] if n_feat == 1 else feats
+    target = None if n_lab == 0 else (labs[0] if n_lab == 1 else labs)
+    return MiniBatch(input, target)

@@ -1,0 +1,74 @@
+"""Transformer pipeline (BigDL dataset/Transformer.scala:44).
+
+A ``Transformer[A, B]`` maps an iterator of A to an iterator of B; ``a >> b``
+(or ``a.chain(b)``) composes, mirroring the reference's ``->`` operator
+(ChainedTransformer, Transformer.scala:86).
+"""
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+from bigdl_tpu.dataset.sample import (MiniBatch, PaddingParam, Sample,
+                                      samples_to_minibatch)
+
+
+class Transformer:
+    def apply(self, it: Iterator) -> Iterator:
+        raise NotImplementedError
+
+    def __call__(self, it):
+        return self.apply(iter(it))
+
+    def chain(self, other: "Transformer") -> "ChainedTransformer":
+        return ChainedTransformer(self, other)
+
+    # BigDL uses `->`; Python gets `>>`
+    def __rshift__(self, other: "Transformer") -> "ChainedTransformer":
+        return self.chain(other)
+
+
+class ChainedTransformer(Transformer):
+    def __init__(self, first: Transformer, second: Transformer):
+        self.first = first
+        self.second = second
+
+    def apply(self, it):
+        return self.second.apply(self.first.apply(it))
+
+
+class SampleToMiniBatch(Transformer):
+    """Group Samples into MiniBatches (Transformer.scala:309)."""
+
+    def __init__(self, batch_size: int,
+                 feature_padding: Optional[PaddingParam] = None,
+                 label_padding: Optional[PaddingParam] = None,
+                 partition_num: int = 1, drop_remainder: bool = False):
+        # total batch size, like the reference's batchSize (split happens at
+        # the sharding layer, not here)
+        self.batch_size = batch_size
+        self.feature_padding = feature_padding
+        self.label_padding = label_padding
+        self.drop_remainder = drop_remainder
+
+    def apply(self, it):
+        buf = []
+        for s in it:
+            buf.append(s)
+            if len(buf) == self.batch_size:
+                yield samples_to_minibatch(buf, self.feature_padding,
+                                           self.label_padding)
+                buf = []
+        if buf and not self.drop_remainder:
+            yield samples_to_minibatch(buf, self.feature_padding,
+                                       self.label_padding)
+
+
+class Lambda(Transformer):
+    """Wrap a per-element function as a transformer."""
+
+    def __init__(self, fn):
+        self.fn = fn
+
+    def apply(self, it):
+        for x in it:
+            yield self.fn(x)

@@ -1,0 +1,61 @@
+"""bigdl_tpu.nn — the module/criterion library (BigDL nn/, 230 files).
+
+Layer inventory mirrors SURVEY.md §2.2; semantics follow the reference
+(1-based dims, NCHW convs, 1-based class labels) while compute is pure
+JAX traced through ``Module.apply``.
+"""
+from bigdl_tpu.nn.module import Module, Criterion, Params, State
+from bigdl_tpu.nn.initialization import (
+    InitializationMethod, Zeros, Ones, ConstInitMethod, RandomUniform,
+    RandomNormal, Xavier, MsraFiller, BilinearFiller)
+from bigdl_tpu.optim.regularizer import (
+    Regularizer, L1L2Regularizer, L1Regularizer, L2Regularizer)
+from bigdl_tpu.nn.container import (
+    Container, Sequential, ConcatTable, ParallelTable, Concat, MapTable,
+    Bottle, NarrowTable, MixtureTable)
+from bigdl_tpu.nn.graph import Graph, Input
+from bigdl_tpu.nn.activation import (
+    ReLU, ReLU6, Tanh, TanhShrink, Sigmoid, LogSigmoid, SoftMax, SoftMin,
+    LogSoftMax, SoftPlus, SoftSign, ELU, LeakyReLU, PReLU, RReLU, SoftShrink,
+    HardShrink, HardTanh, HardSigmoid, Threshold, BinaryThreshold, Clamp,
+    Power, Square, Sqrt, Log, Log1p, Exp, Abs, Negative, Identity, Echo,
+    GradientReversal, GaussianSampler)
+from bigdl_tpu.nn.linear import (
+    Linear, Bilinear, CMul, CAdd, Mul, Add, MulConstant, AddConstant, MM, MV,
+    Cosine, Euclidean, DotProduct, PairwiseDistance, CosineDistance)
+from bigdl_tpu.nn.conv import (
+    SpatialConvolution, SpatialShareConvolution, SpatialDilatedConvolution,
+    SpatialFullConvolution, TemporalConvolution, VolumetricConvolution,
+    VolumetricFullConvolution)
+from bigdl_tpu.nn.pool import (
+    SpatialMaxPooling, SpatialAveragePooling, TemporalMaxPooling,
+    VolumetricMaxPooling, RoiPooling)
+from bigdl_tpu.nn.norm import (
+    BatchNormalization, SpatialBatchNormalization, Normalize,
+    SpatialCrossMapLRN, SpatialWithinChannelLRN,
+    SpatialSubtractiveNormalization, SpatialDivisiveNormalization,
+    SpatialContrastiveNormalization)
+from bigdl_tpu.nn.shape import (
+    Reshape, InferReshape, View, Squeeze, Unsqueeze, Transpose, Contiguous,
+    Replicate, Padding, SpatialZeroPadding, Narrow, Select, SelectTable,
+    MaskedSelect, Index, Max, Min, Mean, Sum, Scale, Tile, Pack, Reverse,
+    SplitTable, BifurcateSplitTable, JoinTable, FlattenTable, ResizeBilinear,
+    DenseToSparse)
+from bigdl_tpu.nn.table_ops import (
+    CAddTable, CSubTable, CMulTable, CDivTable, CMaxTable, CMinTable)
+from bigdl_tpu.nn.dropout import Dropout, SpatialDropout2D, L1Penalty
+from bigdl_tpu.nn.embedding import LookupTable
+from bigdl_tpu.nn.recurrent import (
+    Cell, RnnCell, LSTM, LSTMPeephole, GRU, ConvLSTMPeephole,
+    ConvLSTMPeephole3D, Recurrent, BiRecurrent, RecurrentDecoder,
+    TimeDistributed)
+from bigdl_tpu.nn.criterion import (
+    ClassNLLCriterion, CrossEntropyCriterion, MSECriterion, AbsCriterion,
+    BCECriterion, SmoothL1Criterion, SmoothL1CriterionWithWeights,
+    MarginCriterion, MarginRankingCriterion, MultiMarginCriterion,
+    MultiLabelMarginCriterion, MultiLabelSoftMarginCriterion,
+    SoftMarginCriterion, HingeEmbeddingCriterion, L1HingeEmbeddingCriterion,
+    CosineEmbeddingCriterion, CosineDistanceCriterion, DistKLDivCriterion,
+    KLDCriterion, GaussianCriterion, ClassSimplexCriterion,
+    DiceCoefficientCriterion, SoftmaxWithCriterion, L1Cost,
+    ParallelCriterion, MultiCriterion, TimeDistributedCriterion)

@@ -1,0 +1,320 @@
+"""Activation layers (BigDL nn/{ReLU,Tanh,Sigmoid,...}.scala).
+
+All stateless elementwise maps — XLA fuses these into neighbouring matmuls on
+TPU, so each is a one-liner over jnp/lax. RReLU is the only stochastic one.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module
+
+
+class _Elementwise(Module):
+    def op(self, x):
+        raise NotImplementedError
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        return self.op(input)
+
+
+class ReLU(_Elementwise):
+    """nn/ReLU.scala (ip flag is a no-op under functional semantics)."""
+
+    def __init__(self, ip: bool = False):
+        super().__init__()
+
+    def op(self, x):
+        return jax.nn.relu(x)
+
+
+class ReLU6(_Elementwise):
+    """nn/ReLU6.scala"""
+
+    def op(self, x):
+        return jnp.clip(x, 0.0, 6.0)
+
+
+class Tanh(_Elementwise):
+    def op(self, x):
+        return jnp.tanh(x)
+
+
+class TanhShrink(_Elementwise):
+    """nn/TanhShrink.scala: x - tanh(x)"""
+
+    def op(self, x):
+        return x - jnp.tanh(x)
+
+
+class Sigmoid(_Elementwise):
+    def op(self, x):
+        return jax.nn.sigmoid(x)
+
+
+class LogSigmoid(_Elementwise):
+    def op(self, x):
+        return jax.nn.log_sigmoid(x)
+
+
+class SoftMax(_Elementwise):
+    """nn/SoftMax.scala — softmax over the feature dim (last for 1/2-D,
+    dim 1 for 3/4-D batch-of-maps inputs, matching Torch semantics)."""
+
+    def op(self, x):
+        axis = -1 if x.ndim <= 2 else 1
+        return jax.nn.softmax(x, axis=axis)
+
+
+class SoftMin(_Elementwise):
+    """nn/SoftMin.scala: softmax of -x"""
+
+    def op(self, x):
+        axis = -1 if x.ndim <= 2 else 1
+        return jax.nn.softmax(-x, axis=axis)
+
+
+class LogSoftMax(_Elementwise):
+    """nn/LogSoftMax.scala:21 (MKL-accelerated in reference; XLA here)."""
+
+    def op(self, x):
+        return jax.nn.log_softmax(x, axis=-1)
+
+
+class SoftPlus(_Elementwise):
+    """nn/SoftPlus.scala: 1/beta * log(1 + exp(beta x))"""
+
+    def __init__(self, beta: float = 1.0):
+        super().__init__()
+        self.beta = beta
+
+    def op(self, x):
+        return jax.nn.softplus(self.beta * x) / self.beta
+
+
+class SoftSign(_Elementwise):
+    def op(self, x):
+        return x / (1.0 + jnp.abs(x))
+
+
+class ELU(_Elementwise):
+    def __init__(self, alpha: float = 1.0, ip: bool = False):
+        super().__init__()
+        self.alpha = alpha
+
+    def op(self, x):
+        return jnp.where(x > 0, x, self.alpha * jnp.expm1(x))
+
+
+class LeakyReLU(_Elementwise):
+    def __init__(self, negval: float = 0.01, ip: bool = False):
+        super().__init__()
+        self.negval = negval
+
+    def op(self, x):
+        return jnp.where(x >= 0, x, self.negval * x)
+
+
+class PReLU(Module):
+    """nn/PReLU.scala — learnable per-channel slope (nOutputPlane=0 means a
+    single shared slope)."""
+
+    def __init__(self, n_output_plane: int = 0):
+        super().__init__()
+        self.n_output_plane = n_output_plane
+
+    def init(self, rng):
+        n = max(1, self.n_output_plane)
+        return {"weight": jnp.full((n,), 0.25, jnp.float32)}
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        w = params["weight"]
+        if self.n_output_plane > 0 and input.ndim > 1:
+            # channel dim is 1 for (N,C,...) inputs
+            shape = [1] * input.ndim
+            shape[1] = self.n_output_plane
+            w = w.reshape(shape)
+        return jnp.where(input >= 0, input, w * input)
+
+
+class RReLU(Module):
+    """nn/RReLU.scala — randomized leaky ReLU: slope ~ U[lower,upper] in
+    training, fixed mean slope in eval."""
+
+    def __init__(self, lower: float = 1.0 / 8, upper: float = 1.0 / 3,
+                 ip: bool = False):
+        super().__init__()
+        self.lower = lower
+        self.upper = upper
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        if training and rng is not None:
+            a = jax.random.uniform(rng, input.shape, input.dtype,
+                                   minval=self.lower, maxval=self.upper)
+        else:
+            a = (self.lower + self.upper) / 2.0
+        return jnp.where(input >= 0, input, a * input)
+
+
+class SoftShrink(_Elementwise):
+    def __init__(self, lambd: float = 0.5):
+        super().__init__()
+        self.lambd = lambd
+
+    def op(self, x):
+        return jnp.where(x > self.lambd, x - self.lambd,
+                         jnp.where(x < -self.lambd, x + self.lambd, 0.0))
+
+
+class HardShrink(_Elementwise):
+    def __init__(self, lambd: float = 0.5):
+        super().__init__()
+        self.lambd = lambd
+
+    def op(self, x):
+        return jnp.where(jnp.abs(x) > self.lambd, x, 0.0)
+
+
+class HardTanh(_Elementwise):
+    def __init__(self, min_value: float = -1.0, max_value: float = 1.0,
+                 ip: bool = False):
+        super().__init__()
+        self.min_value = min_value
+        self.max_value = max_value
+
+    def op(self, x):
+        return jnp.clip(x, self.min_value, self.max_value)
+
+
+class HardSigmoid(_Elementwise):
+    def op(self, x):
+        return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+class Threshold(_Elementwise):
+    """nn/Threshold.scala: x if x > th else value"""
+
+    def __init__(self, th: float = 1e-6, v: float = 0.0, ip: bool = False):
+        super().__init__()
+        self.th = th
+        self.v = v
+
+    def op(self, x):
+        return jnp.where(x > self.th, x, self.v)
+
+
+class BinaryThreshold(_Elementwise):
+    """nn/BinaryThreshold.scala: 1 if x > th else 0"""
+
+    def __init__(self, th: float = 1e-6, ip: bool = False):
+        super().__init__()
+        self.th = th
+
+    def op(self, x):
+        return (x > self.th).astype(x.dtype)
+
+
+class Clamp(HardTanh):
+    """nn/Clamp.scala"""
+
+    def __init__(self, min_value: float, max_value: float):
+        super().__init__(min_value, max_value)
+
+
+class Power(_Elementwise):
+    """nn/Power.scala: (shift + scale*x)^power"""
+
+    def __init__(self, power: float, scale: float = 1.0, shift: float = 0.0):
+        super().__init__()
+        self.power = power
+        self.scale = scale
+        self.shift = shift
+
+    def op(self, x):
+        return jnp.power(self.shift + self.scale * x, self.power)
+
+
+class Square(_Elementwise):
+    def op(self, x):
+        return x * x
+
+
+class Sqrt(_Elementwise):
+    def op(self, x):
+        return jnp.sqrt(x)
+
+
+class Log(_Elementwise):
+    def op(self, x):
+        return jnp.log(x)
+
+
+class Log1p(_Elementwise):
+    def op(self, x):
+        return jnp.log1p(x)
+
+
+class Exp(_Elementwise):
+    def op(self, x):
+        return jnp.exp(x)
+
+
+class Abs(_Elementwise):
+    def op(self, x):
+        return jnp.abs(x)
+
+
+class Negative(_Elementwise):
+    def op(self, x):
+        return -x
+
+
+class Identity(_Elementwise):
+    def op(self, x):
+        return x
+
+
+class Echo(Module):
+    """nn/Echo.scala — identity that prints shape (debug aid)."""
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        jax.debug.print("Echo: shape {s}", s=str(getattr(input, "shape", "?")))
+        return input
+
+
+class GradientReversal(Module):
+    """nn/GradientReversal.scala — identity forward, -lambda * grad backward
+    (domain-adversarial training)."""
+
+    def __init__(self, the_lambda: float = 1.0):
+        super().__init__()
+        self.the_lambda = the_lambda
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        lam = self.the_lambda
+
+        @jax.custom_vjp
+        def rev(x):
+            return x
+
+        def fwd(x):
+            return x, None
+
+        def bwd(_, g):
+            return (-lam * g,)
+
+        rev.defvjp(fwd, bwd)
+        return rev(input)
+
+
+class GaussianSampler(Module):
+    """nn/GaussianSampler.scala — VAE reparameterized sample from
+    T(mean, log_var)."""
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        mean, log_var = input[1], input[2]
+        if rng is None:
+            raise ValueError("GaussianSampler requires an rng")
+        eps = jax.random.normal(rng, mean.shape, mean.dtype)
+        return mean + jnp.exp(0.5 * log_var) * eps

@@ -1,0 +1,234 @@
+"""Containers (BigDL nn/Container.scala:40, Sequential.scala:32, Concat*, ...).
+
+Containers compose child modules; their params/state pytrees are dicts keyed
+by child index ("0", "1", ...) so the structure is stable under jit/pytree ops.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module, Params, State
+from bigdl_tpu.utils.table import Table, T
+
+
+def _split_rng(rng, n):
+    if rng is None:
+        return [None] * n
+    return list(jax.random.split(rng, n)) if n > 0 else []
+
+
+class Container(Module):
+    """Base container (nn/Container.scala:40)."""
+
+    def __init__(self, *modules: Module):
+        super().__init__()
+        self.modules: List[Module] = list(modules)
+
+    def add(self, module: Module) -> "Container":
+        self.modules.append(module)
+        return self
+
+    def __getitem__(self, i: int) -> Module:
+        return self.modules[i]
+
+    def __len__(self):
+        return len(self.modules)
+
+    # -- functional core ---------------------------------------------------
+    def init(self, rng) -> Params:
+        keys = _split_rng(rng, len(self.modules))
+        return {str(i): m.init(k)
+                for i, (m, k) in enumerate(zip(self.modules, keys))}
+
+    def initial_state(self) -> State:
+        return {str(i): m.initial_state() for i, m in enumerate(self.modules)}
+
+    def regularization_loss(self, params: Params):
+        return sum(m.regularization_loss(params[str(i)])
+                   for i, m in enumerate(self.modules))
+
+    def param_scales(self, params: Params) -> Params:
+        return {str(i): m.param_scales(params[str(i)])
+                for i, m in enumerate(self.modules)}
+
+    # -- mode recursion ----------------------------------------------------
+    def training(self):
+        super().training()
+        for m in self.modules:
+            m.training()
+        return self
+
+    def evaluate(self):
+        super().evaluate()
+        for m in self.modules:
+            m.evaluate()
+        return self
+
+    def freeze(self):
+        for m in self.modules:
+            m.freeze()
+        return self
+
+    def unfreeze(self):
+        for m in self.modules:
+            m.unfreeze()
+        return self
+
+    def find(self, name: str) -> Optional[Module]:
+        """Find a descendant by name (Container.apply in reference)."""
+        for m in self.modules:
+            if m.get_name() == name:
+                return m
+            if isinstance(m, Container):
+                found = m.find(name)
+                if found is not None:
+                    return found
+        return None
+
+    def __repr__(self):
+        inner = "\n  ".join(repr(m).replace("\n", "\n  ")
+                            for m in self.modules)
+        return f"{type(self).__name__}(\n  {inner}\n)"
+
+
+class Sequential(Container):
+    """Feed-forward chain (nn/Sequential.scala:32)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        keys = _split_rng(rng, len(self.modules))
+        x = input
+        new_state = {}
+        for i, (m, k) in enumerate(zip(self.modules, keys)):
+            x, s = m.apply(params[str(i)], state[str(i)], x,
+                           training=training, rng=k)
+            new_state[str(i)] = s
+        return x, new_state
+
+
+class ConcatTable(Container):
+    """Applies each child to the same input; outputs a Table
+    (nn/ConcatTable.scala)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        keys = _split_rng(rng, len(self.modules))
+        outs, new_state = [], {}
+        for i, (m, k) in enumerate(zip(self.modules, keys)):
+            o, s = m.apply(params[str(i)], state[str(i)], input,
+                           training=training, rng=k)
+            outs.append(o)
+            new_state[str(i)] = s
+        return T(*outs), new_state
+
+
+class ParallelTable(Container):
+    """i-th child applied to i-th input table entry (nn/ParallelTable.scala)."""
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        keys = _split_rng(rng, len(self.modules))
+        inputs = list(input) if isinstance(input, Table) else list(input)
+        outs, new_state = [], {}
+        for i, (m, k) in enumerate(zip(self.modules, keys)):
+            o, s = m.apply(params[str(i)], state[str(i)], inputs[i],
+                           training=training, rng=k)
+            outs.append(o)
+            new_state[str(i)] = s
+        return T(*outs), new_state
+
+
+class Concat(Container):
+    """Applies each child to the input and concatenates outputs along
+    ``dimension`` (nn/Concat.scala; dimension is 1-based as in Torch)."""
+
+    def __init__(self, dimension: int, *modules: Module):
+        super().__init__(*modules)
+        self.dimension = dimension
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        keys = _split_rng(rng, len(self.modules))
+        outs, new_state = [], {}
+        for i, (m, k) in enumerate(zip(self.modules, keys)):
+            o, s = m.apply(params[str(i)], state[str(i)], input,
+                           training=training, rng=k)
+            outs.append(o)
+            new_state[str(i)] = s
+        return jnp.concatenate(outs, axis=self.dimension - 1), new_state
+
+
+class MapTable(Container):
+    """Applies a single shared child to every input entry
+    (nn/MapTable.scala) — weights are shared, so params hold one child."""
+
+    def __init__(self, module: Module):
+        super().__init__(module)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        m = self.modules[0]
+        entries = list(input)
+        keys = _split_rng(rng, len(entries))
+        outs = []
+        s = state["0"]
+        for x, k in zip(entries, keys):
+            o, s = m.apply(params["0"], s, x, training=training, rng=k)
+            outs.append(o)
+        return T(*outs), {"0": s}
+
+
+class Bottle(Container):
+    """Collapses leading dims, applies child, restores (nn/Bottle.scala)."""
+
+    def __init__(self, module: Module, n_input_dim: int = 2,
+                 n_output_dim: int = 2):
+        super().__init__(module)
+        self.n_input_dim = n_input_dim
+        self.n_output_dim = n_output_dim
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        in_shape = input.shape
+        keep = len(in_shape) - self.n_input_dim + 1
+        lead = in_shape[:keep]
+        flat = input.reshape((-1,) + in_shape[keep:])
+        out, s = self.modules[0].apply(params["0"], state["0"], flat,
+                                       training=training, rng=rng)
+        out = out.reshape(lead + out.shape[1:])
+        return out, {"0": s}
+
+
+class NarrowTable(Module):
+    """Selects a slice [offset, offset+length) of the input table
+    (nn/NarrowTable.scala); offset is 1-based."""
+
+    def __init__(self, offset: int, length: int = 1):
+        super().__init__()
+        self.offset = offset
+        self.length = length
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        entries = list(input)
+        n = len(entries)
+        length = self.length if self.length > 0 else n + self.length + 1 - (self.offset - 1)
+        picked = entries[self.offset - 1: self.offset - 1 + length]
+        return T(*picked)
+
+
+class MixtureTable(Module):
+    """Mixture-of-experts blend: input = T(gater [B,E], experts Table/array)
+    (nn/MixtureTable.scala). Output = sum_e gater[:,e] * expert_e."""
+
+    def __init__(self, dim: int = None):
+        super().__init__()
+        self.dim = dim
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        gater = input[1]
+        experts = input[2]
+        if isinstance(experts, Table):
+            stacked = jnp.stack(list(experts), axis=1)  # [B, E, ...]
+        else:
+            stacked = experts
+        g = gater
+        extra = stacked.ndim - g.ndim
+        g = g.reshape(g.shape + (1,) * extra)
+        return jnp.sum(stacked * g, axis=1)

@@ -1,0 +1,371 @@
+"""Convolution family (BigDL nn/SpatialConvolution.scala et al.).
+
+The reference implements conv as im2col+MKL gemm (tensor/NNPrimitive.scala);
+here every variant is one ``lax.conv_general_dilated`` call — XLA lowers it
+straight onto the MXU, picking layouts itself. Logical layout follows the
+reference: NCHW activations, OIHW weights, 1-based `dimension` args elsewhere.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.initialization import InitializationMethod
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.utils.engine import Engine
+
+
+def _default_conv_init(rng, shape, fan_in, dtype):
+    stdv = 1.0 / math.sqrt(fan_in)
+    return jax.random.uniform(rng, shape, dtype, minval=-stdv, maxval=stdv)
+
+
+class SpatialConvolution(Module):
+    """2-D convolution over NCHW input (nn/SpatialConvolution.scala).
+
+    Args follow the reference: (n_input_plane, n_output_plane, kernel_w,
+    kernel_h, stride_w, stride_h, pad_w, pad_h, n_group).
+    """
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 kernel_w: int, kernel_h: int,
+                 stride_w: int = 1, stride_h: int = 1,
+                 pad_w: int = 0, pad_h: int = 0,
+                 n_group: int = 1, propagate_back: bool = True,
+                 w_regularizer=None, b_regularizer=None,
+                 init_weight: Optional[InitializationMethod] = None,
+                 init_bias: Optional[InitializationMethod] = None,
+                 with_bias: bool = True):
+        super().__init__()
+        self.n_input_plane = n_input_plane
+        self.n_output_plane = n_output_plane
+        self.kernel_w, self.kernel_h = kernel_w, kernel_h
+        self.stride_w, self.stride_h = stride_w, stride_h
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.n_group = n_group
+        self.with_bias = with_bias
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+        self.weight_init = init_weight
+        self.bias_init = init_bias
+
+    def set_init_method(self, weight_init=None, bias_init=None):
+        if weight_init is not None:
+            self.weight_init = weight_init
+        if bias_init is not None:
+            self.bias_init = bias_init
+        return self
+
+    def _fans(self):
+        fan_in = self.n_input_plane // self.n_group * self.kernel_h * self.kernel_w
+        fan_out = self.n_output_plane // self.n_group * self.kernel_h * self.kernel_w
+        return fan_in, fan_out
+
+    def init(self, rng):
+        dtype = Engine.default_dtype()
+        kw, kb = jax.random.split(rng)
+        fan_in, fan_out = self._fans()
+        wshape = (self.n_output_plane, self.n_input_plane // self.n_group,
+                  self.kernel_h, self.kernel_w)
+        if self.weight_init is not None:
+            w = self.weight_init(kw, wshape, fan_in, fan_out, dtype)
+        else:
+            w = _default_conv_init(kw, wshape, fan_in, dtype)
+        p = {"weight": w}
+        if self.with_bias:
+            if self.bias_init is not None:
+                b = self.bias_init(kb, (self.n_output_plane,), fan_in,
+                                   fan_out, dtype)
+            else:
+                b = _default_conv_init(kb, (self.n_output_plane,), fan_in,
+                                       dtype)
+            p["bias"] = b
+        return p
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        x = input
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        y = lax.conv_general_dilated(
+            x, params["weight"],
+            window_strides=(self.stride_h, self.stride_w),
+            padding=((self.pad_h, self.pad_h), (self.pad_w, self.pad_w)),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=self.n_group,
+            preferred_element_type=x.dtype)
+        if self.with_bias:
+            y = y + params["bias"].reshape(1, -1, 1, 1)
+        return y[0] if squeeze else y
+
+    def regularization_loss(self, params):
+        out = 0.0
+        if self.w_regularizer is not None:
+            out = out + self.w_regularizer.loss(params["weight"])
+        if self.b_regularizer is not None and self.with_bias:
+            out = out + self.b_regularizer.loss(params["bias"])
+        return out
+
+
+class SpatialShareConvolution(SpatialConvolution):
+    """nn/SpatialShareConvolution.scala — identical math; the reference's
+    buffer-sharing trick is irrelevant under XLA memory planning."""
+
+
+class SpatialDilatedConvolution(SpatialConvolution):
+    """nn/SpatialDilatedConvolution.scala — atrous conv."""
+
+    def __init__(self, n_input_plane, n_output_plane, kw, kh,
+                 dw: int = 1, dh: int = 1, pad_w: int = 0, pad_h: int = 0,
+                 dilation_w: int = 1, dilation_h: int = 1,
+                 w_regularizer=None, b_regularizer=None):
+        super().__init__(n_input_plane, n_output_plane, kw, kh, dw, dh,
+                         pad_w, pad_h, 1,
+                         w_regularizer=w_regularizer,
+                         b_regularizer=b_regularizer)
+        self.dilation_w = dilation_w
+        self.dilation_h = dilation_h
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        x = input
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        y = lax.conv_general_dilated(
+            x, params["weight"],
+            window_strides=(self.stride_h, self.stride_w),
+            padding=((self.pad_h, self.pad_h), (self.pad_w, self.pad_w)),
+            rhs_dilation=(self.dilation_h, self.dilation_w),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            preferred_element_type=x.dtype)
+        if self.with_bias:
+            y = y + params["bias"].reshape(1, -1, 1, 1)
+        return y[0] if squeeze else y
+
+
+class SpatialFullConvolution(Module):
+    """Transposed conv / deconv (nn/SpatialFullConvolution.scala).
+
+    out = (in - 1) * stride - 2*pad + kernel + adj, matching Torch.
+    """
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 kw: int, kh: int, dw: int = 1, dh: int = 1,
+                 pad_w: int = 0, pad_h: int = 0,
+                 adj_w: int = 0, adj_h: int = 0,
+                 n_group: int = 1, no_bias: bool = False,
+                 w_regularizer=None, b_regularizer=None):
+        super().__init__()
+        self.n_input_plane = n_input_plane
+        self.n_output_plane = n_output_plane
+        self.kw, self.kh = kw, kh
+        self.dw, self.dh = dw, dh
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.adj_w, self.adj_h = adj_w, adj_h
+        self.n_group = n_group
+        self.with_bias = not no_bias
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+
+    def init(self, rng):
+        dtype = Engine.default_dtype()
+        kwk, kb = jax.random.split(rng)
+        fan_in = self.n_output_plane // self.n_group * self.kh * self.kw
+        # Torch stores deconv weight (in, out/g, kh, kw)
+        wshape = (self.n_input_plane, self.n_output_plane // self.n_group,
+                  self.kh, self.kw)
+        p = {"weight": _default_conv_init(kwk, wshape, fan_in, dtype)}
+        if self.with_bias:
+            p["bias"] = _default_conv_init(kb, (self.n_output_plane,),
+                                           fan_in, dtype)
+        return p
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        x = input
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        # transposed conv = lhs-dilated conv with flipped kernel
+        w = params["weight"]  # (in, out/g, kh, kw)
+        w = jnp.flip(w, axis=(-1, -2))
+        w = jnp.swapaxes(w, 0, 1)  # (out/g, in, kh, kw) -> OIHW w/ groups
+        if self.n_group > 1:
+            # regroup: weight (in, out/g, ...) with in = g * in/g
+            w = params["weight"].reshape(
+                self.n_group, self.n_input_plane // self.n_group,
+                self.n_output_plane // self.n_group, self.kh, self.kw)
+            w = jnp.flip(w, axis=(-1, -2))
+            w = jnp.swapaxes(w, 1, 2).reshape(
+                self.n_output_plane, self.n_input_plane // self.n_group,
+                self.kh, self.kw)
+        pad_h = self.kh - 1 - self.pad_h
+        pad_w = self.kw - 1 - self.pad_w
+        y = lax.conv_general_dilated(
+            x, w, window_strides=(1, 1),
+            padding=((pad_h, pad_h + self.adj_h),
+                     (pad_w, pad_w + self.adj_w)),
+            lhs_dilation=(self.dh, self.dw),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=self.n_group,
+            preferred_element_type=x.dtype)
+        if self.with_bias:
+            y = y + params["bias"].reshape(1, -1, 1, 1)
+        return y[0] if squeeze else y
+
+    def regularization_loss(self, params):
+        out = 0.0
+        if self.w_regularizer is not None:
+            out = out + self.w_regularizer.loss(params["weight"])
+        if self.b_regularizer is not None and self.with_bias:
+            out = out + self.b_regularizer.loss(params["bias"])
+        return out
+
+
+class TemporalConvolution(Module):
+    """1-D conv over (B, T, inF) (nn/TemporalConvolution.scala)."""
+
+    def __init__(self, input_frame_size: int, output_frame_size: int,
+                 kernel_w: int, stride_w: int = 1,
+                 w_regularizer=None, b_regularizer=None):
+        super().__init__()
+        self.input_frame_size = input_frame_size
+        self.output_frame_size = output_frame_size
+        self.kernel_w = kernel_w
+        self.stride_w = stride_w
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+
+    def init(self, rng):
+        dtype = Engine.default_dtype()
+        kw, kb = jax.random.split(rng)
+        fan_in = self.input_frame_size * self.kernel_w
+        return {
+            "weight": _default_conv_init(
+                kw, (self.output_frame_size, self.input_frame_size,
+                     self.kernel_w), fan_in, dtype),
+            "bias": _default_conv_init(kb, (self.output_frame_size,), fan_in,
+                                       dtype),
+        }
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        x = input
+        squeeze = x.ndim == 2
+        if squeeze:
+            x = x[None]
+        x = jnp.swapaxes(x, 1, 2)  # (B, C, T)
+        y = lax.conv_general_dilated(
+            x, params["weight"], window_strides=(self.stride_w,),
+            padding=((0, 0),), dimension_numbers=("NCH", "OIH", "NCH"),
+            preferred_element_type=x.dtype)
+        y = jnp.swapaxes(y, 1, 2) + params["bias"]
+        return y[0] if squeeze else y
+
+    def regularization_loss(self, params):
+        out = 0.0
+        if self.w_regularizer is not None:
+            out = out + self.w_regularizer.loss(params["weight"])
+        if self.b_regularizer is not None:
+            out = out + self.b_regularizer.loss(params["bias"])
+        return out
+
+
+class VolumetricConvolution(Module):
+    """3-D conv over (B, C, D, H, W) (nn/VolumetricConvolution.scala)."""
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 kt: int, kw: int, kh: int,
+                 dt: int = 1, dw: int = 1, dh: int = 1,
+                 pad_t: int = 0, pad_w: int = 0, pad_h: int = 0,
+                 with_bias: bool = True,
+                 w_regularizer=None, b_regularizer=None):
+        super().__init__()
+        self.n_input_plane = n_input_plane
+        self.n_output_plane = n_output_plane
+        self.kt, self.kw, self.kh = kt, kw, kh
+        self.dt, self.dw, self.dh = dt, dw, dh
+        self.pad_t, self.pad_w, self.pad_h = pad_t, pad_w, pad_h
+        self.with_bias = with_bias
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+
+    def init(self, rng):
+        dtype = Engine.default_dtype()
+        kwk, kb = jax.random.split(rng)
+        fan_in = self.n_input_plane * self.kt * self.kh * self.kw
+        p = {"weight": _default_conv_init(
+            kwk, (self.n_output_plane, self.n_input_plane, self.kt, self.kh,
+                  self.kw), fan_in, dtype)}
+        if self.with_bias:
+            p["bias"] = _default_conv_init(kb, (self.n_output_plane,),
+                                           fan_in, dtype)
+        return p
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        x = input
+        squeeze = x.ndim == 4
+        if squeeze:
+            x = x[None]
+        y = lax.conv_general_dilated(
+            x, params["weight"],
+            window_strides=(self.dt, self.dh, self.dw),
+            padding=((self.pad_t, self.pad_t), (self.pad_h, self.pad_h),
+                     (self.pad_w, self.pad_w)),
+            dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+            preferred_element_type=x.dtype)
+        if self.with_bias:
+            y = y + params["bias"].reshape(1, -1, 1, 1, 1)
+        return y[0] if squeeze else y
+
+
+class VolumetricFullConvolution(Module):
+    """3-D transposed conv (nn/VolumetricFullConvolution.scala)."""
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 kt: int, kw: int, kh: int,
+                 dt: int = 1, dw: int = 1, dh: int = 1,
+                 pad_t: int = 0, pad_w: int = 0, pad_h: int = 0,
+                 adj_t: int = 0, adj_w: int = 0, adj_h: int = 0,
+                 n_group: int = 1, no_bias: bool = False):
+        super().__init__()
+        self.n_input_plane = n_input_plane
+        self.n_output_plane = n_output_plane
+        self.kt, self.kw, self.kh = kt, kw, kh
+        self.dt, self.dw, self.dh = dt, dw, dh
+        self.pad_t, self.pad_w, self.pad_h = pad_t, pad_w, pad_h
+        self.adj_t, self.adj_w, self.adj_h = adj_t, adj_w, adj_h
+        self.with_bias = not no_bias
+
+    def init(self, rng):
+        dtype = Engine.default_dtype()
+        kwk, kb = jax.random.split(rng)
+        fan_in = self.n_output_plane * self.kt * self.kh * self.kw
+        p = {"weight": _default_conv_init(
+            kwk, (self.n_input_plane, self.n_output_plane, self.kt, self.kh,
+                  self.kw), fan_in, dtype)}
+        if self.with_bias:
+            p["bias"] = _default_conv_init(kb, (self.n_output_plane,),
+                                           fan_in, dtype)
+        return p
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        x = input
+        squeeze = x.ndim == 4
+        if squeeze:
+            x = x[None]
+        w = jnp.flip(params["weight"], axis=(-1, -2, -3))
+        w = jnp.swapaxes(w, 0, 1)
+        y = lax.conv_general_dilated(
+            x, w, window_strides=(1, 1, 1),
+            padding=((self.kt - 1 - self.pad_t, self.kt - 1 - self.pad_t + self.adj_t),
+                     (self.kh - 1 - self.pad_h, self.kh - 1 - self.pad_h + self.adj_h),
+                     (self.kw - 1 - self.pad_w, self.kw - 1 - self.pad_w + self.adj_w)),
+            lhs_dilation=(self.dt, self.dh, self.dw),
+            dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+            preferred_element_type=x.dtype)
+        if self.with_bias:
+            y = y + params["bias"].reshape(1, -1, 1, 1, 1)
+        return y[0] if squeeze else y

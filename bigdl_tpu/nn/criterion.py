@@ -1,0 +1,530 @@
+"""Criterions (BigDL nn/*Criterion.scala — ~30 losses).
+
+Targets use the reference's conventions: class labels are **1-based** floats
+or ints; ``size_average=True`` divides by batch size. GradInput comes from
+autodiff (Criterion.backward), matching the hand-written backwards.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Criterion
+from bigdl_tpu.utils.table import Table, T
+
+
+def _reduce(x, size_average: bool):
+    return jnp.mean(x) if size_average else jnp.sum(x)
+
+
+class ClassNLLCriterion(Criterion):
+    """NLL over log-probabilities (nn/ClassNLLCriterion.scala).
+
+    input: (B, C) log-probs; target: (B,) 1-based labels. Optional per-class
+    weights. Matches the reference's weighted size-average (divide by total
+    weight).
+    """
+
+    def __init__(self, weights=None, size_average: bool = True,
+                 logProbAsInput: bool = True):
+        super().__init__()
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.size_average = size_average
+        self.log_prob_as_input = logProbAsInput
+
+    def apply(self, input, target):
+        x = input if self.log_prob_as_input else jnp.log(jnp.clip(input, 1e-8))
+        if x.ndim == 1:
+            x = x[None]
+        t = jnp.asarray(target).reshape(-1).astype(jnp.int32) - 1
+        picked = jnp.take_along_axis(x, t[:, None], axis=1)[:, 0]
+        if self.weights is not None:
+            w = jnp.take(self.weights, t)
+            loss = -jnp.sum(picked * w)
+            return loss / jnp.sum(w) if self.size_average else loss
+        return -_reduce(picked, self.size_average)
+
+
+class CrossEntropyCriterion(Criterion):
+    """LogSoftMax + ClassNLL fused (nn/CrossEntropyCriterion.scala)."""
+
+    def __init__(self, weights=None, size_average: bool = True):
+        super().__init__()
+        self.inner = ClassNLLCriterion(weights, size_average)
+
+    def apply(self, input, target):
+        return self.inner.apply(jax.nn.log_softmax(input, axis=-1), target)
+
+
+class MSECriterion(Criterion):
+    """nn/MSECriterion.scala"""
+
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        d = input - target
+        return _reduce(d * d, self.size_average)
+
+
+class AbsCriterion(Criterion):
+    """nn/AbsCriterion.scala"""
+
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        return _reduce(jnp.abs(input - target), self.size_average)
+
+
+class BCECriterion(Criterion):
+    """Binary cross-entropy on probabilities (nn/BCECriterion.scala)."""
+
+    def __init__(self, weights=None, size_average: bool = True):
+        super().__init__()
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        eps = 1e-12
+        x = jnp.clip(input, eps, 1.0 - eps)
+        l = -(target * jnp.log(x) + (1.0 - target) * jnp.log(1.0 - x))
+        if self.weights is not None:
+            l = l * self.weights
+        return _reduce(l, self.size_average)
+
+
+class SmoothL1Criterion(Criterion):
+    """Huber with delta=1 (nn/SmoothL1Criterion.scala)."""
+
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        d = jnp.abs(input - target)
+        l = jnp.where(d < 1.0, 0.5 * d * d, d - 0.5)
+        return _reduce(l, self.size_average)
+
+
+class SmoothL1CriterionWithWeights(Criterion):
+    """nn/SmoothL1CriterionWithWeights.scala — sigma-scaled smooth L1 with
+    inside/outside weights (Fast-RCNN bbox loss). input/target plus optional
+    T(target, inWeights, outWeights)."""
+
+    def __init__(self, sigma: float = 1.0, num: int = 0):
+        super().__init__()
+        self.sigma2 = sigma * sigma
+        self.num = num
+
+    def apply(self, input, target):
+        if isinstance(target, Table):
+            t, win, wout = target[1], target[2], target[3]
+        else:
+            t, win, wout = target, None, None
+        d = input - t
+        if win is not None:
+            d = d * win
+        ad = jnp.abs(d)
+        l = jnp.where(ad < 1.0 / self.sigma2,
+                      0.5 * self.sigma2 * d * d, ad - 0.5 / self.sigma2)
+        if wout is not None:
+            l = l * wout
+        s = jnp.sum(l)
+        return s / self.num if self.num > 0 else s
+
+
+class MarginCriterion(Criterion):
+    """Hinge / margin loss (nn/MarginCriterion.scala); targets ±1."""
+
+    def __init__(self, margin: float = 1.0, size_average: bool = True,
+                 squared: bool = False):
+        super().__init__()
+        self.margin = margin
+        self.size_average = size_average
+        self.squared = squared
+
+    def apply(self, input, target):
+        h = jnp.maximum(0.0, self.margin - input * target)
+        if self.squared:
+            h = h * h
+        return _reduce(h, self.size_average)
+
+
+class MarginRankingCriterion(Criterion):
+    """nn/MarginRankingCriterion.scala — input T(x1, x2), target y=±1."""
+
+    def __init__(self, margin: float = 1.0, size_average: bool = True):
+        super().__init__()
+        self.margin = margin
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        x1, x2 = input[1], input[2]
+        y = target[1] if isinstance(target, Table) else target
+        l = jnp.maximum(0.0, -y * (x1 - x2) + self.margin)
+        return _reduce(l, self.size_average)
+
+
+class MultiMarginCriterion(Criterion):
+    """Multi-class hinge (nn/MultiMarginCriterion.scala); target 1-based."""
+
+    def __init__(self, p: int = 1, weights=None, margin: float = 1.0,
+                 size_average: bool = True):
+        super().__init__()
+        self.p = p
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.margin = margin
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        x = input if input.ndim == 2 else input[None]
+        t = jnp.asarray(target).reshape(-1).astype(jnp.int32) - 1
+        correct = jnp.take_along_axis(x, t[:, None], axis=1)
+        m = jnp.maximum(0.0, self.margin - correct + x)
+        if self.p == 2:
+            m = m * m
+        if self.weights is not None:
+            m = m * jnp.take(self.weights, t)[:, None]
+        # exclude the correct class itself
+        mask = jax.nn.one_hot(t, x.shape[1], dtype=bool)
+        m = jnp.where(mask, 0.0, m)
+        per_sample = jnp.sum(m, axis=1) / x.shape[1]
+        return _reduce(per_sample, self.size_average)
+
+
+class MultiLabelMarginCriterion(Criterion):
+    """nn/MultiLabelMarginCriterion.scala — target rows list 1-based label
+    ids, zero-terminated."""
+
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        x = input if input.ndim == 2 else input[None]
+        t = jnp.asarray(target).astype(jnp.int32)
+        if t.ndim == 1:
+            t = t[None]
+        B, C = x.shape
+        valid = t > 0  # zero-terminated
+        tidx = jnp.clip(t - 1, 0, C - 1)
+        is_target = jax.vmap(
+            lambda ti, vi: jnp.zeros((C,), bool).at[ti].set(vi))(tidx, valid)
+
+        def per_sample(xi, ti, vi, it):
+            # sum over target labels j and non-target k of max(0, 1 - (x_j - x_k))
+            xt = jnp.take(xi, ti)  # (C,) target scores (masked by vi)
+            diff = 1.0 - (xt[:, None] - xi[None, :])  # (C_t, C)
+            hinge = jnp.maximum(0.0, diff)
+            mask = vi[:, None] & (~it)[None, :]
+            return jnp.sum(jnp.where(mask, hinge, 0.0)) / C
+
+        losses = jax.vmap(per_sample)(x, tidx, valid, is_target)
+        return _reduce(losses, self.size_average)
+
+
+class MultiLabelSoftMarginCriterion(Criterion):
+    """nn/MultiLabelSoftMarginCriterion.scala — sigmoid BCE on logits."""
+
+    def __init__(self, weights=None, size_average: bool = True):
+        super().__init__()
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        l = jnp.logaddexp(0.0, -input) * target \
+            + jnp.logaddexp(0.0, input) * (1.0 - target)
+        if self.weights is not None:
+            l = l * self.weights
+        per_sample = jnp.mean(l, axis=-1)
+        return _reduce(per_sample, self.size_average)
+
+
+class SoftMarginCriterion(Criterion):
+    """nn/SoftMarginCriterion.scala: mean log(1 + exp(-y*x))"""
+
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        return _reduce(jnp.logaddexp(0.0, -input * target),
+                       self.size_average)
+
+
+class HingeEmbeddingCriterion(Criterion):
+    """nn/HingeEmbeddingCriterion.scala — y=1: x; y=-1: max(0, margin - x)."""
+
+    def __init__(self, margin: float = 1.0, size_average: bool = True):
+        super().__init__()
+        self.margin = margin
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        l = jnp.where(target > 0, input,
+                      jnp.maximum(0.0, self.margin - input))
+        return _reduce(l, self.size_average)
+
+
+class L1HingeEmbeddingCriterion(Criterion):
+    """nn/L1HingeEmbeddingCriterion.scala — L1 distance of a pair + hinge."""
+
+    def __init__(self, margin: float = 1.0):
+        super().__init__()
+        self.margin = margin
+
+    def apply(self, input, target):
+        d = jnp.sum(jnp.abs(input[1] - input[2]))
+        y = jnp.asarray(target).reshape(())
+        return jnp.where(y > 0, d, jnp.maximum(0.0, self.margin - d))
+
+
+class CosineEmbeddingCriterion(Criterion):
+    """nn/CosineEmbeddingCriterion.scala"""
+
+    def __init__(self, margin: float = 0.0, size_average: bool = True):
+        super().__init__()
+        self.margin = margin
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        x1, x2 = input[1], input[2]
+        if x1.ndim == 1:
+            x1, x2 = x1[None], x2[None]
+        y = jnp.asarray(target[1] if isinstance(target, Table) else target
+                        ).reshape(-1)
+        cos = jnp.sum(x1 * x2, axis=-1) / jnp.clip(
+            jnp.linalg.norm(x1, axis=-1) * jnp.linalg.norm(x2, axis=-1), 1e-12)
+        l = jnp.where(y > 0, 1.0 - cos, jnp.maximum(0.0, cos - self.margin))
+        return _reduce(l, self.size_average)
+
+
+class CosineDistanceCriterion(Criterion):
+    """nn/CosineDistanceCriterion.scala: 1 - cos(input, target)."""
+
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        x, t = input, target
+        if x.ndim == 1:
+            x, t = x[None], t[None]
+        cos = jnp.sum(x * t, axis=-1) / jnp.clip(
+            jnp.linalg.norm(x, axis=-1) * jnp.linalg.norm(t, axis=-1), 1e-12)
+        return _reduce(1.0 - cos, self.size_average)
+
+
+class DistKLDivCriterion(Criterion):
+    """KL(target || input) with log-prob input (nn/DistKLDivCriterion.scala)."""
+
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def apply(self, input, target):
+        l = jnp.where(target > 0, target * (jnp.log(jnp.clip(target, 1e-12))
+                                            - input), 0.0)
+        if self.size_average:
+            # reference averages over batch dim (total elements for 1-D)
+            n = input.shape[0] if input.ndim > 1 else input.size
+            return jnp.sum(l) / n
+        return jnp.sum(l)
+
+
+class KLDCriterion(Criterion):
+    """VAE posterior KL to N(0,I): input T(mean, log_var)
+    (nn/KLDCriterion.scala)."""
+
+    def apply(self, input, target=None):
+        mean, log_var = input[1], input[2]
+        kl = 0.5 * jnp.sum(mean ** 2 + jnp.exp(log_var) - 1.0 - log_var,
+                           axis=-1)
+        return jnp.mean(kl)
+
+    def forward(self, input, target=None):
+        self.output = self.apply(input, target)
+        return self.output
+
+
+class GaussianCriterion(Criterion):
+    """VAE reconstruction -log N(target; mean, exp(log_var))
+    (nn/GaussianCriterion.scala)."""
+
+    def apply(self, input, target):
+        mean, log_var = input[1], input[2]
+        nll = 0.5 * (jnp.log(2 * jnp.pi) + log_var
+                     + (target - mean) ** 2 / jnp.exp(log_var))
+        return jnp.sum(nll)
+
+
+class ClassSimplexCriterion(Criterion):
+    """MSE to simplex-embedded class targets (nn/ClassSimplexCriterion.scala)."""
+
+    def __init__(self, n_classes: int, size_average: bool = True):
+        super().__init__()
+        self.n_classes = n_classes
+        self.size_average = size_average
+        self.simplex = self._build_simplex(n_classes)
+
+    @staticmethod
+    def _build_simplex(n_classes):
+        import numpy as np
+        # regsplex: n_classes unit vertices in R^(n_classes-1) with pairwise
+        # dot -1/(n_classes-1), zero-padded to n_classes columns (reference's
+        # regsplex in ClassSimplexCriterion.scala)
+        n = max(1, n_classes - 1)
+        a = np.zeros((n + 1, n), dtype=np.float32)
+        for k in range(n):
+            a[k, k] = np.sqrt(max(0.0, 1.0 - np.dot(a[k, :k], a[k, :k])))
+            for i in range(k + 1, n + 1):
+                a[i, k] = (-1.0 / n - np.dot(a[i, :k], a[k, :k])) / a[k, k]
+        out = np.zeros((n_classes, n_classes), dtype=np.float32)
+        out[:, :n] = a[:n_classes]
+        return jnp.asarray(out)
+
+    def apply(self, input, target):
+        t = jnp.asarray(target).reshape(-1).astype(jnp.int32) - 1
+        goal = jnp.take(self.simplex, t, axis=0)
+        k = min(self.n_classes, input.shape[-1])
+        d = input[..., :k] - goal[..., :k]
+        return _reduce(d * d, self.size_average)
+
+
+class DiceCoefficientCriterion(Criterion):
+    """1 - Dice overlap (nn/DiceCoefficientCriterion.scala)."""
+
+    def __init__(self, size_average: bool = True, epsilon: float = 1.0):
+        super().__init__()
+        self.size_average = size_average
+        self.epsilon = epsilon
+
+    def apply(self, input, target):
+        x = input.reshape(input.shape[0], -1) if input.ndim > 1 \
+            else input[None]
+        t = target.reshape(x.shape)
+        inter = jnp.sum(x * t, axis=-1)
+        denom = jnp.sum(x, axis=-1) + jnp.sum(t, axis=-1) + self.epsilon
+        dice = 1.0 - 2.0 * inter / denom
+        return _reduce(dice, self.size_average)
+
+
+class SoftmaxWithCriterion(Criterion):
+    """Caffe SoftmaxWithLoss over NCHW maps (nn/SoftmaxWithCriterion.scala).
+    target: (B, H, W) 1-based labels; ignore_label skips positions."""
+
+    def __init__(self, ignore_label: Optional[int] = None,
+                 normalize_mode: str = "VALID"):
+        super().__init__()
+        self.ignore_label = ignore_label
+        self.normalize_mode = normalize_mode
+
+    def apply(self, input, target):
+        logp = jax.nn.log_softmax(input, axis=1)
+        t = jnp.asarray(target).astype(jnp.int32)
+        if t.ndim == input.ndim:
+            t = t[:, 0]
+        t0 = t - 1
+        picked = jnp.take_along_axis(logp, t0[:, None], axis=1)[:, 0]
+        if self.ignore_label is not None:
+            mask = (t != self.ignore_label)
+            picked = jnp.where(mask, picked, 0.0)
+            count = jnp.sum(mask)
+        else:
+            count = picked.size
+        loss = -jnp.sum(picked)
+        if self.normalize_mode == "VALID":
+            return loss / jnp.maximum(count, 1)
+        if self.normalize_mode == "BATCH_SIZE":
+            return loss / input.shape[0]
+        if self.normalize_mode == "FULL":
+            return loss / picked.size
+        return loss
+
+
+class L1Cost(Criterion):
+    """nn/L1Cost.scala: sum |x| (target ignored)."""
+
+    def apply(self, input, target=None):
+        return jnp.sum(jnp.abs(input))
+
+    def forward(self, input, target=None):
+        self.output = self.apply(input, target)
+        return self.output
+
+
+class ParallelCriterion(Criterion):
+    """Weighted sum of criterions over parallel table entries
+    (nn/ParallelCriterion.scala)."""
+
+    def __init__(self, repeat_target: bool = False):
+        super().__init__()
+        self.criterions = []
+        self.weights = []
+        self.repeat_target = repeat_target
+
+    def add(self, criterion: Criterion, weight: float = 1.0):
+        self.criterions.append(criterion)
+        self.weights.append(weight)
+        return self
+
+    def apply(self, input, target):
+        total = 0.0
+        inputs = list(input)
+        if self.repeat_target:
+            targets = [target] * len(inputs)
+        else:
+            targets = list(target) if isinstance(target, Table) else [target]
+        for c, w, i, t in zip(self.criterions, self.weights, inputs, targets):
+            total = total + w * c.apply(i, t)
+        return total
+
+
+class MultiCriterion(Criterion):
+    """Weighted sum of criterions on the same input (nn/MultiCriterion.scala)."""
+
+    def __init__(self):
+        super().__init__()
+        self.criterions = []
+        self.weights = []
+
+    def add(self, criterion: Criterion, weight: float = 1.0):
+        self.criterions.append(criterion)
+        self.weights.append(weight)
+        return self
+
+    def apply(self, input, target):
+        total = 0.0
+        for c, w in zip(self.criterions, self.weights):
+            total = total + w * c.apply(input, target)
+        return total
+
+
+class TimeDistributedCriterion(Criterion):
+    """Applies a criterion at every time step of (B, T, ...) input
+    (nn/TimeDistributedCriterion.scala)."""
+
+    def __init__(self, critrn: Criterion, size_average: bool = False,
+                 dimension: int = 2):
+        super().__init__()
+        self.critrn = critrn
+        self.size_average = size_average
+        self.dimension = dimension
+
+    def apply(self, input, target):
+        axis = self.dimension - 1
+        steps = input.shape[axis]
+        total = 0.0
+        for i in range(steps):
+            xi = jnp.take(input, i, axis=axis)
+            if target.ndim > axis and target.shape[axis] == steps:
+                ti = jnp.take(target, i, axis=axis)
+            else:
+                ti = target
+            total = total + self.critrn.apply(xi, ti)
+        return total / steps if self.size_average else total

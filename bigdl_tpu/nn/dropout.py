@@ -1,0 +1,82 @@
+"""Dropout & penalties (BigDL nn/Dropout.scala, nn/L1Penalty.scala)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module
+
+
+class Dropout(Module):
+    """nn/Dropout.scala — inverted dropout by default (scale=True):
+    train: x * mask / (1-p); eval: identity (or x*(1-p) if scale=False)."""
+
+    def __init__(self, init_p: float = 0.5, inplace: bool = False,
+                 scale: bool = True):
+        super().__init__()
+        self.p = init_p
+        self.scale = scale
+
+    def set_p(self, p: float):
+        self.p = p
+        return self
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        if not training or self.p <= 0.0:
+            if not training and not self.scale:
+                return input * (1.0 - self.p)
+            return input
+        if rng is None:
+            raise ValueError("Dropout in training mode requires an rng")
+        keep = jax.random.bernoulli(rng, 1.0 - self.p, input.shape)
+        y = jnp.where(keep, input, 0.0)
+        if self.scale:
+            y = y / (1.0 - self.p)
+        return y
+
+
+class SpatialDropout2D(Module):
+    """Channel-wise dropout for NCHW maps."""
+
+    def __init__(self, init_p: float = 0.5):
+        super().__init__()
+        self.p = init_p
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        if not training or self.p <= 0.0:
+            return input
+        if rng is None:
+            raise ValueError("SpatialDropout2D requires an rng in training")
+        shape = (input.shape[0], input.shape[1]) + (1,) * (input.ndim - 2)
+        keep = jax.random.bernoulli(rng, 1.0 - self.p, shape)
+        return jnp.where(keep, input / (1.0 - self.p), 0.0)
+
+
+class L1Penalty(Module):
+    """nn/L1Penalty.scala — identity forward; adds l1weight*|x| to the loss
+    in the reference via a side-channel. Here implemented as a straight-
+    through op whose regularization contribution rides the custom_vjp."""
+
+    def __init__(self, l1weight: float, size_average: bool = False,
+                 provide_output: bool = True):
+        super().__init__()
+        self.l1weight = float(l1weight)
+        self.size_average = size_average
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        lam = self.l1weight
+        if self.size_average:
+            lam = lam / input.size
+
+        @jax.custom_vjp
+        def penalty(x):
+            return x
+
+        def fwd(x):
+            return x, jnp.sign(x)
+
+        def bwd(sign, g):
+            return (g + lam * sign,)
+
+        penalty.defvjp(fwd, bwd)
+        return penalty(input) if training else input

@@ -1,0 +1,53 @@
+"""Embedding layers (BigDL nn/LookupTable.scala).
+
+A lookup is a gather — XLA handles it natively; on TPU a one-hot matmul is
+sometimes faster for tiny vocabularies, but gather is the right default.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.utils.engine import Engine
+
+
+class LookupTable(Module):
+    """Embedding lookup (nn/LookupTable.scala). Indices are 1-based like the
+    reference; max_norm renormalizes rows touched by the batch."""
+
+    def __init__(self, n_index: int, n_output: int,
+                 padding_value: float = 0.0, max_norm: float = float("inf"),
+                 norm_type: float = 2.0, should_scale_grad_by_freq: bool = False,
+                 w_regularizer=None):
+        super().__init__()
+        self.n_index = n_index
+        self.n_output = n_output
+        self.padding_value = padding_value
+        self.max_norm = max_norm
+        self.norm_type = norm_type
+        self.w_regularizer = w_regularizer
+
+    def init(self, rng):
+        w = jax.random.normal(rng, (self.n_index, self.n_output),
+                              Engine.default_dtype())
+        if self.padding_value != 0.0:
+            pad = int(self.padding_value) - 1
+            w = w.at[pad].set(0.0)
+        return {"weight": w}
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        w = params["weight"]
+        if self.max_norm != float("inf"):
+            norms = jnp.power(
+                jnp.sum(jnp.power(jnp.abs(w), self.norm_type), axis=-1),
+                1.0 / self.norm_type)
+            scale = jnp.minimum(1.0, self.max_norm / jnp.clip(norms, 1e-7))
+            w = w * scale[:, None]
+        idx = input.astype(jnp.int32) - 1  # reference is 1-based
+        return jnp.take(w, idx, axis=0)
+
+    def regularization_loss(self, params):
+        if self.w_regularizer is not None:
+            return self.w_regularizer.loss(params["weight"])
+        return 0.0

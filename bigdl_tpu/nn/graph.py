@@ -1,0 +1,158 @@
+"""Graph container (BigDL nn/Graph.scala:72 + nn/Scheduler.scala:40).
+
+The reference walks a DAG with a ready-set scheduler at runtime. Under XLA the
+walk happens once at trace time: nodes execute in topological order inside the
+traced function, and XLA schedules the fused result. Control-flow ops
+(Switch/Merge) map to ``lax.cond`` at a later stage; static DAGs cover the
+reference model zoo.
+
+Build with the functional wiring sugar:
+
+    inp = Input()
+    h = Linear(10, 4)(inp)
+    out = LogSoftMax()(h)
+    model = Graph(inp, out)
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Union
+
+import jax
+
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.utils.directed_graph import Node
+from bigdl_tpu.utils.table import Table, T
+
+
+class Input(Module):
+    """Graph input placeholder (nn/Input.scala)."""
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        return input
+
+    def __call__(self, *args, **kwargs):
+        node = Node(self)
+        if args:
+            node(*args)
+        return node
+
+
+def _as_list(x) -> List[Node]:
+    if isinstance(x, Node):
+        return [x]
+    return list(x)
+
+
+class Graph(Module):
+    """DAG of module nodes with explicit inputs/outputs."""
+
+    def __init__(self, input: Union[Node, Sequence[Node]],
+                 output: Union[Node, Sequence[Node]]):
+        super().__init__()
+        self.input_nodes = _as_list(input)
+        self.output_nodes = _as_list(output)
+        self.exec_order = self._topo_sort()
+        # stable unique names for the params pytree
+        self.node_names = {}
+        counts = {}
+        for n in self.exec_order:
+            base = n.element.get_name()
+            if base in counts:
+                counts[base] += 1
+                name = f"{base}_{counts[base]}"
+            else:
+                counts[base] = 0
+                name = base
+            self.node_names[id(n)] = name
+        self.modules = [n.element for n in self.exec_order]
+
+    def _topo_sort(self) -> List[Node]:
+        # collect all nodes reachable backwards from outputs
+        seen = {}
+        order: List[Node] = []
+
+        def visit(n: Node, stack):
+            if id(n) in seen:
+                if seen[id(n)] == 1:
+                    raise ValueError("Graph contains a cycle")
+                return
+            seen[id(n)] = 1
+            for p, _ in n.prevs:
+                visit(p, stack)
+            seen[id(n)] = 2
+            order.append(n)
+
+        for out in self.output_nodes:
+            visit(out, [])
+        return order
+
+    # -- functional core ---------------------------------------------------
+    def init(self, rng):
+        keys = jax.random.split(rng, max(1, len(self.exec_order)))
+        return {self.node_names[id(n)]: n.element.init(k)
+                for n, k in zip(self.exec_order, keys)}
+
+    def initial_state(self):
+        return {self.node_names[id(n)]: n.element.initial_state()
+                for n in self.exec_order}
+
+    def regularization_loss(self, params):
+        return sum(n.element.regularization_loss(params[self.node_names[id(n)]])
+                   for n in self.exec_order)
+
+    def param_scales(self, params):
+        return {self.node_names[id(n)]:
+                n.element.param_scales(params[self.node_names[id(n)]])
+                for n in self.exec_order}
+
+    def training(self):
+        super().training()
+        for n in self.exec_order:
+            n.element.training()
+        return self
+
+    def evaluate(self):
+        super().evaluate()
+        for n in self.exec_order:
+            n.element.evaluate()
+        return self
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        # bind graph inputs
+        if len(self.input_nodes) == 1:
+            inputs = [input]
+        else:
+            inputs = list(input) if isinstance(input, Table) else list(input)
+        values = {}
+        keys = (jax.random.split(rng, max(1, len(self.exec_order)))
+                if rng is not None else [None] * len(self.exec_order))
+        new_state = {}
+        for n, k in zip(self.exec_order, keys):
+            name = self.node_names[id(n)]
+            if any(n is inp for inp in self.input_nodes):
+                idx = next(i for i, inp in enumerate(self.input_nodes)
+                           if n is inp)
+                node_in = inputs[idx]
+            elif not n.prevs:
+                node_in = input  # parameterless source (e.g. Const-like)
+            else:
+                gathered = []
+                for p, e in n.prevs:
+                    v = values[id(p)]
+                    if e.from_index is not None:
+                        v = v[e.from_index]
+                    gathered.append(v)
+                node_in = gathered[0] if len(gathered) == 1 else T(*gathered)
+            out, s = n.element.apply(params[name], state[name], node_in,
+                                     training=training, rng=k)
+            values[id(n)] = out
+            new_state[name] = s
+        outs = [values[id(n)] for n in self.output_nodes]
+        result = outs[0] if len(outs) == 1 else T(*outs)
+        return result, new_state
+
+    def find(self, name: str):
+        for n in self.exec_order:
+            if n.element.get_name() == name:
+                return n.element
+        return None

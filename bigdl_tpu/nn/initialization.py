@@ -1,0 +1,111 @@
+"""Parameter initialization methods (BigDL nn/InitializationMethod.scala).
+
+Each method is a callable ``(rng, shape, fan_in, fan_out, dtype) -> array``.
+VariableFormat bookkeeping from the reference collapses into explicit
+fan_in/fan_out arguments computed by each layer.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+class InitializationMethod:
+    def __call__(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        raise NotImplementedError
+
+
+class Zeros(InitializationMethod):
+    """InitializationMethod.scala:221"""
+
+    def __call__(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        return jnp.zeros(shape, dtype)
+
+
+class Ones(InitializationMethod):
+    """InitializationMethod.scala:233"""
+
+    def __call__(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        return jnp.ones(shape, dtype)
+
+
+class ConstInitMethod(InitializationMethod):
+    """InitializationMethod.scala:244"""
+
+    def __init__(self, value: float):
+        self.value = value
+
+    def __call__(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        return jnp.full(shape, self.value, dtype)
+
+
+class RandomUniform(InitializationMethod):
+    """InitializationMethod.scala:178,196 — with no bounds, uses the Torch
+    default 1/sqrt(fan_in) bound (the ``reset()`` convention of Linear/conv)."""
+
+    def __init__(self, lower: float = None, upper: float = None):
+        self.lower = lower
+        self.upper = upper
+
+    def __call__(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        if self.lower is None:
+            stdv = 1.0 / math.sqrt(max(1, fan_in))
+            lo, hi = -stdv, stdv
+        else:
+            lo, hi = self.lower, self.upper
+        return jax.random.uniform(rng, shape, dtype, minval=lo, maxval=hi)
+
+
+class RandomNormal(InitializationMethod):
+    """InitializationMethod.scala:209"""
+
+    def __init__(self, mean: float = 0.0, stdv: float = 1.0):
+        self.mean = mean
+        self.stdv = stdv
+
+    def __call__(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        return self.mean + self.stdv * jax.random.normal(rng, shape, dtype)
+
+
+class Xavier(InitializationMethod):
+    """Glorot uniform (InitializationMethod.scala:272)."""
+
+    def __call__(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        stdv = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(rng, shape, dtype, minval=-stdv, maxval=stdv)
+
+
+class MsraFiller(InitializationMethod):
+    """Kaiming/MSRA normal (InitializationMethod.scala:297)."""
+
+    def __init__(self, var_in_count: bool = True):
+        self.var_in_count = var_in_count
+
+    def __call__(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        n = fan_in if self.var_in_count else fan_out
+        std = math.sqrt(2.0 / max(1, n))
+        return std * jax.random.normal(rng, shape, dtype)
+
+
+class BilinearFiller(InitializationMethod):
+    """Bilinear-upsampling kernel for deconv (InitializationMethod.scala:321).
+    Expects a 4-D (out, in, kh, kw) shape."""
+
+    def __call__(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
+        assert len(shape) == 4, "BilinearFiller expects 4D weight"
+        kh, kw = shape[2], shape[3]
+        f = math.ceil(kw / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        i = jnp.arange(kh * kw, dtype=dtype)
+        x = i % kw
+        y = (i // kw) % kh
+        filt = (1 - jnp.abs(x / f - c)) * (1 - jnp.abs(y / f - c))
+        return jnp.broadcast_to(filt.reshape(1, 1, kh, kw), shape).astype(dtype)
+
+
+# convenience singletons matching reference object names
+zeros = Zeros()
+ones = Ones()
+xavier = Xavier()

@@ -1,0 +1,311 @@
+"""Linear-algebra layers (BigDL nn/{Linear,Bilinear,CMul,CAdd,MM,...}.scala).
+
+All matmuls route through ``jnp.dot``/``einsum`` so XLA maps them to the MXU;
+params stay in ``Engine.default_dtype`` while compute may run in bf16.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.initialization import InitializationMethod, RandomUniform, Zeros
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.utils.engine import Engine
+
+
+class Linear(Module):
+    """Fully-connected layer y = xW^T + b (nn/Linear.scala).
+
+    Weight stored (out, in) like Torch; compute uses x @ W.T on the MXU.
+    """
+
+    def __init__(self, input_size: int, output_size: int,
+                 with_bias: bool = True,
+                 w_regularizer=None, b_regularizer=None,
+                 init_weight: Optional[InitializationMethod] = None,
+                 init_bias: Optional[InitializationMethod] = None):
+        super().__init__()
+        self.input_size = input_size
+        self.output_size = output_size
+        self.with_bias = with_bias
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+        self.weight_init = init_weight or RandomUniform()
+        self.bias_init = init_bias or RandomUniform()
+
+    def set_init_method(self, weight_init=None, bias_init=None):
+        if weight_init is not None:
+            self.weight_init = weight_init
+        if bias_init is not None:
+            self.bias_init = bias_init
+        return self
+
+    def init(self, rng):
+        dtype = Engine.default_dtype()
+        kw, kb = jax.random.split(rng)
+        fan_in, fan_out = self.input_size, self.output_size
+        p = {"weight": self.weight_init(
+            kw, (self.output_size, self.input_size), fan_in, fan_out, dtype)}
+        if self.with_bias:
+            p["bias"] = self.bias_init(kb, (self.output_size,), fan_in,
+                                       fan_out, dtype)
+        return p
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        x = input
+        squeeze = x.ndim == 1
+        if squeeze:
+            x = x[None, :]
+        y = jnp.dot(x, params["weight"].T,
+                    preferred_element_type=x.dtype)
+        if self.with_bias:
+            y = y + params["bias"]
+        return y[0] if squeeze else y
+
+    def regularization_loss(self, params):
+        out = 0.0
+        if self.w_regularizer is not None:
+            out = out + self.w_regularizer.loss(params["weight"])
+        if self.b_regularizer is not None and self.with_bias:
+            out = out + self.b_regularizer.loss(params["bias"])
+        return out
+
+
+class Bilinear(Module):
+    """y_k = x1^T W_k x2 + b_k over a table input (nn/Bilinear.scala)."""
+
+    def __init__(self, input_size1: int, input_size2: int, output_size: int,
+                 bias_res: bool = True,
+                 w_regularizer=None, b_regularizer=None):
+        super().__init__()
+        self.input_size1 = input_size1
+        self.input_size2 = input_size2
+        self.output_size = output_size
+        self.bias_res = bias_res
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+
+    def init(self, rng):
+        dtype = Engine.default_dtype()
+        kw, kb = jax.random.split(rng)
+        stdv = 1.0 / math.sqrt(self.input_size1)
+        p = {"weight": jax.random.uniform(
+            kw, (self.output_size, self.input_size1, self.input_size2),
+            dtype, minval=-stdv, maxval=stdv)}
+        if self.bias_res:
+            p["bias"] = jax.random.uniform(kb, (self.output_size,), dtype,
+                                           minval=-stdv, maxval=stdv)
+        return p
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        x1, x2 = input[1], input[2]
+        y = jnp.einsum("bi,kij,bj->bk", x1, params["weight"], x2)
+        if self.bias_res:
+            y = y + params["bias"]
+        return y
+
+    def regularization_loss(self, params):
+        out = 0.0
+        if self.w_regularizer is not None:
+            out = out + self.w_regularizer.loss(params["weight"])
+        if self.b_regularizer is not None and self.bias_res:
+            out = out + self.b_regularizer.loss(params["bias"])
+        return out
+
+
+class CMul(Module):
+    """Learnable elementwise scale broadcast over input (nn/CMul.scala)."""
+
+    def __init__(self, size: Sequence[int]):
+        super().__init__()
+        self.size = tuple(size)
+
+    def init(self, rng):
+        n = 1
+        for s in self.size:
+            n *= s
+        stdv = 1.0 / math.sqrt(n)
+        return {"weight": jax.random.uniform(
+            rng, self.size, Engine.default_dtype(), minval=-stdv, maxval=stdv)}
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        return input * params["weight"]
+
+
+class CAdd(Module):
+    """Learnable elementwise bias broadcast over input (nn/CAdd.scala)."""
+
+    def __init__(self, size: Sequence[int]):
+        super().__init__()
+        self.size = tuple(size)
+
+    def init(self, rng):
+        n = 1
+        for s in self.size:
+            n *= s
+        stdv = 1.0 / math.sqrt(n)
+        return {"bias": jax.random.uniform(
+            rng, self.size, Engine.default_dtype(), minval=-stdv, maxval=stdv)}
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        return input + params["bias"]
+
+
+class Mul(Module):
+    """Single learnable scalar gain (nn/Mul.scala)."""
+
+    def init(self, rng):
+        return {"weight": jax.random.uniform(
+            rng, (1,), Engine.default_dtype(), minval=-1.0, maxval=1.0)}
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        return input * params["weight"][0]
+
+
+class Add(Module):
+    """Learnable per-element bias of length input_size (nn/Add.scala)."""
+
+    def __init__(self, input_size: int):
+        super().__init__()
+        self.input_size = input_size
+
+    def init(self, rng):
+        stdv = 1.0 / math.sqrt(self.input_size)
+        return {"bias": jax.random.uniform(
+            rng, (self.input_size,), Engine.default_dtype(),
+            minval=-stdv, maxval=stdv)}
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        return input + params["bias"]
+
+
+class MulConstant(Module):
+    """nn/MulConstant.scala"""
+
+    def __init__(self, scalar: float, ip: bool = False):
+        super().__init__()
+        self.scalar = scalar
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        return input * self.scalar
+
+
+class AddConstant(Module):
+    """nn/AddConstant.scala"""
+
+    def __init__(self, constant_scalar: float, ip: bool = False):
+        super().__init__()
+        self.constant_scalar = constant_scalar
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        return input + self.constant_scalar
+
+
+class MM(Module):
+    """Batch/plain matrix-matrix product of a 2-tensor table (nn/MM.scala)."""
+
+    def __init__(self, trans_a: bool = False, trans_b: bool = False):
+        super().__init__()
+        self.trans_a = trans_a
+        self.trans_b = trans_b
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        a, b = input[1], input[2]
+        if self.trans_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if self.trans_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b)
+
+
+class MV(Module):
+    """Matrix-vector product of a table (nn/MV.scala)."""
+
+    def __init__(self, trans: bool = False):
+        super().__init__()
+        self.trans = trans
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        m, v = input[1], input[2]
+        if self.trans:
+            m = jnp.swapaxes(m, -1, -2)
+        return jnp.einsum("...ij,...j->...i", m, v)
+
+
+class Cosine(Module):
+    """Cosine similarity to each of `output_size` learned anchors
+    (nn/Cosine.scala)."""
+
+    def __init__(self, input_size: int, output_size: int):
+        super().__init__()
+        self.input_size = input_size
+        self.output_size = output_size
+
+    def init(self, rng):
+        stdv = 1.0 / math.sqrt(self.input_size)
+        return {"weight": jax.random.uniform(
+            rng, (self.output_size, self.input_size), Engine.default_dtype(),
+            minval=-stdv, maxval=stdv)}
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        w = params["weight"]
+        xn = input / jnp.clip(jnp.linalg.norm(input, axis=-1, keepdims=True),
+                              1e-12)
+        wn = w / jnp.clip(jnp.linalg.norm(w, axis=-1, keepdims=True), 1e-12)
+        return jnp.dot(xn, wn.T)
+
+
+class Euclidean(Module):
+    """Distance to learned centers (nn/Euclidean.scala); weight (in, out)."""
+
+    def __init__(self, input_size: int, output_size: int,
+                 fast_backward: bool = True):
+        super().__init__()
+        self.input_size = input_size
+        self.output_size = output_size
+
+    def init(self, rng):
+        stdv = 1.0 / math.sqrt(self.input_size)
+        return {"weight": jax.random.uniform(
+            rng, (self.input_size, self.output_size), Engine.default_dtype(),
+            minval=-stdv, maxval=stdv)}
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        w = params["weight"]  # (in, out)
+        diff = input[..., :, None] - w[None, :, :]
+        return jnp.linalg.norm(diff, axis=-2)
+
+
+class DotProduct(Module):
+    """Row-wise dot product of a 2-tensor table (nn/DotProduct.scala)."""
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        a, b = input[1], input[2]
+        return jnp.sum(a * b, axis=-1)
+
+
+class PairwiseDistance(Module):
+    """Row-wise Lp distance (nn/PairwiseDistance.scala)."""
+
+    def __init__(self, norm: int = 2):
+        super().__init__()
+        self.norm = norm
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        a, b = input[1], input[2]
+        d = jnp.abs(a - b)
+        return jnp.power(jnp.sum(jnp.power(d, self.norm), axis=-1),
+                         1.0 / self.norm)
+
+
+class CosineDistance(Module):
+    """Row-wise cosine similarity of a table (nn/CosineDistance.scala)."""
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        a, b = input[1], input[2]
+        na = jnp.clip(jnp.linalg.norm(a, axis=-1), 1e-12)
+        nb = jnp.clip(jnp.linalg.norm(b, axis=-1), 1e-12)
+        return jnp.sum(a * b, axis=-1) / (na * nb)

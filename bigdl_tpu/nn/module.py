@@ -1,0 +1,266 @@
+"""Module & Criterion contracts — BigDL nn/abstractnn/AbstractModule.scala:56.
+
+Design (TPU-first): a ``Module`` is a *declarative object* describing a layer;
+compute lives in a pure-functional core that JAX can trace, jit, differentiate
+and shard:
+
+    params             = module.init(rng)                 # parameter pytree
+    state              = module.initial_state()           # running stats etc.
+    output, new_state  = module.apply(params, state, x, training=..., rng=...)
+
+There is no hand-written backward: BigDL's ``updateGradInput`` /
+``accGradParameters`` (AbstractModule.scala:329,:340) are replaced by
+``jax.vjp`` over ``apply``. The reference's mutable ``output``/``gradInput``
+fields and its thread-cloned sub-models (DistriOptimizer.scala:116) do not
+exist — replication is a batch dimension, state is explicit.
+
+For API parity with the reference, a *stateful convenience layer* is kept on
+top: ``module.forward(x)`` lazily initializes parameters (seeded from
+``RandomGenerator``, like layer ``reset()`` in the reference) and caches them
+on the object; ``module.backward(x, gradOutput)`` returns gradInput and
+accumulates parameter gradients, so unit tests and eager exploration read like
+BigDL programs. Training always goes through the functional core.
+
+Parameter pytrees are nested dicts: leaf layers use {"weight": ..., "bias": ...};
+containers use {child_name: child_params}. Empty dicts for parameterless layers.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.utils.random import RandomGenerator
+from bigdl_tpu.utils.table import Table
+
+Params = Dict[str, Any]
+State = Dict[str, Any]
+
+
+def _to_jax(x):
+    if isinstance(x, (Table, list, tuple)) or isinstance(x, dict):
+        return jax.tree.map(jnp.asarray, x)
+    return jnp.asarray(x)
+
+
+class Module:
+    """Base of every layer/container (AbstractModule.scala:56)."""
+
+    def __init__(self):
+        self._name: Optional[str] = None
+        self.train_mode: bool = True
+        # layer-wise LR scaling / freeze (AbstractModule setScaleW/setScaleB,
+        # nn/Utils.scala:247); 0.0 == frozen
+        self.scale_w: float = 1.0
+        self.scale_b: float = 1.0
+        # stateful convenience cache
+        self._params: Optional[Params] = None
+        self._state: Optional[State] = None
+        self._grad_params: Optional[Params] = None
+        self._last_rng: Optional[jax.Array] = None
+        self.output = None
+        self.grad_input = None
+
+    # ---- functional core (override) -------------------------------------
+    def init(self, rng: jax.Array) -> Params:
+        """Build the parameter pytree. Parameterless layers return {}."""
+        return {}
+
+    def initial_state(self) -> State:
+        """Non-trainable state (e.g. BatchNorm running stats)."""
+        return {}
+
+    def apply(self, params: Params, state: State, input, *,
+              training: bool = False, rng: Optional[jax.Array] = None):
+        """Pure forward. Returns (output, new_state)."""
+        return self.forward_fn(params, input, training=training, rng=rng), state
+
+    def forward_fn(self, params: Params, input, *, training: bool = False,
+                   rng: Optional[jax.Array] = None):
+        """Shortcut override point for the (majority) stateless layers."""
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement forward_fn or apply")
+
+    def regularization_loss(self, params: Params):
+        """Sum of this module's regularizer penalties.
+
+        The reference applies wRegularizer/bRegularizer inside each layer's
+        accGradParameters (optim/Regularizer.scala:30); under autodiff the
+        equivalent is an additive loss term, which yields identical gradients.
+        """
+        return 0.0
+
+    def param_scales(self, params: Params) -> Params:
+        """Pytree of per-leaf LR scale factors (layer-wise scaling / freeze).
+
+        Mirrors setScaleW/setScaleB + freeze (AbstractModule.scala). The
+        optimizer multiplies gradients by these before the update.
+        """
+        def leaf_scale(key):
+            if key == "bias":
+                return self.scale_b
+            return self.scale_w
+        return {k: jax.tree.map(lambda _: leaf_scale(k), v)
+                for k, v in params.items()}
+
+    # ---- shape/metadata --------------------------------------------------
+    def set_name(self, name: str) -> "Module":
+        self._name = name
+        return self
+
+    def get_name(self) -> str:
+        return self._name or f"{type(self).__name__}{id(self) & 0xffff:04x}"
+
+    def set_scale_w(self, s: float) -> "Module":
+        self.scale_w = s
+        return self
+
+    def set_scale_b(self, s: float) -> "Module":
+        self.scale_b = s
+        return self
+
+    def freeze(self) -> "Module":
+        """Stop updates to this module's params (AbstractModule.freeze)."""
+        self.scale_w = 0.0
+        self.scale_b = 0.0
+        return self
+
+    def unfreeze(self) -> "Module":
+        self.scale_w = 1.0
+        self.scale_b = 1.0
+        return self
+
+    def training(self) -> "Module":
+        self.train_mode = True
+        return self
+
+    def evaluate(self) -> "Module":
+        self.train_mode = False
+        return self
+
+    def is_training(self) -> bool:
+        return self.train_mode
+
+    # ---- stateful convenience API (BigDL-style eager use) ----------------
+    def ensure_initialized(self):
+        if self._params is None:
+            self._params = self.init(RandomGenerator.next_key())
+        if self._state is None:
+            self._state = self.initial_state()
+        return self
+
+    def forward(self, input):
+        """Eager forward (AbstractModule.forward, :277). Lazily initializes
+        parameters like the reference's constructor-time ``reset()``."""
+        self.ensure_initialized()
+        self._last_rng = RandomGenerator.next_key()
+        out, new_state = self.apply(self._params, self._state, _to_jax(input),
+                                    training=self.train_mode,
+                                    rng=self._last_rng)
+        self._state = new_state
+        self.output = out
+        return out
+
+    def backward(self, input, grad_output):
+        """Eager backward: returns gradInput, accumulates param grads
+        (AbstractModule.backward, :303). Reuses forward's rng so stochastic
+        layers (Dropout/RReLU) see the same mask, matching the reference's
+        stored-noise semantics."""
+        self.ensure_initialized()
+        rng = self._last_rng if self._last_rng is not None \
+            else RandomGenerator.next_key()
+        x = _to_jax(input)
+
+        def f(p, xx):
+            out, _ = self.apply(p, self._state, xx,
+                                training=self.train_mode, rng=rng)
+            return out
+
+        _, vjp = jax.vjp(f, self._params, x)
+        d_params, d_input = vjp(_to_jax(grad_output))
+        if self._grad_params is None:
+            self._grad_params = d_params
+        else:
+            self._grad_params = jax.tree.map(jnp.add, self._grad_params,
+                                             d_params)
+        self.grad_input = d_input
+        return d_input
+
+    def zero_grad_parameters(self):
+        self._grad_params = None
+        return self
+
+    def get_parameters(self) -> Params:
+        self.ensure_initialized()
+        return self._params
+
+    def set_parameters(self, params: Params) -> "Module":
+        self._params = params
+        return self
+
+    def get_grad_parameters(self) -> Optional[Params]:
+        return self._grad_params
+
+    def get_state(self) -> State:
+        self.ensure_initialized()
+        return self._state
+
+    def set_state(self, state: State) -> "Module":
+        self._state = state
+        return self
+
+    # ---- sugar -----------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        """Functional-graph wiring sugar: module(node...) builds a graph Node
+        (reference's ``def apply(nodes)`` on AbstractModule for Graph API)."""
+        from bigdl_tpu.utils.directed_graph import Node
+        node = Node(self)
+        if args:
+            node(*args)
+        return node
+
+    def __repr__(self):
+        return f"{type(self).__name__}"
+
+    # parity helpers
+    def predict(self, dataset, batch_size: int = 32):
+        from bigdl_tpu.optim.predictor import LocalPredictor
+        return LocalPredictor(self).predict(dataset, batch_size=batch_size)
+
+    def evaluate_on(self, dataset, methods, batch_size: int = 32):
+        from bigdl_tpu.optim.evaluator import Evaluator
+        return Evaluator(self).test(dataset, methods, batch_size=batch_size)
+
+
+class Criterion:
+    """Loss contract (nn/abstractnn/AbstractCriterion.scala).
+
+    ``apply(input, target)`` returns a scalar loss; gradInput comes from
+    autodiff. The eager ``forward``/``backward`` pair mirrors the reference.
+    """
+
+    def __init__(self):
+        self.output = None
+        self.grad_input = None
+
+    def apply(self, input, target):
+        raise NotImplementedError
+
+    def forward(self, input, target):
+        self.output = self.apply(_to_jax(input), _to_jax(target))
+        return self.output
+
+    def backward(self, input, target):
+        x = _to_jax(input)
+        t = _to_jax(target)
+        self.grad_input = jax.grad(lambda i: self.apply(i, t))(x)
+        return self.grad_input
+
+    def __repr__(self):
+        return f"{type(self).__name__}"
+
+
+def total_regularization(module: Module, params: Params):
+    """Total regularization penalty for a module tree."""
+    return module.regularization_loss(params)

@@ -1,0 +1,221 @@
+"""Normalization layers (BigDL nn/BatchNormalization.scala et al.).
+
+BatchNormalization is the canonical *stateful* module: running statistics live
+in the explicit ``state`` pytree (the reference mutates fields; here state
+threads functionally so it jits and shards cleanly).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.utils.engine import Engine
+
+
+class BatchNormalization(Module):
+    """Batch norm over (B, F) (nn/BatchNormalization.scala).
+
+    state = {running_mean, running_var}; update rule matches Torch:
+    running = (1 - momentum) * running + momentum * batch_stat, with the
+    unbiased variance entering the running estimate.
+    """
+
+    _feature_axes = (0,)  # axes reduced over; feature dim is 1
+
+    def __init__(self, n_output: int, eps: float = 1e-5,
+                 momentum: float = 0.1, affine: bool = True):
+        super().__init__()
+        self.n_output = n_output
+        self.eps = eps
+        self.momentum = momentum
+        self.affine = affine
+
+    def init(self, rng):
+        if not self.affine:
+            return {}
+        dtype = Engine.default_dtype()
+        # reference init: weight ~ U(0,1), bias = 0 (BatchNormalization.reset)
+        return {"weight": jax.random.uniform(rng, (self.n_output,), dtype),
+                "bias": jnp.zeros((self.n_output,), dtype)}
+
+    def initial_state(self):
+        dtype = Engine.default_dtype()
+        return {"running_mean": jnp.zeros((self.n_output,), dtype),
+                "running_var": jnp.ones((self.n_output,), dtype)}
+
+    def _reshape(self, v, ndim):
+        shape = [1] * ndim
+        shape[1 if ndim > 1 else 0] = self.n_output
+        return v.reshape(shape)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input
+        ndim = x.ndim
+        axes = tuple(i for i in range(ndim) if i != (1 if ndim > 1 else 0))
+        if training:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.mean(jnp.square(x - self._reshape(mean, ndim)),
+                           axis=axes)
+            n = x.size // self.n_output
+            unbiased = var * n / max(1, n - 1)
+            new_state = {
+                "running_mean": (1 - self.momentum) * state["running_mean"]
+                                + self.momentum * mean,
+                "running_var": (1 - self.momentum) * state["running_var"]
+                               + self.momentum * unbiased,
+            }
+        else:
+            mean, var = state["running_mean"], state["running_var"]
+            new_state = state
+        inv = lax.rsqrt(var + self.eps)
+        y = (x - self._reshape(mean, ndim)) * self._reshape(inv, ndim)
+        if self.affine:
+            y = y * self._reshape(params["weight"], ndim) \
+                + self._reshape(params["bias"], ndim)
+        return y, new_state
+
+
+class SpatialBatchNormalization(BatchNormalization):
+    """BN over (B, C, H, W) (nn/SpatialBatchNormalization.scala) — same code:
+    reduction axes derive from input rank."""
+
+
+class Normalize(Module):
+    """Lp-normalize along dim 1 (nn/Normalize.scala)."""
+
+    def __init__(self, p: float = 2.0, eps: float = 1e-10):
+        super().__init__()
+        self.p = p
+        self.eps = eps
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        if self.p == float("inf"):
+            norm = jnp.max(jnp.abs(input), axis=1, keepdims=True)
+        else:
+            norm = jnp.power(
+                jnp.sum(jnp.power(jnp.abs(input), self.p), axis=1,
+                        keepdims=True), 1.0 / self.p)
+        return input / (norm + self.eps)
+
+
+class SpatialCrossMapLRN(Module):
+    """AlexNet-style local response norm across channels
+    (nn/SpatialCrossMapLRN.scala): y = x / (k + alpha/n * sum x^2)^beta."""
+
+    def __init__(self, size: int = 5, alpha: float = 1.0,
+                 beta: float = 0.75, k: float = 1.0):
+        super().__init__()
+        self.size = size
+        self.alpha = alpha
+        self.beta = beta
+        self.k = k
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        x = input
+        sq = x * x
+        half = (self.size - 1) // 2
+        # sum over a channel window: pad C then reduce_window
+        summed = lax.reduce_window(
+            sq, 0.0, lax.add,
+            window_dimensions=(1, self.size, 1, 1),
+            window_strides=(1, 1, 1, 1),
+            padding=((0, 0), (half, self.size - 1 - half), (0, 0), (0, 0)))
+        denom = jnp.power(self.k + self.alpha / self.size * summed, self.beta)
+        return x / denom
+
+
+class SpatialWithinChannelLRN(Module):
+    """LRN within each channel over a spatial window
+    (nn/SpatialWithinChannelLRN.scala)."""
+
+    def __init__(self, size: int = 5, alpha: float = 1.0,
+                 beta: float = 0.75):
+        super().__init__()
+        self.size = size
+        self.alpha = alpha
+        self.beta = beta
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        x = input
+        half = (self.size - 1) // 2
+        summed = lax.reduce_window(
+            x * x, 0.0, lax.add,
+            window_dimensions=(1, 1, self.size, self.size),
+            window_strides=(1, 1, 1, 1),
+            padding=((0, 0), (0, 0),
+                     (half, self.size - 1 - half),
+                     (half, self.size - 1 - half)))
+        denom = jnp.power(1.0 + self.alpha / (self.size * self.size) * summed,
+                          self.beta)
+        return x / denom
+
+
+def _gaussian_kernel_2d(kernel_size: int, dtype=jnp.float32):
+    half = (kernel_size - 1) / 2.0
+    xs = jnp.arange(kernel_size, dtype=dtype) - half
+    g = jnp.exp(-(xs ** 2) / (2 * (0.25 * kernel_size) ** 2))
+    k2 = g[:, None] * g[None, :]
+    return k2 / jnp.sum(k2)
+
+
+class SpatialSubtractiveNormalization(Module):
+    """Subtract a (gaussian-)weighted local mean
+    (nn/SpatialSubtractiveNormalization.scala)."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None):
+        super().__init__()
+        self.n_input_plane = n_input_plane
+        self.kernel = kernel  # 2-D numpy/jnp array or None -> gaussian 9x9
+
+    def _local_mean(self, x):
+        k = self.kernel if self.kernel is not None \
+            else _gaussian_kernel_2d(9, x.dtype)
+        k = jnp.asarray(k, x.dtype)
+        k = k / jnp.sum(k)
+        kh, kw = k.shape
+        w = jnp.broadcast_to(k[None, None], (1, self.n_input_plane, kh, kw)) \
+            / self.n_input_plane
+        return lax.conv_general_dilated(
+            x, w, window_strides=(1, 1),
+            padding=((kh // 2, (kh - 1) // 2), (kw // 2, (kw - 1) // 2)),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        mean = self._local_mean(input)
+        return input - mean
+
+
+class SpatialDivisiveNormalization(SpatialSubtractiveNormalization):
+    """Divide by local std (nn/SpatialDivisiveNormalization.scala)."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None,
+                 threshold: float = 1e-4, thresval: float = 1e-4):
+        super().__init__(n_input_plane, kernel)
+        self.threshold = threshold
+        self.thresval = thresval
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        local_sq = self._local_mean(input * input)
+        std = jnp.sqrt(jnp.maximum(local_sq, 0.0))
+        mean_std = jnp.mean(std, axis=(2, 3), keepdims=True)
+        denom = jnp.maximum(std, mean_std)
+        denom = jnp.where(denom < self.threshold, self.thresval, denom)
+        return input / denom
+
+
+class SpatialContrastiveNormalization(Module):
+    """Subtractive then divisive normalization
+    (nn/SpatialContrastiveNormalization.scala)."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None,
+                 threshold: float = 1e-4, thresval: float = 1e-4):
+        super().__init__()
+        self.sub = SpatialSubtractiveNormalization(n_input_plane, kernel)
+        self.div = SpatialDivisiveNormalization(n_input_plane, kernel,
+                                                threshold, thresval)
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        y = self.sub.forward_fn({}, input)
+        return self.div.forward_fn({}, y)

@@ -1,0 +1,214 @@
+"""Pooling layers (BigDL nn/SpatialMaxPooling.scala et al.).
+
+All are ``lax.reduce_window`` calls; floor/ceil output-size modes follow the
+reference's Torch semantics.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.module import Module
+
+
+def _pool_pads(in_size, k, d, pad, ceil_mode):
+    """Compute (lo, hi) padding producing Torch's output size."""
+    if ceil_mode:
+        out = int(math.ceil(float(in_size - k + 2 * pad) / d)) + 1
+    else:
+        out = int(math.floor(float(in_size - k + 2 * pad) / d)) + 1
+    if pad > 0 and (out - 1) * d >= in_size + pad:
+        out -= 1  # Torch rule: last window must start inside the padded input
+    needed = (out - 1) * d + k - in_size - pad
+    return out, (pad, max(needed, pad))
+
+
+class SpatialMaxPooling(Module):
+    """2-D max pool over NCHW (nn/SpatialMaxPooling.scala)."""
+
+    def __init__(self, kw: int, kh: int, dw: int = None, dh: int = None,
+                 pad_w: int = 0, pad_h: int = 0):
+        super().__init__()
+        self.kw, self.kh = kw, kh
+        self.dw = dw if dw is not None else kw
+        self.dh = dh if dh is not None else kh
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.ceil_mode = False
+
+    def ceil(self):
+        self.ceil_mode = True
+        return self
+
+    def floor(self):
+        self.ceil_mode = False
+        return self
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        x = input
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        _, ph = _pool_pads(x.shape[2], self.kh, self.dh, self.pad_h,
+                           self.ceil_mode)
+        _, pw = _pool_pads(x.shape[3], self.kw, self.dw, self.pad_w,
+                           self.ceil_mode)
+        y = lax.reduce_window(
+            x, -jnp.inf, lax.max,
+            window_dimensions=(1, 1, self.kh, self.kw),
+            window_strides=(1, 1, self.dh, self.dw),
+            padding=((0, 0), (0, 0), ph, pw))
+        return y[0] if squeeze else y
+
+
+class SpatialAveragePooling(Module):
+    """2-D average pool (nn/SpatialAveragePooling.scala).
+
+    count_include_pad matches Torch: padded zeros count in the divisor when
+    True (the default).
+    """
+
+    def __init__(self, kw: int, kh: int, dw: int = 1, dh: int = 1,
+                 pad_w: int = 0, pad_h: int = 0,
+                 global_pooling: bool = False,
+                 ceil_mode: bool = False, count_include_pad: bool = True,
+                 divide: bool = True):
+        super().__init__()
+        self.kw, self.kh = kw, kh
+        self.dw, self.dh = dw, dh
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.global_pooling = global_pooling
+        self.ceil_mode = ceil_mode
+        self.count_include_pad = count_include_pad
+        self.divide = divide
+
+    def ceil(self):
+        self.ceil_mode = True
+        return self
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        x = input
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        kh, kw = self.kh, self.kw
+        if self.global_pooling:
+            kh, kw = x.shape[2], x.shape[3]
+        _, ph = _pool_pads(x.shape[2], kh, self.dh, self.pad_h, self.ceil_mode)
+        _, pw = _pool_pads(x.shape[3], kw, self.dw, self.pad_w, self.ceil_mode)
+        summed = lax.reduce_window(
+            x, 0.0, lax.add,
+            window_dimensions=(1, 1, kh, kw),
+            window_strides=(1, 1, self.dh, self.dw),
+            padding=((0, 0), (0, 0), ph, pw))
+        if not self.divide:
+            y = summed
+        elif self.count_include_pad:
+            y = summed / (kh * kw)
+        else:
+            ones = jnp.ones_like(x)
+            counts = lax.reduce_window(
+                ones, 0.0, lax.add,
+                window_dimensions=(1, 1, kh, kw),
+                window_strides=(1, 1, self.dh, self.dw),
+                padding=((0, 0), (0, 0), ph, pw))
+            y = summed / counts
+        return y[0] if squeeze else y
+
+
+class TemporalMaxPooling(Module):
+    """1-D max pool over (B, T, F) (nn/TemporalMaxPooling.scala)."""
+
+    def __init__(self, k_w: int, d_w: int = None):
+        super().__init__()
+        self.k_w = k_w
+        self.d_w = d_w if d_w is not None else k_w
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        x = input
+        squeeze = x.ndim == 2
+        if squeeze:
+            x = x[None]
+        y = lax.reduce_window(
+            x, -jnp.inf, lax.max,
+            window_dimensions=(1, self.k_w, 1),
+            window_strides=(1, self.d_w, 1),
+            padding=((0, 0), (0, 0), (0, 0)))
+        return y[0] if squeeze else y
+
+
+class VolumetricMaxPooling(Module):
+    """3-D max pool over (B, C, D, H, W) (nn/VolumetricMaxPooling.scala)."""
+
+    def __init__(self, kt: int, kw: int, kh: int,
+                 dt: int = None, dw: int = None, dh: int = None,
+                 pad_t: int = 0, pad_w: int = 0, pad_h: int = 0):
+        super().__init__()
+        self.kt, self.kw, self.kh = kt, kw, kh
+        self.dt = dt if dt is not None else kt
+        self.dw = dw if dw is not None else kw
+        self.dh = dh if dh is not None else kh
+        self.pad_t, self.pad_w, self.pad_h = pad_t, pad_w, pad_h
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        x = input
+        squeeze = x.ndim == 4
+        if squeeze:
+            x = x[None]
+        y = lax.reduce_window(
+            x, -jnp.inf, lax.max,
+            window_dimensions=(1, 1, self.kt, self.kh, self.kw),
+            window_strides=(1, 1, self.dt, self.dh, self.dw),
+            padding=((0, 0), (0, 0), (self.pad_t, self.pad_t),
+                     (self.pad_h, self.pad_h), (self.pad_w, self.pad_w)))
+        return y[0] if squeeze else y
+
+
+class RoiPooling(Module):
+    """ROI max pooling (nn/RoiPooling.scala). Input: T(features NCHW,
+    rois [R,5] (batch_idx, x1, y1, x2, y2)); output [R, C, ph, pw]."""
+
+    def __init__(self, pooled_w: int, pooled_h: int, spatial_scale: float):
+        super().__init__()
+        self.pooled_w = pooled_w
+        self.pooled_h = pooled_h
+        self.spatial_scale = spatial_scale
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        import jax
+        data, rois = input[1], input[2]
+        N, C, H, W = data.shape
+
+        def pool_one(roi):
+            batch = roi[0].astype(jnp.int32)
+            x1 = jnp.round(roi[1] * self.spatial_scale)
+            y1 = jnp.round(roi[2] * self.spatial_scale)
+            x2 = jnp.round(roi[3] * self.spatial_scale)
+            y2 = jnp.round(roi[4] * self.spatial_scale)
+            roi_w = jnp.maximum(x2 - x1 + 1.0, 1.0)
+            roi_h = jnp.maximum(y2 - y1 + 1.0, 1.0)
+            bin_w = roi_w / self.pooled_w
+            bin_h = roi_h / self.pooled_h
+            fmap = data[batch]  # (C, H, W)
+            ys = jnp.arange(H, dtype=data.dtype)
+            xs = jnp.arange(W, dtype=data.dtype)
+
+            def bin_val(py, px):
+                hstart = jnp.floor(py * bin_h) + y1
+                hend = jnp.ceil((py + 1) * bin_h) + y1
+                wstart = jnp.floor(px * bin_w) + x1
+                wend = jnp.ceil((px + 1) * bin_w) + x1
+                ymask = (ys >= hstart) & (ys < hend)
+                xmask = (xs >= wstart) & (xs < wend)
+                mask = ymask[:, None] & xmask[None, :]
+                masked = jnp.where(mask[None], fmap, -jnp.inf)
+                v = jnp.max(masked, axis=(1, 2))
+                return jnp.where(jnp.isfinite(v), v, 0.0)
+
+            py = jnp.arange(self.pooled_h)
+            px = jnp.arange(self.pooled_w)
+            vals = jax.vmap(lambda y: jax.vmap(lambda x: bin_val(y, x))(px))(py)
+            return jnp.transpose(vals, (2, 0, 1))  # (C, ph, pw)
+
+        return jax.vmap(pool_one)(rois)

@@ -1,0 +1,470 @@
+"""Recurrent stack (BigDL nn/{Recurrent,Cell,RNN,LSTM,GRU,...}.scala).
+
+The reference unrolls time in Scala with shared weights
+(nn/Recurrent.scala:36). TPU-first design: cells expose a pure
+``step(params, x_t, hidden) -> (out_t, hidden)`` and the ``Recurrent``
+container runs ``lax.scan`` over the time axis — one compiled loop body,
+weights resident in VMEM/HBM, no per-step dispatch. Input is batch-first
+(B, T, ...) like the reference.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.utils.engine import Engine
+from bigdl_tpu.utils.table import Table, T
+
+
+def _uniform(rng, shape, stdv, dtype):
+    return jax.random.uniform(rng, shape, dtype, minval=-stdv, maxval=stdv)
+
+
+class Cell(Module):
+    """Recurrent cell contract (nn/Cell.scala:47)."""
+
+    hidden_size: int
+
+    def init_hidden(self, batch_size: int, dtype=None):
+        """Zero hidden state pytree (Cell.hidResize, Cell.scala:104)."""
+        raise NotImplementedError
+
+    def step(self, params, x, hidden, *, training=False, rng=None):
+        """One time step: returns (output, new_hidden)."""
+        raise NotImplementedError
+
+    # A cell used standalone maps T(x, hidden) -> T(out, hidden), like the
+    # reference's Cell.forward.
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        x, hidden = input[1], input[2]
+        out, h = self.step(params, x, hidden, training=training, rng=rng)
+        return T(out, h)
+
+
+class RnnCell(Cell):
+    """Vanilla RNN cell h' = act(Wx x + Wh h + b) (nn/RNN.scala)."""
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 activation: Optional[Module] = None,
+                 isInputWithBias: bool = True,
+                 w_regularizer=None, u_regularizer=None, b_regularizer=None):
+        super().__init__()
+        from bigdl_tpu.nn.activation import Tanh
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation or Tanh()
+        self.w_regularizer = w_regularizer
+        self.u_regularizer = u_regularizer
+        self.b_regularizer = b_regularizer
+
+    def init(self, rng):
+        dtype = Engine.default_dtype()
+        k1, k2, k3 = jax.random.split(rng, 3)
+        stdv = 1.0 / math.sqrt(self.hidden_size)
+        return {
+            "w_ih": _uniform(k1, (self.hidden_size, self.input_size), stdv, dtype),
+            "w_hh": _uniform(k2, (self.hidden_size, self.hidden_size), stdv, dtype),
+            "bias": _uniform(k3, (self.hidden_size,), stdv, dtype),
+        }
+
+    def init_hidden(self, batch_size, dtype=None):
+        dtype = dtype or Engine.default_dtype()
+        return jnp.zeros((batch_size, self.hidden_size), dtype)
+
+    def step(self, params, x, hidden, *, training=False, rng=None):
+        pre = x @ params["w_ih"].T + hidden @ params["w_hh"].T + params["bias"]
+        h = self.activation.forward_fn({}, pre)
+        return h, h
+
+    def regularization_loss(self, params):
+        out = 0.0
+        if self.w_regularizer is not None:
+            out = out + self.w_regularizer.loss(params["w_ih"])
+        if self.u_regularizer is not None:
+            out = out + self.u_regularizer.loss(params["w_hh"])
+        if self.b_regularizer is not None:
+            out = out + self.b_regularizer.loss(params["bias"])
+        return out
+
+
+class LSTM(Cell):
+    """Standard LSTM (nn/LSTM.scala). Gate order i, f, g, o; hidden =
+    T(h, c). One fused (4H, in+H) matmul per step for the MXU."""
+
+    def __init__(self, input_size: int, hidden_size: int, p: float = 0.0,
+                 activation: Optional[Module] = None,
+                 inner_activation: Optional[Module] = None,
+                 w_regularizer=None, u_regularizer=None, b_regularizer=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.p = p  # reference applies dropout on the 4 gate inputs
+        self.w_regularizer = w_regularizer
+        self.u_regularizer = u_regularizer
+        self.b_regularizer = b_regularizer
+
+    def init(self, rng):
+        dtype = Engine.default_dtype()
+        k1, k2, k3 = jax.random.split(rng, 3)
+        stdv = 1.0 / math.sqrt(self.hidden_size)
+        H, I = self.hidden_size, self.input_size
+        return {
+            "w_ih": _uniform(k1, (4 * H, I), stdv, dtype),
+            "w_hh": _uniform(k2, (4 * H, H), stdv, dtype),
+            "bias": _uniform(k3, (4 * H,), stdv, dtype),
+        }
+
+    def init_hidden(self, batch_size, dtype=None):
+        dtype = dtype or Engine.default_dtype()
+        z = jnp.zeros((batch_size, self.hidden_size), dtype)
+        return T(z, z)
+
+    def step(self, params, x, hidden, *, training=False, rng=None):
+        h, c = hidden[1], hidden[2]
+        if self.p > 0 and training and rng is not None:
+            kx, kh = jax.random.split(rng)
+            x = jnp.where(jax.random.bernoulli(kx, 1 - self.p, x.shape),
+                          x / (1 - self.p), 0.0)
+            h = jnp.where(jax.random.bernoulli(kh, 1 - self.p, h.shape),
+                          h / (1 - self.p), 0.0)
+        gates = x @ params["w_ih"].T + h @ params["w_hh"].T + params["bias"]
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c2 = f * c + i * g
+        h2 = o * jnp.tanh(c2)
+        return h2, T(h2, c2)
+
+    def regularization_loss(self, params):
+        out = 0.0
+        if self.w_regularizer is not None:
+            out = out + self.w_regularizer.loss(params["w_ih"])
+        if self.u_regularizer is not None:
+            out = out + self.u_regularizer.loss(params["w_hh"])
+        if self.b_regularizer is not None:
+            out = out + self.b_regularizer.loss(params["bias"])
+        return out
+
+
+class LSTMPeephole(LSTM):
+    """LSTM with peephole connections (nn/LSTMPeephole.scala)."""
+
+    def init(self, rng):
+        p = super().init(rng)
+        dtype = Engine.default_dtype()
+        k = jax.random.fold_in(rng, 7)
+        stdv = 1.0 / math.sqrt(self.hidden_size)
+        k1, k2, k3 = jax.random.split(k, 3)
+        p["w_ci"] = _uniform(k1, (self.hidden_size,), stdv, dtype)
+        p["w_cf"] = _uniform(k2, (self.hidden_size,), stdv, dtype)
+        p["w_co"] = _uniform(k3, (self.hidden_size,), stdv, dtype)
+        return p
+
+    def step(self, params, x, hidden, *, training=False, rng=None):
+        h, c = hidden[1], hidden[2]
+        gates = x @ params["w_ih"].T + h @ params["w_hh"].T + params["bias"]
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(i + params["w_ci"] * c)
+        f = jax.nn.sigmoid(f + params["w_cf"] * c)
+        g = jnp.tanh(g)
+        c2 = f * c + i * g
+        o = jax.nn.sigmoid(o + params["w_co"] * c2)
+        h2 = o * jnp.tanh(c2)
+        return h2, T(h2, c2)
+
+
+class GRU(Cell):
+    """GRU (nn/GRU.scala). Gate order r, z; hidden = h."""
+
+    def __init__(self, input_size: int, hidden_size: int, p: float = 0.0,
+                 w_regularizer=None, u_regularizer=None, b_regularizer=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.p = p
+        self.w_regularizer = w_regularizer
+        self.u_regularizer = u_regularizer
+        self.b_regularizer = b_regularizer
+
+    def init(self, rng):
+        dtype = Engine.default_dtype()
+        k1, k2, k3, k4, k5 = jax.random.split(rng, 5)
+        stdv = 1.0 / math.sqrt(self.hidden_size)
+        H, I = self.hidden_size, self.input_size
+        return {
+            "w_ih": _uniform(k1, (2 * H, I), stdv, dtype),
+            "w_hh": _uniform(k2, (2 * H, H), stdv, dtype),
+            "bias": _uniform(k3, (2 * H,), stdv, dtype),
+            "w_ih_n": _uniform(k4, (H, I), stdv, dtype),
+            "w_hh_n": _uniform(k5, (H, H), stdv, dtype),
+            "bias_n": jnp.zeros((H,), dtype),
+        }
+
+    def init_hidden(self, batch_size, dtype=None):
+        dtype = dtype or Engine.default_dtype()
+        return jnp.zeros((batch_size, self.hidden_size), dtype)
+
+    def step(self, params, x, hidden, *, training=False, rng=None):
+        h = hidden
+        rz = jax.nn.sigmoid(x @ params["w_ih"].T + h @ params["w_hh"].T
+                            + params["bias"])
+        r, z = jnp.split(rz, 2, axis=-1)
+        n = jnp.tanh(x @ params["w_ih_n"].T
+                     + r * (h @ params["w_hh_n"].T) + params["bias_n"])
+        h2 = (1.0 - z) * n + z * h
+        return h2, h2
+
+
+class ConvLSTMPeephole(Cell):
+    """Convolutional LSTM with peepholes over NCHW maps
+    (nn/ConvLSTMPeephole.scala). Hidden = T(h, c), each (B, C_out, H, W)."""
+
+    def __init__(self, input_size: int, output_size: int,
+                 kernel_i: int = 3, kernel_c: int = 3, stride: int = 1,
+                 with_peephole: bool = True):
+        super().__init__()
+        self.input_size = input_size
+        self.output_size = output_size
+        self.kernel_i = kernel_i
+        self.kernel_c = kernel_c
+        self.stride = stride
+        self.with_peephole = with_peephole
+        self.hidden_shape = None  # set lazily from input H, W
+
+    def init(self, rng):
+        dtype = Engine.default_dtype()
+        k1, k2, k3, k4 = jax.random.split(rng, 4)
+        Ci, Co = self.input_size, self.output_size
+        ki, kc = self.kernel_i, self.kernel_c
+        fan_in = Ci * ki * ki
+        stdv = 1.0 / math.sqrt(fan_in)
+        p = {
+            "w_xi": _uniform(k1, (4 * Co, Ci, ki, ki), stdv, dtype),
+            "w_hi": _uniform(k2, (4 * Co, Co, kc, kc),
+                             1.0 / math.sqrt(Co * kc * kc), dtype),
+            "bias": jnp.zeros((4 * Co,), dtype),
+        }
+        if self.with_peephole:
+            p["w_ci"] = _uniform(k3, (Co,), stdv, dtype)
+            p["w_cf"] = _uniform(k4, (Co,), stdv, dtype)
+            p["w_co"] = jnp.zeros((Co,), dtype)
+        return p
+
+    def _conv(self, x, w, k):
+        pad = (k - 1) // 2
+        return lax.conv_general_dilated(
+            x, w, window_strides=(self.stride, self.stride),
+            padding=((pad, k - 1 - pad), (pad, k - 1 - pad)),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            preferred_element_type=x.dtype)
+
+    def init_hidden(self, batch_size, dtype=None, spatial=None):
+        dtype = dtype or Engine.default_dtype()
+        if spatial is None:
+            spatial = self.hidden_shape
+        z = jnp.zeros((batch_size, self.output_size) + tuple(spatial), dtype)
+        return T(z, z)
+
+    def step(self, params, x, hidden, *, training=False, rng=None):
+        h, c = hidden[1], hidden[2]
+        gates = self._conv(x, params["w_xi"], self.kernel_i) \
+            + self._conv(h, params["w_hi"], self.kernel_c) \
+            + params["bias"].reshape(1, -1, 1, 1)
+        i, f, g, o = jnp.split(gates, 4, axis=1)
+        if self.with_peephole:
+            i = i + params["w_ci"].reshape(1, -1, 1, 1) * c
+            f = f + params["w_cf"].reshape(1, -1, 1, 1) * c
+        i, f = jax.nn.sigmoid(i), jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        c2 = f * c + i * g
+        if self.with_peephole:
+            o = o + params["w_co"].reshape(1, -1, 1, 1) * c2
+        o = jax.nn.sigmoid(o)
+        h2 = o * jnp.tanh(c2)
+        return h2, T(h2, c2)
+
+
+class ConvLSTMPeephole3D(ConvLSTMPeephole):
+    """3-D variant (nn/ConvLSTMPeephole3D.scala) over NCDHW maps."""
+
+    def init(self, rng):
+        dtype = Engine.default_dtype()
+        k1, k2, k3, k4 = jax.random.split(rng, 4)
+        Ci, Co = self.input_size, self.output_size
+        ki, kc = self.kernel_i, self.kernel_c
+        stdv = 1.0 / math.sqrt(Ci * ki ** 3)
+        p = {
+            "w_xi": _uniform(k1, (4 * Co, Ci, ki, ki, ki), stdv, dtype),
+            "w_hi": _uniform(k2, (4 * Co, Co, kc, kc, kc),
+                             1.0 / math.sqrt(Co * kc ** 3), dtype),
+            "bias": jnp.zeros((4 * Co,), dtype),
+        }
+        if self.with_peephole:
+            p["w_ci"] = _uniform(k3, (Co,), stdv, dtype)
+            p["w_cf"] = _uniform(k4, (Co,), stdv, dtype)
+            p["w_co"] = jnp.zeros((Co,), dtype)
+        return p
+
+    def _conv(self, x, w, k):
+        pad = (k - 1) // 2
+        pads = ((pad, k - 1 - pad),) * 3
+        return lax.conv_general_dilated(
+            x, w, window_strides=(self.stride,) * 3, padding=pads,
+            dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+            preferred_element_type=x.dtype)
+
+    def step(self, params, x, hidden, *, training=False, rng=None):
+        h, c = hidden[1], hidden[2]
+        gates = self._conv(x, params["w_xi"], self.kernel_i) \
+            + self._conv(h, params["w_hi"], self.kernel_c) \
+            + params["bias"].reshape(1, -1, 1, 1, 1)
+        i, f, g, o = jnp.split(gates, 4, axis=1)
+        if self.with_peephole:
+            i = i + params["w_ci"].reshape(1, -1, 1, 1, 1) * c
+            f = f + params["w_cf"].reshape(1, -1, 1, 1, 1) * c
+        i, f = jax.nn.sigmoid(i), jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        c2 = f * c + i * g
+        if self.with_peephole:
+            o = o + params["w_co"].reshape(1, -1, 1, 1, 1) * c2
+        o = jax.nn.sigmoid(o)
+        h2 = o * jnp.tanh(c2)
+        return h2, T(h2, c2)
+
+
+class Recurrent(Module):
+    """Time-unrolling container (nn/Recurrent.scala:36) as a single
+    ``lax.scan``. Input (B, T, ...) -> output (B, T, hidden)."""
+
+    def __init__(self, cell: Optional[Cell] = None):
+        super().__init__()
+        self.cell = cell
+
+    def add(self, cell: Cell):
+        self.cell = cell
+        return self
+
+    def init(self, rng):
+        return {"cell": self.cell.init(rng)}
+
+    def _h0(self, x):
+        if isinstance(self.cell, ConvLSTMPeephole):
+            return self.cell.init_hidden(x.shape[0], x.dtype,
+                                         spatial=x.shape[3:])
+        return self.cell.init_hidden(x.shape[0], x.dtype)
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        x = input  # (B, T, ...)
+        h0 = self._h0(x)
+        xs = jnp.moveaxis(x, 1, 0)  # (T, B, ...)
+        n_steps = xs.shape[0]
+        keys = (jax.random.split(rng, n_steps) if rng is not None
+                else jnp.zeros((n_steps, 2), jnp.uint32))
+
+        def body(h, inp):
+            x_t, k = inp
+            out, h2 = self.cell.step(params["cell"], x_t, h,
+                                     training=training,
+                                     rng=k if rng is not None else None)
+            return h2, out
+
+        _, outs = lax.scan(body, h0, (xs, keys))
+        return jnp.moveaxis(outs, 0, 1), state
+
+    def regularization_loss(self, params):
+        return self.cell.regularization_loss(params["cell"])
+
+
+class BiRecurrent(Module):
+    """Bidirectional recurrence (nn/BiRecurrent.scala); merge defaults to
+    concat on the feature dim (CAddTable merge supported via `merge`)."""
+
+    def __init__(self, merge: Optional[Module] = None,
+                 cell: Optional[Cell] = None):
+        super().__init__()
+        self.merge = merge
+        self.fwd = Recurrent(cell)
+        self.bwd = Recurrent(cell)
+        self._cell_ctor = None
+
+    def add(self, cell: Cell):
+        import copy
+        self.fwd.add(cell)
+        self.bwd.add(copy.deepcopy(cell))
+        return self
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        return {"fwd": self.fwd.init(k1), "bwd": self.bwd.init(k2)}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        k1, k2 = (jax.random.split(rng) if rng is not None else (None, None))
+        yf, _ = self.fwd.apply(params["fwd"], {}, input,
+                               training=training, rng=k1)
+        rev = jnp.flip(input, axis=1)
+        yb, _ = self.bwd.apply(params["bwd"], {}, rev,
+                               training=training, rng=k2)
+        yb = jnp.flip(yb, axis=1)
+        if self.merge is not None:
+            return self.merge.forward_fn({}, T(yf, yb)), state
+        return jnp.concatenate([yf, yb], axis=-1), state
+
+
+class RecurrentDecoder(Module):
+    """Feeds each output back as the next input for seq_length steps
+    (nn/RecurrentDecoder.scala). Input: (B, F) start symbol."""
+
+    def __init__(self, seq_length: int, cell: Optional[Cell] = None):
+        super().__init__()
+        self.seq_length = seq_length
+        self.cell = cell
+
+    def add(self, cell: Cell):
+        self.cell = cell
+        return self
+
+    def init(self, rng):
+        return {"cell": self.cell.init(rng)}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        h0 = self.cell.init_hidden(input.shape[0], input.dtype)
+
+        def body(carry, k):
+            x, h = carry
+            out, h2 = self.cell.step(params["cell"], x, h,
+                                     training=training, rng=None)
+            return (out, h2), out
+
+        (_, _), outs = lax.scan(body, (input, h0), jnp.arange(self.seq_length))
+        return jnp.moveaxis(outs, 0, 1), state
+
+
+class TimeDistributed(Module):
+    """Applies an inner module to every time slice of (B, T, ...)
+    (nn/TimeDistributed.scala) by folding T into the batch dim — on TPU this
+    is *better* than a loop: one big MXU matmul."""
+
+    def __init__(self, layer: Module):
+        super().__init__()
+        self.layer = layer
+
+    def init(self, rng):
+        return {"layer": self.layer.init(rng)}
+
+    def initial_state(self):
+        return {"layer": self.layer.initial_state()}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        B, Tm = input.shape[0], input.shape[1]
+        flat = input.reshape((B * Tm,) + input.shape[2:])
+        out, s = self.layer.apply(params["layer"], state["layer"], flat,
+                                  training=training, rng=rng)
+        return out.reshape((B, Tm) + out.shape[1:]), {"layer": s}
+
+    def regularization_loss(self, params):
+        return self.layer.regularization_loss(params["layer"])

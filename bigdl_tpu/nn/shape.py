@@ -1,0 +1,489 @@
+"""Shape/structure layers (BigDL nn/{Reshape,View,Squeeze,...}.scala).
+
+Dimension arguments are 1-based (Torch convention), counted *excluding* the
+batch dim where the reference does. Pure metadata ops — free under XLA.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.utils.table import Table, T
+
+
+class Reshape(Module):
+    """nn/Reshape.scala — size excludes batch dim when batch_mode is None
+    and input has one more dim than size."""
+
+    def __init__(self, size: Sequence[int], batch_mode: Optional[bool] = None):
+        super().__init__()
+        self.size = tuple(size)
+        self.batch_mode = batch_mode
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        n = 1
+        for s in self.size:
+            n *= s
+        batched = self.batch_mode is True or (
+            self.batch_mode is None and input.size != n)
+        if batched:
+            return input.reshape((input.shape[0],) + self.size)
+        return input.reshape(self.size)
+
+
+class InferReshape(Module):
+    """nn/InferReshape.scala — size may contain -1 (infer) and 0 (copy)."""
+
+    def __init__(self, size: Sequence[int], batch_mode: bool = False):
+        super().__init__()
+        self.size = tuple(size)
+        self.batch_mode = batch_mode
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        in_shape = input.shape[1:] if self.batch_mode else input.shape
+        out = []
+        for i, s in enumerate(self.size):
+            if s == 0:
+                out.append(in_shape[i])
+            else:
+                out.append(s)
+        if self.batch_mode:
+            return input.reshape((input.shape[0],) + tuple(out))
+        return input.reshape(tuple(out))
+
+
+class View(Module):
+    """nn/View.scala"""
+
+    def __init__(self, *sizes):
+        super().__init__()
+        if len(sizes) == 1 and isinstance(sizes[0], (list, tuple)):
+            sizes = tuple(sizes[0])
+        self.sizes = tuple(sizes)
+        self.num_input_dims = 0
+
+    def set_num_input_dims(self, n):
+        self.num_input_dims = n
+        return self
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        n = 1
+        for s in self.sizes:
+            n *= s
+        if input.size == n:
+            return input.reshape(self.sizes)
+        return input.reshape((input.shape[0],) + self.sizes)
+
+
+class Squeeze(Module):
+    """nn/Squeeze.scala — dim is 1-based; batch_mode shifts by one."""
+
+    def __init__(self, dim: Optional[int] = None, num_input_dims: int = 0,
+                 batch_mode: bool = False):
+        super().__init__()
+        self.dim = dim
+        self.batch_mode = batch_mode
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        if self.dim is None:
+            return jnp.squeeze(input)
+        axis = self.dim - 1 + (1 if self.batch_mode else 0)
+        return jnp.squeeze(input, axis=axis)
+
+
+class Unsqueeze(Module):
+    """nn/Unsqueeze.scala"""
+
+    def __init__(self, pos: int, num_input_dims: int = 0):
+        super().__init__()
+        self.pos = pos
+        self.num_input_dims = num_input_dims
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        axis = self.pos - 1
+        if self.num_input_dims > 0 and input.ndim > self.num_input_dims:
+            axis += input.ndim - self.num_input_dims
+        return jnp.expand_dims(input, axis)
+
+
+class Transpose(Module):
+    """nn/Transpose.scala — list of (dim1, dim2) swaps, 1-based."""
+
+    def __init__(self, permutations: Sequence[Sequence[int]]):
+        super().__init__()
+        self.permutations = [tuple(p) for p in permutations]
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        x = input
+        for d1, d2 in self.permutations:
+            x = jnp.swapaxes(x, d1 - 1, d2 - 1)
+        return x
+
+
+class Contiguous(Module):
+    """nn/Contiguous.scala — identity under XLA."""
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        return input
+
+
+class Replicate(Module):
+    """nn/Replicate.scala — adds a new dim of size n_features at dim (1-based)."""
+
+    def __init__(self, n_features: int, dim: int = 1, n_dim: int = 0):
+        super().__init__()
+        self.n_features = n_features
+        self.dim = dim
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        x = jnp.expand_dims(input, self.dim - 1)
+        reps = [1] * x.ndim
+        reps[self.dim - 1] = self.n_features
+        return jnp.tile(x, reps)
+
+
+class Padding(Module):
+    """nn/Padding.scala — pad `pad` entries (negative = before) along dim;
+    n_input_dim distinguishes batched input."""
+
+    def __init__(self, dim: int, pad: int, n_input_dim: int,
+                 value: float = 0.0, n_index: int = 1):
+        super().__init__()
+        self.dim = dim
+        self.pad = pad
+        self.n_input_dim = n_input_dim
+        self.value = value
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        axis = self.dim - 1
+        if input.ndim > self.n_input_dim:
+            axis += 1
+        cfg = [(0, 0)] * input.ndim
+        cfg[axis] = (abs(self.pad), 0) if self.pad < 0 else (0, self.pad)
+        return jnp.pad(input, cfg, constant_values=self.value)
+
+
+class SpatialZeroPadding(Module):
+    """nn/SpatialZeroPadding.scala — pads H/W of NCHW."""
+
+    def __init__(self, pad_left: int, pad_right: int = None,
+                 pad_top: int = None, pad_bottom: int = None):
+        super().__init__()
+        self.pl = pad_left
+        self.pr = pad_right if pad_right is not None else pad_left
+        self.pt = pad_top if pad_top is not None else pad_left
+        self.pb = pad_bottom if pad_bottom is not None else pad_left
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        cfg = [(0, 0)] * (input.ndim - 2) + [(self.pt, self.pb),
+                                             (self.pl, self.pr)]
+        return jnp.pad(input, cfg)
+
+
+class Narrow(Module):
+    """nn/Narrow.scala — slice [offset, offset+length) along dim (1-based)."""
+
+    def __init__(self, dimension: int, offset: int, length: int = 1):
+        super().__init__()
+        self.dimension = dimension
+        self.offset = offset
+        self.length = length
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        axis = self.dimension - 1
+        length = self.length
+        if length < 0:
+            length = input.shape[axis] - self.offset + 1 + length + 1
+        return jax.lax.slice_in_dim(input, self.offset - 1,
+                                    self.offset - 1 + length, axis=axis)
+
+
+class Select(Module):
+    """nn/Select.scala — pick index along dim, dropping it (1-based;
+    negative index counts from the end)."""
+
+    def __init__(self, dimension: int, index: int):
+        super().__init__()
+        self.dimension = dimension
+        self.index = index
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        axis = self.dimension - 1
+        idx = self.index - 1 if self.index > 0 else input.shape[axis] + self.index
+        return jnp.take(input, idx, axis=axis)
+
+
+class SelectTable(Module):
+    """nn/SelectTable.scala — pick the i-th table entry (1-based)."""
+
+    def __init__(self, index: int):
+        super().__init__()
+        self.index = index
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        entries = list(input)
+        idx = self.index if self.index > 0 else len(entries) + self.index + 1
+        return entries[idx - 1]
+
+
+class MaskedSelect(Module):
+    """nn/MaskedSelect.scala — input T(x, mask); dynamic-shape op, so under
+    jit it returns x where mask else 0 flattened to x's shape is not possible;
+    eager path returns the compacted vector like the reference."""
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        x, mask = input[1], input[2]
+        import numpy as np
+        if isinstance(x, jax.core.Tracer):
+            raise NotImplementedError(
+                "MaskedSelect produces a data-dependent shape; use it outside "
+                "jit (the reference runs it on CPU-side tensors too)")
+        xn, mn = np.asarray(x), np.asarray(mask).astype(bool)
+        return jnp.asarray(xn[mn])
+
+
+class Index(Module):
+    """nn/Index.scala — input T(x, indices); gathers along dim (1-based)."""
+
+    def __init__(self, dimension: int):
+        super().__init__()
+        self.dimension = dimension
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        x, idx = input[1], input[2]
+        return jnp.take(x, idx.astype(jnp.int32) - 1,
+                        axis=self.dimension - 1)
+
+
+class Max(Module):
+    """nn/Max.scala — max over dim; returns values (reference returns
+    values + indices table when asked)."""
+
+    def __init__(self, dim: int = 1, num_input_dims: int = 0):
+        super().__init__()
+        self.dim = dim
+        self.num_input_dims = num_input_dims
+
+    def _axis(self, x):
+        axis = self.dim - 1
+        if self.num_input_dims > 0 and x.ndim > self.num_input_dims:
+            axis += x.ndim - self.num_input_dims
+        return axis
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        return jnp.max(input, axis=self._axis(input))
+
+
+class Min(Max):
+    """nn/Min.scala"""
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        return jnp.min(input, axis=self._axis(input))
+
+
+class Mean(Module):
+    """nn/Mean.scala — mean over `dimension` (1-based); squeeze unless
+    squeeze=False."""
+
+    def __init__(self, dimension: int = 1, n_input_dims: int = -1,
+                 squeeze: bool = True):
+        super().__init__()
+        self.dimension = dimension
+        self.n_input_dims = n_input_dims
+        self.squeeze = squeeze
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        axis = self.dimension - 1
+        if self.n_input_dims > 0 and input.ndim > self.n_input_dims:
+            axis += input.ndim - self.n_input_dims
+        return jnp.mean(input, axis=axis, keepdims=not self.squeeze)
+
+
+class Sum(Module):
+    """nn/Sum.scala"""
+
+    def __init__(self, dimension: int = 1, n_input_dims: int = -1,
+                 size_average: bool = False, squeeze: bool = True):
+        super().__init__()
+        self.dimension = dimension
+        self.n_input_dims = n_input_dims
+        self.size_average = size_average
+        self.squeeze = squeeze
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        axis = self.dimension - 1
+        if self.n_input_dims > 0 and input.ndim > self.n_input_dims:
+            axis += input.ndim - self.n_input_dims
+        out = jnp.sum(input, axis=axis, keepdims=not self.squeeze)
+        if self.size_average:
+            out = out / input.shape[axis]
+        return out
+
+
+class Scale(Module):
+    """nn/Scale.scala — CMul then CAdd with learned size-shaped params."""
+
+    def __init__(self, size: Sequence[int]):
+        super().__init__()
+        from bigdl_tpu.nn.linear import CMul, CAdd
+        self.cmul = CMul(size)
+        self.cadd = CAdd(size)
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        return {"cmul": self.cmul.init(k1), "cadd": self.cadd.init(k2)}
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        y = self.cmul.forward_fn(params["cmul"], input)
+        return self.cadd.forward_fn(params["cadd"], y)
+
+
+class Tile(Module):
+    """nn/Tile.scala — repeat `copies` times along dim (1-based)."""
+
+    def __init__(self, dim: int = 1, copies: int = 2):
+        super().__init__()
+        self.dim = dim
+        self.copies = copies
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        reps = [1] * input.ndim
+        reps[self.dim - 1] = self.copies
+        return jnp.tile(input, reps)
+
+
+class Pack(Module):
+    """nn/Pack.scala — stack table entries along a new dim (1-based)."""
+
+    def __init__(self, dimension: int):
+        super().__init__()
+        self.dimension = dimension
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        entries = list(input) if isinstance(input, Table) else [input]
+        return jnp.stack(entries, axis=self.dimension - 1)
+
+
+class Reverse(Module):
+    """nn/Reverse.scala — flip along dim (1-based)."""
+
+    def __init__(self, dimension: int = 1, is_inplace: bool = False):
+        super().__init__()
+        self.dimension = dimension
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        return jnp.flip(input, axis=self.dimension - 1)
+
+
+class SplitTable(Module):
+    """nn/SplitTable.scala — split a tensor into a table of slices along dim."""
+
+    def __init__(self, dimension: int, n_input_dims: int = -1):
+        super().__init__()
+        self.dimension = dimension
+        self.n_input_dims = n_input_dims
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        axis = self.dimension - 1
+        if self.n_input_dims > 0 and input.ndim > self.n_input_dims:
+            axis += input.ndim - self.n_input_dims
+        if axis < 0:
+            axis += input.ndim
+        n = input.shape[axis]
+        slices = [jnp.squeeze(s, axis=axis)
+                  for s in jnp.split(input, n, axis=axis)]
+        return T(*slices)
+
+
+class BifurcateSplitTable(Module):
+    """nn/BifurcateSplitTable.scala — split in two halves along dim."""
+
+    def __init__(self, dimension: int):
+        super().__init__()
+        self.dimension = dimension
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        axis = self.dimension - 1
+        half = input.shape[axis] // 2
+        a = jax.lax.slice_in_dim(input, 0, half, axis=axis)
+        b = jax.lax.slice_in_dim(input, half, input.shape[axis], axis=axis)
+        return T(a, b)
+
+
+class JoinTable(Module):
+    """nn/JoinTable.scala — concat table entries along dim (1-based;
+    n_input_dims shifts for batched input)."""
+
+    def __init__(self, dimension: int, n_input_dims: int = -1):
+        super().__init__()
+        self.dimension = dimension
+        self.n_input_dims = n_input_dims
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        entries = list(input)
+        axis = self.dimension - 1
+        if self.n_input_dims > 0 and entries[0].ndim > self.n_input_dims:
+            axis += entries[0].ndim - self.n_input_dims
+        return jnp.concatenate(entries, axis=axis)
+
+
+class FlattenTable(Module):
+    """nn/FlattenTable.scala — flatten nested tables to a flat table."""
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        out = []
+
+        def rec(t):
+            if isinstance(t, Table):
+                for v in t:
+                    rec(v)
+            else:
+                out.append(t)
+
+        rec(input)
+        return T(*out)
+
+
+class ResizeBilinear(Module):
+    """nn/ResizeBilinear.scala — bilinear resize of NCHW to (oh, ow)."""
+
+    def __init__(self, output_height: int, output_width: int,
+                 align_corners: bool = False):
+        super().__init__()
+        self.output_height = output_height
+        self.output_width = output_width
+        self.align_corners = align_corners
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        x = input
+        B, C, H, W = x.shape
+        oh, ow = self.output_height, self.output_width
+        if self.align_corners and oh > 1 and ow > 1:
+            ys = jnp.linspace(0.0, H - 1, oh)
+            xs = jnp.linspace(0.0, W - 1, ow)
+        else:
+            ys = (jnp.arange(oh) + 0.0) * (H / oh)
+            xs = (jnp.arange(ow) + 0.0) * (W / ow)
+            ys = jnp.clip(ys, 0, H - 1)
+            xs = jnp.clip(xs, 0, W - 1)
+        y0 = jnp.floor(ys).astype(jnp.int32)
+        x0 = jnp.floor(xs).astype(jnp.int32)
+        y1 = jnp.clip(y0 + 1, 0, H - 1)
+        x1 = jnp.clip(x0 + 1, 0, W - 1)
+        wy = (ys - y0)[None, None, :, None]
+        wx = (xs - x0)[None, None, None, :]
+        g = lambda yy, xx: x[:, :, yy, :][:, :, :, xx]
+        out = (g(y0, x0) * (1 - wy) * (1 - wx) + g(y1, x0) * wy * (1 - wx)
+               + g(y0, x1) * (1 - wy) * wx + g(y1, x1) * wy * wx)
+        return out
+
+
+class DenseToSparse(Module):
+    """nn/DenseToSparse.scala — identity in this framework (sparse tensors
+    are represented densely on TPU; kept for API parity)."""
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        return input

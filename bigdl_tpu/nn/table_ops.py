@@ -1,0 +1,63 @@
+"""Elementwise table ops (BigDL nn/{CAddTable,CSubTable,...}.scala)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module
+
+
+class _TableReduce(Module):
+    def combine(self, a, b):
+        raise NotImplementedError
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        entries = list(input)
+        out = entries[0]
+        for e in entries[1:]:
+            out = self.combine(out, e)
+        return out
+
+
+class CAddTable(_TableReduce):
+    """nn/CAddTable.scala"""
+
+    def __init__(self, inplace: bool = False):
+        super().__init__()
+
+    def combine(self, a, b):
+        return a + b
+
+
+class CSubTable(_TableReduce):
+    """nn/CSubTable.scala"""
+
+    def combine(self, a, b):
+        return a - b
+
+
+class CMulTable(_TableReduce):
+    """nn/CMulTable.scala"""
+
+    def combine(self, a, b):
+        return a * b
+
+
+class CDivTable(_TableReduce):
+    """nn/CDivTable.scala"""
+
+    def combine(self, a, b):
+        return a / b
+
+
+class CMaxTable(_TableReduce):
+    """nn/CMaxTable.scala"""
+
+    def combine(self, a, b):
+        return jnp.maximum(a, b)
+
+
+class CMinTable(_TableReduce):
+    """nn/CMinTable.scala"""
+
+    def combine(self, a, b):
+        return jnp.minimum(a, b)

@@ -1,0 +1,18 @@
+from bigdl_tpu.optim.optim_method import (
+    OptimMethod, SGD, Adam, Adagrad, Adadelta, Adamax, RMSprop, Ftrl,
+    LearningRateSchedule, Default, Step, MultiStep, EpochStep, EpochDecay,
+    EpochSchedule, Regime, Poly, NaturalExp, Exponential, Plateau, Warmup,
+    SequentialSchedule)
+from bigdl_tpu.optim.regularizer import (
+    Regularizer, L1L2Regularizer, L1Regularizer, L2Regularizer)
+from bigdl_tpu.optim.trigger import (
+    Trigger, every_epoch, several_iteration, max_epoch, max_iteration,
+    max_score, min_loss)
+from bigdl_tpu.optim.validation import (
+    ValidationMethod, ValidationResult, AccuracyResult, LossResult,
+    Top1Accuracy, Top5Accuracy, Loss, MAE)
+from bigdl_tpu.optim.optimizer import (
+    Optimizer, LocalOptimizer, DistriOptimizer, Metrics, build_train_step,
+    build_eval_step)
+from bigdl_tpu.optim.predictor import LocalPredictor, Predictor
+from bigdl_tpu.optim.evaluator import Evaluator, LocalValidator, DistriValidator

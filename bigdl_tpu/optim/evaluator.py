@@ -1,0 +1,58 @@
+"""Evaluator / Validator (BigDL optim/Evaluator.scala:37, Validator.scala:43)."""
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import jax
+import numpy as np
+
+from bigdl_tpu.dataset.dataset import AbstractDataSet
+from bigdl_tpu.dataset.sample import MiniBatch
+from bigdl_tpu.dataset.transformer import SampleToMiniBatch
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.optim.validation import ValidationMethod, ValidationResult
+
+
+class Evaluator:
+    def __init__(self, model: Module):
+        self.model = model
+
+    def test(self, dataset, methods: Sequence[ValidationMethod],
+             batch_size: int = 32) -> Dict[str, ValidationResult]:
+        model = self.model
+        model.evaluate()
+        model.ensure_initialized()
+        params = model.get_parameters()
+        state = model.get_state()
+
+        @jax.jit
+        def step(p, s, x):
+            out, _ = model.apply(p, s, x, training=False)
+            return out
+
+        if isinstance(dataset, AbstractDataSet):
+            it = dataset.data(train=False)
+        else:
+            it = iter(dataset)
+        first = []
+        for el in it:
+            first.append(el)
+            break
+        if not first:
+            return {}
+        import itertools
+        full = itertools.chain(first, it)
+        batches = full if isinstance(first[0], MiniBatch) \
+            else SampleToMiniBatch(batch_size).apply(full)
+        results = None
+        for b in batches:
+            out = np.asarray(step(params, state, np.asarray(b.get_input())))
+            tgt = np.asarray(b.get_target())
+            batch_res = [m(out, tgt) for m in methods]
+            results = batch_res if results is None \
+                else [r + br for r, br in zip(results, batch_res)]
+        return {m.name: r for m, r in zip(methods, results)}
+
+
+LocalValidator = Evaluator
+DistriValidator = Evaluator

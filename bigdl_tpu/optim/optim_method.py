@@ -1,0 +1,561 @@
+"""OptimMethods (BigDL optim/OptimMethod.scala:28, SGD.scala:38, Adam, ...).
+
+Split TPU-style: the *update rule* is a pure jittable function
+``update(grads, state, params, lr) -> (params, state)`` that runs inside the
+compiled train step (and under shard_map when optimizer state is sharded);
+the *learning-rate schedule* runs on the host each iteration, mutating its
+own counters exactly like the reference's driver (SGD.scala:198-560), and
+feeds ``lr`` in as a scalar argument so no recompilation happens.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+# --------------------------------------------------------------------------
+# Learning-rate schedules (SGD.scala:198-560). All host-side.
+# --------------------------------------------------------------------------
+
+class LearningRateSchedule:
+    """Computes current LR from optimizer state; mutates nothing global.
+
+    ``update_hyper_parameter(optim)`` mirrors the reference: reads
+    optim.state counters (neval, epoch), writes optim.current_lr.
+    """
+
+    def update(self, optim: "OptimMethod") -> float:
+        raise NotImplementedError
+
+
+class Default(LearningRateSchedule):
+    """lr / (1 + neval * learningRateDecay) (SGD.scala Default)."""
+
+    def update(self, optim):
+        n = optim.state["evalCounter"]
+        lr = optim.learning_rate / (1 + n * optim.learning_rate_decay)
+        optim.state["evalCounter"] = n + 1
+        return lr
+
+
+class Step(LearningRateSchedule):
+    """lr * gamma^(floor(neval / stepSize)) (SGD.scala Step)."""
+
+    def __init__(self, step_size: int, gamma: float):
+        self.step_size = step_size
+        self.gamma = gamma
+
+    def update(self, optim):
+        n = optim.state["evalCounter"]
+        lr = optim.learning_rate * self.gamma ** (n // self.step_size)
+        optim.state["evalCounter"] = n + 1
+        return lr
+
+
+class MultiStep(LearningRateSchedule):
+    """Decay at given iteration milestones (SGD.scala MultiStep)."""
+
+    def __init__(self, step_sizes, gamma: float):
+        self.step_sizes = list(step_sizes)
+        self.gamma = gamma
+
+    def update(self, optim):
+        n = optim.state["evalCounter"]
+        k = sum(1 for s in self.step_sizes if n >= s)
+        optim.state["evalCounter"] = n + 1
+        return optim.learning_rate * self.gamma ** k
+
+
+class EpochStep(LearningRateSchedule):
+    """lr * gamma^(floor((epoch-1)/stepSize)) (SGD.scala EpochStep)."""
+
+    def __init__(self, step_size: int, gamma: float):
+        self.step_size = step_size
+        self.gamma = gamma
+
+    def update(self, optim):
+        epoch = optim.state.get("epoch", 1)
+        return optim.learning_rate * self.gamma ** ((epoch - 1) // self.step_size)
+
+
+class EpochDecay(LearningRateSchedule):
+    """lr * 0.1^decayFn(epoch) (SGD.scala EpochDecay)."""
+
+    def __init__(self, decay_fn):
+        self.decay_fn = decay_fn
+
+    def update(self, optim):
+        epoch = optim.state.get("epoch", 1)
+        return optim.learning_rate * (0.1 ** self.decay_fn(epoch))
+
+
+class Regime:
+    """An LR regime row for EpochSchedule (SGD.scala Regime)."""
+
+    def __init__(self, start_epoch: int, end_epoch: int,
+                 config: Dict[str, Any]):
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.config = config
+
+
+class EpochSchedule(LearningRateSchedule):
+    """Table of per-epoch-range configs (SGD.scala EpochSchedule)."""
+
+    def __init__(self, regimes):
+        self.regimes = list(regimes)
+
+    def update(self, optim):
+        epoch = optim.state.get("epoch", 1)
+        lr = optim.learning_rate
+        for r in self.regimes:
+            if r.start_epoch <= epoch <= r.end_epoch:
+                lr = r.config.get("learningRate", lr)
+                if "weightDecay" in r.config:
+                    optim.weight_decay = r.config["weightDecay"]
+                if "momentum" in r.config:
+                    optim.momentum = r.config["momentum"]
+        return lr
+
+
+class Poly(LearningRateSchedule):
+    """lr * (1 - neval/maxIteration)^power (SGD.scala Poly;
+    models/inception/Train.scala:74 uses Poly(0.5, ...))."""
+
+    def __init__(self, power: float, max_iteration: int):
+        self.power = power
+        self.max_iteration = max_iteration
+
+    def update(self, optim):
+        n = optim.state["evalCounter"]
+        optim.state["evalCounter"] = n + 1
+        if n >= self.max_iteration:
+            return 0.0
+        return optim.learning_rate * (1.0 - n / self.max_iteration) ** self.power
+
+
+class NaturalExp(LearningRateSchedule):
+    """lr * exp(-gamma * floor(neval/decayStep)) (SGD.scala NaturalExp)."""
+
+    def __init__(self, decay_step: int, gamma: float):
+        self.decay_step = decay_step
+        self.gamma = gamma
+
+    def update(self, optim):
+        n = optim.state["evalCounter"]
+        optim.state["evalCounter"] = n + 1
+        return optim.learning_rate * math.exp(
+            -self.gamma * (n // self.decay_step))
+
+
+class Exponential(LearningRateSchedule):
+    """lr * gamma^(neval / decayStep) (SGD.scala Exponential)."""
+
+    def __init__(self, decay_step: int, decay_rate: float,
+                 staircase: bool = False):
+        self.decay_step = decay_step
+        self.decay_rate = decay_rate
+        self.staircase = staircase
+
+    def update(self, optim):
+        n = optim.state["evalCounter"]
+        optim.state["evalCounter"] = n + 1
+        p = n / self.decay_step
+        if self.staircase:
+            p = math.floor(p)
+        return optim.learning_rate * self.decay_rate ** p
+
+
+class Plateau(LearningRateSchedule):
+    """Reduce LR when a monitored metric stops improving
+    (SGD.scala Plateau). Driven by ``Optimizer`` feeding validation results
+    via ``record_metric``."""
+
+    def __init__(self, monitor: str = "score", factor: float = 0.1,
+                 patience: int = 10, mode: str = "min",
+                 epsilon: float = 1e-4, cooldown: int = 0,
+                 min_lr: float = 0.0):
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.mode = mode
+        self.epsilon = epsilon
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self._lr = None
+        self._best = None
+        self._wait = 0
+        self._cooldown_counter = 0
+
+    def record_metric(self, value: float):
+        if self._best is None:
+            self._best = value
+            return
+        improved = (value < self._best - self.epsilon if self.mode == "min"
+                    else value > self._best + self.epsilon)
+        if self._cooldown_counter > 0:
+            self._cooldown_counter -= 1
+            self._wait = 0
+        if improved:
+            self._best = value
+            self._wait = 0
+        elif self._cooldown_counter <= 0:
+            self._wait += 1
+            if self._wait >= self.patience:
+                self._lr = max(self._lr * self.factor, self.min_lr)
+                self._cooldown_counter = self.cooldown
+                self._wait = 0
+
+    def update(self, optim):
+        if self._lr is None:
+            self._lr = optim.learning_rate
+        return self._lr
+
+
+class Warmup(LearningRateSchedule):
+    """Linear warmup by delta per iteration (SGD.scala Warmup)."""
+
+    def __init__(self, delta: float):
+        self.delta = delta
+
+    def update(self, optim):
+        n = optim.state["evalCounter"]
+        optim.state["evalCounter"] = n + 1
+        return optim.learning_rate + self.delta * n
+
+
+class SequentialSchedule(LearningRateSchedule):
+    """Chain schedules, each active for `maxIteration` evals
+    (SGD.scala SequentialSchedule)."""
+
+    def __init__(self, iteration_per_epoch: int = 1):
+        self.schedules = []
+        self.iteration_per_epoch = iteration_per_epoch
+
+    def add(self, schedule: LearningRateSchedule, max_iteration: int):
+        self.schedules.append((schedule, max_iteration))
+        return self
+
+    def update(self, optim):
+        n = optim.state.get("seqCounter", 0)
+        optim.state["seqCounter"] = n + 1
+        acc = 0
+        for sched, max_it in self.schedules:
+            if n < acc + max_it:
+                return sched.update(optim)
+            acc += max_it
+        return self.schedules[-1][0].update(optim) if self.schedules else \
+            optim.learning_rate
+
+
+# --------------------------------------------------------------------------
+# OptimMethods
+# --------------------------------------------------------------------------
+
+class OptimMethod:
+    """Base optimizer (optim/OptimMethod.scala:28).
+
+    ``state`` (host dict) carries epoch/neval/loss like the reference;
+    device-side slot buffers live in the pytree returned by ``init_state``.
+    """
+
+    def __init__(self):
+        self.state: Dict[str, Any] = {"epoch": 1, "evalCounter": 0,
+                                      "neval": 1}
+        self.current_lr: float = 0.0
+
+    # host-side -----------------------------------------------------------
+    def get_hyper_parameter(self) -> float:
+        """Current LR for this iteration (mutates schedule counters)."""
+        return self.current_lr
+
+    def update_hyper_parameter(self):
+        self.current_lr = self._compute_lr()
+        return self.current_lr
+
+    def _compute_lr(self) -> float:
+        return 0.0
+
+    def get_state(self):
+        return dict(self.state)
+
+    def load_state(self, state):
+        self.state.update(state)
+        return self
+
+    # device-side ----------------------------------------------------------
+    def init_state(self, params):
+        return {}
+
+    def update(self, grads, opt_state, params, lr):
+        """Pure update: returns (new_params, new_opt_state). lr is a traced
+        scalar so schedules never trigger recompilation."""
+        raise NotImplementedError
+
+
+class SGD(OptimMethod):
+    """SGD with momentum/nesterov/dampening/weightDecay + schedules
+    (optim/SGD.scala:38). Semantics match Torch/BigDL:
+
+        grad += weightDecay * param
+        v = momentum * v + (1 - dampening) * grad
+        step = grad + momentum * v   (nesterov)  |  v  (classic)
+        param -= clr * step
+    """
+
+    def __init__(self, learning_rate: float = 1e-3,
+                 learning_rate_decay: float = 0.0,
+                 weight_decay: float = 0.0, momentum: float = 0.0,
+                 dampening: Optional[float] = None, nesterov: bool = False,
+                 learning_rate_schedule: Optional[LearningRateSchedule] = None):
+        super().__init__()
+        self.learning_rate = learning_rate
+        self.learning_rate_decay = learning_rate_decay
+        self.weight_decay = weight_decay
+        self.momentum = momentum
+        self.dampening = momentum if dampening is None else dampening
+        self.nesterov = nesterov
+        if nesterov and (momentum <= 0 or self.dampening != 0):
+            # reference requires dampening==0 for nesterov (SGD.scala)
+            self.dampening = 0.0
+        self.learning_rate_schedule = learning_rate_schedule or Default()
+
+    def _compute_lr(self):
+        return self.learning_rate_schedule.update(self)
+
+    def init_state(self, params):
+        if self.momentum > 0:
+            return {"v": jax.tree.map(jnp.zeros_like, params)}
+        return {}
+
+    def update(self, grads, opt_state, params, lr):
+        wd, mom, damp = self.weight_decay, self.momentum, self.dampening
+
+        if wd > 0:
+            grads = jax.tree.map(lambda g, p: g + wd * p, grads, params)
+        if mom > 0:
+            v = jax.tree.map(lambda vv, g: mom * vv + (1 - damp) * g,
+                             opt_state["v"], grads)
+            if self.nesterov:
+                step = jax.tree.map(lambda g, vv: g + mom * vv, grads, v)
+            else:
+                step = v
+            new_state = {"v": v}
+        else:
+            step = grads
+            new_state = {}
+        new_params = jax.tree.map(lambda p, s: p - lr * s, params, step)
+        return new_params, new_state
+
+
+class Adam(OptimMethod):
+    """Adam (optim/Adam.scala) with bias correction."""
+
+    def __init__(self, learning_rate: float = 1e-3,
+                 learning_rate_decay: float = 0.0,
+                 beta1: float = 0.9, beta2: float = 0.999,
+                 epsilon: float = 1e-8):
+        super().__init__()
+        self.learning_rate = learning_rate
+        self.learning_rate_decay = learning_rate_decay
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def _compute_lr(self):
+        n = self.state["evalCounter"]
+        self.state["evalCounter"] = n + 1
+        return self.learning_rate / (1 + n * self.learning_rate_decay)
+
+    def init_state(self, params):
+        return {"m": jax.tree.map(jnp.zeros_like, params),
+                "v": jax.tree.map(jnp.zeros_like, params),
+                "t": jnp.zeros((), jnp.int32)}
+
+    def update(self, grads, opt_state, params, lr):
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
+        t = opt_state["t"] + 1
+        m = jax.tree.map(lambda mm, g: b1 * mm + (1 - b1) * g,
+                         opt_state["m"], grads)
+        v = jax.tree.map(lambda vv, g: b2 * vv + (1 - b2) * g * g,
+                         opt_state["v"], grads)
+        tf = t.astype(jnp.float32)
+        mhat_c = 1.0 / (1.0 - jnp.power(b1, tf))
+        vhat_c = 1.0 / (1.0 - jnp.power(b2, tf))
+        new_params = jax.tree.map(
+            lambda p, mm, vv: p - lr * (mm * mhat_c)
+            / (jnp.sqrt(vv * vhat_c) + eps), params, m, v)
+        return new_params, {"m": m, "v": v, "t": t}
+
+
+class Adagrad(OptimMethod):
+    """Adagrad (optim/Adagrad.scala)."""
+
+    def __init__(self, learning_rate: float = 1e-3,
+                 learning_rate_decay: float = 0.0,
+                 weight_decay: float = 0.0):
+        super().__init__()
+        self.learning_rate = learning_rate
+        self.learning_rate_decay = learning_rate_decay
+        self.weight_decay = weight_decay
+
+    def _compute_lr(self):
+        n = self.state["evalCounter"]
+        self.state["evalCounter"] = n + 1
+        return self.learning_rate / (1 + n * self.learning_rate_decay)
+
+    def init_state(self, params):
+        return {"accum": jax.tree.map(jnp.zeros_like, params)}
+
+    def update(self, grads, opt_state, params, lr):
+        if self.weight_decay > 0:
+            grads = jax.tree.map(lambda g, p: g + self.weight_decay * p,
+                                 grads, params)
+        accum = jax.tree.map(lambda a, g: a + g * g, opt_state["accum"],
+                             grads)
+        new_params = jax.tree.map(
+            lambda p, g, a: p - lr * g / (jnp.sqrt(a) + 1e-10),
+            params, grads, accum)
+        return new_params, {"accum": accum}
+
+
+class Adadelta(OptimMethod):
+    """Adadelta (optim/Adadelta.scala)."""
+
+    def __init__(self, decay_rate: float = 0.9, epsilon: float = 1e-10):
+        super().__init__()
+        self.decay_rate = decay_rate
+        self.epsilon = epsilon
+        self.learning_rate = 1.0
+
+    def _compute_lr(self):
+        return 1.0
+
+    def init_state(self, params):
+        return {"accum_g": jax.tree.map(jnp.zeros_like, params),
+                "accum_dx": jax.tree.map(jnp.zeros_like, params)}
+
+    def update(self, grads, opt_state, params, lr):
+        rho, eps = self.decay_rate, self.epsilon
+        ag = jax.tree.map(lambda a, g: rho * a + (1 - rho) * g * g,
+                          opt_state["accum_g"], grads)
+        dx = jax.tree.map(
+            lambda g, a, ad: -jnp.sqrt(ad + eps) / jnp.sqrt(a + eps) * g,
+            grads, ag, opt_state["accum_dx"])
+        adx = jax.tree.map(lambda a, d: rho * a + (1 - rho) * d * d,
+                           opt_state["accum_dx"], dx)
+        new_params = jax.tree.map(lambda p, d: p + lr * d, params, dx)
+        return new_params, {"accum_g": ag, "accum_dx": adx}
+
+
+class Adamax(OptimMethod):
+    """Adamax (optim/Adamax.scala)."""
+
+    def __init__(self, learning_rate: float = 2e-3, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-38):
+        super().__init__()
+        self.learning_rate = learning_rate
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def _compute_lr(self):
+        return self.learning_rate
+
+    def init_state(self, params):
+        return {"m": jax.tree.map(jnp.zeros_like, params),
+                "u": jax.tree.map(jnp.zeros_like, params),
+                "t": jnp.zeros((), jnp.int32)}
+
+    def update(self, grads, opt_state, params, lr):
+        b1, b2 = self.beta1, self.beta2
+        t = opt_state["t"] + 1
+        m = jax.tree.map(lambda mm, g: b1 * mm + (1 - b1) * g,
+                         opt_state["m"], grads)
+        u = jax.tree.map(lambda uu, g: jnp.maximum(b2 * uu,
+                                                   jnp.abs(g) + self.epsilon),
+                         opt_state["u"], grads)
+        corr = 1.0 / (1.0 - jnp.power(b1, t.astype(jnp.float32)))
+        new_params = jax.tree.map(lambda p, mm, uu: p - lr * corr * mm / uu,
+                                  params, m, u)
+        return new_params, {"m": m, "u": u, "t": t}
+
+
+class RMSprop(OptimMethod):
+    """RMSprop (optim/RMSprop.scala)."""
+
+    def __init__(self, learning_rate: float = 1e-2,
+                 learning_rate_decay: float = 0.0,
+                 decay_rate: float = 0.99, epsilon: float = 1e-8):
+        super().__init__()
+        self.learning_rate = learning_rate
+        self.learning_rate_decay = learning_rate_decay
+        self.decay_rate = decay_rate
+        self.epsilon = epsilon
+
+    def _compute_lr(self):
+        n = self.state["evalCounter"]
+        self.state["evalCounter"] = n + 1
+        return self.learning_rate / (1 + n * self.learning_rate_decay)
+
+    def init_state(self, params):
+        return {"accum": jax.tree.map(jnp.zeros_like, params)}
+
+    def update(self, grads, opt_state, params, lr):
+        rho, eps = self.decay_rate, self.epsilon
+        accum = jax.tree.map(lambda a, g: rho * a + (1 - rho) * g * g,
+                             opt_state["accum"], grads)
+        new_params = jax.tree.map(
+            lambda p, g, a: p - lr * g / (jnp.sqrt(a) + eps),
+            params, grads, accum)
+        return new_params, {"accum": accum}
+
+
+class Ftrl(OptimMethod):
+    """FTRL-proximal — present in later BigDL versions; included for the
+    sparse/wide-and-deep use-cases the SparseLinear path serves."""
+
+    def __init__(self, learning_rate: float = 1e-3,
+                 learning_rate_power: float = -0.5,
+                 initial_accumulator_value: float = 0.1,
+                 l1_regularization_strength: float = 0.0,
+                 l2_regularization_strength: float = 0.0):
+        super().__init__()
+        self.learning_rate = learning_rate
+        self.lr_power = learning_rate_power
+        self.init_accum = initial_accumulator_value
+        self.l1 = l1_regularization_strength
+        self.l2 = l2_regularization_strength
+
+    def _compute_lr(self):
+        return self.learning_rate
+
+    def init_state(self, params):
+        return {"accum": jax.tree.map(
+                    lambda p: jnp.full_like(p, self.init_accum), params),
+                "linear": jax.tree.map(jnp.zeros_like, params)}
+
+    def update(self, grads, opt_state, params, lr):
+        def upd(p, g, a, l):
+            new_a = a + g * g
+            sigma = (jnp.power(new_a, -self.lr_power)
+                     - jnp.power(a, -self.lr_power)) / lr
+            new_l = l + g - sigma * p
+            quad = jnp.power(new_a, -self.lr_power) / lr + 2 * self.l2
+            pre = jnp.clip(new_l, -self.l1, self.l1) - new_l
+            new_p = pre / quad
+            return new_p, new_a, new_l
+
+        flat_p, tree = jax.tree.flatten(params)
+        flat_g = jax.tree.leaves(grads)
+        flat_a = jax.tree.leaves(opt_state["accum"])
+        flat_l = jax.tree.leaves(opt_state["linear"])
+        out = [upd(p, g, a, l)
+               for p, g, a, l in zip(flat_p, flat_g, flat_a, flat_l)]
+        new_params = jax.tree.unflatten(tree, [o[0] for o in out])
+        new_accum = jax.tree.unflatten(tree, [o[1] for o in out])
+        new_linear = jax.tree.unflatten(tree, [o[2] for o in out])
+        return new_params, {"accum": new_accum, "linear": new_linear}

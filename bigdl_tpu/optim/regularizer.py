@@ -1,0 +1,45 @@
+"""Weight regularizers (BigDL optim/Regularizer.scala:30).
+
+The reference mutates gradients inside accGradParameters; here a regularizer
+returns a penalty term added to the loss — autodiff then produces the same
+gradient contribution (d/dw [alpha/2*||w||^2] = alpha*w; L1 uses |w| whose
+subgradient sign(w) matches the reference's implementation).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class Regularizer:
+    def loss(self, w):
+        raise NotImplementedError
+
+
+class L1L2Regularizer(Regularizer):
+    """optim/Regularizer.scala:87"""
+
+    def __init__(self, l1: float = 0.0, l2: float = 0.0):
+        self.l1 = float(l1)
+        self.l2 = float(l2)
+
+    def loss(self, w):
+        out = 0.0
+        if self.l1:
+            out = out + self.l1 * jnp.sum(jnp.abs(w))
+        if self.l2:
+            out = out + 0.5 * self.l2 * jnp.sum(w * w)
+        return out
+
+
+class L1Regularizer(L1L2Regularizer):
+    """optim/Regularizer.scala:175"""
+
+    def __init__(self, l1: float):
+        super().__init__(l1=l1, l2=0.0)
+
+
+class L2Regularizer(L1L2Regularizer):
+    """optim/Regularizer.scala:186"""
+
+    def __init__(self, l2: float):
+        super().__init__(l1=0.0, l2=l2)

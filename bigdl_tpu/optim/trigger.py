@@ -1,0 +1,64 @@
+"""Trigger algebra (BigDL optim/Trigger.scala:30).
+
+A trigger is a predicate over the driver state dict (epoch, neval, Loss,
+score ...). Combinators and the full reference set are provided.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Any
+
+
+class Trigger:
+    def __init__(self, fn: Callable[[Dict[str, Any]], bool]):
+        self._fn = fn
+
+    def __call__(self, state: Dict[str, Any]) -> bool:
+        return self._fn(state)
+
+    def and_(self, other: "Trigger") -> "Trigger":
+        return Trigger(lambda s: self(s) and other(s))
+
+    def or_(self, other: "Trigger") -> "Trigger":
+        return Trigger(lambda s: self(s) or other(s))
+
+
+def every_epoch() -> Trigger:
+    """Fires once each time the epoch counter advances (Trigger.everyEpoch)."""
+    holder = {"last": None}
+
+    def fn(state):
+        cur = state.get("epoch", 1)
+        if holder["last"] is None:
+            holder["last"] = cur
+            return False
+        if cur > holder["last"]:
+            holder["last"] = cur
+            return True
+        return False
+
+    return Trigger(fn)
+
+
+def several_iteration(interval: int) -> Trigger:
+    """Fires every `interval` iterations (Trigger.severalIteration)."""
+    return Trigger(lambda s: s.get("neval", 1) % interval == 0)
+
+
+def max_epoch(m: int) -> Trigger:
+    """End condition: epoch > m (Trigger.maxEpoch)."""
+    return Trigger(lambda s: s.get("epoch", 1) > m)
+
+
+def max_iteration(m: int) -> Trigger:
+    """End condition: neval > m (Trigger.maxIteration)."""
+    return Trigger(lambda s: s.get("neval", 1) > m)
+
+
+def max_score(m: float) -> Trigger:
+    """End when validation score exceeds m (Trigger.maxScore)."""
+    return Trigger(lambda s: s.get("score", float("-inf")) > m)
+
+
+def min_loss(m: float) -> Trigger:
+    """End when training loss drops below m (Trigger.minLoss)."""
+    return Trigger(lambda s: s.get("Loss", float("inf")) < m)

@@ -1,0 +1,119 @@
+"""ValidationMethods (BigDL optim/ValidationMethod.scala).
+
+Each method maps (output, target) -> ValidationResult; results reduce with
+``+`` across batches/shards exactly like the reference (driver-side reduce in
+DistriOptimizer.scala:607-686).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+class ValidationResult:
+    def result(self):
+        """(value, count)"""
+        raise NotImplementedError
+
+    def __add__(self, other):
+        raise NotImplementedError
+
+
+class AccuracyResult(ValidationResult):
+    def __init__(self, correct: int, count: int):
+        self.correct = int(correct)
+        self.count = int(count)
+
+    def result(self):
+        return (self.correct / max(1, self.count), self.count)
+
+    def __add__(self, other):
+        return AccuracyResult(self.correct + other.correct,
+                              self.count + other.count)
+
+    def __repr__(self):
+        v, c = self.result()
+        return f"Accuracy({self.correct}/{c} = {v:.4f})"
+
+
+class LossResult(ValidationResult):
+    def __init__(self, loss: float, count: int):
+        self.loss = float(loss)
+        self.count = int(count)
+
+    def result(self):
+        return (self.loss / max(1, self.count), self.count)
+
+    def __add__(self, other):
+        return LossResult(self.loss + other.loss, self.count + other.count)
+
+    def __repr__(self):
+        v, _ = self.result()
+        return f"Loss({v:.4f})"
+
+
+class ValidationMethod:
+    name = "ValidationMethod"
+
+    def __call__(self, output, target) -> ValidationResult:
+        raise NotImplementedError
+
+    def __repr__(self):
+        return self.name
+
+
+class Top1Accuracy(ValidationMethod):
+    """optim/ValidationMethod.scala:170 — argmax vs 1-based labels."""
+
+    name = "Top1Accuracy"
+
+    def __call__(self, output, target):
+        out = np.asarray(output)
+        t = np.asarray(target).reshape(-1)
+        if out.ndim == 1:
+            out = out[None]
+        pred = out.argmax(axis=-1) + 1  # 1-based
+        correct = int((pred == t.astype(np.int64)).sum())
+        return AccuracyResult(correct, t.shape[0])
+
+
+class Top5Accuracy(ValidationMethod):
+    """optim/ValidationMethod.scala:218"""
+
+    name = "Top5Accuracy"
+
+    def __call__(self, output, target):
+        out = np.asarray(output)
+        t = np.asarray(target).reshape(-1).astype(np.int64)
+        if out.ndim == 1:
+            out = out[None]
+        top5 = np.argsort(-out, axis=-1)[:, :5] + 1
+        correct = int(sum(t[i] in top5[i] for i in range(t.shape[0])))
+        return AccuracyResult(correct, t.shape[0])
+
+
+class Loss(ValidationMethod):
+    """optim/ValidationMethod.scala:312 — average criterion loss."""
+
+    name = "Loss"
+
+    def __init__(self, criterion=None):
+        from bigdl_tpu.nn.criterion import ClassNLLCriterion
+        self.criterion = criterion or ClassNLLCriterion()
+
+    def __call__(self, output, target):
+        l = float(self.criterion.apply(output, target))
+        n = np.asarray(output).shape[0] if np.asarray(output).ndim > 1 else 1
+        return LossResult(l * n, n)
+
+
+class MAE(ValidationMethod):
+    """optim/ValidationMethod.scala:332 — mean absolute error."""
+
+    name = "MAE"
+
+    def __call__(self, output, target):
+        out = np.asarray(output)
+        t = np.asarray(target)
+        l = float(np.abs(out - t).mean())
+        n = out.shape[0] if out.ndim > 1 else 1
+        return LossResult(l * n, n)

@@ -1,0 +1,2 @@
+from bigdl_tpu.parallel.mesh import (
+    make_mesh, data_parallel_mesh, replicated, batch_sharded)

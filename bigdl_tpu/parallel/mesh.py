@@ -1,0 +1,40 @@
+"""Mesh & sharding helpers (replaces BigDL's AllReduceParameter topology).
+
+The reference's communication pattern (AllReduceParameter.scala:214-303) is
+reduce-scatter -> per-shard optimizer -> all-gather over Spark BlockManager.
+On TPU the same semantics are a single ``psum`` (or
+``psum_scatter``/``all_gather`` pair for ZeRO-1) over the ICI mesh; XLA
+inserts and schedules the collectives from sharding annotations.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(axis_sizes: Sequence[int], axis_names: Sequence[str],
+              devices=None) -> Mesh:
+    """Build a mesh from named axis sizes; devices default to all."""
+    devices = devices if devices is not None else jax.devices()
+    n = int(np.prod(axis_sizes))
+    if n != len(devices):
+        raise ValueError(f"mesh wants {n} devices, have {len(devices)}")
+    return Mesh(np.array(devices).reshape(tuple(axis_sizes)),
+                tuple(axis_names))
+
+
+def data_parallel_mesh(devices=None) -> Mesh:
+    """1-D `data` mesh over all devices — the AllReduceParameter analogue."""
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.array(devices), ("data",))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharded(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    return NamedSharding(mesh, P(axis))

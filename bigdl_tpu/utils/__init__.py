@@ -1,0 +1,6 @@
+from bigdl_tpu.utils.table import Table, T
+from bigdl_tpu.utils.random import RandomGenerator
+from bigdl_tpu.utils.directed_graph import Node, DirectedGraph
+from bigdl_tpu.utils.engine import Engine
+
+__all__ = ["Table", "T", "RandomGenerator", "Node", "DirectedGraph", "Engine"]

@@ -1,0 +1,48 @@
+"""Deterministic RNG for parameter init (BigDL utils/RandomGenerator.scala:56).
+
+BigDL uses a per-JVM Mersenne-Twister singleton seeded by the user; layer
+``reset()`` draws from it. Here the same role is played by a process-global
+seed that derives ``jax.random`` keys: functional code paths take explicit
+keys, while the stateful convenience API (``module.forward`` with lazy init)
+draws from this generator.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as np
+
+
+class RandomGenerator:
+    """Process-global seed registry + numpy MT19937 for host-side sampling."""
+
+    _lock = threading.Lock()
+    _seed = 1
+    _numpy = np.random.RandomState(1)
+    _counter = 0
+
+    @classmethod
+    def set_seed(cls, seed: int):
+        with cls._lock:
+            cls._seed = int(seed)
+            cls._numpy = np.random.RandomState(cls._seed & 0x7FFFFFFF)
+            cls._counter = 0
+        return cls
+
+    @classmethod
+    def get_seed(cls) -> int:
+        return cls._seed
+
+    @classmethod
+    def numpy(cls) -> np.random.RandomState:
+        """Host-side RNG (shuffles, data augmentation)."""
+        return cls._numpy
+
+    @classmethod
+    def next_key(cls) -> jax.Array:
+        """A fresh jax PRNG key; successive calls never repeat."""
+        with cls._lock:
+            cls._counter += 1
+            n = cls._counter
+        return jax.random.fold_in(jax.random.PRNGKey(cls._seed), n)

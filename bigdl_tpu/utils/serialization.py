@@ -1,0 +1,153 @@
+"""Checkpoint & model persistence (BigDL utils/serializer + utils/File.scala).
+
+Native format: a directory with ``spec.json`` (pytree structure + host state)
+and ``arrays.npz`` (flattened leaves). Readable without the framework; stable
+across processes. The reference's protobuf module format (ModuleSerializer)
+maps to ``save_module``/``load_module`` which additionally record the module
+class and constructor args for zoo models that register themselves.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+
+def _flatten_with_paths(tree):
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    out = {}
+    for path, leaf in flat:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                       for p in path)
+        out[key] = np.asarray(leaf)
+    return out, treedef
+
+
+def _tree_to_template(tree):
+    """JSON-able structure with leaf placeholders."""
+    if isinstance(tree, dict):
+        return {k: _tree_to_template(v) for k, v in sorted(tree.items())}
+    from bigdl_tpu.utils.table import Table
+    if isinstance(tree, Table):
+        return {"__table__": {str(k): _tree_to_template(v)
+                              for k, v in tree.items()}}
+    return "__leaf__"
+
+
+def _rebuild(template, arrays, prefix=""):
+    from bigdl_tpu.utils.table import Table
+    if template == "__leaf__":
+        return arrays[prefix.rstrip("/")]
+    if isinstance(template, dict) and "__table__" in template:
+        t = Table()
+        for k, v in template["__table__"].items():
+            key = int(k) if k.lstrip("-").isdigit() else k
+            t[key] = _rebuild(v, arrays, f"{prefix}{k}/")
+        return t
+    out = {}
+    for k, v in template.items():
+        out[k] = _rebuild(v, arrays, f"{prefix}{k}/")
+    return out
+
+
+def _flatten_leaves(tree, prefix=""):
+    from bigdl_tpu.utils.table import Table
+    out = {}
+    if isinstance(tree, Table):
+        for k, v in tree.items():
+            out.update(_flatten_leaves(v, f"{prefix}{k}/"))
+    elif isinstance(tree, dict):
+        for k, v in sorted(tree.items()):
+            out.update(_flatten_leaves(v, f"{prefix}{k}/"))
+    else:
+        out[prefix.rstrip("/")] = np.asarray(tree)
+    return out
+
+
+def save_tree(path_prefix: str, tree) -> None:
+    """Save a pytree as <prefix>.json + <prefix>.npz."""
+    arrays = _flatten_leaves(tree)
+    template = _tree_to_template(tree)
+    with open(path_prefix + ".json", "w") as f:
+        json.dump(template, f)
+    np.savez(path_prefix + ".npz", **arrays)
+
+
+def load_tree(path_prefix: str):
+    with open(path_prefix + ".json") as f:
+        template = json.load(f)
+    with np.load(path_prefix + ".npz") as z:
+        arrays = {k: z[k] for k in z.files}
+    return _rebuild(template, arrays)
+
+
+def save_checkpoint(path: str, *, params, opt_state, model_state,
+                    optim_host_state: Dict[str, Any],
+                    driver_state: Dict[str, Any]) -> None:
+    """Checkpoint a training run (DistriOptimizer.checkpoint :433-463)."""
+    os.makedirs(path, exist_ok=True)
+    save_tree(os.path.join(path, "params"), params)
+    save_tree(os.path.join(path, "opt_state"), opt_state)
+    save_tree(os.path.join(path, "model_state"), model_state)
+    host = {"optim_host_state": optim_host_state,
+            "driver_state": driver_state}
+    with open(os.path.join(path, "host_state.json"), "w") as f:
+        json.dump(host, f)
+
+
+def load_checkpoint(path: str) -> Dict[str, Any]:
+    with open(os.path.join(path, "host_state.json")) as f:
+        host = json.load(f)
+    return {
+        "params": load_tree(os.path.join(path, "params")),
+        "opt_state": load_tree(os.path.join(path, "opt_state")),
+        "model_state": load_tree(os.path.join(path, "model_state")),
+        "optim_host_state": host["optim_host_state"],
+        "driver_state": host["driver_state"],
+    }
+
+
+def find_latest_checkpoint(directory: str) -> Optional[str]:
+    """Latest ``checkpoint.N`` dir (DistriOptimizer.getLatestFile :867-880)."""
+    if not os.path.isdir(directory):
+        return None
+    best, best_n = None, -1
+    for name in os.listdir(directory):
+        full = os.path.join(directory, name)
+        if not os.path.isdir(full):
+            continue
+        if name == "checkpoint":
+            n = 0
+        else:
+            m = re.match(r"checkpoint\.(\d+)$", name)
+            if not m:
+                continue
+            n = int(m.group(1))
+        if n >= best_n and os.path.exists(
+                os.path.join(full, "host_state.json")):
+            best, best_n = full, n
+    return best
+
+
+# -- module-level save/load (ModuleSerializer analogue) ---------------------
+
+def save_module(path: str, module) -> None:
+    """Persist a module's params+state (+ name metadata)."""
+    os.makedirs(path, exist_ok=True)
+    module.ensure_initialized()
+    save_tree(os.path.join(path, "params"), module.get_parameters())
+    save_tree(os.path.join(path, "state"), module.get_state())
+    meta = {"class": type(module).__name__, "name": module.get_name()}
+    with open(os.path.join(path, "module.json"), "w") as f:
+        json.dump(meta, f)
+
+
+def load_module_weights(path: str, module):
+    """Load params/state saved by save_module into a compatible module."""
+    module.set_parameters(load_tree(os.path.join(path, "params")))
+    module.set_state(load_tree(os.path.join(path, "state")))
+    return module

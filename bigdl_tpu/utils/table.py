@@ -1,0 +1,134 @@
+"""Table — the heterogeneous activity container (BigDL utils/Table.scala:34).
+
+BigDL's ``Table`` is a Lua-style int/any-keyed map used wherever a module takes
+or returns multiple tensors (``Activity = Tensor | Table``). In a JAX-native
+design a Table is just a pytree node, so tables flow through ``jit``, ``grad``
+and shardings with no special handling.
+
+Keys follow BigDL's Lua convention: ``T(a, b, c)`` builds {1: a, 2: b, 3: c}
+(1-indexed), matching utils/Table.scala:318's ``T()`` constructor. String and
+other keys are allowed, as in the reference.
+"""
+from __future__ import annotations
+
+import jax
+
+
+class Table:
+    """An int/any-keyed container registered as a JAX pytree.
+
+    Mirrors BigDL ``utils.Table`` semantics: 1-indexed ``insert``/``apply``,
+    ``length`` counts consecutive integer keys from 1.
+    """
+
+    __slots__ = ("_state",)
+
+    def __init__(self, state=None):
+        object.__setattr__(self, "_state", dict(state) if state else {})
+
+    # -- dict-like surface -------------------------------------------------
+    def __getitem__(self, key):
+        return self._state[key]
+
+    def __setitem__(self, key, value):
+        self._state[key] = value
+
+    def __contains__(self, key):
+        return key in self._state
+
+    def __delitem__(self, key):
+        del self._state[key]
+
+    def get(self, key, default=None):
+        return self._state.get(key, default)
+
+    def keys(self):
+        return self._state.keys()
+
+    def values(self):
+        return self._state.values()
+
+    def items(self):
+        return self._state.items()
+
+    def __iter__(self):
+        # iterate positional entries 1..length (Lua array part)
+        for i in range(1, self.length() + 1):
+            yield self._state[i]
+
+    def __len__(self):
+        return self.length()
+
+    def length(self):
+        """Number of consecutive int keys starting at 1 (Table.scala:120)."""
+        n = 0
+        while (n + 1) in self._state:
+            n += 1
+        return n
+
+    def insert(self, value):
+        """Append at the end of the array part (Table.scala:151)."""
+        self._state[self.length() + 1] = value
+        return self
+
+    def remove(self, index=None):
+        if index is None:
+            index = self.length()
+        if index not in self._state:
+            return None
+        value = self._state.pop(index)
+        # shift down the array part above `index`
+        i = index
+        while (i + 1) in self._state:
+            self._state[i] = self._state.pop(i + 1)
+            i += 1
+        return value
+
+    def update(self, other):
+        if isinstance(other, Table):
+            other = other._state
+        self._state.update(other)
+        return self
+
+    def to_dict(self):
+        return dict(self._state)
+
+    def to_list(self):
+        return [self._state[i] for i in range(1, self.length() + 1)]
+
+    def __eq__(self, other):
+        if isinstance(other, Table):
+            return self._state == other._state
+        return NotImplemented
+
+    def __hash__(self):
+        return object.__hash__(self)
+
+    def __repr__(self):
+        inner = ", ".join(f"{k!r}: {v!r}" for k, v in self._state.items())
+        return f"T({{{inner}}})"
+
+
+def T(*args, **kwargs):
+    """Table constructor mirroring BigDL's ``T()`` (utils/Table.scala:318).
+
+    ``T(a, b)`` -> {1: a, 2: b}; ``T(k=v)`` adds string keys.
+    """
+    t = Table()
+    for i, a in enumerate(args):
+        t[i + 1] = a
+    for k, v in kwargs.items():
+        t[k] = v
+    return t
+
+
+def _table_flatten(t: Table):
+    keys = sorted(t._state.keys(), key=lambda k: (str(type(k)), str(k)))
+    return [t._state[k] for k in keys], tuple(keys)
+
+
+def _table_unflatten(keys, children):
+    return Table(dict(zip(keys, children)))
+
+
+jax.tree_util.register_pytree_node(Table, _table_flatten, _table_unflatten)

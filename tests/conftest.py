@@ -1,0 +1,29 @@
+"""Test config: force an 8-device virtual CPU platform BEFORE jax imports,
+so sharding/mesh tests run anywhere (SURVEY.md §4 — the reference simulates
+multi-node with multiple partitions in one JVM; we simulate a pod with
+virtual CPU devices)."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The container's sitecustomize imports jax (registering the TPU/axon
+# backend) before this file runs, so env vars alone are too late; force the
+# platform through the live config instead.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    from bigdl_tpu.utils.random import RandomGenerator
+    RandomGenerator.set_seed(42)
+    np.random.seed(42)
+    yield

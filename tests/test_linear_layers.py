@@ -1,0 +1,116 @@
+"""Golden tests for linear-algebra layers against numpy/torch references
+(the reference's torch/ golden-spec strategy, SURVEY.md §4.2)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.utils.table import T
+
+
+def test_linear_forward_matches_numpy():
+    layer = nn.Linear(5, 3)
+    x = np.random.randn(4, 5).astype(np.float32)
+    out = np.asarray(layer.forward(x))
+    p = layer.get_parameters()
+    expect = x @ np.asarray(p["weight"]).T + np.asarray(p["bias"])
+    np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+
+def test_linear_matches_torch():
+    torch = pytest.importorskip("torch")
+    layer = nn.Linear(6, 4)
+    x = np.random.randn(3, 6).astype(np.float32)
+    out = np.asarray(layer.forward(x))
+    p = layer.get_parameters()
+    tl = torch.nn.Linear(6, 4)
+    with torch.no_grad():
+        tl.weight.copy_(torch.from_numpy(np.asarray(p["weight"])))
+        tl.bias.copy_(torch.from_numpy(np.asarray(p["bias"])))
+        expect = tl(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_linear_backward_gradinput():
+    layer = nn.Linear(5, 3)
+    x = np.random.randn(4, 5).astype(np.float32)
+    layer.forward(x)
+    grad_out = np.ones((4, 3), np.float32)
+    grad_in = np.asarray(layer.backward(x, grad_out))
+    p = layer.get_parameters()
+    expect = grad_out @ np.asarray(p["weight"])
+    np.testing.assert_allclose(grad_in, expect, rtol=1e-5)
+    # accumulated param grads
+    g = layer.get_grad_parameters()
+    np.testing.assert_allclose(np.asarray(g["bias"]), grad_out.sum(0),
+                               rtol=1e-5)
+
+
+def test_bilinear():
+    layer = nn.Bilinear(3, 4, 2)
+    x1 = np.random.randn(5, 3).astype(np.float32)
+    x2 = np.random.randn(5, 4).astype(np.float32)
+    out = np.asarray(layer.forward(T(x1, x2)))
+    p = layer.get_parameters()
+    w, b = np.asarray(p["weight"]), np.asarray(p["bias"])
+    expect = np.einsum("bi,kij,bj->bk", x1, w, x2) + b
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_cmul_cadd_broadcast():
+    cmul = nn.CMul((1, 4))
+    x = np.random.randn(2, 4).astype(np.float32)
+    out = np.asarray(cmul.forward(x))
+    w = np.asarray(cmul.get_parameters()["weight"])
+    np.testing.assert_allclose(out, x * w, rtol=1e-6)
+
+    cadd = nn.CAdd((1, 4))
+    out2 = np.asarray(cadd.forward(x))
+    b = np.asarray(cadd.get_parameters()["bias"])
+    np.testing.assert_allclose(out2, x + b, rtol=1e-6)
+
+
+def test_mm_mv_dot():
+    a = np.random.randn(2, 3, 4).astype(np.float32)
+    b = np.random.randn(2, 4, 5).astype(np.float32)
+    out = np.asarray(nn.MM().forward(T(a, b)))
+    np.testing.assert_allclose(out, a @ b, rtol=1e-5)
+
+    m = np.random.randn(2, 3, 4).astype(np.float32)
+    v = np.random.randn(2, 4).astype(np.float32)
+    out = np.asarray(nn.MV().forward(T(m, v)))
+    np.testing.assert_allclose(out, np.einsum("bij,bj->bi", m, v), rtol=1e-5)
+
+    x = np.random.randn(4, 7).astype(np.float32)
+    y = np.random.randn(4, 7).astype(np.float32)
+    out = np.asarray(nn.DotProduct().forward(T(x, y)))
+    np.testing.assert_allclose(out, (x * y).sum(-1), rtol=1e-5)
+
+
+def test_cosine_distance_pairwise():
+    x = np.random.randn(4, 7).astype(np.float32)
+    y = np.random.randn(4, 7).astype(np.float32)
+    out = np.asarray(nn.CosineDistance().forward(T(x, y)))
+    expect = (x * y).sum(-1) / (np.linalg.norm(x, axis=-1)
+                                * np.linalg.norm(y, axis=-1))
+    np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+    out2 = np.asarray(nn.PairwiseDistance(2).forward(T(x, y)))
+    np.testing.assert_allclose(out2, np.linalg.norm(x - y, axis=-1),
+                               rtol=1e-5)
+
+
+def test_mul_add_constants():
+    x = np.random.randn(3, 3).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(nn.MulConstant(2.5).forward(x)), x * 2.5, rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(nn.AddConstant(1.5).forward(x)), x + 1.5, rtol=1e-6)
+
+
+def test_freeze_scales():
+    layer = nn.Linear(5, 3).freeze()
+    layer.ensure_initialized()
+    scales = layer.param_scales(layer.get_parameters())
+    assert all(s == 0.0 for s in np.asarray(
+        [scales["weight"], scales["bias"]], dtype=object).ravel())

@@ -1,0 +1,45 @@
+"""Table pytree semantics (reference: utils/TableSpec)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.utils.table import Table, T
+
+
+def test_t_constructor_positional():
+    t = T(1, 2, 3)
+    assert t[1] == 1 and t[3] == 3
+    assert t.length() == 3
+    assert list(t) == [1, 2, 3]
+
+
+def test_insert_remove():
+    t = T("a", "b")
+    t.insert("c")
+    assert t.length() == 3
+    assert t.remove(2) == "b"
+    assert t.to_list() == ["a", "c"]
+
+
+def test_table_is_pytree():
+    t = T(jnp.ones((2,)), jnp.zeros((3,)))
+    leaves = jax.tree.leaves(t)
+    assert len(leaves) == 2
+    doubled = jax.tree.map(lambda x: x * 2, t)
+    assert isinstance(doubled, Table)
+    np.testing.assert_allclose(doubled[1], 2 * np.ones((2,)))
+
+
+def test_table_through_jit():
+    @jax.jit
+    def f(t):
+        return t[1] + t[2]
+
+    out = f(T(jnp.ones((4,)), 2 * jnp.ones((4,))))
+    np.testing.assert_allclose(out, 3 * np.ones((4,)))
+
+
+def test_string_keys():
+    t = T(1, 2, foo="bar")
+    assert t["foo"] == "bar"
+    assert t.length() == 2
