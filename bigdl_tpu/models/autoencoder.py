@@ -1,0 +1,18 @@
+"""MNIST autoencoder (reference: models/autoencoder/Autoencoder.scala)."""
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+
+ROW_N = 28
+COL_N = 28
+FEATURE_SIZE = ROW_N * COL_N
+
+
+def Autoencoder(class_num: int = 32) -> nn.Sequential:
+    m = nn.Sequential()
+    m.add(nn.Reshape((FEATURE_SIZE,)))
+    m.add(nn.Linear(FEATURE_SIZE, class_num))
+    m.add(nn.ReLU())
+    m.add(nn.Linear(class_num, FEATURE_SIZE))
+    m.add(nn.Sigmoid())
+    return m

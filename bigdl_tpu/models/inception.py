@@ -1,0 +1,184 @@
+"""GoogLeNet / Inception v1 (reference: models/inception/Inception_v1.scala;
+BASELINE config 4 loads this topology from Caffe)."""
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.initialization import Xavier, Zeros
+from bigdl_tpu.utils.table import T
+
+
+def _conv(cin, cout, kw, kh, sw=1, sh=1, pw=0, ph=0, name=None):
+    c = nn.SpatialConvolution(cin, cout, kw, kh, sw, sh, pw, ph,
+                              init_weight=Xavier(), init_bias=Zeros())
+    if name:
+        c.set_name(name)
+    return c
+
+
+def Inception_Layer_v1(input_size: int, config, name_prefix: str = ""
+                       ) -> nn.Concat:
+    """Inception block (Inception_v1.scala:26-63): 1x1 / 3x3 / 5x5 / pool-proj
+    branches concatenated on the channel dim."""
+    concat = nn.Concat(2)
+    conv1 = nn.Sequential()
+    conv1.add(_conv(input_size, config[1][1], 1, 1, name=name_prefix + "1x1"))
+    conv1.add(nn.ReLU(True))
+    concat.add(conv1)
+    conv3 = nn.Sequential()
+    conv3.add(_conv(input_size, config[2][1], 1, 1,
+                    name=name_prefix + "3x3_reduce"))
+    conv3.add(nn.ReLU(True))
+    conv3.add(_conv(config[2][1], config[2][2], 3, 3, 1, 1, 1, 1,
+                    name=name_prefix + "3x3"))
+    conv3.add(nn.ReLU(True))
+    concat.add(conv3)
+    conv5 = nn.Sequential()
+    conv5.add(_conv(input_size, config[3][1], 1, 1,
+                    name=name_prefix + "5x5_reduce"))
+    conv5.add(nn.ReLU(True))
+    conv5.add(_conv(config[3][1], config[3][2], 5, 5, 1, 1, 2, 2,
+                    name=name_prefix + "5x5"))
+    conv5.add(nn.ReLU(True))
+    concat.add(conv5)
+    pool = nn.Sequential()
+    pool.add(nn.SpatialMaxPooling(3, 3, 1, 1, 1, 1).ceil())
+    pool.add(_conv(input_size, config[4][1], 1, 1,
+                   name=name_prefix + "pool_proj"))
+    pool.add(nn.ReLU(True))
+    concat.add(pool)
+    concat.set_name(name_prefix + "output")
+    return concat
+
+
+def Inception_v1_NoAuxClassifier(class_num: int = 1000,
+                                 has_dropout: bool = True) -> nn.Sequential:
+    """Inception_v1.scala:97-132."""
+    m = nn.Sequential()
+    m.add(_conv(3, 64, 7, 7, 2, 2, 3, 3, name="conv1/7x7_s2"))
+    m.add(nn.ReLU(True))
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+    m.add(nn.SpatialCrossMapLRN(5, 0.0001, 0.75))
+    m.add(_conv(64, 64, 1, 1, name="conv2/3x3_reduce"))
+    m.add(nn.ReLU(True))
+    m.add(_conv(64, 192, 3, 3, 1, 1, 1, 1, name="conv2/3x3"))
+    m.add(nn.ReLU(True))
+    m.add(nn.SpatialCrossMapLRN(5, 0.0001, 0.75))
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+    m.add(Inception_Layer_v1(192, T(T(64), T(96, 128), T(16, 32), T(32)),
+                             "inception_3a/"))
+    m.add(Inception_Layer_v1(256, T(T(128), T(128, 192), T(32, 96), T(64)),
+                             "inception_3b/"))
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+    m.add(Inception_Layer_v1(480, T(T(192), T(96, 208), T(16, 48), T(64)),
+                             "inception_4a/"))
+    m.add(Inception_Layer_v1(512, T(T(160), T(112, 224), T(24, 64), T(64)),
+                             "inception_4b/"))
+    m.add(Inception_Layer_v1(512, T(T(128), T(128, 256), T(24, 64), T(64)),
+                             "inception_4c/"))
+    m.add(Inception_Layer_v1(512, T(T(112), T(144, 288), T(32, 64), T(64)),
+                             "inception_4d/"))
+    m.add(Inception_Layer_v1(528, T(T(256), T(160, 320), T(32, 128), T(128)),
+                             "inception_4e/"))
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+    m.add(Inception_Layer_v1(832, T(T(256), T(160, 320), T(32, 128), T(128)),
+                             "inception_5a/"))
+    m.add(Inception_Layer_v1(832, T(T(384), T(192, 384), T(48, 128), T(128)),
+                             "inception_5b/"))
+    m.add(nn.SpatialAveragePooling(7, 7, 1, 1))
+    if has_dropout:
+        m.add(nn.Dropout(0.4))
+    m.add(nn.View(1024).set_num_input_dims(3))
+    m.add(nn.Linear(1024, class_num, init_weight=Xavier(),
+                    init_bias=Zeros()).set_name("loss3/classifier"))
+    m.add(nn.LogSoftMax())
+    return m
+
+
+def Inception_v1(class_num: int = 1000, has_dropout: bool = True
+                 ) -> nn.Sequential:
+    """Full GoogLeNet with the two auxiliary classifier heads
+    (Inception_v1.scala:181-268). Output is the channel-concat of
+    [main, aux2, aux1] heads like the reference's nested Concat."""
+    feature1 = nn.Sequential()
+    feature1.add(_conv(3, 64, 7, 7, 2, 2, 3, 3, name="conv1/7x7_s2"))
+    feature1.add(nn.ReLU(True))
+    feature1.add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+    feature1.add(nn.SpatialCrossMapLRN(5, 0.0001, 0.75))
+    feature1.add(_conv(64, 64, 1, 1, name="conv2/3x3_reduce"))
+    feature1.add(nn.ReLU(True))
+    feature1.add(_conv(64, 192, 3, 3, 1, 1, 1, 1, name="conv2/3x3"))
+    feature1.add(nn.ReLU(True))
+    feature1.add(nn.SpatialCrossMapLRN(5, 0.0001, 0.75))
+    feature1.add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+    feature1.add(Inception_Layer_v1(
+        192, T(T(64), T(96, 128), T(16, 32), T(32)), "inception_3a/"))
+    feature1.add(Inception_Layer_v1(
+        256, T(T(128), T(128, 192), T(32, 96), T(64)), "inception_3b/"))
+    feature1.add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+    feature1.add(Inception_Layer_v1(
+        480, T(T(192), T(96, 208), T(16, 48), T(64)), "inception_4a/"))
+
+    output1 = nn.Sequential()
+    output1.add(nn.SpatialAveragePooling(5, 5, 3, 3, ceil_mode=True))
+    output1.add(_conv(512, 128, 1, 1, name="loss1/conv"))
+    output1.add(nn.ReLU(True))
+    output1.add(nn.View(128 * 4 * 4).set_num_input_dims(3))
+    output1.add(nn.Linear(128 * 4 * 4, 1024).set_name("loss1/fc"))
+    output1.add(nn.ReLU(True))
+    if has_dropout:
+        output1.add(nn.Dropout(0.7))
+    output1.add(nn.Linear(1024, class_num).set_name("loss1/classifier"))
+    output1.add(nn.LogSoftMax())
+
+    feature2 = nn.Sequential()
+    feature2.add(Inception_Layer_v1(
+        512, T(T(160), T(112, 224), T(24, 64), T(64)), "inception_4b/"))
+    feature2.add(Inception_Layer_v1(
+        512, T(T(128), T(128, 256), T(24, 64), T(64)), "inception_4c/"))
+    feature2.add(Inception_Layer_v1(
+        512, T(T(112), T(144, 288), T(32, 64), T(64)), "inception_4d/"))
+
+    output2 = nn.Sequential()
+    output2.add(nn.SpatialAveragePooling(5, 5, 3, 3))
+    output2.add(_conv(528, 128, 1, 1, name="loss2/conv"))
+    output2.add(nn.ReLU(True))
+    output2.add(nn.View(128 * 4 * 4).set_num_input_dims(3))
+    output2.add(nn.Linear(128 * 4 * 4, 1024).set_name("loss2/fc"))
+    output2.add(nn.ReLU(True))
+    if has_dropout:
+        output2.add(nn.Dropout(0.7))
+    output2.add(nn.Linear(1024, class_num).set_name("loss2/classifier"))
+    output2.add(nn.LogSoftMax())
+
+    output3 = nn.Sequential()
+    output3.add(Inception_Layer_v1(
+        528, T(T(256), T(160, 320), T(32, 128), T(128)), "inception_4e/"))
+    output3.add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+    output3.add(Inception_Layer_v1(
+        832, T(T(256), T(160, 320), T(32, 128), T(128)), "inception_5a/"))
+    output3.add(Inception_Layer_v1(
+        832, T(T(384), T(192, 384), T(48, 128), T(128)), "inception_5b/"))
+    output3.add(nn.SpatialAveragePooling(7, 7, 1, 1))
+    if has_dropout:
+        output3.add(nn.Dropout(0.4))
+    output3.add(nn.View(1024).set_num_input_dims(3))
+    output3.add(nn.Linear(1024, class_num, init_weight=Xavier(),
+                          init_bias=Zeros()).set_name("loss3/classifier"))
+    output3.add(nn.LogSoftMax())
+
+    split2 = nn.Concat(2).set_name("split2")
+    split2.add(output3)
+    split2.add(output2)
+
+    main_branch = nn.Sequential()
+    main_branch.add(feature2)
+    main_branch.add(split2)
+
+    split1 = nn.Concat(2).set_name("split1")
+    split1.add(main_branch)
+    split1.add(output1)
+
+    model = nn.Sequential()
+    model.add(feature1)
+    model.add(split1)
+    return model

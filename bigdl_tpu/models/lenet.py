@@ -1,0 +1,40 @@
+"""LeNet-5 (reference: models/lenet/LeNet5.scala:23 seq, :39 graph)."""
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+
+
+def LeNet5(class_num: int = 10) -> nn.Sequential:
+    """Sequential LeNet-5 exactly mirroring LeNet5.scala:23-37."""
+    model = nn.Sequential()
+    model.add(nn.Reshape((1, 28, 28))) \
+        .add(nn.SpatialConvolution(1, 6, 5, 5).set_name("conv1_5x5")) \
+        .add(nn.Tanh()) \
+        .add(nn.SpatialMaxPooling(2, 2, 2, 2)) \
+        .add(nn.Tanh()) \
+        .add(nn.SpatialConvolution(6, 12, 5, 5).set_name("conv2_5x5")) \
+        .add(nn.SpatialMaxPooling(2, 2, 2, 2)) \
+        .add(nn.Reshape((12 * 4 * 4,))) \
+        .add(nn.Linear(12 * 4 * 4, 100).set_name("fc1")) \
+        .add(nn.Tanh()) \
+        .add(nn.Linear(100, class_num).set_name("fc2")) \
+        .add(nn.LogSoftMax())
+    return model
+
+
+def LeNet5_graph(class_num: int = 10) -> nn.Graph:
+    """Graph-API variant (LeNet5.scala:39-53)."""
+    inp = nn.Input()()
+    x = nn.Reshape((1, 28, 28))(inp)
+    x = nn.SpatialConvolution(1, 6, 5, 5).set_name("conv1_5x5")(x)
+    x = nn.Tanh()(x)
+    x = nn.SpatialMaxPooling(2, 2, 2, 2)(x)
+    x = nn.Tanh()(x)
+    x = nn.SpatialConvolution(6, 12, 5, 5).set_name("conv2_5x5")(x)
+    x = nn.SpatialMaxPooling(2, 2, 2, 2)(x)
+    x = nn.Reshape((12 * 4 * 4,))(x)
+    x = nn.Linear(12 * 4 * 4, 100).set_name("fc1")(x)
+    x = nn.Tanh()(x)
+    x = nn.Linear(100, class_num).set_name("fc2")(x)
+    out = nn.LogSoftMax()(x)
+    return nn.Graph(inp, out)

@@ -1,0 +1,140 @@
+"""ResNet for CIFAR-10 / ImageNet (reference: models/resnet/ResNet.scala:133).
+
+Supports depths 20/32/44/56/110 (CIFAR) and 18/34/50/101/152/200 (ImageNet),
+shortcut types A/B/C, MSRA init (ResNet.modelInit, ResNet.scala:103-131).
+The reference's optnet buffer sharing (shareGradInput) is XLA's job here.
+"""
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.initialization import MsraFiller, Zeros, Ones
+
+
+class ShortcutType:
+    A = "A"
+    B = "B"
+    C = "C"
+
+
+class DatasetType:
+    CIFAR10 = "CIFAR10"
+    ImageNet = "ImageNet"
+
+
+def _conv(cin, cout, kw, kh, sw=1, sh=1, pw=0, ph=0):
+    c = nn.SpatialConvolution(cin, cout, kw, kh, sw, sh, pw, ph)
+    # MSRA init, zero bias (ResNet.modelInit)
+    c.set_init_method(MsraFiller(var_in_count=False), Zeros())
+    return c
+
+
+def _bn(n):
+    # modelInit: gamma=1, beta=0 (ResNet.scala:120-124)
+    return nn.SpatialBatchNormalization(n, init_weight=Ones(),
+                                        init_bias=Zeros())
+
+
+class _State:
+    def __init__(self):
+        self.i_channels = 0
+
+
+def ResNet(class_num: int, depth: int = 18,
+           shortcut_type: str = ShortcutType.B,
+           dataset: str = DatasetType.CIFAR10) -> nn.Sequential:
+    st = _State()
+
+    def shortcut(n_in, n_out, stride):
+        use_conv = shortcut_type == ShortcutType.C or (
+            shortcut_type == ShortcutType.B and n_in != n_out)
+        if use_conv:
+            return nn.Sequential() \
+                .add(_conv(n_in, n_out, 1, 1, stride, stride)) \
+                .add(_bn(n_out))
+        elif n_in != n_out:
+            # type A: stride subsample + zero-pad channels via Concat
+            return nn.Sequential() \
+                .add(nn.SpatialAveragePooling(1, 1, stride, stride)) \
+                .add(nn.Concat(2)
+                     .add(nn.Identity())
+                     .add(nn.MulConstant(0.0)))
+        return nn.Identity()
+
+    def basic_block(n, stride):
+        n_in = st.i_channels
+        st.i_channels = n
+        s = nn.Sequential()
+        s.add(_conv(n_in, n, 3, 3, stride, stride, 1, 1))
+        s.add(_bn(n))
+        s.add(nn.ReLU(True))
+        s.add(_conv(n, n, 3, 3, 1, 1, 1, 1))
+        s.add(_bn(n))
+        return nn.Sequential() \
+            .add(nn.ConcatTable().add(s).add(shortcut(n_in, n, stride))) \
+            .add(nn.CAddTable(True)) \
+            .add(nn.ReLU(True))
+
+    def bottleneck(n, stride):
+        n_in = st.i_channels
+        st.i_channels = n * 4
+        s = nn.Sequential()
+        s.add(_conv(n_in, n, 1, 1, 1, 1, 0, 0)) \
+            .add(_bn(n)) \
+            .add(nn.ReLU(True)) \
+            .add(_conv(n, n, 3, 3, stride, stride, 1, 1)) \
+            .add(_bn(n)) \
+            .add(nn.ReLU(True)) \
+            .add(_conv(n, n * 4, 1, 1, 1, 1, 0, 0)) \
+            .add(_bn(n * 4))
+        return nn.Sequential() \
+            .add(nn.ConcatTable().add(s).add(shortcut(n_in, n * 4, stride))) \
+            .add(nn.CAddTable(True)) \
+            .add(nn.ReLU(True))
+
+    def layer(block, features, count, stride=1):
+        s = nn.Sequential()
+        for i in range(count):
+            s.add(block(features, stride if i == 0 else 1))
+        return s
+
+    model = nn.Sequential()
+    if dataset == DatasetType.ImageNet:
+        cfg = {18: ((2, 2, 2, 2), 512, basic_block),
+               34: ((3, 4, 6, 3), 512, basic_block),
+               50: ((3, 4, 6, 3), 2048, bottleneck),
+               101: ((3, 4, 23, 3), 2048, bottleneck),
+               152: ((3, 8, 36, 3), 2048, bottleneck),
+               200: ((3, 24, 36, 3), 2048, bottleneck)}
+        if depth not in cfg:
+            raise ValueError(f"Invalid depth {depth}")
+        loop, n_features, block = cfg[depth]
+        st.i_channels = 64
+        model.add(_conv(3, 64, 7, 7, 2, 2, 3, 3)) \
+            .add(_bn(64)) \
+            .add(nn.ReLU(True)) \
+            .add(nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1)) \
+            .add(layer(block, 64, loop[0])) \
+            .add(layer(block, 128, loop[1], 2)) \
+            .add(layer(block, 256, loop[2], 2)) \
+            .add(layer(block, 512, loop[3], 2)) \
+            .add(nn.SpatialAveragePooling(7, 7, 1, 1)) \
+            .add(nn.View(n_features).set_num_input_dims(3)) \
+            .add(nn.Linear(n_features, class_num,
+                           init_bias=Zeros()))
+    elif dataset == DatasetType.CIFAR10:
+        if (depth - 2) % 6 != 0:
+            raise ValueError("depth should be one of 20, 32, 44, 56, 110")
+        n = (depth - 2) // 6
+        st.i_channels = 16
+        model.add(_conv(3, 16, 3, 3, 1, 1, 1, 1)) \
+            .add(_bn(16)) \
+            .add(nn.ReLU(True)) \
+            .add(layer(basic_block, 16, n)) \
+            .add(layer(basic_block, 32, n, 2)) \
+            .add(layer(basic_block, 64, n, 2)) \
+            .add(nn.SpatialAveragePooling(8, 8, 1, 1)) \
+            .add(nn.View(64).set_num_input_dims(3)) \
+            .add(nn.Linear(64, 10, init_bias=Zeros()))
+    else:
+        raise ValueError(f"unknown dataset {dataset}")
+    return model

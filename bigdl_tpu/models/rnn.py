@@ -1,0 +1,29 @@
+"""RNN language models (reference: models/rnn/SimpleRNN.scala and
+example/languagemodel/PTBModel.scala; BASELINE config 5)."""
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+
+
+def SimpleRNN(input_size: int, hidden_size: int, output_size: int
+              ) -> nn.Sequential:
+    """SimpleRNN.scala:22-34: Recurrent(RnnCell) + TimeDistributed(Linear)."""
+    m = nn.Sequential()
+    m.add(nn.Recurrent(nn.RnnCell(input_size, hidden_size, nn.Tanh())))
+    m.add(nn.TimeDistributed(nn.Linear(hidden_size, output_size)))
+    return m
+
+
+def PTBModel(input_size: int, hidden_size: int, output_size: int,
+             num_layers: int = 2, keep_prob: float = 2.0) -> nn.Sequential:
+    """PTBModel.scala:23-45: embedding -> (dropout) -> stacked LSTM ->
+    TimeDistributed(Linear). Built as a Sequential (the traced graph is
+    identical to the reference's Graph form)."""
+    m = nn.Sequential()
+    m.add(nn.LookupTable(input_size, hidden_size))
+    if keep_prob < 1:
+        m.add(nn.Dropout(keep_prob))
+    for _ in range(num_layers):
+        m.add(nn.Recurrent(nn.LSTM(hidden_size, hidden_size, 0.0)))
+    m.add(nn.TimeDistributed(nn.Linear(hidden_size, output_size)))
+    return m

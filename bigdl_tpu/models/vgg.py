@@ -1,0 +1,104 @@
+"""VGG models (reference: models/vgg/VggForCifar10.scala, Vgg_16/19 in
+models/vgg — conv-BN-ReLU stacks; BASELINE config 2)."""
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+
+
+def VggForCifar10(class_num: int = 10, has_dropout: bool = True
+                  ) -> nn.Sequential:
+    """VggForCifar10.scala:24-78."""
+    m = nn.Sequential()
+
+    def conv_bn_relu(cin, cout):
+        m.add(nn.SpatialConvolution(cin, cout, 3, 3, 1, 1, 1, 1))
+        m.add(nn.SpatialBatchNormalization(cout, 1e-3))
+        m.add(nn.ReLU(True))
+
+    conv_bn_relu(3, 64)
+    if has_dropout:
+        m.add(nn.Dropout(0.3))
+    conv_bn_relu(64, 64)
+    m.add(nn.SpatialMaxPooling(2, 2, 2, 2).ceil())
+
+    conv_bn_relu(64, 128)
+    if has_dropout:
+        m.add(nn.Dropout(0.4))
+    conv_bn_relu(128, 128)
+    m.add(nn.SpatialMaxPooling(2, 2, 2, 2).ceil())
+
+    conv_bn_relu(128, 256)
+    if has_dropout:
+        m.add(nn.Dropout(0.4))
+    conv_bn_relu(256, 256)
+    if has_dropout:
+        m.add(nn.Dropout(0.4))
+    conv_bn_relu(256, 256)
+    m.add(nn.SpatialMaxPooling(2, 2, 2, 2).ceil())
+
+    conv_bn_relu(256, 512)
+    if has_dropout:
+        m.add(nn.Dropout(0.4))
+    conv_bn_relu(512, 512)
+    if has_dropout:
+        m.add(nn.Dropout(0.4))
+    conv_bn_relu(512, 512)
+    m.add(nn.SpatialMaxPooling(2, 2, 2, 2).ceil())
+
+    conv_bn_relu(512, 512)
+    if has_dropout:
+        m.add(nn.Dropout(0.4))
+    conv_bn_relu(512, 512)
+    if has_dropout:
+        m.add(nn.Dropout(0.4))
+    conv_bn_relu(512, 512)
+    m.add(nn.SpatialMaxPooling(2, 2, 2, 2).ceil())
+    m.add(nn.View(512))
+
+    classifier = nn.Sequential()
+    if has_dropout:
+        classifier.add(nn.Dropout(0.5))
+    classifier.add(nn.Linear(512, 512))
+    classifier.add(nn.BatchNormalization(512))
+    classifier.add(nn.ReLU(True))
+    if has_dropout:
+        classifier.add(nn.Dropout(0.5))
+    classifier.add(nn.Linear(512, class_num))
+    classifier.add(nn.LogSoftMax())
+    m.add(classifier)
+    return m
+
+
+def _vgg_blocks(cfg, class_num):
+    """Plain VGG-16/19 for 224x224 ImageNet (models/vgg in reference zoo /
+    DistriOptimizerPerf's vgg16/vgg19)."""
+    m = nn.Sequential()
+    cin = 3
+    for v in cfg:
+        if v == "M":
+            m.add(nn.SpatialMaxPooling(2, 2, 2, 2))
+        else:
+            m.add(nn.SpatialConvolution(cin, v, 3, 3, 1, 1, 1, 1))
+            m.add(nn.ReLU(True))
+            cin = v
+    m.add(nn.View(512 * 7 * 7))
+    m.add(nn.Linear(512 * 7 * 7, 4096))
+    m.add(nn.Threshold(0, 1e-6))
+    m.add(nn.Dropout(0.5))
+    m.add(nn.Linear(4096, 4096))
+    m.add(nn.Threshold(0, 1e-6))
+    m.add(nn.Dropout(0.5))
+    m.add(nn.Linear(4096, class_num))
+    m.add(nn.LogSoftMax())
+    return m
+
+
+def Vgg_16(class_num: int = 1000) -> nn.Sequential:
+    return _vgg_blocks([64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+                        512, 512, 512, "M", 512, 512, 512, "M"], class_num)
+
+
+def Vgg_19(class_num: int = 1000) -> nn.Sequential:
+    return _vgg_blocks([64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+                        512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
+                       class_num)

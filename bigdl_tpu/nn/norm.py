@@ -25,20 +25,38 @@ class BatchNormalization(Module):
     _feature_axes = (0,)  # axes reduced over; feature dim is 1
 
     def __init__(self, n_output: int, eps: float = 1e-5,
-                 momentum: float = 0.1, affine: bool = True):
+                 momentum: float = 0.1, affine: bool = True,
+                 init_weight=None, init_bias=None):
         super().__init__()
         self.n_output = n_output
         self.eps = eps
         self.momentum = momentum
         self.affine = affine
+        self.weight_init = init_weight
+        self.bias_init = init_bias
+
+    def set_init_method(self, weight_init=None, bias_init=None):
+        if weight_init is not None:
+            self.weight_init = weight_init
+        if bias_init is not None:
+            self.bias_init = bias_init
+        return self
 
     def init(self, rng):
         if not self.affine:
             return {}
         dtype = Engine.default_dtype()
-        # reference init: weight ~ U(0,1), bias = 0 (BatchNormalization.reset)
-        return {"weight": jax.random.uniform(rng, (self.n_output,), dtype),
-                "bias": jnp.zeros((self.n_output,), dtype)}
+        n = self.n_output
+        if self.weight_init is not None:
+            w = self.weight_init(rng, (n,), n, n, dtype)
+        else:
+            # reference init: weight ~ U(0,1), bias = 0 (BatchNormalization.reset)
+            w = jax.random.uniform(rng, (n,), dtype)
+        if self.bias_init is not None:
+            b = self.bias_init(rng, (n,), n, n, dtype)
+        else:
+            b = jnp.zeros((n,), dtype)
+        return {"weight": w, "bias": b}
 
     def initial_state(self):
         dtype = Engine.default_dtype()

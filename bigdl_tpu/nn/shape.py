@@ -73,6 +73,8 @@ class View(Module):
         n = 1
         for s in self.sizes:
             n *= s
+        if self.num_input_dims > 0 and input.ndim > self.num_input_dims:
+            return input.reshape((input.shape[0],) + self.sizes)
         if input.size == n:
             return input.reshape(self.sizes)
         return input.reshape((input.shape[0],) + self.sizes)

@@ -1,0 +1,172 @@
+"""Golden tests for conv/pool/norm against torch (reference torch/ specs)."""
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+
+torch = pytest.importorskip("torch")
+
+
+def test_spatial_convolution_matches_torch():
+    layer = nn.SpatialConvolution(3, 8, 3, 3, 2, 2, 1, 1)
+    x = np.random.randn(2, 3, 9, 9).astype(np.float32)
+    out = np.asarray(layer.forward(x))
+    p = layer.get_parameters()
+    tconv = torch.nn.Conv2d(3, 8, 3, stride=2, padding=1)
+    with torch.no_grad():
+        tconv.weight.copy_(torch.from_numpy(np.asarray(p["weight"]).copy()))
+        tconv.bias.copy_(torch.from_numpy(np.asarray(p["bias"]).copy()))
+        expect = tconv(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_grouped_convolution():
+    layer = nn.SpatialConvolution(4, 8, 3, 3, 1, 1, 1, 1, n_group=2)
+    x = np.random.randn(2, 4, 5, 5).astype(np.float32)
+    out = np.asarray(layer.forward(x))
+    p = layer.get_parameters()
+    tconv = torch.nn.Conv2d(4, 8, 3, padding=1, groups=2)
+    with torch.no_grad():
+        tconv.weight.copy_(torch.from_numpy(np.asarray(p["weight"]).copy()))
+        tconv.bias.copy_(torch.from_numpy(np.asarray(p["bias"]).copy()))
+        expect = tconv(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_dilated_convolution():
+    layer = nn.SpatialDilatedConvolution(3, 6, 3, 3, 1, 1, 2, 2, 2, 2)
+    x = np.random.randn(1, 3, 10, 10).astype(np.float32)
+    out = np.asarray(layer.forward(x))
+    p = layer.get_parameters()
+    tconv = torch.nn.Conv2d(3, 6, 3, padding=2, dilation=2)
+    with torch.no_grad():
+        tconv.weight.copy_(torch.from_numpy(np.asarray(p["weight"]).copy()))
+        tconv.bias.copy_(torch.from_numpy(np.asarray(p["bias"]).copy()))
+        expect = tconv(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_full_convolution_matches_torch_convtranspose():
+    layer = nn.SpatialFullConvolution(4, 6, 3, 3, 2, 2, 1, 1, 1, 1)
+    x = np.random.randn(2, 4, 5, 5).astype(np.float32)
+    out = np.asarray(layer.forward(x))
+    p = layer.get_parameters()
+    t = torch.nn.ConvTranspose2d(4, 6, 3, stride=2, padding=1,
+                                 output_padding=1)
+    with torch.no_grad():
+        t.weight.copy_(torch.from_numpy(np.asarray(p["weight"]).copy()))
+        t.bias.copy_(torch.from_numpy(np.asarray(p["bias"]).copy()))
+        expect = t(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_temporal_convolution():
+    layer = nn.TemporalConvolution(5, 7, 3, 1)
+    x = np.random.randn(2, 9, 5).astype(np.float32)
+    out = np.asarray(layer.forward(x))
+    assert out.shape == (2, 7, 7)
+    p = layer.get_parameters()
+    t = torch.nn.Conv1d(5, 7, 3)
+    with torch.no_grad():
+        t.weight.copy_(torch.from_numpy(np.asarray(p["weight"]).copy()))
+        t.bias.copy_(torch.from_numpy(np.asarray(p["bias"]).copy()))
+        expect = t(torch.from_numpy(x).transpose(1, 2)).transpose(1, 2).numpy()
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_max_pool_floor_and_ceil():
+    x = np.random.randn(1, 2, 7, 7).astype(np.float32)
+    out_floor = np.asarray(nn.SpatialMaxPooling(2, 2, 2, 2).forward(x))
+    assert out_floor.shape == (1, 2, 3, 3)
+    expect = torch.nn.functional.max_pool2d(torch.from_numpy(x), 2).numpy()
+    np.testing.assert_allclose(out_floor, expect, rtol=1e-6)
+
+    out_ceil = np.asarray(nn.SpatialMaxPooling(2, 2, 2, 2).ceil().forward(x))
+    assert out_ceil.shape == (1, 2, 4, 4)
+    expect_c = torch.nn.functional.max_pool2d(torch.from_numpy(x), 2,
+                                              ceil_mode=True).numpy()
+    np.testing.assert_allclose(out_ceil, expect_c, rtol=1e-6)
+
+
+def test_avg_pool_matches_torch():
+    x = np.random.randn(2, 3, 8, 8).astype(np.float32)
+    out = np.asarray(nn.SpatialAveragePooling(3, 3, 2, 2, 1, 1).forward(x))
+    expect = torch.nn.functional.avg_pool2d(
+        torch.from_numpy(x), 3, stride=2, padding=1).numpy()
+    np.testing.assert_allclose(out, expect, rtol=1e-5)
+
+
+def test_volumetric_pool_and_conv_shapes():
+    x = np.random.randn(1, 2, 6, 8, 8).astype(np.float32)
+    out = np.asarray(nn.VolumetricMaxPooling(2, 2, 2).forward(x))
+    assert out.shape == (1, 2, 3, 4, 4)
+    conv = nn.VolumetricConvolution(2, 4, 3, 3, 3, 1, 1, 1, 1, 1, 1)
+    out2 = np.asarray(conv.forward(x))
+    assert out2.shape == (1, 4, 6, 8, 8)
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNormalization(4, eps=1e-5, momentum=0.1)
+    x = np.random.randn(16, 4).astype(np.float32) * 3 + 1
+    bn.training()
+    out = np.asarray(bn.forward(x))
+    p = bn.get_parameters()
+    tbn = torch.nn.BatchNorm1d(4, eps=1e-5, momentum=0.1)
+    with torch.no_grad():
+        tbn.weight.copy_(torch.from_numpy(np.asarray(p["weight"]).copy()))
+        tbn.bias.copy_(torch.from_numpy(np.asarray(p["bias"]).copy()))
+    tbn.train()
+    expect = tbn(torch.from_numpy(x)).detach().numpy()
+    np.testing.assert_allclose(out, expect, rtol=1e-3, atol=1e-4)
+    # running stats updated like torch
+    st = bn.get_state()
+    np.testing.assert_allclose(np.asarray(st["running_mean"]),
+                               tbn.running_mean.numpy(), rtol=1e-3,
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(st["running_var"]),
+                               tbn.running_var.numpy(), rtol=1e-3, atol=1e-4)
+    # eval mode uses running stats
+    bn.evaluate()
+    tbn.eval()
+    out_e = np.asarray(bn.forward(x))
+    expect_e = tbn(torch.from_numpy(x)).detach().numpy()
+    np.testing.assert_allclose(out_e, expect_e, rtol=1e-3, atol=1e-4)
+
+
+def test_spatial_batchnorm():
+    bn = nn.SpatialBatchNormalization(3)
+    x = np.random.randn(4, 3, 5, 5).astype(np.float32)
+    out = np.asarray(bn.forward(x))
+    p = bn.get_parameters()
+    tbn = torch.nn.BatchNorm2d(3)
+    with torch.no_grad():
+        tbn.weight.copy_(torch.from_numpy(np.asarray(p["weight"]).copy()))
+        tbn.bias.copy_(torch.from_numpy(np.asarray(p["bias"]).copy()))
+    tbn.train()
+    expect = tbn(torch.from_numpy(x)).detach().numpy()
+    np.testing.assert_allclose(out, expect, rtol=1e-3, atol=1e-4)
+
+
+def test_cross_map_lrn_matches_torch():
+    lrn = nn.SpatialCrossMapLRN(5, 0.0001, 0.75, 1.0)
+    x = np.random.rand(2, 7, 4, 4).astype(np.float32)
+    out = np.asarray(lrn.forward(x))
+    t = torch.nn.LocalResponseNorm(5, alpha=0.0001, beta=0.75, k=1.0)
+    expect = t(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_normalize():
+    x = np.random.randn(3, 6).astype(np.float32)
+    out = np.asarray(nn.Normalize(2).forward(x))
+    expect = x / np.linalg.norm(x, axis=1, keepdims=True)
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_lookup_table():
+    lt = nn.LookupTable(10, 4)
+    idx = np.array([[1, 3, 5], [2, 4, 10]], np.float32)
+    out = np.asarray(lt.forward(idx))
+    w = np.asarray(lt.get_parameters()["weight"])
+    np.testing.assert_allclose(out[0, 0], w[0], rtol=1e-6)
+    np.testing.assert_allclose(out[1, 2], w[9], rtol=1e-6)

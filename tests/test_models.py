@@ -1,0 +1,111 @@
+"""Model zoo forward-shape tests (reference model specs in test/.../models)."""
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+
+
+def test_lenet_forward_shape():
+    from bigdl_tpu.models import LeNet5
+    m = LeNet5(10)
+    x = np.random.rand(4, 28, 28).astype(np.float32)
+    out = np.asarray(m.forward(x))
+    assert out.shape == (4, 10)
+    # log-softmax rows sum to 1 in prob space
+    np.testing.assert_allclose(np.exp(out).sum(-1), np.ones(4), rtol=1e-4)
+
+
+def test_lenet_graph_matches_sequential():
+    from bigdl_tpu.models.lenet import LeNet5, LeNet5_graph
+    from bigdl_tpu.utils.random import RandomGenerator
+    x = np.random.rand(2, 28, 28).astype(np.float32)
+    RandomGenerator.set_seed(7)
+    seq = LeNet5(10)
+    out_seq = np.asarray(seq.forward(x))
+    RandomGenerator.set_seed(7)
+    g = LeNet5_graph(10)
+    out_g = np.asarray(g.forward(x))
+    assert out_seq.shape == out_g.shape == (2, 10)
+
+
+def test_vgg_cifar_forward():
+    from bigdl_tpu.models import VggForCifar10
+    m = VggForCifar10(10, has_dropout=False).evaluate()
+    x = np.random.rand(2, 3, 32, 32).astype(np.float32)
+    out = np.asarray(m.forward(x))
+    assert out.shape == (2, 10)
+
+
+def test_resnet20_cifar_forward():
+    from bigdl_tpu.models import ResNet
+    m = ResNet(10, depth=20, dataset="CIFAR10").evaluate()
+    x = np.random.rand(2, 3, 32, 32).astype(np.float32)
+    out = np.asarray(m.forward(x))
+    assert out.shape == (2, 10)
+
+
+def test_resnet18_imagenet_forward():
+    from bigdl_tpu.models import ResNet
+    m = ResNet(1000, depth=18, dataset="ImageNet").evaluate()
+    x = np.random.rand(1, 3, 224, 224).astype(np.float32)
+    out = np.asarray(m.forward(x))
+    assert out.shape == (1, 1000)
+
+
+@pytest.mark.slow
+def test_resnet50_imagenet_forward():
+    from bigdl_tpu.models import ResNet
+    m = ResNet(1000, depth=50, dataset="ImageNet").evaluate()
+    x = np.random.rand(1, 3, 224, 224).astype(np.float32)
+    out = np.asarray(m.forward(x))
+    assert out.shape == (1, 1000)
+
+
+def test_inception_v1_noaux_forward():
+    from bigdl_tpu.models import Inception_v1_NoAuxClassifier
+    m = Inception_v1_NoAuxClassifier(1000, has_dropout=False).evaluate()
+    x = np.random.rand(1, 3, 224, 224).astype(np.float32)
+    out = np.asarray(m.forward(x))
+    assert out.shape == (1, 1000)
+
+
+def test_simple_rnn_forward():
+    from bigdl_tpu.models import SimpleRNN
+    m = SimpleRNN(input_size=8, hidden_size=16, output_size=5)
+    x = np.random.rand(3, 7, 8).astype(np.float32)
+    out = np.asarray(m.forward(x))
+    assert out.shape == (3, 7, 5)
+
+
+def test_ptb_model_forward():
+    from bigdl_tpu.models import PTBModel
+    m = PTBModel(input_size=50, hidden_size=32, output_size=50,
+                 num_layers=2).evaluate()
+    x = (np.random.randint(1, 51, size=(4, 10))).astype(np.float32)
+    out = np.asarray(m.forward(x))
+    assert out.shape == (4, 10, 50)
+
+
+def test_autoencoder_forward():
+    from bigdl_tpu.models import Autoencoder
+    m = Autoencoder(32)
+    x = np.random.rand(5, 28, 28).astype(np.float32)
+    out = np.asarray(m.forward(x))
+    assert out.shape == (5, 784)
+
+
+def test_graph_multi_input_output():
+    inp1 = nn.Input()()
+    inp2 = nn.Input()()
+    h1 = nn.Linear(4, 8)(inp1)
+    h2 = nn.Linear(6, 8)(inp2)
+    merged = nn.CAddTable()(h1, h2)
+    out1 = nn.Linear(8, 3)(merged)
+    out2 = nn.ReLU()(merged)
+    g = nn.Graph([inp1, inp2], [out1, out2])
+    from bigdl_tpu.utils.table import T
+    x1 = np.random.rand(2, 4).astype(np.float32)
+    x2 = np.random.rand(2, 6).astype(np.float32)
+    out = g.forward(T(x1, x2))
+    assert np.asarray(out[1]).shape == (2, 3)
+    assert np.asarray(out[2]).shape == (2, 8)
