@@ -1,0 +1,88 @@
+"""Benchmark harness (reference: models/utils/DistriOptimizerPerf.scala:38 —
+synthetic-data throughput for the zoo models).
+
+Runs ResNet-50 ImageNet *training* steps (fwd+bwd+SGD update, the BASELINE
+north-star config) on the available accelerator with synthetic data and
+prints ONE JSON line:
+
+    {"metric": ..., "value": imgs/sec, "unit": "images/sec", "vs_baseline": r}
+
+Baseline: the reference publishes no absolute numbers (BASELINE.md); the
+working Xeon baseline recorded there is 56 img/s/node (BigDL-paper-era
+dual-socket Xeon ResNet-50 estimate) until a measured value replaces it.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# BASELINE.md "working baseline" — see §North star.
+REFERENCE_BASELINE_IMGS_PER_SEC = 56.0
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.models import ResNet
+    from bigdl_tpu.optim import SGD
+    from bigdl_tpu.optim.optimizer import build_train_step
+    from bigdl_tpu.utils.engine import Engine
+    from bigdl_tpu.utils.random import RandomGenerator
+
+    batch = int(os.environ.get("BENCH_BATCH", 256))
+    iters = int(os.environ.get("BENCH_ITERS", 10))
+    warmup = int(os.environ.get("BENCH_WARMUP", 3))
+
+    platform = jax.devices()[0].platform
+    # bf16 compute on accelerators (TPU-native analogue of the reference's
+    # fp16 gradient compression); f32 master params.
+    if platform != "cpu":
+        Engine.set_compute_dtype(jnp.bfloat16)
+
+    RandomGenerator.set_seed(1)
+    model = ResNet(1000, depth=50, dataset="ImageNet").training()
+    model.ensure_initialized()
+    criterion = nn.CrossEntropyCriterion()
+    optim = SGD(learning_rate=0.1, momentum=0.9, weight_decay=1e-4,
+                nesterov=True, dampening=0.0)
+
+    params = model.get_parameters()
+    mstate = model.get_state()
+    opt_state = optim.init_state(params)
+    step = build_train_step(model, criterion, optim)
+
+    rng = jax.random.PRNGKey(0)
+    x = jnp.asarray(np.random.RandomState(0).rand(batch, 3, 224, 224),
+                    jnp.float32)
+    y = jnp.asarray(np.random.RandomState(1).randint(1, 1001, size=(batch,)),
+                    jnp.float32)
+
+    for _ in range(warmup):
+        params, opt_state, mstate, loss = step(params, opt_state, mstate,
+                                               rng, 0.1, x, y)
+    float(loss)  # sync: the loss depends on every prior step's params
+
+    t0 = time.time()
+    for _ in range(iters):
+        params, opt_state, mstate, loss = step(params, opt_state, mstate,
+                                               rng, 0.1, x, y)
+    float(loss)  # data dependency forces completion of the whole chain
+    dt = time.time() - t0
+
+    imgs_per_sec = batch * iters / dt
+    result = {
+        "metric": "resnet50_imagenet_train_imgs_per_sec_per_chip",
+        "value": round(imgs_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(imgs_per_sec / REFERENCE_BASELINE_IMGS_PER_SEC,
+                             3),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -68,9 +68,26 @@ def build_train_step(module: Module, criterion: Criterion,
     """
 
     def step(params, opt_state, model_state, rng, lr, inputs, targets):
+        cdtype = Engine.compute_dtype()
+        ddtype = Engine.default_dtype()
+
+        def maybe_cast(tree, dtype):
+            if cdtype == ddtype:
+                return tree
+            return jax.tree.map(
+                lambda a: a.astype(dtype)
+                if jnp.issubdtype(a.dtype, jnp.floating) else a, tree)
+
         def loss_fn(p):
-            out, new_mstate = module.apply(p, model_state, inputs,
+            # mixed precision: compute fwd/bwd in compute_dtype (bf16 on
+            # TPU — the analogue of the reference's fp16 gradient
+            # compression, FP16CompressedTensor.scala), master params and
+            # the update stay in default_dtype.
+            p_c = maybe_cast(p, cdtype)
+            x_c = maybe_cast(inputs, cdtype)
+            out, new_mstate = module.apply(p_c, model_state, x_c,
                                            training=True, rng=rng)
+            out = maybe_cast(out, ddtype)
             loss = criterion.apply(out, targets)
             reg = module.regularization_loss(p)
             return loss + reg, (new_mstate, loss)
