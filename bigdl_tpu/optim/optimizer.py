@@ -376,6 +376,19 @@ class Optimizer:
                 self.train_summary.add_scalar("Throughput",
                                               state["Throughput"],
                                               state["neval"])
+                # per-parameter histograms, opt-in via trigger
+                # (TrainSummary.scala:64; DistriOptimizer.scala:464-498)
+                get_trig = getattr(self.train_summary,
+                                   "get_summary_trigger", None)
+                ptrig = get_trig("Parameters") if get_trig else None
+                if ptrig is not None and ptrig(state):
+                    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+                    for path, leaf in flat:
+                        tag = "/".join(
+                            str(getattr(k, "key", getattr(k, "idx", k)))
+                            for k in path)
+                        self.train_summary.add_histogram(
+                            tag, np.asarray(leaf), state["neval"])
 
             # epoch rollover (DistriOptimizer.scala:368-380)
             if state["recordsProcessedThisEpoch"] >= ds_size:

@@ -1,0 +1,172 @@
+"""Minimal protobuf wire-format codec (no protoc dependency).
+
+The reference ships generated protobuf Java for its own model format, Caffe
+and TensorFlow interop (SURVEY.md §2.5: serialization/Bigdl.java,
+caffe/Caffe.java, 121 TF proto files). The TPU build needs the same wire
+compatibility but not the codegen: messages of interest are small and
+well-known, so a hand-rolled varint/length-delimited codec keeps the
+framework dependency-free. Used by visualization (tfevents), the Caffe
+importer and the TF GraphDef importer.
+
+Wire types: 0 varint, 1 fixed64, 2 length-delimited, 5 fixed32.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, Iterator, List, Tuple, Union
+
+# ---------------------------------------------------------------- encoding
+
+def encode_varint(value: int) -> bytes:
+    if value < 0:
+        value &= (1 << 64) - 1
+    out = bytearray()
+    while True:
+        b = value & 0x7F
+        value >>= 7
+        if value:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def encode_tag(field: int, wire_type: int) -> bytes:
+    return encode_varint((field << 3) | wire_type)
+
+
+def encode_field(field: int, value, wire_type: int = None) -> bytes:
+    """Encode one field. Type inferred when wire_type is None:
+    int -> varint, float -> fixed64 (double), bytes/str -> length-delim."""
+    if wire_type is None:
+        if isinstance(value, bool):
+            wire_type = 0
+        elif isinstance(value, int):
+            wire_type = 0
+        elif isinstance(value, float):
+            wire_type = 1
+        elif isinstance(value, (bytes, bytearray, str)):
+            wire_type = 2
+        else:
+            raise TypeError(f"cannot infer wire type for {type(value)}")
+    if wire_type == 0:
+        return encode_tag(field, 0) + encode_varint(int(value))
+    if wire_type == 1:
+        return encode_tag(field, 1) + struct.pack("<d", float(value))
+    if wire_type == 5:
+        return encode_tag(field, 5) + struct.pack("<f", float(value))
+    if wire_type == 2:
+        if isinstance(value, str):
+            value = value.encode("utf-8")
+        return (encode_tag(field, 2) + encode_varint(len(value)) +
+                bytes(value))
+    raise ValueError(f"bad wire type {wire_type}")
+
+
+def encode_float32(field: int, value: float) -> bytes:
+    return encode_field(field, value, wire_type=5)
+
+
+def encode_double(field: int, value: float) -> bytes:
+    return encode_field(field, value, wire_type=1)
+
+
+def encode_packed_doubles(field: int, values) -> bytes:
+    payload = b"".join(struct.pack("<d", float(v)) for v in values)
+    return encode_field(field, payload, wire_type=2)
+
+
+def encode_message(field: int, payload: bytes) -> bytes:
+    return encode_field(field, payload, wire_type=2)
+
+
+# ---------------------------------------------------------------- decoding
+
+def decode_varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return result, pos
+        shift += 7
+        if shift > 70:
+            raise ValueError("varint too long")
+
+
+def iter_fields(buf: bytes) -> Iterator[Tuple[int, int, Union[int, bytes]]]:
+    """Yield (field_number, wire_type, raw_value) over a message buffer.
+
+    Varints come back as ints; fixed32/64 as raw 4/8 bytes; length-delimited
+    as bytes.
+    """
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        key, pos = decode_varint(buf, pos)
+        field, wire_type = key >> 3, key & 7
+        if wire_type == 0:
+            value, pos = decode_varint(buf, pos)
+        elif wire_type == 1:
+            value = buf[pos:pos + 8]
+            pos += 8
+        elif wire_type == 5:
+            value = buf[pos:pos + 4]
+            pos += 4
+        elif wire_type == 2:
+            length, pos = decode_varint(buf, pos)
+            value = buf[pos:pos + length]
+            pos += length
+        elif wire_type in (3, 4):  # groups: skip (deprecated)
+            continue
+        else:
+            raise ValueError(f"bad wire type {wire_type} at {pos}")
+        yield field, wire_type, value
+
+
+def parse_message(buf: bytes) -> Dict[int, List]:
+    """Collect fields into {field_number: [raw values...]}."""
+    out: Dict[int, List] = {}
+    for field, _, value in iter_fields(buf):
+        out.setdefault(field, []).append(value)
+    return out
+
+
+def as_double(raw) -> float:
+    return struct.unpack("<d", raw)[0]
+
+
+def as_float(raw) -> float:
+    return struct.unpack("<f", raw)[0]
+
+
+def as_string(raw: bytes) -> str:
+    return raw.decode("utf-8")
+
+
+def as_sint(raw: int) -> int:
+    """Reinterpret a decoded varint as a signed 64-bit int (non-zigzag)."""
+    if raw >= 1 << 63:
+        return raw - (1 << 64)
+    return raw
+
+
+def unpack_packed_doubles(raw: bytes) -> List[float]:
+    return [struct.unpack_from("<d", raw, i)[0]
+            for i in range(0, len(raw), 8)]
+
+
+def unpack_packed_floats(raw: bytes) -> List[float]:
+    return [struct.unpack_from("<f", raw, i)[0]
+            for i in range(0, len(raw), 4)]
+
+
+def unpack_packed_varints(raw: bytes) -> List[int]:
+    out = []
+    pos = 0
+    while pos < len(raw):
+        v, pos = decode_varint(raw, pos)
+        out.append(v)
+    return out

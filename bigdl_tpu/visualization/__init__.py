@@ -1,0 +1,7 @@
+"""Observability: TensorBoard summaries (reference L6, SURVEY.md §1)."""
+from bigdl_tpu.visualization.summary import (Summary, TrainSummary,
+                                             ValidationSummary)
+from bigdl_tpu.visualization.tensorboard import FileReader, FileWriter
+
+__all__ = ["Summary", "TrainSummary", "ValidationSummary", "FileReader",
+           "FileWriter"]

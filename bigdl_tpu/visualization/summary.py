@@ -1,0 +1,65 @@
+"""Training/validation summaries (reference: visualization/Summary.scala:32,
+TrainSummary.scala:32, ValidationSummary.scala:29).
+
+`TrainSummary` is handed to `Optimizer.set_train_summary`; the optimizer
+logs Loss/LearningRate/Throughput scalars every iteration and, when a
+per-tag trigger is registered via `set_summary_trigger` (TrainSummary.
+scala:64), parameter histograms at the triggered cadence.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from bigdl_tpu.optim.trigger import Trigger
+from bigdl_tpu.visualization.tensorboard import FileReader, FileWriter
+
+
+class Summary:
+    """Base writer bound to  <log_dir>/<app_name>/<train|validation>."""
+
+    def __init__(self, log_dir: str, app_name: str, folder: str):
+        self.log_dir = os.path.join(log_dir, app_name, folder)
+        self.writer = FileWriter(self.log_dir)
+
+    def add_scalar(self, tag: str, value: float, step: int) -> "Summary":
+        self.writer.add_scalar(tag, float(value), int(step))
+        return self
+
+    def add_histogram(self, tag: str, values, step: int) -> "Summary":
+        self.writer.add_histogram(tag, np.asarray(values), int(step))
+        return self
+
+    def read_scalar(self, tag: str) -> List[Tuple[int, float, float]]:
+        self.writer.flush()
+        return FileReader.read_scalar(self.log_dir, tag)
+
+    def close(self):
+        self.writer.close()
+
+
+class TrainSummary(Summary):
+    """Per-tag trigger control (TrainSummary.scala:64): "Parameters" is
+    opt-in (expensive histograms), Loss/LearningRate/Throughput default to
+    every iteration."""
+
+    def __init__(self, log_dir: str, app_name: str):
+        super().__init__(log_dir, app_name, "train")
+        self._triggers: Dict[str, Trigger] = {}
+
+    def set_summary_trigger(self, name: str,
+                            trigger: Trigger) -> "TrainSummary":
+        if name not in ("Loss", "LearningRate", "Throughput", "Parameters"):
+            raise ValueError(f"unsupported summary tag {name}")
+        self._triggers[name] = trigger
+        return self
+
+    def get_summary_trigger(self, name: str) -> Optional[Trigger]:
+        return self._triggers.get(name)
+
+
+class ValidationSummary(Summary):
+    def __init__(self, log_dir: str, app_name: str):
+        super().__init__(log_dir, app_name, "validation")

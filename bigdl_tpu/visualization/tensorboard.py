@@ -1,0 +1,250 @@
+"""TensorBoard event-file IO (reference: visualization/tensorboard/
+{FileWriter,EventWriter,RecordWriter,FileReader}.scala).
+
+Writes real ``events.out.tfevents.*`` files TensorBoard can display, and
+reads scalars back (FileReader.readScalar, tensorboard/FileReader.scala:80 —
+exposed to Python in the reference so training curves are queryable).
+
+Event/Summary protos are encoded with the in-repo wire codec
+(bigdl_tpu/utils/proto.py) — no TensorFlow dependency.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import socket
+import struct
+import threading
+import time
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from bigdl_tpu.utils import proto
+from bigdl_tpu.visualization.crc32c import masked_crc32c
+
+# proto field numbers (tensorflow/core/util/event.proto,
+# tensorflow/core/framework/summary.proto)
+_EVENT_WALL_TIME = 1      # double
+_EVENT_STEP = 2           # int64
+_EVENT_FILE_VERSION = 3   # string
+_EVENT_SUMMARY = 5        # Summary message
+_SUMMARY_VALUE = 1        # repeated Value
+_VALUE_TAG = 1            # string
+_VALUE_SIMPLE = 2         # float
+_VALUE_HISTO = 5          # HistogramProto
+_HISTO_MIN = 1
+_HISTO_MAX = 2
+_HISTO_NUM = 3
+_HISTO_SUM = 4
+_HISTO_SUM_SQUARES = 5
+_HISTO_BUCKET_LIMIT = 6   # packed double
+_HISTO_BUCKET = 7         # packed double
+
+
+def _encode_record(data: bytes) -> bytes:
+    """TFRecord framing: len u64 | masked_crc(len) u32 | data |
+    masked_crc(data) u32."""
+    header = struct.pack("<Q", len(data))
+    return (header + struct.pack("<I", masked_crc32c(header)) + data +
+            struct.pack("<I", masked_crc32c(data)))
+
+
+def scalar_event(tag: str, value: float, step: int,
+                 wall_time: Optional[float] = None) -> bytes:
+    val = proto.encode_field(_VALUE_TAG, tag) + \
+        proto.encode_float32(_VALUE_SIMPLE, float(value))
+    summary = proto.encode_message(_SUMMARY_VALUE, val)
+    ev = (proto.encode_double(_EVENT_WALL_TIME, wall_time or time.time()) +
+          proto.encode_field(_EVENT_STEP, int(step)) +
+          proto.encode_message(_EVENT_SUMMARY, summary))
+    return ev
+
+
+def histogram_event(tag: str, values: np.ndarray, step: int,
+                    wall_time: Optional[float] = None) -> bytes:
+    """Exponentially-bucketed histogram matching TF's conventions.
+
+    Non-finite values are dropped (as TF's summary op does) and the rest
+    clamped into the bucket range so num == sum(bucket) stays consistent
+    even when training diverges.
+    """
+    values = np.asarray(values, dtype=np.float64).ravel()
+    values = values[np.isfinite(values)]
+    limits = _default_buckets()
+    clipped = np.clip(values, -9e19, 9e19)
+    counts, _ = np.histogram(clipped, bins=[-np.inf] + limits)
+    # trim empty tail/head buckets but keep at least one
+    nz = np.nonzero(counts)[0]
+    if len(nz) == 0:
+        start, end = 0, 1
+    else:
+        start, end = nz[0], nz[-1] + 1
+    vmin = float(values.min()) if values.size else 0.0
+    vmax = float(values.max()) if values.size else 0.0
+    histo = (proto.encode_double(_HISTO_MIN, vmin) +
+             proto.encode_double(_HISTO_MAX, vmax) +
+             proto.encode_double(_HISTO_NUM, float(values.size)) +
+             proto.encode_double(_HISTO_SUM, float(values.sum())) +
+             proto.encode_double(_HISTO_SUM_SQUARES,
+                                 float(np.square(values).sum())) +
+             proto.encode_packed_doubles(_HISTO_BUCKET_LIMIT,
+                                         limits[start:end]) +
+             proto.encode_packed_doubles(_HISTO_BUCKET, counts[start:end]))
+    val = proto.encode_field(_VALUE_TAG, tag) + \
+        proto.encode_message(_VALUE_HISTO, histo)
+    summary = proto.encode_message(_SUMMARY_VALUE, val)
+    return (proto.encode_double(_EVENT_WALL_TIME, wall_time or time.time()) +
+            proto.encode_field(_EVENT_STEP, int(step)) +
+            proto.encode_message(_EVENT_SUMMARY, summary))
+
+
+_BUCKETS_CACHE: Optional[List[float]] = None
+
+
+def _default_buckets() -> List[float]:
+    global _BUCKETS_CACHE
+    if _BUCKETS_CACHE is None:
+        pos = []
+        v = 1e-12
+        while v < 1e20:
+            pos.append(v)
+            v *= 1.1
+        _BUCKETS_CACHE = [-x for x in reversed(pos)] + [0.0] + pos
+    return _BUCKETS_CACHE
+
+
+class FileWriter:
+    """Async event-file writer (visualization/tensorboard/FileWriter.scala:31
+    + EventWriter.scala:31 — the reference also queues events onto a writer
+    thread)."""
+
+    _uid = 0
+
+    def __init__(self, log_dir: str, flush_secs: float = 2.0):
+        os.makedirs(log_dir, exist_ok=True)
+        self.log_dir = log_dir
+        FileWriter._uid += 1
+        fname = "events.out.tfevents.%d.%s.%d.%d" % (
+            int(time.time()), socket.gethostname(), os.getpid(),
+            FileWriter._uid)
+        self.path = os.path.join(log_dir, fname)
+        self._f = open(self.path, "ab")
+        self._q: "queue.Queue[Optional[bytes]]" = queue.Queue()
+        self._flush_secs = flush_secs
+        self._closed = False
+        # file_version header event
+        self._write_now(proto.encode_double(_EVENT_WALL_TIME, time.time()) +
+                        proto.encode_field(_EVENT_FILE_VERSION, "brain.Event:2"))
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _write_now(self, event: bytes):
+        self._f.write(_encode_record(event))
+        self._f.flush()
+
+    def _run(self):
+        last_flush = time.time()
+        while True:
+            try:
+                item = self._q.get(timeout=self._flush_secs)
+            except queue.Empty:
+                item = b""
+            if item is None:
+                break
+            if isinstance(item, threading.Event):
+                # flush marker: everything enqueued before it is written
+                self._f.flush()
+                item.set()
+                continue
+            if item:
+                self._f.write(_encode_record(item))
+            if time.time() - last_flush >= self._flush_secs:
+                self._f.flush()
+                last_flush = time.time()
+        self._f.flush()
+
+    def add_event(self, event: bytes):
+        if not self._closed:
+            self._q.put(event)
+
+    def add_scalar(self, tag: str, value: float, step: int):
+        self.add_event(scalar_event(tag, value, step))
+
+    def add_histogram(self, tag: str, values, step: int):
+        self.add_event(histogram_event(tag, np.asarray(values), step))
+
+    def flush(self):
+        """Block until every previously-enqueued event is on disk."""
+        if self._closed or not self._thread.is_alive():
+            return
+        marker = threading.Event()
+        self._q.put(marker)
+        marker.wait(timeout=10)
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        self._q.put(None)
+        self._thread.join(timeout=10)
+        self._f.close()
+
+
+def _iter_records(path: str):
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(8)
+            if len(header) < 8:
+                return
+            (length,) = struct.unpack("<Q", header)
+            hcrc_raw = f.read(4)
+            if len(hcrc_raw) < 4:
+                return  # partially-written trailing record
+            (hcrc,) = struct.unpack("<I", hcrc_raw)
+            if masked_crc32c(header) != hcrc:
+                raise IOError(f"corrupt record header in {path}")
+            data = f.read(length)
+            dcrc_raw = f.read(4)
+            if len(data) < length or len(dcrc_raw) < 4:
+                return  # writer still appending; treat as EOF
+            (dcrc,) = struct.unpack("<I", dcrc_raw)
+            if masked_crc32c(data) != dcrc:
+                raise IOError(f"corrupt record payload in {path}")
+            yield data
+
+
+class FileReader:
+    """Read scalars back from event files (FileReader.scala:80; the Python
+    API exposes this as optimizer.read_scalar)."""
+
+    @staticmethod
+    def list_event_files(log_dir: str) -> List[str]:
+        return sorted(
+            os.path.join(log_dir, f) for f in os.listdir(log_dir)
+            if "tfevents" in f)
+
+    @staticmethod
+    def read_scalar(log_dir: str, tag: str) -> List[Tuple[int, float, float]]:
+        """Returns [(step, value, wall_time)] for `tag` across all event
+        files in the directory, sorted by step."""
+        out = []
+        for path in FileReader.list_event_files(log_dir):
+            for rec in _iter_records(path):
+                fields = proto.parse_message(rec)
+                if _EVENT_SUMMARY not in fields:
+                    continue
+                step = fields.get(_EVENT_STEP, [0])[0]
+                wall = proto.as_double(fields.get(_EVENT_WALL_TIME,
+                                                  [b"\0" * 8])[0])
+                for summary in fields[_EVENT_SUMMARY]:
+                    for value_msg in proto.parse_message(summary).get(
+                            _SUMMARY_VALUE, []):
+                        vf = proto.parse_message(value_msg)
+                        vtag = proto.as_string(vf.get(_VALUE_TAG, [b""])[0])
+                        if vtag == tag and _VALUE_SIMPLE in vf:
+                            out.append((int(step),
+                                        proto.as_float(vf[_VALUE_SIMPLE][0]),
+                                        wall))
+        out.sort(key=lambda t: t[0])
+        return out
