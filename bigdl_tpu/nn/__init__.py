@@ -59,3 +59,5 @@ from bigdl_tpu.nn.criterion import (
     KLDCriterion, GaussianCriterion, ClassSimplexCriterion,
     DiceCoefficientCriterion, SoftmaxWithCriterion, L1Cost,
     ParallelCriterion, MultiCriterion, TimeDistributedCriterion)
+from bigdl_tpu.nn.quantized import (
+    QuantizedLinear, QuantizedSpatialConvolution, quantize)

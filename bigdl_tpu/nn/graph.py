@@ -52,11 +52,12 @@ class Graph(Module):
         self.input_nodes = _as_list(input)
         self.output_nodes = _as_list(output)
         self.exec_order = self._topo_sort()
-        # stable unique names for the params pytree
+        # stable unique names for the params pytree — deterministic across
+        # processes (no id()-derived parts) so saved params reload cleanly
         self.node_names = {}
         counts = {}
         for n in self.exec_order:
-            base = n.element.get_name()
+            base = n.element._name or type(n.element).__name__
             if base in counts:
                 counts[base] += 1
                 name = f"{base}_{counts[base]}"

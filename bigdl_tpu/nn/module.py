@@ -47,6 +47,30 @@ def _to_jax(x):
 class Module:
     """Base of every layer/container (AbstractModule.scala:56)."""
 
+    def __init_subclass__(cls, **kw):
+        """Auto-capture constructor args on every subclass so modules can be
+        serialized by topology (the reference's reflection-driven
+        ModuleSerializable does the same via Scala reflection over
+        constructor symbols)."""
+        super().__init_subclass__(**kw)
+        if "__init__" not in cls.__dict__:
+            return  # inherits an already-wrapped __init__
+        orig = cls.__dict__["__init__"]
+        if getattr(orig, "_captures_args", False):
+            return
+
+        import functools
+
+        @functools.wraps(orig)
+        def wrapped(self, *args, **kwargs):
+            if not hasattr(self, "_init_args"):
+                self._init_args = args
+                self._init_kwargs = kwargs
+            orig(self, *args, **kwargs)
+
+        wrapped._captures_args = True
+        cls.__init__ = wrapped
+
     def __init__(self):
         self._name: Optional[str] = None
         self.train_mode: bool = True
@@ -224,6 +248,11 @@ class Module:
         return f"{type(self).__name__}"
 
     # parity helpers
+    def quantize(self) -> "Module":
+        """Int8 inference rewrite (AbstractModule.quantize :708)."""
+        from bigdl_tpu.nn.quantized import quantize as _q
+        return _q(self)
+
     def predict(self, dataset, batch_size: int = 32):
         from bigdl_tpu.optim.predictor import LocalPredictor
         return LocalPredictor(self).predict(dataset, batch_size=batch_size)

@@ -1,0 +1,229 @@
+"""Int8 quantized inference modules + model rewrite (reference:
+nn/quantized/{Linear,SpatialConvolution,SpatialDilatedConvolution}.scala,
+Quantization.quantize graph rewrite nn/quantized/Quantization.scala:168,
+Quantizer.scala:32,83).
+
+`quantize(model)` walks a trained module tree and swaps eligible layers for
+int8 twins whose parameters are the quantized weights (int8 + per-channel
+fp32 scales). Inference-only, like the reference (backward raises).
+
+On real TPU with tile-aligned shapes, QuantizedLinear dispatches to the
+fused pallas kernel; elsewhere the XLA int8 path (ops/quant.py) runs — the
+MXU multiplies int8 natively either way.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.ops.quant import (quantize_symmetric, quantized_conv2d,
+                                 quantized_linear)
+
+
+class QuantizedLinear(Module):
+    """Int8 FC (nn/quantized/Linear.scala:77-88). Built from a float Linear's
+    weights via ``from_float`` or ``quantize(model)``."""
+
+    def __init__(self, input_size: int, output_size: int,
+                 with_bias: bool = True):
+        super().__init__()
+        self.input_size = input_size
+        self.output_size = output_size
+        self.with_bias = with_bias
+        self._qparams = None  # set by from_float
+
+    @classmethod
+    def from_float(cls, linear, params) -> "QuantizedLinear":
+        m = cls(linear.input_size, linear.output_size, linear.with_bias)
+        w = np.asarray(params["weight"], np.float32)
+        q, scale = quantize_symmetric(w, axis=0)
+        m._qparams = {"weight_q": np.asarray(q),
+                      "w_scale": np.asarray(scale).reshape(-1)}
+        if linear.with_bias and "bias" in params:
+            m._qparams["bias"] = np.asarray(params["bias"], np.float32)
+        if linear._name:
+            m.set_name(linear._name)
+        return m
+
+    def init(self, rng):
+        if self._qparams is None:
+            raise ValueError(
+                "QuantizedLinear has no weights; build via from_float or "
+                "quantize(model)")
+        return {k: jnp.asarray(v) for k, v in self._qparams.items()}
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        if training:
+            raise RuntimeError(
+                "QuantizedLinear is inference-only (reference: quantized "
+                "modules have no backward, nn/quantized/Linear.scala)")
+        x = input
+        squeeze = x.ndim == 1
+        if squeeze:
+            x = x[None]
+        lead = x.shape[:-1]
+        x2 = x.reshape(-1, x.shape[-1])
+        out = self._dispatch(x2, params)
+        out = out.reshape(lead + (self.output_size,))
+        return out[0] if squeeze else out
+
+    def _dispatch(self, x2, params):
+        bias = params.get("bias")
+        m, k = x2.shape
+        n = self.output_size
+        if (jax.default_backend() == "tpu" and m % 256 == 0
+                and n % 256 == 0 and k % 512 == 0):
+            from bigdl_tpu.ops.pallas_kernels import pallas_quantized_matmul
+            x_q, x_scale = quantize_symmetric(x2.astype(jnp.float32), axis=0)
+            return pallas_quantized_matmul(
+                x_q, params["weight_q"], x_scale.reshape(-1),
+                params["w_scale"], bias)
+        return quantized_linear(x2, params["weight_q"], params["w_scale"],
+                                bias)
+
+
+class QuantizedSpatialConvolution(Module):
+    """Int8 NCHW conv (nn/quantized/SpatialConvolution.scala; dilation covers
+    SpatialDilatedConvolution too)."""
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 kernel_w: int, kernel_h: int, stride_w: int = 1,
+                 stride_h: int = 1, pad_w: int = 0, pad_h: int = 0,
+                 n_group: int = 1, dilation_w: int = 1, dilation_h: int = 1,
+                 with_bias: bool = True):
+        super().__init__()
+        self.n_input_plane = n_input_plane
+        self.n_output_plane = n_output_plane
+        self.kernel_w, self.kernel_h = kernel_w, kernel_h
+        self.stride_w, self.stride_h = stride_w, stride_h
+        self.pad_w, self.pad_h = pad_w, pad_h
+        self.n_group = n_group
+        self.dilation_w, self.dilation_h = dilation_w, dilation_h
+        self.with_bias = with_bias
+        self._qparams = None
+
+    @classmethod
+    def from_float(cls, conv, params) -> "QuantizedSpatialConvolution":
+        m = cls(conv.n_input_plane, conv.n_output_plane, conv.kernel_w,
+                conv.kernel_h, conv.stride_w, conv.stride_h, conv.pad_w,
+                conv.pad_h, conv.n_group,
+                getattr(conv, "dilation_w", 1), getattr(conv, "dilation_h", 1),
+                conv.with_bias)
+        w = np.asarray(params["weight"], np.float32)  # [O, I/g, kh, kw]
+        q, scale = quantize_symmetric(w, axis=0)      # per-out-channel
+        m._qparams = {"weight_q": np.asarray(q),
+                      "w_scale": np.asarray(scale).reshape(-1)}
+        if conv.with_bias and "bias" in params:
+            m._qparams["bias"] = np.asarray(params["bias"], np.float32)
+        if conv._name:
+            m.set_name(conv._name)
+        return m
+
+    def init(self, rng):
+        if self._qparams is None:
+            raise ValueError("no quantized weights; use from_float")
+        return {k: jnp.asarray(v) for k, v in self._qparams.items()}
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        if training:
+            raise RuntimeError(
+                "QuantizedSpatialConvolution is inference-only (reference: "
+                "quantized modules have no backward)")
+        x = input
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        if self.dilation_w != 1 or self.dilation_h != 1:
+            # dilated path: fall back to float conv on dequantized weight
+            w = (params["weight_q"].astype(jnp.float32)
+                 * params["w_scale"].reshape(-1, 1, 1, 1))
+            out = jax.lax.conv_general_dilated(
+                x.astype(jnp.float32), w,
+                window_strides=(self.stride_h, self.stride_w),
+                padding=[(self.pad_h, self.pad_h), (self.pad_w, self.pad_w)],
+                rhs_dilation=(self.dilation_h, self.dilation_w),
+                dimension_numbers=("NCHW", "OIHW", "NCHW"),
+                feature_group_count=self.n_group)
+            if self.with_bias and "bias" in params:
+                out = out + params["bias"].reshape(1, -1, 1, 1)
+        else:
+            out = quantized_conv2d(
+                x, params["weight_q"], params["w_scale"],
+                params.get("bias"),
+                stride=(self.stride_h, self.stride_w),
+                padding=[(self.pad_h, self.pad_h), (self.pad_w, self.pad_w)],
+                n_group=self.n_group)
+        return out[0] if squeeze else out
+
+
+def quantize(model: Module) -> Module:
+    """Rewrite a trained model for int8 inference
+    (Quantization.scala:168). Returns a NEW module tree; the original is
+    untouched. Only inference makes sense afterwards."""
+    from bigdl_tpu.nn.container import Container
+    from bigdl_tpu.nn.conv import SpatialConvolution
+    from bigdl_tpu.nn.graph import Graph
+    from bigdl_tpu.nn.linear import Linear
+
+    model.ensure_initialized()
+
+    def convert(m: Module, params, state):
+        """Returns (new_module, new_params, new_state) — trained float
+        params/state carry over unchanged for layers that stay float."""
+        if isinstance(m, Linear):
+            qm = QuantizedLinear.from_float(m, params)
+            return qm, qm.init(None), {}
+        if isinstance(m, SpatialConvolution) and m.n_group == 1:
+            qm = QuantizedSpatialConvolution.from_float(m, params)
+            return qm, qm.init(None), {}
+        if isinstance(m, Graph):
+            # rebuild nodes/edges so the original graph stays untouched
+            from bigdl_tpu.utils.directed_graph import Edge, Node
+            idx = {id(n): i for i, n in enumerate(m.exec_order)}
+            converted = [convert(n.element,
+                                 params.get(m.node_names[id(n)], {}),
+                                 state.get(m.node_names[id(n)], {}))
+                         for n in m.exec_order]
+            new_nodes = [Node(cm) for cm, _, _ in converted]
+            for n in m.exec_order:
+                for p, e in n.prevs:
+                    new_nodes[idx[id(p)]].add(new_nodes[idx[id(n)]],
+                                              Edge(e.from_index))
+            new_g = Graph([new_nodes[idx[id(n)]] for n in m.input_nodes],
+                          [new_nodes[idx[id(n)]] for n in m.output_nodes])
+            new_params = {new_g.node_names[id(nn_)]: cp
+                          for nn_, (_, cp, _) in zip(new_nodes, converted)}
+            new_state = {new_g.node_names[id(nn_)]: cs
+                         for nn_, (_, _, cs) in zip(new_nodes, converted)}
+            return new_g, new_params, new_state
+        if isinstance(m, Container):
+            new_c = copy.copy(m)
+            triples = [convert(child, params.get(str(i), {}),
+                               state.get(str(i), {}))
+                       for i, child in enumerate(m.modules)]
+            new_c.modules = [cm for cm, _, _ in triples]
+            new_c._params = None
+            new_c._state = None
+            # repair captured ctor args so to_spec serializes the QUANTIZED
+            # children, not the stale float ones
+            if hasattr(new_c, "_init_args"):
+                it = iter(new_c.modules)
+                new_c._init_args = tuple(
+                    next(it) if isinstance(a, Module) else a
+                    for a in new_c._init_args)
+            return (new_c,
+                    {str(i): cp for i, (_, cp, _) in enumerate(triples)},
+                    {str(i): cs for i, (_, _, cs) in enumerate(triples)})
+        return m, params, state
+
+    out, qparams, qstate = convert(model, model.get_parameters(),
+                                   model.get_state())
+    out.set_parameters(jax.tree.map(jnp.asarray, qparams))
+    out.set_state(qstate)
+    out.evaluate()
+    return out

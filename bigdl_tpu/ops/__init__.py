@@ -1,0 +1,10 @@
+"""TPU compute primitives: XLA reference ops + pallas kernels.
+
+The reference's native layer (L0: MKL BLAS + BigQuant int8, SURVEY.md §1)
+maps here — XLA generates the float kernels; pallas supplies the custom
+int8 path."""
+from bigdl_tpu.ops.quant import (int8_matmul, quantize_symmetric,
+                                 quantized_conv2d, quantized_linear)
+
+__all__ = ["int8_matmul", "quantize_symmetric", "quantized_conv2d",
+           "quantized_linear"]

@@ -1,0 +1,195 @@
+"""Topology (de)serialization of Module trees — the ModuleSerializer analogue
+(reference: utils/serializer/ModuleSerializer.scala:34, ModuleSerializable
+reflection path, registry :115).
+
+A module saves as a JSON spec: class name + captured constructor args
+(auto-recorded by Module.__init_subclass__) + extra children added after
+construction + per-module metadata (name, scales, train mode). Graph modules
+serialize their node/edge structure. Weights travel separately (save_tree);
+`save_module`/`load_module` in utils.serialization bundle both.
+
+Classes resolve through a registry seeded from ``bigdl_tpu.nn``; user classes
+register with :func:`register_module_class`.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def register_module_class(cls: type, name: Optional[str] = None) -> type:
+    _REGISTRY[name or cls.__name__] = cls
+    return cls
+
+
+def _resolve(name: str) -> type:
+    if name in _REGISTRY:
+        return _REGISTRY[name]
+    import bigdl_tpu.nn as nn
+    if hasattr(nn, name):
+        return getattr(nn, name)
+    import bigdl_tpu.models as models
+    if hasattr(models, name):
+        return getattr(models, name)
+    raise KeyError(
+        f"unknown module class {name!r}; register it with "
+        "bigdl_tpu.utils.module_serializer.register_module_class")
+
+
+# ------------------------------------------------------------------ encode
+
+def _encode_value(v) -> Any:
+    from bigdl_tpu.nn.module import Module
+    from bigdl_tpu.utils.table import Table
+    if isinstance(v, Module):
+        return {"__module__": to_spec(v)}
+    if isinstance(v, (np.ndarray, np.generic, jax.Array)):
+        arr = np.asarray(v)
+        return {"__ndarray__": arr.tolist(), "dtype": str(arr.dtype)}
+    if isinstance(v, Table):
+        return {"__table__": {str(k): _encode_value(x)
+                              for k, x in v.items()}}
+    if isinstance(v, dict):
+        return {"__dict__": {k: _encode_value(x) for k, x in v.items()}}
+    if isinstance(v, tuple):
+        return {"__tuple__": [_encode_value(x) for x in v]}
+    if isinstance(v, list):
+        return [_encode_value(x) for x in v]
+    if isinstance(v, (bool, int, float, str)) or v is None:
+        return v
+    # value objects (InitializationMethod, Regularizer, schedules...):
+    # shallow state capture
+    state = {k: _encode_value(x) for k, x in vars(v).items()
+             if not k.startswith("_")}
+    register_module_class(type(v))
+    return {"__obj__": type(v).__name__, "state": state}
+
+
+def _decode_value(v):
+    from bigdl_tpu.utils.table import Table
+    if isinstance(v, list):
+        return [_decode_value(x) for x in v]
+    if not isinstance(v, dict):
+        return v
+    if "__module__" in v:
+        return from_spec(v["__module__"])
+    if "__ndarray__" in v:
+        return np.asarray(v["__ndarray__"], dtype=v["dtype"])
+    if "__table__" in v:
+        t = Table()
+        for k, x in v["__table__"].items():
+            t[int(k) if k.lstrip("-").isdigit() else k] = _decode_value(x)
+        return t
+    if "__dict__" in v:
+        return {k: _decode_value(x) for k, x in v["__dict__"].items()}
+    if "__tuple__" in v:
+        return tuple(_decode_value(x) for x in v["__tuple__"])
+    if "__obj__" in v:
+        cls = _resolve(v["__obj__"])
+        obj = cls.__new__(cls)
+        obj.__dict__.update(
+            {k: _decode_value(x) for k, x in v["state"].items()})
+        return obj
+    return {k: _decode_value(x) for k, x in v.items()}
+
+
+def to_spec(module) -> Dict[str, Any]:
+    """Recursive JSON-able spec of a module tree."""
+    from bigdl_tpu.nn.container import Container
+    from bigdl_tpu.nn.graph import Graph
+    from bigdl_tpu.nn.module import Module
+
+    if isinstance(module, Graph):
+        return _graph_to_spec(module)
+
+    args = list(getattr(module, "_init_args", ()))
+    kwargs = dict(getattr(module, "_init_kwargs", {}))
+    spec: Dict[str, Any] = {
+        "class": type(module).__name__,
+        "args": [_encode_value(a) for a in args],
+        "kwargs": {k: _encode_value(v) for k, v in kwargs.items()},
+    }
+    _meta_to_spec(module, spec)
+    if isinstance(module, Container):
+        n_ctor = sum(1 for a in args if isinstance(a, Module))
+        extra = module.modules[n_ctor:]
+        if extra:
+            spec["n_ctor"] = n_ctor
+            spec["children"] = [to_spec(m) for m in extra]
+    return spec
+
+
+def _meta_to_spec(module, spec: Dict[str, Any]) -> None:
+    if module._name is not None:
+        spec["name"] = module._name
+    if module.scale_w != 1.0 or module.scale_b != 1.0:
+        spec["scales"] = [module.scale_w, module.scale_b]
+    if not module.train_mode:
+        spec["eval_mode"] = True
+
+
+def _meta_from_spec(module, spec: Dict[str, Any]) -> None:
+    if "name" in spec:
+        module.set_name(spec["name"])
+    if "scales" in spec:
+        module.scale_w, module.scale_b = spec["scales"]
+    if spec.get("eval_mode"):
+        # set only this module's flag; children restore their own
+        module.train_mode = False
+
+
+def from_spec(spec: Dict[str, Any]):
+    """Rebuild a module tree from its spec."""
+    if spec.get("class") == "Graph":
+        return _graph_from_spec(spec)
+    cls = _resolve(spec["class"])
+    args = [_decode_value(a) for a in spec.get("args", [])]
+    kwargs = {k: _decode_value(v) for k, v in spec.get("kwargs", {}).items()}
+    module = cls(*args, **kwargs)
+    _meta_from_spec(module, spec)
+    children = spec.get("children", [])
+    if children:
+        # A subclass __init__ may itself have built children beyond those
+        # passed as ctor args (e.g. a model class that calls self.add in
+        # __init__); those are already present — only add the remainder.
+        already_built = len(module.modules) - spec.get("n_ctor", 0)
+        for child_spec in children[max(0, already_built):]:
+            module.add(from_spec(child_spec))
+    return module
+
+
+# ---------------------------------------------------------- Graph handling
+
+def _graph_to_spec(g) -> Dict[str, Any]:
+    """Serialize nodes + edges; node ids are positions in exec_order."""
+    idx = {id(n): i for i, n in enumerate(g.exec_order)}
+    nodes = [to_spec(n.element) for n in g.exec_order]
+    edges: List[List] = []
+    for n in g.exec_order:
+        for p, e in n.prevs:
+            edges.append([idx[id(p)], idx[id(n)], e.from_index])
+    spec = {
+        "class": "Graph",
+        "nodes": nodes,
+        "edges": edges,
+        "inputs": [idx[id(n)] for n in g.input_nodes],
+        "outputs": [idx[id(n)] for n in g.output_nodes],
+    }
+    _meta_to_spec(g, spec)
+    return spec
+
+
+def _graph_from_spec(spec: Dict[str, Any]):
+    from bigdl_tpu.nn.graph import Graph
+    from bigdl_tpu.utils.directed_graph import Edge, Node
+    nodes = [Node(from_spec(s)) for s in spec["nodes"]]
+    for src, dst, from_index in spec["edges"]:
+        nodes[src].add(nodes[dst], Edge(from_index))
+    g = Graph([nodes[i] for i in spec["inputs"]],
+              [nodes[i] for i in spec["outputs"]])
+    _meta_from_spec(g, spec)
+    return g

@@ -136,14 +136,31 @@ def find_latest_checkpoint(directory: str) -> Optional[str]:
 # -- module-level save/load (ModuleSerializer analogue) ---------------------
 
 def save_module(path: str, module) -> None:
-    """Persist a module's params+state (+ name metadata)."""
+    """Persist a module: topology spec + params + state.
+
+    The saved directory is self-contained — ``load_module`` reconstructs the
+    module tree (class, constructor args, children, graph wiring) and its
+    weights without any user code, like the reference's
+    ``Module.loadModule`` (utils/serializer/ModuleLoader.scala).
+    """
+    from bigdl_tpu.utils.module_serializer import to_spec
     os.makedirs(path, exist_ok=True)
     module.ensure_initialized()
     save_tree(os.path.join(path, "params"), module.get_parameters())
     save_tree(os.path.join(path, "state"), module.get_state())
-    meta = {"class": type(module).__name__, "name": module.get_name()}
+    meta = {"class": type(module).__name__, "name": module.get_name(),
+            "spec": to_spec(module), "format_version": 1}
     with open(os.path.join(path, "module.json"), "w") as f:
         json.dump(meta, f)
+
+
+def load_module(path: str):
+    """Rebuild a module (topology + weights) saved by ``save_module``."""
+    from bigdl_tpu.utils.module_serializer import from_spec
+    with open(os.path.join(path, "module.json")) as f:
+        meta = json.load(f)
+    module = from_spec(meta["spec"])
+    return load_module_weights(path, module)
 
 
 def load_module_weights(path: str, module):

@@ -1,0 +1,101 @@
+"""Int8 quantized inference tests (reference test model: nn/quantized specs
++ bigquant correctness — quantized output close to float, rewrite preserves
+untouched layers)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.ops.quant import (int8_matmul, quantize_symmetric,
+                                 quantized_linear)
+
+
+def test_quantize_symmetric_roundtrip():
+    w = np.random.randn(8, 32).astype(np.float32)
+    q, scale = quantize_symmetric(w, axis=0)
+    assert q.dtype == jnp.int8
+    deq = np.asarray(q, np.float32) * np.asarray(scale)
+    assert np.abs(deq - w).max() <= np.abs(w).max() / 127.0 + 1e-6
+
+
+def test_int8_matmul_exact():
+    a = np.random.randint(-127, 128, (4, 16), dtype=np.int8)
+    b = np.random.randint(-127, 128, (8, 16), dtype=np.int8)
+    out = np.asarray(int8_matmul(jnp.asarray(a), jnp.asarray(b)))
+    ref = a.astype(np.int64) @ b.astype(np.int64).T
+    np.testing.assert_array_equal(out, ref.astype(np.int32))
+
+
+def test_quantized_linear_close_to_float():
+    x = np.random.randn(16, 64).astype(np.float32)
+    w = np.random.randn(32, 64).astype(np.float32) * 0.2
+    b = np.random.randn(32).astype(np.float32)
+    q, scale = quantize_symmetric(w, axis=0)
+    out = np.asarray(quantized_linear(jnp.asarray(x), q, scale.reshape(-1),
+                                      jnp.asarray(b)))
+    ref = x @ w.T + b
+    # int8 quantization error bound: ~1-2% relative
+    assert np.abs(out - ref).max() / np.abs(ref).max() < 0.05
+
+
+def test_quantize_model_sequential():
+    model = (nn.Sequential()
+             .add(nn.SpatialConvolution(3, 8, 3, 3, 1, 1, 1, 1))
+             .add(nn.ReLU())
+             .add(nn.Reshape((8 * 8 * 8,)))
+             .add(nn.Linear(8 * 8 * 8, 10))
+             .add(nn.LogSoftMax()))
+    x = np.random.randn(4, 3, 8, 8).astype(np.float32)
+    model.evaluate()
+    ref = np.asarray(model.forward(x))
+    qmodel = model.quantize()
+    assert isinstance(qmodel[0], nn.QuantizedSpatialConvolution)
+    assert isinstance(qmodel[3], nn.QuantizedLinear)
+    out = np.asarray(qmodel.forward(x))
+    assert out.shape == ref.shape
+    # top-1 predictions agree on almost all samples
+    assert (out.argmax(1) == ref.argmax(1)).mean() >= 0.75
+    # original model untouched: still float, same outputs
+    ref2 = np.asarray(model.forward(x))
+    np.testing.assert_allclose(ref, ref2, atol=1e-6)
+
+
+def test_quantize_graph_model():
+    from bigdl_tpu.models.lenet import LeNet5_graph
+    model = LeNet5_graph(10).evaluate()
+    x = np.random.randn(2, 1, 28, 28).astype(np.float32)
+    ref = np.asarray(model.forward(x))
+    qmodel = model.quantize()
+    out = np.asarray(qmodel.forward(x))
+    assert out.shape == ref.shape
+    assert np.abs(out - ref).max() < 0.5  # logsoftmax outputs, loose bound
+    # original graph still float
+    assert np.allclose(np.asarray(model.forward(x)), ref, atol=1e-6)
+
+
+def test_quantized_preserves_batchnorm_stats():
+    model = (nn.Sequential()
+             .add(nn.Linear(8, 8))
+             .add(nn.BatchNormalization(8))
+             .add(nn.Linear(8, 4)))
+    model.training()
+    for _ in range(3):
+        model.forward(np.random.randn(16, 8).astype(np.float32) * 3 + 1)
+    model.evaluate()
+    x = np.random.randn(4, 8).astype(np.float32)
+    ref = np.asarray(model.forward(x))
+    q = model.quantize()
+    out = np.asarray(q.forward(x))
+    # BN running stats carried over -> outputs stay close
+    assert np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9) < 0.1
+
+
+def test_quantized_model_serializes(tmp_path):
+    from bigdl_tpu.utils.serialization import load_module, save_module
+    model = nn.Sequential().add(nn.Linear(16, 8)).evaluate()
+    x = np.random.randn(2, 16).astype(np.float32)
+    q = model.quantize()
+    ref = np.asarray(q.forward(x))
+    save_module(str(tmp_path / "q"), q)
+    loaded = load_module(str(tmp_path / "q")).evaluate()
+    np.testing.assert_allclose(ref, np.asarray(loaded.forward(x)), atol=1e-5)

@@ -1,0 +1,148 @@
+"""Module topology + weight serialization round-trips (reference test model:
+utils/serializer specs — save, load into a FRESH process-independent tree,
+compare forward outputs)."""
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.utils.module_serializer import (from_spec,
+                                               register_module_class, to_spec)
+from bigdl_tpu.utils.serialization import load_module, save_module
+
+
+def _roundtrip_forward(model, x, tmp_path, atol=1e-6):
+    model.evaluate()
+    y0 = np.asarray(model.forward(x))
+    save_module(str(tmp_path / "m"), model)
+    loaded = load_module(str(tmp_path / "m")).evaluate()
+    y1 = np.asarray(loaded.forward(x))
+    np.testing.assert_allclose(y0, y1, atol=atol)
+    return loaded
+
+
+def test_sequential_lenet_roundtrip(tmp_path):
+    from bigdl_tpu.models import LeNet5
+    x = np.random.randn(2, 1, 28, 28).astype(np.float32)
+    loaded = _roundtrip_forward(LeNet5(10), x, tmp_path)
+    assert isinstance(loaded, nn.Sequential)
+
+
+def test_graph_lenet_roundtrip(tmp_path):
+    from bigdl_tpu.models.lenet import LeNet5_graph
+    x = np.random.randn(2, 1, 28, 28).astype(np.float32)
+    loaded = _roundtrip_forward(LeNet5_graph(10), x, tmp_path)
+    assert isinstance(loaded, nn.Graph)
+
+
+def test_resnet20_roundtrip(tmp_path):
+    from bigdl_tpu.models import ResNet
+    x = np.random.randn(2, 3, 32, 32).astype(np.float32)
+    _roundtrip_forward(ResNet(10, depth=20, dataset="CIFAR10"), x, tmp_path,
+                       atol=1e-4)
+
+
+def test_container_with_ctor_and_added_children(tmp_path):
+    m = nn.Concat(2, nn.Linear(4, 3), nn.Linear(4, 5))
+    m.add(nn.Linear(4, 2))
+    x = np.random.randn(3, 4).astype(np.float32)
+    y0 = np.asarray(m.forward(x))
+    assert y0.shape == (3, 10)
+    spec = to_spec(m)
+    rebuilt = from_spec(spec)
+    assert len(rebuilt.modules) == 3
+    save_module(str(tmp_path / "c"), m)
+    loaded = load_module(str(tmp_path / "c"))
+    np.testing.assert_allclose(y0, np.asarray(loaded.forward(x)), atol=1e-6)
+
+
+def test_metadata_preserved(tmp_path):
+    m = nn.Sequential().add(
+        nn.Linear(4, 4).set_name("proj").set_scale_w(0.5))
+    m.forward(np.zeros((1, 4), np.float32))
+    save_module(str(tmp_path / "meta"), m)
+    loaded = load_module(str(tmp_path / "meta"))
+    assert loaded[0].get_name() == "proj"
+    assert loaded[0].scale_w == 0.5
+
+
+def test_regularizer_arg_roundtrip(tmp_path):
+    from bigdl_tpu.optim.regularizer import L2Regularizer
+    m = nn.Linear(4, 4, w_regularizer=L2Regularizer(1e-4))
+    x = np.random.randn(2, 4).astype(np.float32)
+    _roundtrip_forward(m, x, tmp_path)
+    loaded = load_module(str(tmp_path / "m"))
+    p = loaded.get_parameters()
+    assert float(loaded.regularization_loss(p)) > 0.0
+
+
+def test_unknown_class_raises(tmp_path):
+    with pytest.raises(KeyError):
+        from_spec({"class": "DoesNotExist", "args": [], "kwargs": {}})
+
+
+def test_custom_class_registration(tmp_path):
+    class MyScale(nn.Module):
+        def __init__(self, factor):
+            super().__init__()
+            self.factor = factor
+
+        def forward_fn(self, params, input, *, training=False, rng=None):
+            return input * self.factor
+
+    register_module_class(MyScale)
+    m = nn.Sequential().add(MyScale(3.0))
+    x = np.ones((2, 2), np.float32)
+    _roundtrip_forward(m, x, tmp_path)
+
+
+def test_quantized_ctor_children_roundtrip(tmp_path):
+    """Review regression: quantize() must repair captured ctor args so the
+    quantized topology (not the stale float one) serializes."""
+    from bigdl_tpu.utils.serialization import load_module, save_module
+    m = nn.Concat(2, nn.Linear(4, 3), nn.Linear(4, 5)).evaluate()
+    x = np.random.randn(3, 4).astype(np.float32)
+    m.forward(x)
+    q = m.quantize()
+    ref = np.asarray(q.forward(x))
+    save_module(str(tmp_path / "qc"), q)
+    loaded = load_module(str(tmp_path / "qc"))
+    assert isinstance(loaded[0], nn.QuantizedLinear)
+    np.testing.assert_allclose(ref, np.asarray(loaded.forward(x)), atol=1e-5)
+
+
+def test_self_building_subclass_no_double_children(tmp_path):
+    from bigdl_tpu.utils.module_serializer import register_module_class
+    from bigdl_tpu.utils.serialization import load_module, save_module
+
+    class TinyNet(nn.Sequential):
+        def __init__(self, n):
+            super().__init__()
+            self.add(nn.Linear(4, n)).add(nn.ReLU())
+
+    register_module_class(TinyNet)
+    m = TinyNet(3)
+    x = np.random.randn(2, 4).astype(np.float32)
+    y0 = np.asarray(m.forward(x))
+    save_module(str(tmp_path / "t"), m)
+    loaded = load_module(str(tmp_path / "t"))
+    assert len(loaded.modules) == 2
+    np.testing.assert_allclose(y0, np.asarray(loaded.forward(x)), atol=1e-6)
+
+
+def test_graph_metadata_and_eval_mode(tmp_path):
+    from bigdl_tpu.models.lenet import LeNet5_graph
+    from bigdl_tpu.utils.serialization import load_module, save_module
+    g = LeNet5_graph(10).set_name("lenet").evaluate()
+    g.forward(np.random.randn(1, 1, 28, 28).astype(np.float32))
+    save_module(str(tmp_path / "g"), g)
+    loaded = load_module(str(tmp_path / "g"))
+    assert loaded.get_name() == "lenet"
+    assert loaded.is_training() is False
+
+
+def test_quantized_conv_keeps_name():
+    m = (nn.Sequential()
+         .add(nn.SpatialConvolution(3, 4, 3, 3).set_name("conv1")))
+    m.forward(np.random.randn(1, 3, 8, 8).astype(np.float32))
+    q = m.quantize()
+    assert q.find("conv1") is not None
