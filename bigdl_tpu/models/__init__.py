@@ -5,3 +5,5 @@ from bigdl_tpu.models.resnet import ResNet
 from bigdl_tpu.models.inception import Inception_v1, Inception_v1_NoAuxClassifier
 from bigdl_tpu.models.rnn import SimpleRNN, PTBModel
 from bigdl_tpu.models.autoencoder import Autoencoder
+from bigdl_tpu.models.transformer import (TransformerBlock, TransformerLM,
+                                          FeedForward)

@@ -1,0 +1,200 @@
+"""Transformer LM — the long-context/distributed flagship family (net-new
+capability beyond the reference's RNN LM, models/rnn/SimpleRNN.scala; built
+TPU-first so dp/tp/sp/ep shardings are part of the model definition).
+
+``TransformerLM.sharding_rules(mesh_axes)`` returns param-path → PartitionSpec
+rules (megatron-style: attention QKV column-parallel, O row-parallel; FFN
+up column / down row; embeddings vocab-parallel; MoE experts over the
+expert axis). Feed them to ``bigdl_tpu.parallel.shard_params`` /
+``Optimizer(sharding_rules=...)`` and XLA inserts the collectives.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.nn.attention import MultiHeadAttention
+from bigdl_tpu.nn.moe import MoE
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.nn.norm import LayerNorm
+from bigdl_tpu.utils.engine import Engine
+
+
+class FeedForward(Module):
+    def __init__(self, hidden_size: int, ffn_size: int,
+                 activation: str = "gelu"):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.ffn_size = ffn_size
+        self.activation = activation
+
+    def init(self, rng):
+        dtype = Engine.default_dtype()
+        k1, k2 = jax.random.split(rng)
+        s1 = 1.0 / math.sqrt(self.hidden_size)
+        s2 = 1.0 / math.sqrt(self.ffn_size)
+        return {"w_up": jax.random.uniform(
+                    k1, (self.hidden_size, self.ffn_size), dtype, -s1, s1),
+                "b_up": jnp.zeros((self.ffn_size,), dtype),
+                "w_down": jax.random.uniform(
+                    k2, (self.ffn_size, self.hidden_size), dtype, -s2, s2),
+                "b_down": jnp.zeros((self.hidden_size,), dtype)}
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        act = jax.nn.gelu if self.activation == "gelu" else jax.nn.relu
+        h = act(input @ params["w_up"] + params["b_up"])
+        return h @ params["w_down"] + params["b_down"]
+
+
+class TransformerBlock(Module):
+    """Pre-norm block: x + MHA(LN(x)); x + FFN/MoE(LN(x))."""
+
+    def __init__(self, hidden_size: int, num_heads: int, ffn_size: int,
+                 dropout: float = 0.0, causal: bool = True,
+                 ring_axis: Optional[str] = None,
+                 moe_experts: int = 0, moe_top_k: int = 2):
+        super().__init__()
+        self.ln1 = LayerNorm(hidden_size)
+        self.attn = MultiHeadAttention(hidden_size, num_heads,
+                                       dropout=dropout, causal=causal,
+                                       ring_axis=ring_axis)
+        self.ln2 = LayerNorm(hidden_size)
+        if moe_experts > 0:
+            self.mlp = MoE(hidden_size, ffn_size, moe_experts, moe_top_k)
+        else:
+            self.mlp = FeedForward(hidden_size, ffn_size)
+        self.moe_experts = moe_experts
+
+    def init(self, rng):
+        k1, k2, k3, k4 = jax.random.split(rng, 4)
+        return {"ln1": self.ln1.init(k1), "attn": self.attn.init(k2),
+                "ln2": self.ln2.init(k3), "mlp": self.mlp.init(k4)}
+
+    def initial_state(self):
+        return {"mlp": self.mlp.initial_state()}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        r1, r2 = (jax.random.split(rng) if rng is not None else (None, None))
+        h = self.ln1.forward_fn(params["ln1"], input)
+        h = self.attn.forward_fn(params["attn"], h, training=training,
+                                 rng=r1)
+        x = input + h
+        h = self.ln2.forward_fn(params["ln2"], x)
+        h, mlp_state = self.mlp.apply(params["mlp"], state.get("mlp", {}), h,
+                                      training=training, rng=r2)
+        return x + h, {"mlp": mlp_state}
+
+
+class TransformerLM(Module):
+    """Decoder-only LM over int32 token ids [B, S] -> logits [B, S, V]."""
+
+    def __init__(self, vocab_size: int, hidden_size: int = 512,
+                 num_layers: int = 6, num_heads: int = 8,
+                 ffn_size: Optional[int] = None, max_len: int = 2048,
+                 dropout: float = 0.0, ring_axis: Optional[str] = None,
+                 moe_experts: int = 0, moe_every: int = 2,
+                 tie_embeddings: bool = True):
+        super().__init__()
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.ffn_size = ffn_size or 4 * hidden_size
+        self.max_len = max_len
+        self.dropout = dropout
+        self.ring_axis = ring_axis
+        self.moe_experts = moe_experts
+        self.tie_embeddings = tie_embeddings
+        self.blocks = [
+            TransformerBlock(
+                hidden_size, num_heads, self.ffn_size, dropout=dropout,
+                causal=True, ring_axis=ring_axis,
+                moe_experts=(moe_experts if moe_experts
+                             and (i % moe_every == moe_every - 1) else 0))
+            for i in range(num_layers)]
+        self.ln_f = LayerNorm(hidden_size)
+
+    def init(self, rng):
+        dtype = Engine.default_dtype()
+        keys = jax.random.split(rng, self.num_layers + 4)
+        s = 1.0 / math.sqrt(self.hidden_size)
+        p = {"embed": jax.random.normal(
+                 keys[0], (self.vocab_size, self.hidden_size), dtype) * s,
+             "pos_embed": jax.random.normal(
+                 keys[1], (self.max_len, self.hidden_size), dtype) * s,
+             "ln_f": self.ln_f.init(keys[2])}
+        for i, blk in enumerate(self.blocks):
+            p[f"block_{i}"] = blk.init(keys[3 + i])
+        if not self.tie_embeddings:
+            p["lm_head"] = jax.random.normal(
+                keys[-1], (self.hidden_size, self.vocab_size), dtype) * s
+        return p
+
+    def initial_state(self):
+        return {f"block_{i}": blk.initial_state()
+                for i, blk in enumerate(self.blocks)}
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        tokens = input.astype(jnp.int32)
+        b, s = tokens.shape
+        x = params["embed"][tokens] + params["pos_embed"][:s][None]
+        keys = (jax.random.split(rng, self.num_layers)
+                if rng is not None else [None] * self.num_layers)
+        new_state = {}
+        for i, blk in enumerate(self.blocks):
+            x, st = blk.apply(params[f"block_{i}"],
+                              state.get(f"block_{i}", {}), x,
+                              training=training, rng=keys[i])
+            new_state[f"block_{i}"] = st
+        x = self.ln_f.forward_fn(params["ln_f"], x)
+        if self.tie_embeddings:
+            logits = x @ params["embed"].T
+        else:
+            logits = x @ params["lm_head"]
+        return logits, new_state
+
+    def aux_loss(self, state) -> jnp.ndarray:
+        """Total MoE load-balance loss across blocks."""
+        total = jnp.zeros((), jnp.float32)
+        for st in state.values():
+            mlp = st.get("mlp", {}) if isinstance(st, dict) else {}
+            if "aux_loss" in mlp:
+                total = total + mlp["aux_loss"]
+        return total
+
+    # ---- sharding (megatron-style rules consumed by parallel.shard_params)
+    def sharding_rules(self, data_axis: str = "data",
+                       model_axis: str = "model",
+                       expert_axis: Optional[str] = None):
+        from jax.sharding import PartitionSpec as P
+        e_ax = expert_axis or model_axis
+        # matched in order by parallel.shard_params; a rule only applies
+        # when its spec rank matches the leaf rank, so the 3-D stacked
+        # expert weights pick the expert-parallel rule and the 2-D dense
+        # FFN weights the megatron one.
+        return [
+            # pos_embed before embed: spec_for uses re.search and an
+            # unanchored "embed" would swallow "pos_embed"
+            ("pos_embed", P()),
+            (r"(^|/)embed$", P(model_axis, None)),   # vocab-parallel
+            ("lm_head", P(None, model_axis)),
+            (r"block_\d+/attn/w[qkv]", P(None, model_axis)),  # column
+            (r"block_\d+/attn/b[qkv]", P(model_axis)),
+            (r"block_\d+/attn/wo", P(model_axis, None)),      # row
+            (r"block_\d+/attn/bo", P()),
+            # MoE stacked experts [E, ., .]: shard the expert dim (EP)
+            (r"block_\d+/mlp/w_up", P(e_ax, None, None)),
+            (r"block_\d+/mlp/w_down", P(e_ax, None, None)),
+            # dense FFN (megatron column/row)
+            (r"block_\d+/mlp/w_up", P(None, model_axis)),
+            (r"block_\d+/mlp/b_up", P(model_axis)),
+            (r"block_\d+/mlp/w_down", P(model_axis, None)),
+            (r"block_\d+/mlp/b_down", P()),
+            (r"block_\d+/mlp/router", P()),
+            (r"block_\d+/ln\d", P()),
+            ("ln_f", P()),
+        ]

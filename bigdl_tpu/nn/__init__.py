@@ -58,6 +58,10 @@ from bigdl_tpu.nn.criterion import (
     CosineEmbeddingCriterion, CosineDistanceCriterion, DistKLDivCriterion,
     KLDCriterion, GaussianCriterion, ClassSimplexCriterion,
     DiceCoefficientCriterion, SoftmaxWithCriterion, L1Cost,
+    SequenceCrossEntropyCriterion,
     ParallelCriterion, MultiCriterion, TimeDistributedCriterion)
 from bigdl_tpu.nn.quantized import (
     QuantizedLinear, QuantizedSpatialConvolution, quantize)
+from bigdl_tpu.nn.attention import MultiHeadAttention, dot_product_attention
+from bigdl_tpu.nn.moe import MoE
+from bigdl_tpu.nn.norm import LayerNorm, RMSNorm

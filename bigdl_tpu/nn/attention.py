@@ -1,0 +1,118 @@
+"""Attention layers — net-new TPU-first capability (the reference has no
+attention/sequence-parallel machinery; SURVEY.md §2.3 "explicit parallelism
+checklist": TP/SP/CP absent. Long-context is first-class here, so attention
+ships with a ring/context-parallel path from the start).
+
+Layout convention: [batch, seq, model] (B,S,E); heads split E. Matmuls are
+einsums that XLA tiles onto the MXU; bf16-friendly.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.utils.engine import Engine
+
+
+def dot_product_attention(q, k, v, *, causal: bool = False, mask=None,
+                          dropout_rate: float = 0.0, rng=None,
+                          training: bool = False):
+    """Scaled dot-product attention. q,k,v: [B, H, S, D]."""
+    d = q.shape[-1]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(d)
+    if causal:
+        sq, sk = scores.shape[-2], scores.shape[-1]
+        cmask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        scores = jnp.where(cmask, scores, jnp.finfo(scores.dtype).min)
+    if mask is not None:
+        scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+    weights = jax.nn.softmax(scores, axis=-1)
+    if training and dropout_rate > 0.0 and rng is not None:
+        keep = jax.random.bernoulli(rng, 1.0 - dropout_rate, weights.shape)
+        weights = weights * keep / (1.0 - dropout_rate)
+    return jnp.einsum("bhqk,bhkd->bhqd", weights, v)
+
+
+class MultiHeadAttention(Module):
+    """Multi-head attention over [B, S, E] input.
+
+    ``ring_axis`` names a mesh axis; when the module runs inside
+    ``shard_map`` with the sequence dim sharded over that axis, attention
+    runs as ring attention (parallel/ring_attention.py) — exact, memory-
+    linear in local sequence length, comms overlapped around the ICI ring.
+    """
+
+    def __init__(self, hidden_size: int, num_heads: int,
+                 dropout: float = 0.0, causal: bool = False,
+                 with_bias: bool = True,
+                 ring_axis: Optional[str] = None):
+        super().__init__()
+        assert hidden_size % num_heads == 0
+        if ring_axis is not None and dropout > 0.0:
+            raise ValueError(
+                "attention dropout is not supported on the ring-attention "
+                "path (it would change the objective vs the unsharded "
+                "model); use dropout=0.0 with ring_axis")
+        self.hidden_size = hidden_size
+        self.num_heads = num_heads
+        self.head_dim = hidden_size // num_heads
+        self.dropout = dropout
+        self.causal = causal
+        self.with_bias = with_bias
+        self.ring_axis = ring_axis
+
+    def init(self, rng):
+        dtype = Engine.default_dtype()
+        keys = jax.random.split(rng, 4)
+        s = 1.0 / math.sqrt(self.hidden_size)
+        p = {}
+        for name, kk in zip(("q", "k", "v", "o"), keys):
+            p[f"w{name}"] = jax.random.uniform(
+                kk, (self.hidden_size, self.hidden_size), dtype, -s, s)
+            if self.with_bias:
+                p[f"b{name}"] = jnp.zeros((self.hidden_size,), dtype)
+        return p
+
+    def _proj(self, params, x, name):
+        y = x @ params[f"w{name}"]
+        if self.with_bias:
+            y = y + params[f"b{name}"]
+        return y
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        x = input
+        b, s, e = x.shape
+        h, d = self.num_heads, self.head_dim
+
+        def split(t):  # [B,S,E] -> [B,H,S,D]
+            return t.reshape(b, s, h, d).transpose(0, 2, 1, 3)
+
+        q = split(self._proj(params, x, "q"))
+        k = split(self._proj(params, x, "k"))
+        v = split(self._proj(params, x, "v"))
+
+        if self.ring_axis is not None and _inside_axis(self.ring_axis):
+            from bigdl_tpu.parallel.ring_attention import ring_attention
+            out = ring_attention(q, k, v, axis_name=self.ring_axis,
+                                 causal=self.causal)
+        else:
+            out = dot_product_attention(
+                q, k, v, causal=self.causal, dropout_rate=self.dropout,
+                rng=rng, training=training)
+        out = out.transpose(0, 2, 1, 3).reshape(b, s, e)
+        return self._proj(params, out, "o")
+
+
+def _inside_axis(axis_name: str) -> bool:
+    """True when tracing under shard_map/pmap with this named axis bound."""
+    try:
+        jax.lax.axis_index(axis_name)
+        return True
+    except NameError:
+        return False
+    except Exception:
+        return False

@@ -528,3 +528,26 @@ class TimeDistributedCriterion(Criterion):
                 ti = target
             total = total + self.critrn.apply(xi, ti)
         return total / steps if self.size_average else total
+
+
+class SequenceCrossEntropyCriterion(Criterion):
+    """Token-level cross-entropy from raw logits for LM training: input
+    [B, S, V] (or [B, V]), target int ids [B, S] (or [B]). The LM-family
+    counterpart of CrossEntropyCriterion (which, like the reference, eats
+    per-sample 2-D scores)."""
+
+    def __init__(self, label_smoothing: float = 0.0):
+        super().__init__()
+        self.label_smoothing = label_smoothing
+
+    def apply(self, input, target):
+        v = input.shape[-1]
+        logits = input.reshape(-1, v)
+        t = target.reshape(-1).astype(jnp.int32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, t[:, None], axis=1)[:, 0]
+        if self.label_smoothing > 0.0:
+            smooth = -jnp.mean(logp, axis=-1)
+            nll = ((1.0 - self.label_smoothing) * nll
+                   + self.label_smoothing * smooth)
+        return jnp.mean(nll)

@@ -237,3 +237,51 @@ class SpatialContrastiveNormalization(Module):
     def forward_fn(self, params, input, *, training=False, rng=None):
         y = self.sub.forward_fn({}, input)
         return self.div.forward_fn({}, y)
+
+
+class LayerNorm(Module):
+    """Layer normalization over the last dim (net-new for the transformer
+    family; the reference predates transformers)."""
+
+    def __init__(self, hidden_size: int, eps: float = 1e-5,
+                 elementwise_affine: bool = True):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.eps = eps
+        self.elementwise_affine = elementwise_affine
+
+    def init(self, rng):
+        from bigdl_tpu.utils.engine import Engine
+        dtype = Engine.default_dtype()
+        if not self.elementwise_affine:
+            return {}
+        return {"weight": jnp.ones((self.hidden_size,), dtype),
+                "bias": jnp.zeros((self.hidden_size,), dtype)}
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        x = input
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        y = (x - mu) * jax.lax.rsqrt(var + self.eps)
+        if self.elementwise_affine:
+            y = y * params["weight"] + params["bias"]
+        return y
+
+
+class RMSNorm(Module):
+    """RMS normalization (LLaMA-style) — cheaper than LayerNorm on the VPU."""
+
+    def __init__(self, hidden_size: int, eps: float = 1e-6):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.eps = eps
+
+    def init(self, rng):
+        from bigdl_tpu.utils.engine import Engine
+        return {"weight": jnp.ones((self.hidden_size,),
+                                   Engine.default_dtype())}
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        x = input
+        ms = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+        return x * jax.lax.rsqrt(ms + self.eps) * params["weight"]

@@ -123,13 +123,21 @@ class Optimizer:
     def __init__(self, model: Module, dataset: AbstractDataSet,
                  criterion: Criterion, batch_size: int = 32,
                  mesh: Optional[jax.sharding.Mesh] = None,
-                 data_axis: str = "data"):
+                 data_axis: str = "data",
+                 sharding_rules=None, zero1: bool = False):
         self.model = model
         self.dataset = dataset
         self.criterion = criterion
         self.batch_size = batch_size
         self.mesh = mesh
         self.data_axis = data_axis
+        # tensor/expert-parallel param layout (parallel/tp.py rules);
+        # None = fully replicated params (pure DP, the reference's layout)
+        self.sharding_rules = sharding_rules
+        # ZeRO-1: optimizer state sharded over the data axis — the direct
+        # analogue of the reference's per-node OWNED weight shard running
+        # the OptimMethod (AllReduceParameter.scala:214-303)
+        self.zero1 = zero1
         self.optim_method: OptimMethod = SGD()
         self.end_when: Trigger = None
         # validation
@@ -212,6 +220,33 @@ class Optimizer:
                                             jax.sharding.PartitionSpec())
             return jax.device_put(tree, sh)
         return tree
+
+    def _put_params(self, tree):
+        """Params: TP/EP-sharded when rules are given, else replicated."""
+        if self.mesh is not None and self.sharding_rules is not None:
+            from bigdl_tpu.parallel.tp import shard_params, validate_rules
+            problems = validate_rules(tree, self.mesh, self.sharding_rules)
+            if problems:
+                raise ValueError("bad sharding rules:\n" +
+                                 "\n".join(problems))
+            return shard_params(tree, self.mesh, self.sharding_rules)
+        return self._put_replicated(tree)
+
+    def _put_opt_state(self, tree):
+        """Optimizer state (momentum/variance buffers mirror the params
+        tree, so the TP rules match their paths too — re.search ignores the
+        'momentum/' prefix). With zero1, moment buffers instead shard dim 0
+        over the data axis (the reference's per-node owned shard running
+        the OptimMethod, AllReduceParameter.scala:214-303 ≈ ZeRO-1)."""
+        if self.mesh is None:
+            return tree
+        if self.zero1:
+            from bigdl_tpu.parallel.tp import shard_opt_state_zero1
+            return shard_opt_state_zero1(tree, self.mesh, self.data_axis)
+        if self.sharding_rules is not None:
+            from bigdl_tpu.parallel.tp import shard_params
+            return shard_params(tree, self.mesh, self.sharding_rules)
+        return self._put_replicated(tree)
 
     def _prep_io(self, batch: MiniBatch):
         inp = batch.get_input()
@@ -322,8 +357,8 @@ class Optimizer:
             self.optim_method.load_state(resumed["optim_host_state"])
             self.driver_state.update(resumed["driver_state"])
 
-        params = self._put_replicated(params)
-        opt_state = self._put_replicated(opt_state)
+        params = self._put_params(params)
+        opt_state = self._put_opt_state(opt_state)
         model_state = self._put_replicated(model_state)
 
         step = build_train_step(model, self.criterion, self.optim_method)

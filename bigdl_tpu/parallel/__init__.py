@@ -1,2 +1,10 @@
+"""Parallelism: mesh/sharding helpers, ring attention (SP/CP), tensor/
+expert-parallel rules (TP/EP). The reference's only axis is DP
+(AllReduceParameter); everything else is additive TPU-first scope."""
 from bigdl_tpu.parallel.mesh import (
     make_mesh, data_parallel_mesh, replicated, batch_sharded)
+from bigdl_tpu.parallel.ring_attention import (
+    ring_attention, ring_attention_sharded)
+from bigdl_tpu.parallel.tp import (
+    shard_params, shard_opt_state_zero1, spec_for, tree_shardings,
+    validate_rules)

@@ -1,0 +1,99 @@
+"""Ring attention — exact sequence/context-parallel attention over an ICI
+ring (net-new vs the reference, which has no sequence parallelism:
+SURVEY.md §2.3/§5. Design follows the blockwise/ring-attention pattern:
+K/V blocks rotate around the mesh axis via ``ppermute`` while each shard
+keeps a running online-softmax accumulator, so memory is linear in the
+LOCAL sequence length and comms overlap compute around the ring).
+
+Use inside ``shard_map`` with the sequence dim sharded over ``axis_name``
+(per-shard shapes [B, H, S_local, D]), or call :func:`ring_attention_sharded`
+on full arrays and let it wrap the shard_map.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _block_accum(q, k, v, m, l, o, qpos, kpos, *, causal, scale):
+    """One K/V block of online-softmax attention.
+
+    q [B,H,Sq,D]; k,v [B,H,Sk,D]; m,l [B,H,Sq]; o [B,H,Sq,D];
+    qpos [Sq], kpos [Sk] global positions for causal masking.
+    """
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    neg = jnp.asarray(jnp.finfo(scores.dtype).min, scores.dtype)
+    if causal:
+        cmask = qpos[:, None] >= kpos[None, :]
+        scores = jnp.where(cmask[None, None], scores, neg)
+    smax = jnp.max(scores, axis=-1)                      # [B,H,Sq]
+    m_new = jnp.maximum(m, smax)
+    # rows with everything masked keep m_new == neg; exp underflows to 0
+    p = jnp.exp(scores - m_new[..., None])
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    o_new = o * corr[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return m_new, l_new, o_new
+
+
+def ring_attention(q, k, v, *, axis_name: str, causal: bool = False):
+    """Exact attention with sequence sharded over ``axis_name``.
+
+    Per-shard q,k,v: [B, H, S_local, D]. Returns [B, H, S_local, D].
+    """
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, h, s_loc, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    dtype = jnp.promote_types(q.dtype, jnp.float32)
+    q32, k0, v0 = q.astype(dtype), k.astype(dtype), v.astype(dtype)
+
+    qpos = my * s_loc + jnp.arange(s_loc)
+    neg = jnp.asarray(jnp.finfo(dtype).min, dtype)
+    m0 = jnp.full((b, h, s_loc), neg, dtype)
+    l0 = jnp.zeros((b, h, s_loc), dtype)
+    o0 = jnp.zeros((b, h, s_loc, d), dtype)
+    # the accumulators become shard-varying inside the scan; mark the
+    # (constant) initial values as such for the vma type check
+    if hasattr(jax.lax, "pcast"):
+        m0, l0, o0 = jax.lax.pcast((m0, l0, o0), (axis_name,),
+                                   to="varying")
+    elif hasattr(jax.lax, "pvary"):
+        m0, l0, o0 = jax.lax.pvary((m0, l0, o0), (axis_name,))
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, t):
+        k_blk, v_blk, m, l, o = carry
+        src = (my - t) % n  # which shard's block we currently hold
+        kpos = src * s_loc + jnp.arange(s_loc)
+        m, l, o = _block_accum(q32, k_blk, v_blk, m, l, o, qpos, kpos,
+                               causal=causal, scale=scale)
+        # rotate AFTER consuming; skip the final (wasted) hop
+        k_nxt, v_nxt = jax.lax.cond(
+            t < n - 1,
+            lambda kv: jax.lax.ppermute(kv, axis_name, perm),
+            lambda kv: kv,
+            (k_blk, v_blk))
+        return (k_nxt, v_nxt, m, l, o), None
+
+    (k_f, v_f, m, l, o), _ = jax.lax.scan(
+        step, (k0, v0, m0, l0, o0), jnp.arange(n))
+    # fully-masked rows (l == 0) -> zeros, not NaN
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    out = o / safe_l[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh: Mesh, seq_axis: str = "seq",
+                           *, causal: bool = False):
+    """Full-array convenience wrapper: shards S over ``seq_axis`` and runs
+    ring attention under shard_map. q,k,v: [B, H, S, D] (global)."""
+    from jax.experimental.shard_map import shard_map
+    spec = P(None, None, seq_axis, None)
+    fn = functools.partial(ring_attention, axis_name=seq_axis, causal=causal)
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec)(q, k, v)

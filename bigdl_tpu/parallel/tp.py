@@ -1,0 +1,86 @@
+"""Tensor/expert-parallel sharding via GSPMD rules.
+
+The reference's only parallelism is data-parallel allreduce
+(AllReduceParameter, SURVEY.md §2.3); TP/EP here is additive TPU-first
+scope. Mechanism: param-path regex → PartitionSpec rules; ``shard_params``
+lays the pytree out over the mesh and XLA's SPMD partitioner inserts the
+collectives (all-gather/reduce-scatter over ICI) at compile time — no
+hand-written comms.
+"""
+from __future__ import annotations
+
+import re
+from typing import List, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Rules = Sequence[Tuple[str, P]]
+
+
+def _path_str(path) -> str:
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                    for k in path)
+
+
+def spec_for(path: str, ndim: int, rules: Rules) -> P:
+    """First rule whose regex matches AND whose spec rank fits the leaf.
+
+    P() (replicated) matches any rank; otherwise the spec must have
+    exactly ``ndim`` entries.
+    """
+    for pattern, spec in rules:
+        if re.search(pattern, path):
+            if len(spec) == 0 or len(spec) == ndim:
+                return spec
+    return P()
+
+
+def tree_shardings(tree, mesh: Mesh, rules: Rules):
+    """Pytree of NamedShardings matching ``tree``'s structure."""
+    def leaf_sharding(path, leaf):
+        spec = spec_for(_path_str(path), np.ndim(leaf), rules)
+        return NamedSharding(mesh, spec)
+    return jax.tree_util.tree_map_with_path(leaf_sharding, tree)
+
+
+def shard_params(params, mesh: Mesh, rules: Rules):
+    """device_put the param pytree according to the rules."""
+    return jax.device_put(params, tree_shardings(params, mesh, rules))
+
+
+def validate_rules(params, mesh: Mesh, rules: Rules) -> List[str]:
+    """Sanity-check: every sharded dim must divide evenly. Returns a list
+    of problem descriptions (empty = all good)."""
+    problems = []
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    for path, leaf in flat:
+        p = _path_str(path)
+        spec = spec_for(p, np.ndim(leaf), rules)
+        for dim, axis in enumerate(spec):
+            if axis is None:
+                continue
+            axes = (axis,) if isinstance(axis, str) else tuple(axis)
+            size = int(np.prod([mesh.shape[a] for a in axes]))
+            if np.shape(leaf)[dim] % size != 0:
+                problems.append(
+                    f"{p}: dim {dim} ({np.shape(leaf)[dim]}) not divisible "
+                    f"by mesh axes {axes} (size {size})")
+    return problems
+
+
+def shard_opt_state_zero1(tree, mesh: Mesh, data_axis: str = "data"):
+    """ZeRO-1 optimizer-state layout: each moment buffer's dim 0 sharded
+    over the data axis when divisible, else replicated — the analogue of
+    the reference's per-node owned weight shard running the OptimMethod
+    (AllReduceParameter.scala:214-303)."""
+    ndev = mesh.shape.get(data_axis, 1)
+
+    def put(leaf):
+        if (hasattr(leaf, "ndim") and leaf.ndim >= 1
+                and leaf.shape[0] % ndev == 0):
+            spec = P(data_axis, *([None] * (leaf.ndim - 1)))
+            return jax.device_put(leaf, NamedSharding(mesh, spec))
+        return jax.device_put(leaf, NamedSharding(mesh, P()))
+    return jax.tree.map(put, tree)
