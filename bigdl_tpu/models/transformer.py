@@ -2,11 +2,12 @@
 capability beyond the reference's RNN LM, models/rnn/SimpleRNN.scala; built
 TPU-first so dp/tp/sp/ep shardings are part of the model definition).
 
-``TransformerLM.sharding_rules(mesh_axes)`` returns param-path → PartitionSpec
-rules (megatron-style: attention QKV column-parallel, O row-parallel; FFN
-up column / down row; embeddings vocab-parallel; MoE experts over the
-expert axis). Feed them to ``bigdl_tpu.parallel.shard_params`` /
-``Optimizer(sharding_rules=...)`` and XLA inserts the collectives.
+``TransformerLM.sharding_rules(model_axis=..., expert_axis=...)`` returns
+param-path → PartitionSpec rules (megatron-style: attention QKV
+column-parallel, O row-parallel; FFN up column / down row; embeddings
+vocab-parallel; MoE experts over the expert axis). Feed them to
+``bigdl_tpu.parallel.shard_params`` / ``Optimizer(sharding_rules=...)`` and
+XLA inserts the collectives.
 """
 from __future__ import annotations
 
@@ -19,7 +20,7 @@ import jax.numpy as jnp
 import bigdl_tpu.nn as nn
 from bigdl_tpu.nn.attention import MultiHeadAttention
 from bigdl_tpu.nn.moe import MoE
-from bigdl_tpu.nn.module import Module
+from bigdl_tpu.nn.module import Module, adopt_or_init, adopt_state
 from bigdl_tpu.nn.norm import LayerNorm
 from bigdl_tpu.utils.engine import Engine
 
@@ -71,11 +72,13 @@ class TransformerBlock(Module):
 
     def init(self, rng):
         k1, k2, k3, k4 = jax.random.split(rng, 4)
-        return {"ln1": self.ln1.init(k1), "attn": self.attn.init(k2),
-                "ln2": self.ln2.init(k3), "mlp": self.mlp.init(k4)}
+        return {"ln1": adopt_or_init(self.ln1, k1),
+                "attn": adopt_or_init(self.attn, k2),
+                "ln2": adopt_or_init(self.ln2, k3),
+                "mlp": adopt_or_init(self.mlp, k4)}
 
     def initial_state(self):
-        return {"mlp": self.mlp.initial_state()}
+        return {"mlp": adopt_state(self.mlp)}
 
     def apply(self, params, state, input, *, training=False, rng=None):
         r1, r2 = (jax.random.split(rng) if rng is not None else (None, None))
@@ -126,16 +129,16 @@ class TransformerLM(Module):
                  keys[0], (self.vocab_size, self.hidden_size), dtype) * s,
              "pos_embed": jax.random.normal(
                  keys[1], (self.max_len, self.hidden_size), dtype) * s,
-             "ln_f": self.ln_f.init(keys[2])}
+             "ln_f": adopt_or_init(self.ln_f, keys[2])}
         for i, blk in enumerate(self.blocks):
-            p[f"block_{i}"] = blk.init(keys[3 + i])
+            p[f"block_{i}"] = adopt_or_init(blk, keys[3 + i])
         if not self.tie_embeddings:
             p["lm_head"] = jax.random.normal(
                 keys[-1], (self.hidden_size, self.vocab_size), dtype) * s
         return p
 
     def initial_state(self):
-        return {f"block_{i}": blk.initial_state()
+        return {f"block_{i}": adopt_state(blk)
                 for i, blk in enumerate(self.blocks)}
 
     def apply(self, params, state, input, *, training=False, rng=None):
@@ -167,8 +170,7 @@ class TransformerLM(Module):
         return total
 
     # ---- sharding (megatron-style rules consumed by parallel.shard_params)
-    def sharding_rules(self, data_axis: str = "data",
-                       model_axis: str = "model",
+    def sharding_rules(self, model_axis: str = "model",
                        expert_axis: Optional[str] = None):
         from jax.sharding import PartitionSpec as P
         e_ax = expert_axis or model_axis

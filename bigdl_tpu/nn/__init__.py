@@ -65,3 +65,4 @@ from bigdl_tpu.nn.quantized import (
 from bigdl_tpu.nn.attention import MultiHeadAttention, dot_product_attention
 from bigdl_tpu.nn.moe import MoE
 from bigdl_tpu.nn.norm import LayerNorm, RMSNorm
+from bigdl_tpu.nn.sparse import DenseToSparse, SparseLinear, SparseJoinTable

@@ -108,11 +108,14 @@ class MultiHeadAttention(Module):
 
 
 def _inside_axis(axis_name: str) -> bool:
-    """True when tracing under shard_map/pmap with this named axis bound."""
+    """True when tracing under shard_map/pmap with this named axis bound.
+
+    Only an unbound axis (NameError) falls back to local full attention —
+    which also means a TYPO in ring_axis silently degrades to shard-local
+    attention; use the same string for the mesh axis and ring_axis. Any
+    other tracing failure propagates."""
     try:
         jax.lax.axis_index(axis_name)
         return True
     except NameError:
-        return False
-    except Exception:
         return False

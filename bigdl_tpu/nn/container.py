@@ -10,7 +10,8 @@ from typing import List, Optional
 import jax
 import jax.numpy as jnp
 
-from bigdl_tpu.nn.module import Module, Params, State
+from bigdl_tpu.nn.module import (Module, Params, State,
+                                  adopt_or_init, adopt_state)
 from bigdl_tpu.utils.table import Table, T
 
 
@@ -39,12 +40,17 @@ class Container(Module):
 
     # -- functional core ---------------------------------------------------
     def init(self, rng) -> Params:
+        """Child params: adopt a child's already-materialized weights (set
+        via the stateful API or a model importer — the reference keeps
+        layer weights from construction, reset() only on demand);
+        otherwise initialize fresh."""
         keys = _split_rng(rng, len(self.modules))
-        return {str(i): m.init(k)
+        return {str(i): adopt_or_init(m, k)
                 for i, (m, k) in enumerate(zip(self.modules, keys))}
 
     def initial_state(self) -> State:
-        return {str(i): m.initial_state() for i, m in enumerate(self.modules)}
+        return {str(i): adopt_state(m)
+                for i, m in enumerate(self.modules)}
 
     def regularization_loss(self, params: Params):
         return sum(m.regularization_loss(params[str(i)])

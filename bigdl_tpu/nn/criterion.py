@@ -532,9 +532,13 @@ class TimeDistributedCriterion(Criterion):
 
 class SequenceCrossEntropyCriterion(Criterion):
     """Token-level cross-entropy from raw logits for LM training: input
-    [B, S, V] (or [B, V]), target int ids [B, S] (or [B]). The LM-family
-    counterpart of CrossEntropyCriterion (which, like the reference, eats
-    per-sample 2-D scores)."""
+    [B, S, V] (or [B, V]), target int TOKEN IDS [B, S] (or [B]).
+
+    NOTE: unlike the Torch-style class criterions above (1-based labels,
+    ClassNLLCriterion subtracts 1), targets here are 0-based vocabulary
+    ids — the universal LM convention. Out-of-range ids are clamped into
+    the vocab rather than silently producing NaN.
+    """
 
     def __init__(self, label_smoothing: float = 0.0):
         super().__init__()
@@ -545,7 +549,8 @@ class SequenceCrossEntropyCriterion(Criterion):
         logits = input.reshape(-1, v)
         t = target.reshape(-1).astype(jnp.int32)
         logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, t[:, None], axis=1)[:, 0]
+        nll = -jnp.take_along_axis(logp, t[:, None], axis=1,
+                                   mode="clip")[:, 0]
         if self.label_smoothing > 0.0:
             smooth = -jnp.mean(logp, axis=-1)
             nll = ((1.0 - self.label_smoothing) * nll

@@ -19,7 +19,7 @@ from typing import List, Sequence, Union
 
 import jax
 
-from bigdl_tpu.nn.module import Module
+from bigdl_tpu.nn.module import Module, adopt_or_init, adopt_state
 from bigdl_tpu.utils.directed_graph import Node
 from bigdl_tpu.utils.table import Table, T
 
@@ -90,11 +90,11 @@ class Graph(Module):
     # -- functional core ---------------------------------------------------
     def init(self, rng):
         keys = jax.random.split(rng, max(1, len(self.exec_order)))
-        return {self.node_names[id(n)]: n.element.init(k)
+        return {self.node_names[id(n)]: adopt_or_init(n.element, k)
                 for n, k in zip(self.exec_order, keys)}
 
     def initial_state(self):
-        return {self.node_names[id(n)]: n.element.initial_state()
+        return {self.node_names[id(n)]: adopt_state(n.element)
                 for n in self.exec_order}
 
     def regularization_loss(self, params):

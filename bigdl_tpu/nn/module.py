@@ -39,9 +39,14 @@ State = Dict[str, Any]
 
 
 def _to_jax(x):
+    def coerce(leaf):
+        # pass sparse (BCOO) and other jax array-likes through untouched
+        if hasattr(leaf, "todense") or isinstance(leaf, jax.Array):
+            return leaf
+        return jnp.asarray(leaf)
     if isinstance(x, (Table, list, tuple)) or isinstance(x, dict):
-        return jax.tree.map(jnp.asarray, x)
-    return jnp.asarray(x)
+        return jax.tree.map(coerce, x)
+    return coerce(x)
 
 
 class Module:
@@ -293,3 +298,19 @@ class Criterion:
 def total_regularization(module: Module, params: Params):
     """Total regularization penalty for a module tree."""
     return module.regularization_loss(params)
+
+
+def adopt_or_init(child: Module, rng) -> Params:
+    """Child params for a composite's init: adopt already-materialized
+    weights (stateful API / model importers — the reference keeps weights
+    from construction, reset() only on demand), else init fresh.
+
+    Every composite module (Container, Graph, Recurrent, TransformerBlock,
+    ...) must use this so adoption semantics are uniform.
+    """
+    return child._params if child._params is not None else child.init(rng)
+
+
+def adopt_state(child: Module) -> State:
+    return child._state if child._state is not None \
+        else child.initial_state()

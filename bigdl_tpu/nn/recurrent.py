@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from bigdl_tpu.nn.module import Module
+from bigdl_tpu.nn.module import Module, adopt_or_init, adopt_state
 from bigdl_tpu.utils.engine import Engine
 from bigdl_tpu.utils.table import Table, T
 
@@ -350,7 +350,7 @@ class Recurrent(Module):
         return self
 
     def init(self, rng):
-        return {"cell": self.cell.init(rng)}
+        return {"cell": adopt_or_init(self.cell, rng)}
 
     def _h0(self, x):
         if isinstance(self.cell, ConvLSTMPeephole):
@@ -400,7 +400,8 @@ class BiRecurrent(Module):
 
     def init(self, rng):
         k1, k2 = jax.random.split(rng)
-        return {"fwd": self.fwd.init(k1), "bwd": self.bwd.init(k2)}
+        return {"fwd": adopt_or_init(self.fwd, k1),
+                "bwd": adopt_or_init(self.bwd, k2)}
 
     def apply(self, params, state, input, *, training=False, rng=None):
         k1, k2 = (jax.random.split(rng) if rng is not None else (None, None))
@@ -429,7 +430,7 @@ class RecurrentDecoder(Module):
         return self
 
     def init(self, rng):
-        return {"cell": self.cell.init(rng)}
+        return {"cell": adopt_or_init(self.cell, rng)}
 
     def apply(self, params, state, input, *, training=False, rng=None):
         h0 = self.cell.init_hidden(input.shape[0], input.dtype)
@@ -454,10 +455,10 @@ class TimeDistributed(Module):
         self.layer = layer
 
     def init(self, rng):
-        return {"layer": self.layer.init(rng)}
+        return {"layer": adopt_or_init(self.layer, rng)}
 
     def initial_state(self):
-        return {"layer": self.layer.initial_state()}
+        return {"layer": adopt_state(self.layer)}
 
     def apply(self, params, state, input, *, training=False, rng=None):
         B, Tm = input.shape[0], input.shape[1]

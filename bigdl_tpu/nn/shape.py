@@ -10,7 +10,7 @@ from typing import Optional, Sequence
 import jax
 import jax.numpy as jnp
 
-from bigdl_tpu.nn.module import Module
+from bigdl_tpu.nn.module import Module, adopt_or_init
 from bigdl_tpu.utils.table import Table, T
 
 
@@ -336,7 +336,8 @@ class Scale(Module):
 
     def init(self, rng):
         k1, k2 = jax.random.split(rng)
-        return {"cmul": self.cmul.init(k1), "cadd": self.cadd.init(k2)}
+        return {"cmul": adopt_or_init(self.cmul, k1),
+                "cadd": adopt_or_init(self.cadd, k2)}
 
     def forward_fn(self, params, input, *, training=False, rng=None):
         y = self.cmul.forward_fn(params["cmul"], input)

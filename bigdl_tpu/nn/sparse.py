@@ -1,0 +1,72 @@
+"""Sparse layers (reference: tensor/SparseTensor.scala COO tensors +
+nn/SparseLinear.scala, nn/SparseJoinTable.scala, nn/DenseToSparse.scala —
+the wide-and-deep input path).
+
+TPU-first: sparse inputs ride ``jax.experimental.sparse.BCOO`` (batched COO,
+jit/grad-compatible); the matmul lowers to gather+MXU via bcoo_dot_general.
+Weights stay dense (the sparse side is the DATA, as in the reference).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from bigdl_tpu.nn.linear import Linear
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.utils.table import Table
+
+
+def to_sparse(x, n_batch: int = 1) -> jsparse.BCOO:
+    """Dense -> BCOO (DenseToSparse semantics)."""
+    return jsparse.BCOO.fromdense(jnp.asarray(x), n_batch=0)
+
+
+class DenseToSparse(Module):
+    """nn/DenseToSparse.scala — converts a dense activation to sparse."""
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        if isinstance(input, jsparse.BCOO):
+            return input
+        return jsparse.BCOO.fromdense(input)
+
+
+class SparseLinear(Linear):
+    """y = xW^T + b with sparse x (nn/SparseLinear.scala).
+
+    Same parameters/init as Linear; forward accepts BCOO or dense input.
+    """
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        if not isinstance(input, jsparse.BCOO):
+            return super().forward_fn(params, input, training=training,
+                                      rng=rng)
+        w = params["weight"]  # [out, in]
+        out = jsparse.bcoo_dot_general(
+            input, w.T, dimension_numbers=(((input.ndim - 1,), (0,)),
+                                           ((), ())))
+        if self.with_bias:
+            out = out + params["bias"]
+        return out
+
+
+class SparseJoinTable(Module):
+    """Concatenate sparse tensors along ``dimension`` (1-based, as Torch;
+    nn/SparseJoinTable.scala). Accepts a Table/list of BCOO or dense."""
+
+    def __init__(self, dimension: int = 2):
+        super().__init__()
+        self.dimension = dimension
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        entries = list(input) if isinstance(input, (Table, list, tuple)) \
+            else [input]
+        axis = self.dimension - 1
+        sparse_entries = [
+            e if isinstance(e, jsparse.BCOO) else jsparse.BCOO.fromdense(e)
+            for e in entries]
+        return jsparse.bcoo_concatenate(sparse_entries, dimension=axis)

@@ -58,13 +58,29 @@ class Metrics:
         return "; ".join(parts)
 
 
+def _collect_aux_losses(state_tree):
+    """Sum every "aux_loss" leaf in a model-state tree (MoE load-balance
+    terms, nn/moe.py). Differentiable — called inside loss_fn."""
+    total = 0.0
+    flat, _ = jax.tree_util.tree_flatten_with_path(state_tree)
+    for path, leaf in flat:
+        keys = [str(getattr(k, "key", getattr(k, "idx", k))) for k in path]
+        if keys and keys[-1] == "aux_loss":
+            total = total + leaf
+    return total
+
+
 def build_train_step(module: Module, criterion: Criterion,
-                     optim_method: OptimMethod):
+                     optim_method: OptimMethod,
+                     aux_loss_weight: float = 0.01):
     """The compiled hot path: loss + grad + update in one jit.
 
     Gradient normalization matches the reference (grads averaged over the
     global batch, DistriOptimizer.scala:296-310 divides by numFinished);
-    param_scales implements layer-wise scaling / freeze.
+    param_scales implements layer-wise scaling / freeze. Auxiliary losses
+    the model emits through its state (MoE load balancing) join the
+    objective with weight ``aux_loss_weight`` so they actually produce
+    router gradients.
     """
 
     def step(params, opt_state, model_state, rng, lr, inputs, targets):
@@ -90,7 +106,8 @@ def build_train_step(module: Module, criterion: Criterion,
             out = maybe_cast(out, ddtype)
             loss = criterion.apply(out, targets)
             reg = module.regularization_loss(p)
-            return loss + reg, (new_mstate, loss)
+            aux = _collect_aux_losses(new_mstate)
+            return loss + reg + aux_loss_weight * aux, (new_mstate, loss)
 
         grads, (new_mstate, data_loss) = jax.grad(
             loss_fn, has_aux=True)(params)

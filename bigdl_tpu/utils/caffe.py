@@ -1,0 +1,645 @@
+"""Caffe model import (reference: utils/caffe/CaffeLoader.scala:56 with
+Converter/LayerConverter/V1LayerConverter — reads .prototxt (text) +
+.caffemodel (binary protobuf), builds the layer graph, copies weights).
+
+No protobuf codegen: the binary side decodes through the in-repo wire codec
+(utils/proto.py) with the public caffe.proto field numbers; the text side
+uses a small recursive prototxt parser. Supports both V2 ``layer`` and V1
+``layers`` nets (the Inception-v1 zoo path, SURVEY.md §2.4 config 4).
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from bigdl_tpu.utils import proto
+
+# ---------------------------------------------------------------- prototxt
+
+_TOKEN = re.compile(r"""
+    \s*(?:
+      (?P<comment>\#[^\n]*) |
+      (?P<brace>[{}]) |
+      (?P<colon>:) |
+      (?P<string>"(?:[^"\\]|\\.)*") |
+      (?P<ident>[A-Za-z0-9_.+\-eE]+)
+    )""", re.VERBOSE)
+
+
+def _tokenize(text: str):
+    pos = 0
+    while pos < len(text):
+        while pos < len(text) and text[pos].isspace():
+            pos += 1
+        if pos >= len(text):
+            break
+        m = _TOKEN.match(text, pos)
+        if not m:
+            raise ValueError(f"prototxt parse error at {text[pos:pos+40]!r}")
+        pos = m.end()
+        if m.lastgroup == "comment":
+            continue
+        yield m.lastgroup, m.group(m.lastgroup)
+    yield "eof", ""
+
+
+def parse_prototxt(text: str) -> Dict[str, List[Any]]:
+    """Parse protobuf text format into {field: [values...]} (repeated-safe).
+    Nested messages are dicts; scalars are str/float/int/bool."""
+    tokens = list(_tokenize(text))
+    idx = 0
+
+    def parse_value(v: str):
+        if v.startswith('"'):
+            return v[1:-1]
+        if v in ("true", "false"):
+            return v == "true"
+        try:
+            return int(v)
+        except ValueError:
+            pass
+        try:
+            return float(v)
+        except ValueError:
+            return v  # enum identifier
+
+    def parse_msg(stop_at_brace: bool):
+        nonlocal idx
+        out: Dict[str, List[Any]] = {}
+        while True:
+            kind, val = tokens[idx]
+            if kind == "eof":
+                if stop_at_brace:
+                    raise ValueError("unexpected EOF in prototxt message")
+                return out
+            if kind == "brace" and val == "}":
+                idx += 1
+                return out
+            if kind != "ident":
+                raise ValueError(f"expected field name, got {val!r}")
+            key = val
+            idx += 1
+            kind, val = tokens[idx]
+            if kind == "colon":
+                idx += 1
+                kind, val = tokens[idx]
+                idx += 1
+                out.setdefault(key, []).append(parse_value(val))
+            elif kind == "brace" and val == "{":
+                idx += 1
+                out.setdefault(key, []).append(parse_msg(True))
+            else:
+                raise ValueError(f"expected ':' or '{{' after {key}")
+
+    return parse_msg(False)
+
+
+# --------------------------------------------------- caffemodel (binary)
+
+def _msgs(fields, n):
+    return fields.get(n, [])
+
+
+def _scalar(fields, n, default=None, conv=lambda x: x):
+    vals = fields.get(n, [])
+    return conv(vals[0]) if vals else default
+
+
+def _floatval(raw):
+    return proto.as_float(raw) if isinstance(raw, bytes) else float(raw)
+
+
+def parse_blob(buf: bytes) -> np.ndarray:
+    """BlobProto: shape=7(BlobShape dim=1), data=5 packed float,
+    double_data=8; legacy dims num=1,channels=2,height=3,width=4."""
+    f = proto.parse_message(buf)
+    if 5 in f:
+        data = np.concatenate([
+            np.frombuffer(raw, dtype="<f4") if isinstance(raw, bytes)
+            else np.array([proto.as_float(raw)], "<f4") for raw in f[5]])
+        data = data.astype(np.float32)
+    elif 8 in f:
+        data = np.concatenate([np.frombuffer(raw, dtype="<f8")
+                               for raw in f[8]]).astype(np.float32)
+    else:
+        data = np.zeros((0,), np.float32)
+    shape = None
+    if 7 in f:
+        sh = proto.parse_message(f[7][0])
+        dims = []
+        for raw in sh.get(1, []):
+            if isinstance(raw, bytes):
+                dims.extend(proto.unpack_packed_varints(raw))
+            else:
+                dims.append(raw)
+        shape = [proto.as_sint(d) for d in dims]
+    else:
+        legacy = [_scalar(f, i) for i in (1, 2, 3, 4)]
+        if any(v is not None for v in legacy):
+            shape = [v if v is not None else 1 for v in legacy]
+            # strip leading 1s of legacy 4-d layout
+            while len(shape) > 1 and shape[0] == 1:
+                shape = shape[1:]
+    if shape:
+        data = data.reshape(shape)
+    return data
+
+
+# V1 layer type enum -> canonical V2-style type string (caffe.proto)
+_V1_TYPES = {
+    3: "Concat", 4: "Convolution", 5: "Data", 6: "Dropout", 8: "Flatten",
+    14: "InnerProduct", 15: "LRN", 17: "Pooling", 18: "ReLU", 19: "Sigmoid",
+    20: "Softmax", 21: "SoftmaxWithLoss", 22: "Split", 23: "TanH",
+    25: "Eltwise", 26: "Power", 30: "ArgMax", 33: "Slice", 35: "AbsVal",
+    39: "Deconvolution", 1: "Accuracy",
+}
+
+
+class CaffeLayer:
+    """Normalized layer record from either text or binary, V1 or V2."""
+
+    def __init__(self, name, type_, bottoms, tops, params, blobs):
+        self.name = name
+        self.type = type_
+        self.bottoms = bottoms
+        self.tops = tops
+        self.params = params  # dict: param-group name -> dict
+        self.blobs = blobs    # list of np arrays
+
+    def __repr__(self):
+        return f"CaffeLayer({self.name}:{self.type})"
+
+
+# V2 LayerParameter param-group field numbers
+_V2_PARAM_FIELDS = {
+    104: "concat_param", 106: "convolution_param", 108: "dropout_param",
+    110: "eltwise_param", 117: "inner_product_param", 118: "lrn_param",
+    121: "pooling_param", 122: "power_param", 123: "relu_param",
+    125: "softmax_param", 133: "reshape_param", 135: "flatten_param",
+    139: "batch_norm_param", 142: "scale_param", 143: "input_param",
+}
+# V1 equivalents
+_V1_PARAM_FIELDS = {
+    9: "concat_param", 10: "convolution_param", 12: "dropout_param",
+    24: "eltwise_param", 17: "inner_product_param", 18: "lrn_param",
+    19: "pooling_param", 21: "power_param", 39: "softmax_param",
+}
+
+# param-group sub-message field numbers → named dicts
+_PARAM_SCHEMAS = {
+    "convolution_param": {1: "num_output", 2: "bias_term", 3: "pad",
+                          4: "kernel_size", 5: "group", 6: "stride",
+                          9: "pad_h", 10: "pad_w", 11: "kernel_h",
+                          12: "kernel_w", 13: "stride_h", 14: "stride_w",
+                          18: "dilation"},
+    "pooling_param": {1: "pool", 2: "kernel_size", 3: "stride", 4: "pad",
+                      5: "kernel_h", 6: "kernel_w", 7: "stride_h",
+                      8: "stride_w", 9: "pad_h", 10: "pad_w",
+                      12: "global_pooling"},
+    "inner_product_param": {1: "num_output", 2: "bias_term", 5: "axis"},
+    "lrn_param": {1: "local_size", 2: "alpha", 3: "beta", 4: "norm_region",
+                  5: "k"},
+    "batch_norm_param": {1: "use_global_stats",
+                         2: "moving_average_fraction", 3: "eps"},
+    "scale_param": {1: "axis", 2: "num_axes", 4: "bias_term"},
+    "concat_param": {1: "concat_dim", 2: "axis"},
+    "dropout_param": {1: "dropout_ratio"},
+    "eltwise_param": {1: "operation", 2: "coeff"},
+    "softmax_param": {2: "axis"},
+    "power_param": {1: "power", 2: "scale", 3: "shift"},
+    "input_param": {1: "shape"},
+    "reshape_param": {1: "shape"},
+    "flatten_param": {1: "axis"},
+}
+_FLOAT_FIELDS = {"alpha", "beta", "k", "eps", "moving_average_fraction",
+                 "dropout_ratio", "coeff", "power", "scale", "shift"}
+
+
+def _decode_param_group(name: str, buf: bytes) -> Dict[str, Any]:
+    schema = _PARAM_SCHEMAS.get(name, {})
+    out: Dict[str, Any] = {}
+    for field, wire, raw in proto.iter_fields(buf):
+        key = schema.get(field)
+        if key is None:
+            continue
+        if key == "shape":
+            sh = proto.parse_message(raw)
+            dims = []
+            for r in sh.get(1, []):
+                if isinstance(r, bytes):
+                    dims.extend(proto.unpack_packed_varints(r))
+                else:
+                    dims.append(r)
+            out.setdefault("shape", []).append(
+                [proto.as_sint(d) for d in dims])
+            continue
+        if key in _FLOAT_FIELDS:
+            val = _floatval(raw) if wire == 5 else (
+                proto.as_double(raw) if isinstance(raw, bytes) else raw)
+        elif isinstance(raw, bytes) and wire == 5:
+            val = proto.as_float(raw)
+        else:
+            val = raw
+        out.setdefault(key, []).append(val)
+    return {k: (v if len(v) > 1 else v[0]) for k, v in out.items()}
+
+
+def _decode_layer_v2(buf: bytes) -> CaffeLayer:
+    f = proto.parse_message(buf)
+    name = proto.as_string(f.get(1, [b""])[0])
+    type_ = proto.as_string(f.get(2, [b""])[0])
+    bottoms = [proto.as_string(b) for b in f.get(3, [])]
+    tops = [proto.as_string(t) for t in f.get(4, [])]
+    blobs = [parse_blob(b) for b in f.get(7, [])]
+    params = {pname: _decode_param_group(pname, f[num][0])
+              for num, pname in _V2_PARAM_FIELDS.items() if num in f}
+    return CaffeLayer(name, type_, bottoms, tops, params, blobs)
+
+
+def _decode_layer_v1(buf: bytes) -> CaffeLayer:
+    f = proto.parse_message(buf)
+    bottoms = [proto.as_string(b) for b in f.get(2, [])]
+    tops = [proto.as_string(t) for t in f.get(3, [])]
+    name = proto.as_string(f.get(4, [b""])[0])
+    type_num = f.get(5, [0])[0]
+    type_ = _V1_TYPES.get(type_num, f"V1Type{type_num}")
+    blobs = [parse_blob(b) for b in f.get(6, [])]
+    params = {pname: _decode_param_group(pname, f[num][0])
+              for num, pname in _V1_PARAM_FIELDS.items() if num in f}
+    return CaffeLayer(name, type_, bottoms, tops, params, blobs)
+
+
+def parse_caffemodel(data: bytes) -> Tuple[str, List[CaffeLayer], Dict]:
+    """NetParameter: name=1, layers(V1)=2, input=3, input_dim=4,
+    input_shape=8, layer(V2)=100."""
+    f = proto.parse_message(data)
+    name = proto.as_string(f.get(1, [b""])[0])
+    layers = [_decode_layer_v2(b) for b in f.get(100, [])]
+    layers += [_decode_layer_v1(b) for b in f.get(2, [])]
+    net_inputs = {"input": [proto.as_string(b) for b in f.get(3, [])],
+                  "input_dim": [proto.as_sint(v) for v in f.get(4, [])]}
+    return name, layers, net_inputs
+
+
+def _layers_from_prototxt(net: Dict[str, List]) -> List[CaffeLayer]:
+    out = []
+    for key in ("layer", "layers"):
+        for msg in net.get(key, []):
+            name = msg.get("name", [""])[0]
+            type_ = msg.get("type", [""])[0]
+            if isinstance(type_, int):
+                type_ = _V1_TYPES.get(type_, str(type_))
+            type_ = str(type_)
+            bottoms = [str(b) for b in msg.get("bottom", [])]
+            tops = [str(t) for t in msg.get("top", [])]
+            params = {k: v[0] for k, v in msg.items()
+                      if k.endswith("_param") and isinstance(v[0], dict)}
+            # prototxt param groups: unwrap single-element lists
+            params = {k: {kk: (vv if len(vv) > 1 else vv[0])
+                          for kk, vv in v.items()}
+                      for k, v in params.items()}
+            out.append(CaffeLayer(name, type_, bottoms, tops, params, []))
+    return out
+
+
+# ----------------------------------------------------------- model build
+
+_SKIP_TYPES = {"Data", "Accuracy", "Silence", "HDF5Data", "ImageData",
+               "DummyData", "MemoryData", "WindowData", "Python"}
+
+
+def _make_global_pooling():
+    """Defined lazily so utils.caffe imports without jax side effects."""
+    from bigdl_tpu.nn.module import Module
+    import jax.numpy as jnp
+
+    class GlobalPooling(Module):
+        """Caffe global_pooling: reduce all spatial dims, keepdims (NCHW)."""
+
+        def __init__(self, mode: str = "ave"):
+            super().__init__()
+            self.mode = mode
+
+        def forward_fn(self, params, input, *, training=False, rng=None):
+            axes = tuple(range(2, input.ndim))
+            if self.mode == "ave":
+                return jnp.mean(input, axis=axes, keepdims=True)
+            return jnp.max(input, axis=axes, keepdims=True)
+
+    return GlobalPooling
+
+
+GlobalPooling = None
+
+
+def _global_pooling(mode: str):
+    global GlobalPooling
+    if GlobalPooling is None:
+        GlobalPooling = _make_global_pooling()
+        from bigdl_tpu.utils.module_serializer import register_module_class
+        register_module_class(GlobalPooling)
+    return GlobalPooling(mode)
+
+
+def _conv_geometry(p):
+    def pick(generic, h_key, w_key, default):
+        h = p.get(h_key)
+        w = p.get(w_key)
+        g = p.get(generic, default)
+        if isinstance(g, list):
+            g = g[0]
+        return (h if h is not None else g, w if w is not None else g)
+    kh, kw = pick("kernel_size", "kernel_h", "kernel_w", 1)
+    sh, sw = pick("stride", "stride_h", "stride_w", 1)
+    ph, pw = pick("pad", "pad_h", "pad_w", 0)
+    return (int(kh), int(kw), int(sh), int(sw), int(ph), int(pw))
+
+
+class CaffeLoader:
+    """Load prototxt+caffemodel into a bigdl_tpu Graph
+    (CaffeLoader.scala:56). Either path may be None:
+    - def_path only  -> random-weight model from the text net
+    - model_path only -> topology+weights from the binary net
+    """
+
+    def __init__(self, def_path: Optional[str] = None,
+                 model_path: Optional[str] = None):
+        self.def_path = def_path
+        self.model_path = model_path
+
+    def load(self):
+        layers: List[CaffeLayer] = []
+        weight_layers: Dict[str, CaffeLayer] = {}
+        if self.model_path:
+            with open(self.model_path, "rb") as f:
+                _, bin_layers, _ = parse_caffemodel(f.read())
+            weight_layers = {l.name: l for l in bin_layers}
+            layers = bin_layers
+        if self.def_path:
+            with open(self.def_path) as f:
+                net = parse_prototxt(f.read())
+            layers = _layers_from_prototxt(net)
+        if not layers:
+            raise ValueError("no layers found")
+        return self._build(layers, weight_layers)
+
+    # -- shape inference (for weight-less prototxt loading) -----------------
+    @staticmethod
+    def _infer_shape(layer: CaffeLayer, in_shapes: List):
+        """Output shape per top, given bottom shapes (None = unknown)."""
+        t = layer.type
+        p = layer.params
+        s = in_shapes[0] if in_shapes else None
+        import math as _math
+        if t == "Input":
+            sh = p.get("input_param", {}).get("shape")
+            if isinstance(sh, dict):
+                dims = sh.get("dim", [])
+                return [list(dims) if isinstance(dims, list) else [dims]]
+            if isinstance(sh, list):
+                return [list(sh[0]) if sh else None]
+            return [None]
+        if s is None:
+            return [None for _ in layer.tops]
+        if t in ("Convolution", "Deconvolution"):
+            cp = p.get("convolution_param", {})
+            kh, kw, sh_, sw, ph, pw = _conv_geometry(cp)
+            n_out = int(cp.get("num_output", 1))
+            oh = (s[2] + 2 * ph - kh) // sh_ + 1
+            ow = (s[3] + 2 * pw - kw) // sw + 1
+            return [[s[0], n_out, oh, ow]]
+        if t == "Pooling":
+            pp = p.get("pooling_param", {})
+            if pp.get("global_pooling"):
+                return [[s[0], s[1], 1, 1]]
+            kh, kw, sh_, sw, ph, pw = _conv_geometry(pp)
+            oh = _math.ceil((s[2] + 2 * ph - kh) / sh_) + 1
+            ow = _math.ceil((s[3] + 2 * pw - kw) / sw) + 1
+            if ph > 0 and (oh - 1) * sh_ >= s[2] + ph:
+                oh -= 1
+            if pw > 0 and (ow - 1) * sw >= s[3] + pw:
+                ow -= 1
+            return [[s[0], s[1], oh, ow]]
+        if t == "InnerProduct":
+            n_out = int(p.get("inner_product_param", {}).get("num_output", 1))
+            return [[s[0], n_out]]
+        if t == "Concat":
+            cp = p.get("concat_param", {})
+            axis = int(cp.get("axis", cp.get("concat_dim", 1)))
+            out = list(s)
+            out[axis] = sum(sh[axis] for sh in in_shapes)
+            return [out]
+        if t == "Flatten":
+            return [[s[0], int(np.prod(s[1:]))]]
+        # shape-preserving (activations, LRN, BN, Scale, Dropout, Eltwise,
+        # Split, Softmax)
+        return [list(s) for _ in (layer.tops or [1])]
+
+    # -- layer conversion ---------------------------------------------------
+    def _convert(self, layer: CaffeLayer, blobs: List[np.ndarray],
+                 in_shapes: Optional[List] = None):
+        import bigdl_tpu.nn as nn
+        t = layer.type
+        p = layer.params
+
+        def set_wb(m, weight, bias=None):
+            m.ensure_initialized()
+            pp = dict(m.get_parameters())
+            pp["weight"] = np.asarray(weight, np.float32)
+            if bias is not None and "bias" in pp:
+                pp["bias"] = np.asarray(bias, np.float32)
+            m.set_parameters(pp)
+            return m
+
+        if t in ("Convolution", "Deconvolution"):
+            cp = p.get("convolution_param", {})
+            kh, kw, sh, sw, ph, pw = _conv_geometry(cp)
+            n_out = int(cp.get("num_output", 1))
+            group = int(cp.get("group", 1))
+            bias_term = bool(cp.get("bias_term", True))
+            if blobs and blobs[0].ndim == 4:
+                n_in = blobs[0].shape[1] * group
+            elif in_shapes and in_shapes[0] is not None:
+                n_in = int(in_shapes[0][1])
+            else:
+                n_in = 3  # unknowable without weights or input shape
+            m = nn.SpatialConvolution(n_in, n_out, kw, kh, sw, sh, pw, ph,
+                                      n_group=group, with_bias=bias_term)
+            if blobs:
+                w = blobs[0].reshape(n_out, n_in // group, kh, kw)
+                b = blobs[1] if bias_term and len(blobs) > 1 else None
+                set_wb(m, w, b)
+            return m
+        if t == "Pooling":
+            pp = p.get("pooling_param", {})
+            kh, kw, sh, sw, ph, pw = _conv_geometry(
+                {**pp, "kernel_h": pp.get("kernel_h"),
+                 "kernel_w": pp.get("kernel_w")})
+            pool = pp.get("pool", 0)
+            if isinstance(pool, str):
+                pool = {"MAX": 0, "AVE": 1}.get(pool, 0)
+            if pp.get("global_pooling"):
+                return _global_pooling("ave" if pool == 1 else "max")
+            # caffe pools use CEIL output shapes by default
+            if pool == 1:
+                m = nn.SpatialAveragePooling(kw, kh, sw, sh, pw, ph)
+            else:
+                m = nn.SpatialMaxPooling(kw, kh, sw, sh, pw, ph)
+            if hasattr(m, "ceil"):
+                m.ceil()
+            return m
+        if t == "InnerProduct":
+            ip = p.get("inner_product_param", {})
+            n_out = int(ip.get("num_output", 1))
+            bias_term = bool(ip.get("bias_term", True))
+            if blobs:
+                w = blobs[0].reshape(n_out, -1)
+                n_in = w.shape[1]
+                lin = nn.Linear(n_in, n_out, with_bias=bias_term)
+                set_wb(lin, w, blobs[1] if bias_term and len(blobs) > 1
+                       else None)
+            else:
+                if in_shapes and in_shapes[0] is not None:
+                    n_in = int(np.prod(in_shapes[0][1:]))
+                else:
+                    raise ValueError(
+                        f"InnerProduct {layer.name}: input size unknown "
+                        "(no weights and no inferable input shape)")
+                lin = nn.Linear(n_in, n_out, with_bias=bias_term)
+            # caffe IP implicitly flattens trailing dims
+            seq = nn.Sequential().add(nn.InferReshape((0, -1))).add(lin)
+            return seq
+        if t == "ReLU":
+            return nn.ReLU()
+        if t == "TanH":
+            return nn.Tanh()
+        if t == "Sigmoid":
+            return nn.Sigmoid()
+        if t in ("Softmax", "SoftmaxWithLoss"):
+            return nn.SoftMax()
+        if t == "Dropout":
+            ratio = float(p.get("dropout_param", {}).get("dropout_ratio",
+                                                         0.5))
+            return nn.Dropout(ratio)
+        if t == "LRN":
+            lp = p.get("lrn_param", {})
+            size = int(lp.get("local_size", 5))
+            alpha = float(lp.get("alpha", 1.0))
+            beta = float(lp.get("beta", 0.75))
+            k = float(lp.get("k", 1.0))
+            region = lp.get("norm_region", 0)
+            if isinstance(region, str):
+                region = {"ACROSS_CHANNELS": 0, "WITHIN_CHANNEL": 1}.get(
+                    region, 0)
+            if region == 1:
+                return nn.SpatialWithinChannelLRN(size, alpha, beta)
+            return nn.SpatialCrossMapLRN(size, alpha, beta, k)
+        if t == "Concat":
+            cp = p.get("concat_param", {})
+            axis = int(cp.get("axis", cp.get("concat_dim", 1)))
+            return nn.JoinTable(axis + 1, 0)
+        if t == "Eltwise":
+            ep = p.get("eltwise_param", {})
+            op = ep.get("operation", 1)
+            if isinstance(op, str):
+                op = {"PROD": 0, "SUM": 1, "MAX": 2}.get(op, 1)
+            return {0: nn.CMulTable(), 1: nn.CAddTable(),
+                    2: nn.CMaxTable()}[int(op)]
+        if t == "Flatten":
+            return nn.InferReshape((0, -1))
+        if t == "Power":
+            pw = p.get("power_param", {})
+            return nn.Power(float(pw.get("power", 1.0)),
+                            float(pw.get("scale", 1.0)),
+                            float(pw.get("shift", 0.0)))
+        if t == "AbsVal":
+            return nn.Abs()
+        if t in ("BatchNorm",):
+            bn_blobs = blobs
+            n = bn_blobs[0].shape[0] if bn_blobs else 1
+            m = nn.SpatialBatchNormalization(n, affine=False)
+            if bn_blobs and len(bn_blobs) >= 3:
+                scale = float(bn_blobs[2].reshape(-1)[0]) or 1.0
+                st = dict(m.ensure_initialized().get_state())
+                st["running_mean"] = (bn_blobs[0] / scale).astype(np.float32)
+                st["running_var"] = (bn_blobs[1] / scale).astype(np.float32)
+                m.set_state(st)
+            return m
+        if t == "Scale":
+            sp = p.get("scale_param", {})
+            n = blobs[0].shape[0] if blobs else 1
+            m = nn.CMul((1, n, 1, 1)) if not sp.get("bias_term") else None
+            if m is None:
+                # scale + shift: emulate with CMul then CAdd in a Sequential
+                seq = nn.Sequential()
+                cm = nn.CMul((1, n, 1, 1))
+                ca = nn.CAdd((1, n, 1, 1))
+                if blobs:
+                    set_wb(cm, blobs[0].reshape(1, n, 1, 1))
+                    if len(blobs) > 1:
+                        set_wb(ca, blobs[1].reshape(1, n, 1, 1))
+                return seq.add(cm).add(ca)
+            if blobs:
+                set_wb(m, blobs[0].reshape(1, n, 1, 1))
+            return m
+        if t in ("Input", "Split"):
+            return nn.Identity()
+        raise ValueError(f"unsupported caffe layer type {t} "
+                         f"({layer.name})")
+
+    # -- graph assembly -----------------------------------------------------
+    def _build(self, layers: List[CaffeLayer],
+               weight_layers: Dict[str, CaffeLayer]):
+        import bigdl_tpu.nn as nn
+        blob_node: Dict[str, Any] = {}
+        blob_shape: Dict[str, Any] = {}
+        input_nodes = []
+        consumed = set()
+        produced_order: List[str] = []
+
+        def input_node():
+            node = nn.Input()()
+            input_nodes.append(node)
+            return node
+
+        for layer in layers:
+            in_shapes = [blob_shape.get(b) for b in layer.bottoms]
+            out_shapes = self._infer_shape(layer, in_shapes)
+            if layer.type in _SKIP_TYPES or layer.type == "Input":
+                for i, top in enumerate(layer.tops):
+                    if top not in blob_node:
+                        blob_node[top] = input_node()
+                    if i < len(out_shapes):
+                        blob_shape[top] = out_shapes[i]
+                continue
+            blobs = layer.blobs or (
+                weight_layers[layer.name].blobs
+                if layer.name in weight_layers else [])
+            module = self._convert(layer, blobs, in_shapes)
+            module.set_name(layer.name)
+            ins = []
+            for b in layer.bottoms:
+                if b not in blob_node:
+                    blob_node[b] = input_node()
+                ins.append(blob_node[b])
+                consumed.add(b)
+            node = module(*ins) if ins else module(input_node())
+            for i, top in enumerate(layer.tops):
+                blob_node[top] = node
+                produced_order.append(top)
+                if i < len(out_shapes):
+                    blob_shape[top] = out_shapes[i]
+        # outputs = blobs produced but never consumed (graph sinks)
+        sinks = [t for t in dict.fromkeys(produced_order)
+                 if t not in consumed]
+        outputs = [blob_node[t] for t in sinks] or \
+            [blob_node[produced_order[-1]]]
+        return nn.Graph(input_nodes, outputs)
+
+
+def load_caffe(def_path: Optional[str] = None,
+               model_path: Optional[str] = None):
+    """Module.loadCaffeModel equivalent."""
+    return CaffeLoader(def_path, model_path).load()

@@ -1,0 +1,209 @@
+"""Caffe importer tests (reference model: CaffeLoaderSpec against tiny
+prototxt/caffemodel fixtures, test/resources/caffe). Fixtures here are
+generated with the same wire codec the importer decodes with, using the
+public caffe.proto field numbers."""
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.utils import proto
+from bigdl_tpu.utils.caffe import (CaffeLoader, load_caffe, parse_caffemodel,
+                                   parse_prototxt)
+
+PROTOTXT = """
+name: "TinyNet"
+# a comment
+layer {
+  name: "data" type: "Input" top: "data"
+  input_param { shape { dim: 1 dim: 3 dim: 8 dim: 8 } }
+}
+layer {
+  name: "conv1" type: "Convolution" bottom: "data" top: "conv1"
+  convolution_param { num_output: 4 kernel_size: 3 stride: 1 pad: 1 }
+}
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer {
+  name: "pool1" type: "Pooling" bottom: "conv1" top: "pool1"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 }
+}
+layer {
+  name: "fc" type: "InnerProduct" bottom: "pool1" top: "fc"
+  inner_product_param { num_output: 10 }
+}
+layer { name: "prob" type: "Softmax" bottom: "fc" top: "prob" }
+"""
+
+
+def test_parse_prototxt():
+    net = parse_prototxt(PROTOTXT)
+    assert net["name"] == ["TinyNet"]
+    layers = net["layer"]
+    assert len(layers) == 6
+    conv = layers[1]
+    assert conv["type"] == ["Convolution"]
+    cp = conv["convolution_param"][0]
+    assert cp["num_output"] == [4]
+    assert cp["kernel_size"] == [3]
+    pool = layers[3]
+    assert pool["pooling_param"][0]["pool"] == ["MAX"]
+
+
+def test_prototxt_topology_build():
+    import tempfile, os
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "net.prototxt")
+        with open(p, "w") as f:
+            f.write(PROTOTXT)
+        model = load_caffe(def_path=p)
+    x = np.random.randn(1, 3, 8, 8).astype(np.float32)
+    out = np.asarray(model.evaluate().forward(x))
+    assert out.shape == (1, 10)
+    np.testing.assert_allclose(out.sum(), 1.0, atol=1e-5)  # softmax
+    assert model.find("conv1") is not None
+
+
+# ---------------------------------------------------- binary caffemodel
+
+def _blob(arr: np.ndarray) -> bytes:
+    shape_msg = b"".join(proto.encode_field(1, int(d), wire_type=0)
+                         for d in arr.shape)
+    payload = np.asarray(arr, "<f4").tobytes()
+    return (proto.encode_message(7, shape_msg) +
+            proto.encode_field(5, payload, wire_type=2))
+
+
+def _layer_v2(name, type_, bottoms, tops, blobs=(), param_field=None,
+              param_payload=b"") -> bytes:
+    msg = proto.encode_field(1, name) + proto.encode_field(2, type_)
+    for b in bottoms:
+        msg += proto.encode_field(3, b)
+    for t in tops:
+        msg += proto.encode_field(4, t)
+    for bl in blobs:
+        msg += proto.encode_message(7, _blob(bl))
+    if param_field:
+        msg += proto.encode_message(param_field, param_payload)
+    return msg
+
+
+def _make_binary_net(w, b, wfc, bfc) -> bytes:
+    conv_param = (proto.encode_field(1, 2, wire_type=0) +    # num_output=2
+                  proto.encode_field(4, 3, wire_type=0) +    # kernel=3
+                  proto.encode_field(6, 1, wire_type=0) +    # stride=1
+                  proto.encode_field(3, 1, wire_type=0))     # pad=1
+    ip_param = proto.encode_field(1, 5, wire_type=0)         # num_output=5
+    net = proto.encode_field(1, "BinNet")
+    net += proto.encode_message(100, _layer_v2(
+        "conv", "Convolution", ["data"], ["conv"], [w, b], 106, conv_param))
+    net += proto.encode_message(100, _layer_v2(
+        "relu", "ReLU", ["conv"], ["conv"]))
+    net += proto.encode_message(100, _layer_v2(
+        "fc", "InnerProduct", ["conv"], ["fc"], [wfc, bfc], 117, ip_param))
+    return net
+
+
+def test_binary_caffemodel_roundtrip(tmp_path):
+    rng = np.random.RandomState(0)
+    w = rng.randn(2, 3, 3, 3).astype(np.float32) * 0.2
+    b = rng.randn(2).astype(np.float32)
+    wfc = rng.randn(5, 2 * 4 * 4).astype(np.float32) * 0.1
+    bfc = rng.randn(5).astype(np.float32)
+    path = tmp_path / "net.caffemodel"
+    path.write_bytes(_make_binary_net(w, b, wfc, bfc))
+
+    name, layers, _ = parse_caffemodel(path.read_bytes())
+    assert name == "BinNet"
+    assert [l.name for l in layers] == ["conv", "relu", "fc"]
+    np.testing.assert_allclose(layers[0].blobs[0], w)
+
+    model = load_caffe(model_path=str(path))
+    x = rng.randn(1, 3, 4, 4).astype(np.float32)
+    out = np.asarray(model.evaluate().forward(x))
+    # numpy reference: conv(pad1) -> relu -> flatten -> fc
+    import jax
+    import jax.numpy as jnp
+    ref_conv = jax.lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(w), (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    ref = np.maximum(np.asarray(ref_conv) + b.reshape(1, -1, 1, 1), 0)
+    ref = ref.reshape(1, -1) @ wfc.T + bfc
+    np.testing.assert_allclose(out, ref, atol=1e-4)
+
+
+def test_prototxt_plus_caffemodel_weights(tmp_path):
+    """Text topology + binary weights matched by layer name (the
+    CaffeLoader.load(defPath, modelPath) path)."""
+    rng = np.random.RandomState(1)
+    w = rng.randn(4, 3, 3, 3).astype(np.float32) * 0.2
+    b = rng.randn(4).astype(np.float32)
+    wfc = rng.randn(10, 4 * 4 * 4).astype(np.float32) * 0.1
+    bfc = rng.randn(10).astype(np.float32)
+    conv_param = (proto.encode_field(1, 4, wire_type=0) +
+                  proto.encode_field(4, 3, wire_type=0) +
+                  proto.encode_field(3, 1, wire_type=0))
+    ip_param = proto.encode_field(1, 10, wire_type=0)
+    net = proto.encode_message(100, _layer_v2(
+        "conv1", "Convolution", ["data"], ["conv1"], [w, b], 106,
+        conv_param))
+    net += proto.encode_message(100, _layer_v2(
+        "fc", "InnerProduct", ["pool1"], ["fc"], [wfc, bfc], 117, ip_param))
+    mp = tmp_path / "weights.caffemodel"
+    mp.write_bytes(net)
+    dp = tmp_path / "net.prototxt"
+    dp.write_text(PROTOTXT)
+    model = CaffeLoader(str(dp), str(mp)).load()
+    x = np.random.randn(1, 3, 8, 8).astype(np.float32)
+    out = np.asarray(model.evaluate().forward(x))
+    assert out.shape == (1, 10)
+    # conv1 weights came from the binary net
+    conv1 = model.find("conv1")
+    np.testing.assert_allclose(np.asarray(conv1.get_parameters()["weight"]),
+                               w, atol=1e-6)
+
+
+def test_inplace_layers_chain():
+    """top == bottom chains (caffe in-place ReLU/Dropout) must thread
+    through the graph in order."""
+    txt = """
+layer { name: "data" type: "Input" top: "d"
+  input_param { shape { dim: 1 dim: 2 } } }
+layer { name: "ip" type: "InnerProduct" bottom: "d" top: "ip"
+  inner_product_param { num_output: 3 } }
+layer { name: "r1" type: "ReLU" bottom: "ip" top: "ip" }
+layer { name: "s" type: "Sigmoid" bottom: "ip" top: "out" }
+"""
+    import tempfile, os
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "x.prototxt")
+        open(p, "w").write(txt)
+        model = load_caffe(def_path=p)
+    x = np.random.randn(2, 2).astype(np.float32)
+    out = np.asarray(model.evaluate().forward(x))
+    assert out.shape == (2, 3)
+    assert (out > 0).all() and (out < 1).all()  # sigmoid output
+
+
+def test_concat_and_eltwise():
+    txt = """
+layer { name: "data" type: "Input" top: "d"
+  input_param { shape { dim: 1 dim: 2 dim: 4 dim: 4 } } }
+layer { name: "c1" type: "Convolution" bottom: "d" top: "c1"
+  convolution_param { num_output: 2 kernel_size: 1 } }
+layer { name: "c2" type: "Convolution" bottom: "d" top: "c2"
+  convolution_param { num_output: 2 kernel_size: 1 } }
+layer { name: "cat" type: "Concat" bottom: "c1" bottom: "c2" top: "cat"
+  concat_param { axis: 1 } }
+layer { name: "sum" type: "Eltwise" bottom: "c1" bottom: "c2" top: "sum"
+  eltwise_param { operation: SUM } }
+"""
+    import tempfile, os
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "x.prototxt")
+        open(p, "w").write(txt)
+        model = load_caffe(def_path=p)
+    x = np.random.randn(1, 2, 4, 4).astype(np.float32)
+    out = model.evaluate().forward(x)
+    # two sinks: cat [1,4,4,4] and sum [1,2,4,4]
+    outs = list(out)
+    shapes = sorted(np.asarray(o).shape for o in outs)
+    assert shapes == [(1, 2, 4, 4), (1, 4, 4, 4)]

@@ -1,0 +1,126 @@
+"""Torch7 .t7 serialization tests (reference model: TorchFile round-trips
+via TH.run in torch/ specs; here: self round-trip of the binary format +
+model conversion fidelity)."""
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.utils import torch_file as t7
+from bigdl_tpu.utils.torch_file import TorchObject
+
+
+def test_primitive_roundtrip(tmp_path):
+    p = str(tmp_path / "x.t7")
+    for obj in [None, True, False, 3, 2.5, "hello"]:
+        t7.save(p, obj)
+        assert t7.load(p) == obj
+
+
+def test_tensor_roundtrip(tmp_path):
+    p = str(tmp_path / "t.t7")
+    for dtype in (np.float32, np.float64, np.int64, np.int32, np.uint8):
+        x = (np.random.rand(3, 4, 5) * 100).astype(dtype)
+        t7.save(p, x)
+        y = t7.load(p)
+        assert y.dtype == dtype
+        np.testing.assert_array_equal(x, y)
+
+
+def test_table_roundtrip(tmp_path):
+    p = str(tmp_path / "tab.t7")
+    obj = {"a": 1, "b": [1.0, 2.0, "three"],
+           "t": np.arange(6, dtype=np.float32).reshape(2, 3)}
+    t7.save(p, obj)
+    back = t7.load(p)
+    assert back["a"] == 1
+    assert back["b"][:2] == [1, 2]
+    np.testing.assert_array_equal(back["t"], obj["t"])
+
+
+def test_shared_object_identity(tmp_path):
+    """Torch memoizes repeated objects; sharing must survive round-trip."""
+    p = str(tmp_path / "shared.t7")
+    w = np.random.rand(4, 4).astype(np.float32)
+    obj = {"first": w, "second": w}
+    t7.save(p, obj)
+    back = t7.load(p)
+    assert back["first"] is back["second"]
+
+
+def test_torch_object_roundtrip(tmp_path):
+    p = str(tmp_path / "obj.t7")
+    lin = TorchObject("nn.Linear", {
+        "weight": np.random.rand(3, 5).astype(np.float64),
+        "bias": np.random.rand(3).astype(np.float64)})
+    t7.save(p, lin)
+    back = t7.load(p)
+    assert back.torch_type == "nn.Linear"
+    np.testing.assert_array_equal(back.state["weight"],
+                                  lin.state["weight"])
+
+
+def test_load_torch_model_mlp(tmp_path):
+    """A torch-saved MLP (as torch.save would lay it out) converts to
+    bigdl_tpu modules with identical forward."""
+    p = str(tmp_path / "mlp.t7")
+    w1 = np.random.randn(8, 4).astype(np.float64)
+    b1 = np.random.randn(8).astype(np.float64)
+    w2 = np.random.randn(2, 8).astype(np.float64)
+    b2 = np.random.randn(2).astype(np.float64)
+    model_t7 = TorchObject("nn.Sequential", {"modules": [
+        TorchObject("nn.Linear", {"weight": w1, "bias": b1}),
+        TorchObject("nn.ReLU", {}),
+        TorchObject("nn.Linear", {"weight": w2, "bias": b2}),
+        TorchObject("nn.LogSoftMax", {}),
+    ]})
+    t7.save(p, model_t7)
+    model = t7.load_torch_model(p)
+    x = np.random.randn(5, 4).astype(np.float32)
+    out = np.asarray(model.evaluate().forward(x))
+    # numpy reference
+    h = np.maximum(x @ w1.T.astype(np.float32) + b1.astype(np.float32), 0)
+    logits = h @ w2.T.astype(np.float32) + b2.astype(np.float32)
+    ref = logits - np.log(np.exp(logits).sum(1, keepdims=True))
+    np.testing.assert_allclose(out, ref, atol=1e-4)
+
+
+def test_load_torch_model_convnet(tmp_path):
+    p = str(tmp_path / "conv.t7")
+    w = np.random.randn(6, 3, 5, 5).astype(np.float64) * 0.1
+    b = np.zeros(6, np.float64)
+    model_t7 = TorchObject("nn.Sequential", {"modules": [
+        TorchObject("nn.SpatialConvolution", {
+            "nInputPlane": 3, "nOutputPlane": 6, "kW": 5, "kH": 5,
+            "dW": 1, "dH": 1, "padW": 2, "padH": 2,
+            "weight": w, "bias": b}),
+        TorchObject("nn.SpatialMaxPooling", {
+            "kW": 2, "kH": 2, "dW": 2, "dH": 2, "padW": 0, "padH": 0}),
+        TorchObject("nn.ReLU", {}),
+    ]})
+    t7.save(p, model_t7)
+    model = t7.load_torch_model(p)
+    x = np.random.randn(2, 3, 8, 8).astype(np.float32)
+    out = np.asarray(model.evaluate().forward(x))
+    assert out.shape == (2, 6, 4, 4)
+    assert np.isfinite(out).all()
+
+
+def test_unsupported_module_raises(tmp_path):
+    p = str(tmp_path / "bad.t7")
+    t7.save(p, TorchObject("nn.ExoticLayer", {}))
+    with pytest.raises(ValueError, match="unsupported torch module"):
+        t7.load_torch_model(p)
+
+
+def test_flattened_conv_weight(tmp_path):
+    """Torch sometimes stores conv weight 2-D [nOut, nIn*kh*kw]."""
+    p = str(tmp_path / "flat.t7")
+    w4 = np.random.randn(4, 2, 3, 3).astype(np.float64)
+    obj = TorchObject("nn.SpatialConvolution", {
+        "nInputPlane": 2, "nOutputPlane": 4, "kW": 3, "kH": 3,
+        "weight": w4.reshape(4, -1), "bias": np.zeros(4)})
+    t7.save(p, obj)
+    from bigdl_tpu.utils.torch_file import _to_module
+    m = _to_module(t7.load(p))
+    np.testing.assert_allclose(np.asarray(m.get_parameters()["weight"]),
+                               w4.astype(np.float32), atol=1e-6)
