@@ -39,8 +39,7 @@ from bigdl_tpu.nn.shape import (
     Reshape, InferReshape, View, Squeeze, Unsqueeze, Transpose, Contiguous,
     Replicate, Padding, SpatialZeroPadding, Narrow, Select, SelectTable,
     MaskedSelect, Index, Max, Min, Mean, Sum, Scale, Tile, Pack, Reverse,
-    SplitTable, BifurcateSplitTable, JoinTable, FlattenTable, ResizeBilinear,
-    DenseToSparse)
+    SplitTable, BifurcateSplitTable, JoinTable, FlattenTable, ResizeBilinear)
 from bigdl_tpu.nn.table_ops import (
     CAddTable, CSubTable, CMulTable, CDivTable, CMaxTable, CMinTable)
 from bigdl_tpu.nn.dropout import Dropout, SpatialDropout2D, L1Penalty

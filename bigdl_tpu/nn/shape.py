@@ -483,10 +483,3 @@ class ResizeBilinear(Module):
                + g(y0, x1) * (1 - wy) * wx + g(y1, x1) * wy * wx)
         return out
 
-
-class DenseToSparse(Module):
-    """nn/DenseToSparse.scala — identity in this framework (sparse tensors
-    are represented densely on TPU; kept for API parity)."""
-
-    def forward_fn(self, params, input, *, training=False, rng=None):
-        return input

@@ -21,9 +21,10 @@ from bigdl_tpu.nn.module import Module
 from bigdl_tpu.utils.table import Table
 
 
-def to_sparse(x, n_batch: int = 1) -> jsparse.BCOO:
-    """Dense -> BCOO (DenseToSparse semantics)."""
-    return jsparse.BCOO.fromdense(jnp.asarray(x), n_batch=0)
+def to_sparse(x, n_batch: int = 0) -> jsparse.BCOO:
+    """Dense -> BCOO (DenseToSparse semantics). ``n_batch`` leading dims
+    stay dense (for vmap/batched sparse ops)."""
+    return jsparse.BCOO.fromdense(jnp.asarray(x), n_batch=n_batch)
 
 
 class DenseToSparse(Module):

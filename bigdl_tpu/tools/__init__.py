@@ -1,0 +1,2 @@
+"""Command-line tools (reference: utils/ConvertModel.scala,
+models/utils/{Distri,Local}OptimizerPerf.scala)."""

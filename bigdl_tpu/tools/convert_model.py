@@ -1,0 +1,67 @@
+"""Model format converter CLI (reference: utils/ConvertModel.scala:24 —
+bigdl/caffe/torch/tensorflow -> bigdl and back where supported).
+
+Usage:
+    python -m bigdl_tpu.tools.convert_model \
+        --from caffe --input net.prototxt,net.caffemodel --output out_dir
+    python -m bigdl_tpu.tools.convert_model \
+        --from torch --input model.t7 --output out_dir
+    python -m bigdl_tpu.tools.convert_model \
+        --from tf --input frozen.pb --output out_dir
+    python -m bigdl_tpu.tools.convert_model \
+        --from bigdl --to tf --input saved_dir --output frozen.pb
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def convert(src: str, dst: str, input_path: str, output_path: str) -> str:
+    from bigdl_tpu.utils.serialization import load_module, save_module
+    if src == "bigdl":
+        model = load_module(input_path)
+    elif src == "caffe":
+        from bigdl_tpu.utils.caffe import load_caffe
+        parts = input_path.split(",")
+        def_path = next((p for p in parts if p.endswith(".prototxt")), None)
+        model_path = next((p for p in parts if not p.endswith(".prototxt")),
+                          None)
+        model = load_caffe(def_path=def_path, model_path=model_path)
+    elif src == "torch":
+        from bigdl_tpu.utils.torch_file import load_torch_model
+        model = load_torch_model(input_path)
+    elif src in ("tf", "tensorflow"):
+        from bigdl_tpu.utils.tf_loader import load_tf_graph
+        model = load_tf_graph(input_path)
+    else:
+        raise ValueError(f"unknown source format {src}")
+
+    if dst == "bigdl":
+        save_module(output_path, model)
+    elif dst in ("tf", "tensorflow"):
+        from bigdl_tpu.utils.tf_saver import save_tf_graph
+        names = save_tf_graph(output_path, model)
+        return f"saved {output_path} (input={names['input']}, " \
+               f"output={names['output']})"
+    else:
+        raise ValueError(f"unsupported target format {dst}")
+    return f"saved {output_path}"
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--from", dest="src", required=True,
+                    choices=["bigdl", "caffe", "torch", "tf", "tensorflow"])
+    ap.add_argument("--to", dest="dst", default="bigdl",
+                    choices=["bigdl", "tf", "tensorflow"])
+    ap.add_argument("--input", required=True,
+                    help="source path ('def.prototxt,weights.caffemodel' "
+                         "for caffe)")
+    ap.add_argument("--output", required=True)
+    args = ap.parse_args(argv)
+    print(convert(args.src, args.dst, args.input, args.output))
+
+
+if __name__ == "__main__":
+    main()

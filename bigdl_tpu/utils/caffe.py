@@ -349,8 +349,12 @@ def _conv_geometry(p):
         w = p.get(w_key)
         g = p.get(generic, default)
         if isinstance(g, list):
-            g = g[0]
-        return (h if h is not None else g, w if w is not None else g)
+            # repeated field = per-spatial-dim (h, w) — Inception-v3 style
+            # 1x7 convs use 'kernel_size: 1 kernel_size: 7'
+            gh, gw = (g[0], g[1]) if len(g) >= 2 else (g[0], g[0])
+        else:
+            gh = gw = g
+        return (h if h is not None else gh, w if w is not None else gw)
     kh, kw = pick("kernel_size", "kernel_h", "kernel_w", 1)
     sh, sw = pick("stride", "stride_h", "stride_w", 1)
     ph, pw = pick("pad", "pad_h", "pad_w", 0)
@@ -403,12 +407,19 @@ class CaffeLoader:
             return [None]
         if s is None:
             return [None for _ in layer.tops]
-        if t in ("Convolution", "Deconvolution"):
+        if t == "Convolution":
             cp = p.get("convolution_param", {})
             kh, kw, sh_, sw, ph, pw = _conv_geometry(cp)
             n_out = int(cp.get("num_output", 1))
             oh = (s[2] + 2 * ph - kh) // sh_ + 1
             ow = (s[3] + 2 * pw - kw) // sw + 1
+            return [[s[0], n_out, oh, ow]]
+        if t == "Deconvolution":
+            cp = p.get("convolution_param", {})
+            kh, kw, sh_, sw, ph, pw = _conv_geometry(cp)
+            n_out = int(cp.get("num_output", 1))
+            oh = (s[2] - 1) * sh_ - 2 * ph + kh
+            ow = (s[3] - 1) * sw - 2 * pw + kw
             return [[s[0], n_out, oh, ow]]
         if t == "Pooling":
             pp = p.get("pooling_param", {})
@@ -453,7 +464,7 @@ class CaffeLoader:
             m.set_parameters(pp)
             return m
 
-        if t in ("Convolution", "Deconvolution"):
+        if t == "Convolution":
             cp = p.get("convolution_param", {})
             kh, kw, sh, sw, ph, pw = _conv_geometry(cp)
             n_out = int(cp.get("num_output", 1))
@@ -471,6 +482,28 @@ class CaffeLoader:
                 w = blobs[0].reshape(n_out, n_in // group, kh, kw)
                 b = blobs[1] if bias_term and len(blobs) > 1 else None
                 set_wb(m, w, b)
+            return m
+        if t == "Deconvolution":
+            cp = p.get("convolution_param", {})
+            kh, kw, sh, sw, ph, pw = _conv_geometry(cp)
+            n_out = int(cp.get("num_output", 1))
+            group = int(cp.get("group", 1))
+            bias_term = bool(cp.get("bias_term", True))
+            # caffe deconv blob layout is [in, out/g, kh, kw] — identical
+            # to SpatialFullConvolution's weight layout
+            if blobs and blobs[0].ndim == 4:
+                n_in = blobs[0].shape[0]
+            elif in_shapes and in_shapes[0] is not None:
+                n_in = int(in_shapes[0][1])
+            else:
+                n_in = 3
+            m = nn.SpatialFullConvolution(n_in, n_out, kw, kh, sw, sh,
+                                          pw, ph, n_group=group,
+                                          no_bias=not bias_term)
+            if blobs:
+                w = blobs[0].reshape(n_in, n_out // group, kh, kw)
+                set_wb(m, w, blobs[1] if bias_term and len(blobs) > 1
+                       else None)
             return m
         if t == "Pooling":
             pp = p.get("pooling_param", {})

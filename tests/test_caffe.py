@@ -207,3 +207,42 @@ layer { name: "sum" type: "Eltwise" bottom: "c1" bottom: "c2" top: "sum"
     outs = list(out)
     shapes = sorted(np.asarray(o).shape for o in outs)
     assert shapes == [(1, 2, 4, 4), (1, 4, 4, 4)]
+
+
+def test_deconvolution_layer():
+    """Review regression: Deconvolution imports as transposed conv with
+    upsampling shape semantics and caffe's [in, out/g, kh, kw] blob."""
+    rng = np.random.RandomState(2)
+    w = rng.randn(3, 4, 2, 2).astype(np.float32) * 0.3  # [in, out, 2, 2]
+    b = np.zeros(4, np.float32)
+    deconv_param = (proto.encode_field(1, 4, wire_type=0) +   # num_output
+                    proto.encode_field(4, 2, wire_type=0) +   # kernel 2
+                    proto.encode_field(6, 2, wire_type=0))    # stride 2
+    net = proto.encode_message(100, _layer_v2(
+        "up", "Deconvolution", ["data"], ["up"], [w, b], 106, deconv_param))
+    import tempfile, os
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "d.caffemodel")
+        open(p, "wb").write(net)
+        model = load_caffe(model_path=p)
+    x = np.random.randn(1, 3, 5, 5).astype(np.float32)
+    out = np.asarray(model.evaluate().forward(x))
+    assert out.shape == (1, 4, 10, 10)  # 2x upsample
+
+
+def test_rectangular_kernel_repeated_field():
+    """'kernel_size: 1 kernel_size: 7' (Inception-v3 1x7 conv)."""
+    txt = """
+layer { name: "data" type: "Input" top: "d"
+  input_param { shape { dim: 1 dim: 2 dim: 9 dim: 9 } } }
+layer { name: "c" type: "Convolution" bottom: "d" top: "c"
+  convolution_param { num_output: 3 kernel_size: 1 kernel_size: 7 } }
+"""
+    import tempfile, os
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "x.prototxt")
+        open(p, "w").write(txt)
+        model = load_caffe(def_path=p)
+    x = np.random.randn(1, 2, 9, 9).astype(np.float32)
+    out = np.asarray(model.evaluate().forward(x))
+    assert out.shape == (1, 3, 9, 3)  # kh=1, kw=7

@@ -1,0 +1,91 @@
+"""TF export tests: our model -> frozen GraphDef -> executed by REAL
+TensorFlow, outputs compared (reference model: TensorflowSaverSpec)."""
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.utils.tf_saver import save_tf_graph
+
+
+def _run_tf(pb_path, names, x):
+    gd = tf.compat.v1.GraphDef()
+    gd.ParseFromString(open(pb_path, "rb").read())
+    with tf.Graph().as_default() as graph:
+        tf.import_graph_def(gd, name="")
+        with tf.compat.v1.Session(graph=graph) as sess:
+            return sess.run(
+                graph.get_tensor_by_name(names["output"] + ":0"),
+                {graph.get_tensor_by_name(names["input"] + ":0"): x})
+
+
+def test_export_mlp(tmp_path):
+    model = (nn.Sequential().add(nn.Linear(6, 12)).add(nn.ReLU())
+             .add(nn.Linear(12, 3)).add(nn.SoftMax())).evaluate()
+    x = np.random.randn(4, 6).astype(np.float32)
+    ours = np.asarray(model.forward(x))
+    p = str(tmp_path / "mlp.pb")
+    names = save_tf_graph(p, model)
+    theirs = _run_tf(p, names, x)
+    np.testing.assert_allclose(ours, theirs, atol=1e-5)
+
+
+def test_export_convnet(tmp_path):
+    model = (nn.Sequential()
+             .add(nn.SpatialConvolution(3, 8, 3, 3, 1, 1, 1, 1))
+             .add(nn.ReLU())
+             .add(nn.SpatialMaxPooling(2, 2, 2, 2))
+             .add(nn.Reshape((8 * 4 * 4,)))
+             .add(nn.Linear(8 * 4 * 4, 5))
+             .add(nn.LogSoftMax())).evaluate()
+    x = np.random.randn(2, 3, 8, 8).astype(np.float32)
+    ours = np.asarray(model.forward(x))
+    p = str(tmp_path / "conv.pb")
+    names = save_tf_graph(p, model)
+    theirs = _run_tf(p, names, x)
+    np.testing.assert_allclose(ours, theirs, atol=1e-4)
+
+
+def test_export_import_roundtrip(tmp_path):
+    """Export with tf_saver then re-import with tf_loader — full circle."""
+    from bigdl_tpu.utils.tf_loader import load_tf_graph
+    model = (nn.Sequential().add(nn.Linear(5, 7)).add(nn.Tanh())
+             .add(nn.Linear(7, 2))).evaluate()
+    x = np.random.randn(3, 5).astype(np.float32)
+    ours = np.asarray(model.forward(x))
+    p = str(tmp_path / "rt.pb")
+    names = save_tf_graph(p, model)
+    back = load_tf_graph(p, inputs=[names["input"]],
+                         outputs=[names["output"]]).evaluate()
+    np.testing.assert_allclose(ours, np.asarray(back.forward(x)), atol=1e-5)
+
+
+def test_export_unsupported_raises(tmp_path):
+    model = nn.Sequential().add(nn.LookupTable(10, 4))
+    with pytest.raises(ValueError, match="unsupported module"):
+        save_tf_graph(str(tmp_path / "x.pb"), model)
+
+
+def test_export_grouped_conv_raises(tmp_path):
+    model = nn.Sequential().add(nn.SpatialConvolution(4, 4, 3, 3, n_group=2))
+    with pytest.raises(ValueError, match="grouped convolution"):
+        save_tf_graph(str(tmp_path / "g.pb"), model)
+
+
+def test_export_ceil_pool_raises(tmp_path):
+    model = nn.Sequential().add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+    with pytest.raises(ValueError, match="ceil-mode"):
+        save_tf_graph(str(tmp_path / "c.pb"), model)
+
+
+def test_export_padded_maxpool_negative_values(tmp_path):
+    """MaxPool padding must not clamp negative activations to 0."""
+    model = (nn.Sequential().add(nn.SpatialMaxPooling(2, 2, 2, 2, 1, 1))
+             .evaluate())
+    x = -np.abs(np.random.randn(1, 2, 4, 4)).astype(np.float32) - 1.0
+    ours = np.asarray(model.forward(x))
+    p = str(tmp_path / "mp.pb")
+    names = save_tf_graph(p, model)
+    theirs = _run_tf(p, names, x)
+    np.testing.assert_allclose(ours, theirs, atol=1e-6)
