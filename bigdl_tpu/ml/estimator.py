@@ -1,0 +1,131 @@
+"""Estimator/Model pipeline wrappers (reference: DLEstimator.scala:54 —
+fit() wraps Optimizer over (features, labels); DLModel transform() batched
+forward; DLClassifier/DLClassifierModel add argmax + 1-based labels,
+DLClassifier.scala:37,68).
+
+Sklearn-compatible surface: fit(X, y) / predict(X) / score(X, y),
+get_params/set_params, so the estimators drop into sklearn pipelines and
+grid search.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.nn.module import Criterion, Module
+
+
+class DLEstimator:
+    """Trains ``model`` against ``criterion`` on (X, y) arrays."""
+
+    def __init__(self, model: Module, criterion: Criterion,
+                 feature_size: Optional[Sequence[int]] = None,
+                 label_size: Optional[Sequence[int]] = None,
+                 batch_size: int = 32, max_epoch: int = 10,
+                 learning_rate: float = 1e-3, optim_method=None):
+        self.model = model
+        self.criterion = criterion
+        self.feature_size = list(feature_size) if feature_size else None
+        self.label_size = list(label_size) if label_size else None
+        self.batch_size = batch_size
+        self.max_epoch = max_epoch
+        self.learning_rate = learning_rate
+        self.optim_method = optim_method
+
+    # -- sklearn plumbing ---------------------------------------------------
+    def get_params(self, deep: bool = True):
+        return {"model": self.model, "criterion": self.criterion,
+                "feature_size": self.feature_size,
+                "label_size": self.label_size,
+                "batch_size": self.batch_size, "max_epoch": self.max_epoch,
+                "learning_rate": self.learning_rate,
+                "optim_method": self.optim_method}
+
+    def set_params(self, **kw):
+        for k, v in kw.items():
+            setattr(self, k, v)
+        return self
+
+    # -- training -----------------------------------------------------------
+    def fit(self, X, y) -> "DLModel":
+        from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+        from bigdl_tpu.optim import SGD
+        from bigdl_tpu.optim.optimizer import LocalOptimizer
+        from bigdl_tpu.optim.trigger import max_epoch as max_epoch_trigger
+        X = np.asarray(X, np.float32)
+        y = np.asarray(y, np.float32)
+        if self.feature_size:
+            X = X.reshape([-1] + self.feature_size)
+        if self.label_size:
+            y = y.reshape([-1] + self.label_size)
+        samples = [Sample(x, t) for x, t in zip(X, y)]
+        ds = DataSet.array(samples).transform(
+            SampleToMiniBatch(self.batch_size))
+        opt = LocalOptimizer(self.model, ds, self.criterion,
+                             self.batch_size)
+        opt.set_optim_method(self.optim_method or
+                             SGD(learning_rate=self.learning_rate))
+        opt.set_end_when(max_epoch_trigger(self.max_epoch))
+        trained = opt.optimize()
+        return self._make_model(trained)
+
+    def _make_model(self, trained: Module) -> "DLModel":
+        return DLModel(trained, feature_size=self.feature_size,
+                       batch_size=self.batch_size)
+
+
+class DLModel:
+    """Fitted model: batched forward over arrays (DLEstimator.scala:190)."""
+
+    def __init__(self, model: Module,
+                 feature_size: Optional[Sequence[int]] = None,
+                 batch_size: int = 32):
+        self.model = model
+        self.feature_size = list(feature_size) if feature_size else None
+        self.batch_size = batch_size
+
+    def transform(self, X) -> np.ndarray:
+        X = np.asarray(X, np.float32)
+        if self.feature_size:
+            X = X.reshape([-1] + self.feature_size)
+        self.model.evaluate()
+        outs = []
+        for i in range(0, len(X), self.batch_size):
+            outs.append(np.asarray(self.model.forward(
+                X[i:i + self.batch_size])))
+        return np.concatenate(outs, axis=0)
+
+    predict = transform
+
+
+class DLClassifier(DLEstimator):
+    """Classification sugar: predictions are 1-based class labels
+    (DLClassifier.scala:37 — matches Torch/reference label convention)."""
+
+    def _make_model(self, trained: Module) -> "DLClassifierModel":
+        return DLClassifierModel(trained, feature_size=self.feature_size,
+                                 batch_size=self.batch_size)
+
+
+class DLClassifierModel(DLModel):
+    def predict(self, X) -> np.ndarray:
+        scores = self.transform(X)
+        return scores.argmax(axis=-1) + 1
+
+    def predict_proba(self, X) -> np.ndarray:
+        """Class probabilities. Handles the three common output heads:
+        SoftMax (already probabilities — returned as-is), LogSoftMax
+        (exponentiated), raw logits (softmaxed)."""
+        scores = self.transform(X)
+        rows = scores.sum(axis=-1)
+        if (scores >= 0).all() and np.allclose(rows, 1.0, atol=1e-3):
+            return scores  # already probabilities
+        if (scores <= 0).all() and np.allclose(
+                np.exp(scores).sum(axis=-1), 1.0, atol=1e-3):
+            return np.exp(scores)  # log-probabilities
+        e = np.exp(scores - scores.max(-1, keepdims=True))
+        return e / e.sum(-1, keepdims=True)
+
+    def score(self, X, y) -> float:
+        return float((self.predict(X) == np.asarray(y)).mean())

@@ -1,0 +1,123 @@
+"""Throughput harness for zoo models on synthetic data (reference:
+models/utils/DistriOptimizerPerf.scala:38 / LocalOptimizerPerf.scala —
+the de-facto benchmark tool; SURVEY.md §6).
+
+Usage:
+    python -m bigdl_tpu.tools.perf --model resnet50 --batch-size 64 \
+        --iterations 20 [--mode train|inference] [--dtype bf16]
+Prints per-iteration and summary images/sec.
+"""
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+
+def build_model(name: str, class_num: int = 1000):
+    from bigdl_tpu import models
+    name = name.lower()
+    if name in ("lenet", "lenet5"):
+        return models.LeNet5(10), (1, 28, 28), 10
+    if name in ("vgg16", "vgg_16"):
+        return models.Vgg_16(class_num), (3, 224, 224), class_num
+    if name in ("vgg19", "vgg_19"):
+        return models.Vgg_19(class_num), (3, 224, 224), class_num
+    if name.startswith("resnet"):
+        depth = int(name[len("resnet"):] or 50)
+        return (models.ResNet(class_num, depth=depth, dataset="ImageNet"),
+                (3, 224, 224), class_num)
+    if name.startswith("inception"):
+        return models.Inception_v1(class_num), (3, 224, 224), class_num
+    if name.startswith("transformer"):
+        return (models.TransformerLM(vocab_size=32000, hidden_size=512,
+                                     num_layers=6, num_heads=8,
+                                     max_len=512), (512,), 32000)
+    raise ValueError(f"unknown model {name}")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model", default="resnet50")
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--iterations", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--mode", choices=["train", "inference"],
+                    default="train")
+    ap.add_argument("--dtype", choices=["f32", "bf16"], default="bf16")
+    args = ap.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.optim import SGD
+    from bigdl_tpu.optim.optimizer import build_eval_step, build_train_step
+    from bigdl_tpu.utils.engine import Engine
+    from bigdl_tpu.utils.random import RandomGenerator
+
+    Engine.init()
+    if args.dtype == "bf16":
+        Engine.set_compute_dtype(jnp.bfloat16)
+    RandomGenerator.set_seed(42)
+
+    model, in_shape, class_num = build_model(args.model)
+    is_lm = len(in_shape) == 1
+    rng = np.random.RandomState(0)
+    if is_lm:
+        x = jnp.asarray(rng.randint(0, class_num,
+                                    (args.batch_size,) + in_shape))
+        y = jnp.asarray(rng.randint(0, class_num,
+                                    (args.batch_size,) + in_shape))
+        criterion = nn.SequenceCrossEntropyCriterion()
+    else:
+        x = jnp.asarray(rng.rand(args.batch_size, *in_shape)
+                        .astype(np.float32))
+        y = jnp.asarray(rng.randint(1, class_num + 1,
+                                    (args.batch_size,)).astype(np.float32))
+        criterion = nn.CrossEntropyCriterion()
+
+    model.training() if args.mode == "train" else model.evaluate()
+    model.ensure_initialized()
+    params = model.get_parameters()
+    mstate = model.get_state()
+
+    if args.mode == "train":
+        optim = SGD(learning_rate=0.01, momentum=0.9)
+        opt_state = optim.init_state(params)
+        step = build_train_step(model, criterion, optim)
+        key = jax.random.PRNGKey(0)
+
+        def run():
+            nonlocal params, opt_state, mstate
+            params, opt_state, mstate, loss = step(
+                params, opt_state, mstate, key, 0.01, x, y)
+            return loss
+    else:
+        eval_step = build_eval_step(model)
+
+        def run():
+            return eval_step(params, mstate, x)
+
+    print(f"# {args.model} {args.mode} batch={args.batch_size} "
+          f"dtype={args.dtype} backend={jax.default_backend()}")
+    for i in range(args.warmup):
+        jax.block_until_ready(run())
+    times = []
+    for i in range(args.iterations):
+        t0 = time.perf_counter()
+        jax.block_until_ready(run())
+        dt = time.perf_counter() - t0
+        times.append(dt)
+        unit = "tok/s" if is_lm else "img/s"
+        rate = (args.batch_size * (in_shape[0] if is_lm else 1)) / dt
+        print(f"iter {i}: {dt*1000:.1f} ms  {rate:.1f} {unit}")
+    med = float(np.median(times))
+    rate = (args.batch_size * (in_shape[0] if is_lm else 1)) / med
+    print(f"median: {med*1000:.1f} ms  {rate:.1f} "
+          f"{'tok/s' if is_lm else 'img/s'}")
+
+
+if __name__ == "__main__":
+    main()

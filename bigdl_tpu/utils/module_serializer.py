@@ -47,6 +47,9 @@ def _encode_value(v) -> Any:
     from bigdl_tpu.utils.table import Table
     if isinstance(v, Module):
         return {"__module__": to_spec(v)}
+    if isinstance(v, (bytes, bytearray)):
+        import base64
+        return {"__bytes__": base64.b64encode(bytes(v)).decode("ascii")}
     if isinstance(v, (np.ndarray, np.generic, jax.Array)):
         arr = np.asarray(v)
         return {"__ndarray__": arr.tolist(), "dtype": str(arr.dtype)}
@@ -77,6 +80,9 @@ def _decode_value(v):
         return v
     if "__module__" in v:
         return from_spec(v["__module__"])
+    if "__bytes__" in v:
+        import base64
+        return base64.b64decode(v["__bytes__"])
     if "__ndarray__" in v:
         return np.asarray(v["__ndarray__"], dtype=v["dtype"])
     if "__table__" in v:

@@ -60,7 +60,8 @@ def _parse_tensor(buf: bytes) -> np.ndarray:
         for field in (7, 10, 11):
             for raw in f.get(field, []):
                 if isinstance(raw, bytes):
-                    vals.extend(proto.unpack_packed_varints(raw))
+                    vals.extend(proto.as_sint(v)
+                                for v in proto.unpack_packed_varints(raw))
                 else:
                     vals.append(proto.as_sint(raw))
         arr = np.asarray(vals, dtype=dtype)
@@ -99,8 +100,16 @@ def _parse_attr(buf: bytes) -> Any:
                 out.append(proto.as_sint(raw))
         if out:
             return out
-        return [proto.as_float(r) if isinstance(r, bytes) else r
-                for r in lf.get(4, [])]
+        floats: List[float] = []
+        for r in lf.get(4, []):  # list(float): packed fixed32 or single
+            if isinstance(r, bytes):
+                if len(r) > 4 and len(r) % 4 == 0:
+                    floats.extend(proto.unpack_packed_floats(r))
+                else:
+                    floats.append(proto.as_float(r))
+            else:
+                floats.append(r)
+        return floats
     return None
 
 
@@ -233,6 +242,9 @@ _OPS: Dict[str, Callable] = {
     "ConcatV2": lambda n, xs: jnp.concatenate(xs[:-1], axis=int(xs[-1])),
     "Pad": lambda n, xs: jnp.pad(
         xs[0], [(int(a), int(b)) for a, b in np.asarray(xs[1])]),
+    "PadV2": lambda n, xs: jnp.pad(
+        xs[0], [(int(a), int(b)) for a, b in np.asarray(xs[1])],
+        constant_values=float(np.asarray(xs[2]))),
     "Mean": lambda n, xs: jnp.mean(
         xs[0], axis=tuple(int(v) for v in np.asarray(xs[1]).ravel()),
         keepdims=bool(n.attrs.get("keep_dims", False))),
@@ -286,10 +298,15 @@ class TFModule(Module):
     like native layers (the reference's Session.run analogue).
     """
 
-    def __init__(self, nodes: Sequence[TFNode],
+    def __init__(self, nodes,
                  inputs: Optional[Sequence[str]] = None,
                  outputs: Optional[Sequence[str]] = None):
         super().__init__()
+        if isinstance(nodes, (bytes, bytearray)):
+            # raw GraphDef bytes: keeps the module serializable through
+            # save_module (ctor-arg capture stores the bytes, not the
+            # parsed TFNode objects with numpy-dtype attrs)
+            nodes = parse_graphdef(bytes(nodes))
         self.nodes = list(nodes)
         self.by_name = {n.name: n for n in self.nodes}
         self.input_names = list(inputs) if inputs else [
@@ -299,9 +316,12 @@ class TFModule(Module):
         else:
             consumed = {inp.split(":")[0].lstrip("^")
                         for n in self.nodes for inp in n.inputs}
+            # orphan Consts/Placeholders (pruning leftovers) are not
+            # outputs
             self.output_names = [n.name for n in self.nodes
                                  if n.name not in consumed
-                                 and n.op != "NoOp"]
+                                 and n.op not in ("NoOp", "Const",
+                                                  "Placeholder")]
         self.consts = {n.name: _ensure_array(n.attrs.get("value"))
                        for n in self.nodes if n.op == "Const"}
 
@@ -346,11 +366,17 @@ def _ensure_array(v):
     return np.asarray(v)
 
 
+# saved/loaded by name through save_module/load_module
+from bigdl_tpu.utils.module_serializer import register_module_class
+
+register_module_class(TFModule)
+
+
 def load_tf_graph(path: str, inputs: Optional[Sequence[str]] = None,
                   outputs: Optional[Sequence[str]] = None) -> TFModule:
     """Module.loadTF equivalent: read a frozen .pb GraphDef."""
     with open(path, "rb") as f:
-        nodes = parse_graphdef(f.read())
-    if not nodes:
+        data = f.read()
+    if not parse_graphdef(data):
         raise ValueError(f"no nodes parsed from {path}")
-    return TFModule(nodes, inputs, outputs)
+    return TFModule(data, inputs, outputs)

@@ -1,0 +1,52 @@
+"""ML-pipeline estimator tests (reference model: DLEstimatorSpec /
+DLClassifierSpec + pyspark test_dl_classifier.py)."""
+import numpy as np
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.ml import DLClassifier, DLEstimator
+
+
+def _toy_data(n=200, d=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    y = (X[:, :3].sum(1) > X[:, 3:].sum(1)).astype(np.float32) + 1.0
+    return X, y
+
+
+def test_dl_classifier_fit_predict_score():
+    X, y = _toy_data()
+    model = (nn.Sequential().add(nn.Linear(6, 24)).add(nn.ReLU())
+             .add(nn.Linear(24, 2)).add(nn.LogSoftMax()))
+    clf = DLClassifier(model, nn.ClassNLLCriterion(), batch_size=32,
+                       max_epoch=30, learning_rate=0.1)
+    fitted = clf.fit(X, y)
+    acc = fitted.score(X, y)
+    assert acc > 0.8, f"train accuracy only {acc}"
+    preds = fitted.predict(X[:5])
+    assert set(preds).issubset({1, 2})
+    proba = fitted.predict_proba(X[:5])
+    np.testing.assert_allclose(proba.sum(1), 1.0, atol=1e-5)
+
+
+def test_dl_estimator_regression():
+    rng = np.random.RandomState(1)
+    X = rng.randn(128, 4).astype(np.float32)
+    w = np.array([1.0, -2.0, 0.5, 3.0], np.float32)
+    y = (X @ w).reshape(-1, 1)
+    model = nn.Sequential().add(nn.Linear(4, 1))
+    est = DLEstimator(model, nn.MSECriterion(), batch_size=32,
+                      max_epoch=60, learning_rate=0.05,
+                      label_size=[1])
+    fitted = est.fit(X, y)
+    pred = fitted.transform(X)
+    mse = float(np.mean((pred - y) ** 2))
+    assert mse < 0.05, f"MSE {mse}"
+
+
+def test_sklearn_params_contract():
+    model = nn.Sequential().add(nn.Linear(2, 2))
+    est = DLEstimator(model, nn.MSECriterion())
+    params = est.get_params()
+    assert params["batch_size"] == 32
+    est.set_params(batch_size=64)
+    assert est.batch_size == 64
