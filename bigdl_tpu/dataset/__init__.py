@@ -5,3 +5,4 @@ from bigdl_tpu.dataset.transformer import (
 from bigdl_tpu.dataset.dataset import (
     AbstractDataSet, LocalDataSet, TransformedDataSet, ShardedDataSet,
     DataSet, array_to_samples)
+from bigdl_tpu.dataset.native_dataset import NativeArrayDataSet, native_available

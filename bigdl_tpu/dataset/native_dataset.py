@@ -1,0 +1,83 @@
+"""DataSet adapter over the native C++ batch loader (reference: the
+multi-threaded MTLabeledBGRImgToBatch batch builder, dataset/image/).
+
+``NativeArrayDataSet`` feeds MiniBatches produced by C++ worker threads
+(random pad-crop/flip/normalize) so host preprocessing overlaps device
+compute. Callers must gate on :func:`native_available` — the constructor
+raises when the native library can't build; the plain python
+DataSet/Transformer pipeline is the portable alternative.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from bigdl_tpu.dataset.dataset import AbstractDataSet
+from bigdl_tpu.dataset.sample import MiniBatch
+
+
+def native_available() -> bool:
+    try:
+        from bigdl_tpu import native
+        return native.native_available()
+    except Exception:
+        return False
+
+
+class NativeArrayDataSet(AbstractDataSet):
+    """In-memory [N,C,H,W] images + labels with native augmentation."""
+
+    def __init__(self, images: np.ndarray, labels: np.ndarray,
+                 batch_size: int, *, crop: Optional[tuple] = None,
+                 pad: int = 0, flip: bool = True, mean=None, std=None,
+                 num_threads: int = 4, prefetch: int = 4, seed: int = 0):
+        from bigdl_tpu import native
+        self.images = np.ascontiguousarray(images, np.float32)
+        self.labels = np.ascontiguousarray(labels, np.float32)
+        self.batch_size = batch_size
+        self._kw = dict(crop=crop, pad=pad, flip=flip, mean=mean, std=std,
+                        num_threads=num_threads, prefetch=prefetch,
+                        seed=seed)
+        self._train_loader = native.NativeBatchLoader(
+            self.images, self.labels, batch_size, train=True, **self._kw)
+        self._native = native
+
+    def size(self) -> int:
+        return len(self.images)
+
+    def shuffle(self):
+        pass  # native train loader samples randomly already
+
+    def data(self, train: bool = True):
+        if train:
+            def it():
+                while True:
+                    imgs, lbls = self._train_loader.next_batch()
+                    yield MiniBatch(imgs, lbls)
+            return it()
+        # eval: deterministic in-order sweep, fresh single-thread loader
+        # each epoch; the final partial batch is trimmed so validation
+        # never double-counts samples (the C++ cursor wraps modulo n)
+        kw = dict(self._kw)
+        kw.update(flip=False, num_threads=1, prefetch=1)
+
+        def eval_it():
+            n = len(self.images)
+            loader = self._native.NativeBatchLoader(
+                self.images, self.labels, self.batch_size, train=False,
+                **kw)
+            try:
+                remaining = n
+                while remaining > 0:
+                    imgs, lbls = loader.next_batch()
+                    if remaining < self.batch_size:
+                        imgs, lbls = imgs[:remaining], lbls[:remaining]
+                    remaining -= len(lbls)
+                    yield MiniBatch(imgs, lbls)
+            finally:
+                loader.close()
+        return eval_it()
+
+    def close(self):
+        self._train_loader.close()

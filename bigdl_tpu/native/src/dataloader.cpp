@@ -1,0 +1,219 @@
+// Native data pipeline — the TPU build's counterpart of the reference's
+// multi-threaded batch building (dataset/image/MTLabeledBGRImgToBatch.scala
+// + the MKL-native preprocessing the JVM leaned on).
+//
+// Provides:
+//  - idx (MNIST) and CIFAR-10 binary decoding into float arrays
+//  - a multi-threaded augmenting batch loader: random crop + horizontal
+//    flip + per-channel normalize, producing NCHW float32 batches into a
+//    ring of prefetch buffers while the accelerator computes.
+// Exported with C linkage for ctypes.
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <queue>
+#include <random>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ------------------------------------------------------------- decoders
+
+// Parse an idx file (MNIST): returns 0 on success; fills dims (up to 4).
+// data_out receives float32 values (bytes scaled 1:1, no normalization).
+int bigdl_parse_idx(const uint8_t* buf, int64_t len, float* data_out,
+                    int64_t out_capacity, int32_t* dims_out,
+                    int32_t* ndim_out) {
+  if (len < 4) return -1;
+  if (buf[0] != 0 || buf[1] != 0) return -2;
+  int dtype = buf[2];
+  int ndim = buf[3];
+  if (ndim > 4) return -3;
+  int64_t off = 4;
+  int64_t total = 1;
+  for (int i = 0; i < ndim; ++i) {
+    if (off + 4 > len) return -4;
+    int32_t d = (buf[off] << 24) | (buf[off + 1] << 16) |
+                (buf[off + 2] << 8) | buf[off + 3];
+    dims_out[i] = d;
+    total *= d;
+    off += 4;
+  }
+  *ndim_out = ndim;
+  if (dtype != 0x08) return -5;  // unsigned byte only
+  if (total > out_capacity) return -6;
+  if (off + total > len) return -7;
+  for (int64_t i = 0; i < total; ++i)
+    data_out[i] = static_cast<float>(buf[off + i]);
+  return 0;
+}
+
+// CIFAR-10 binary format: records of [label u8][3072 u8 RGB planes].
+// Fills labels (1-based, reference convention) and CHW float images.
+int bigdl_parse_cifar(const uint8_t* buf, int64_t len, float* images_out,
+                      float* labels_out, int64_t max_records) {
+  const int64_t rec = 1 + 3 * 32 * 32;
+  int64_t n = len / rec;
+  if (n > max_records) n = max_records;
+  for (int64_t i = 0; i < n; ++i) {
+    const uint8_t* r = buf + i * rec;
+    labels_out[i] = static_cast<float>(r[0]) + 1.0f;
+    const uint8_t* px = r + 1;
+    float* dst = images_out + i * 3 * 32 * 32;
+    for (int64_t j = 0; j < 3 * 32 * 32; ++j)
+      dst[j] = static_cast<float>(px[j]);
+  }
+  return static_cast<int>(n);
+}
+
+// ------------------------------------------------ augmenting batch loader
+
+struct Loader {
+  const float* images;   // [n, c, h, w] source (borrowed)
+  const float* labels;   // [n]
+  int64_t n;
+  int c, h, w;           // source geometry
+  int crop_h, crop_w;    // output geometry
+  int pad;               // zero-pad before crop (CIFAR style)
+  int batch;
+  bool flip, train;
+  float mean[8], std_[8];
+  uint64_t seed;
+
+  std::vector<std::vector<float>> img_bufs;
+  std::vector<std::vector<float>> lbl_bufs;
+  std::queue<int> ready;
+  std::queue<int> free_bufs;
+  std::vector<std::thread> workers;
+  std::mutex mu;
+  std::condition_variable cv_ready, cv_free;
+  std::atomic<bool> stop{false};
+  std::atomic<int64_t> cursor{0};
+
+  void worker(int tid) {
+    std::mt19937_64 rng(seed + tid);
+    const int64_t out_px = int64_t(c) * crop_h * crop_w;
+    while (!stop.load()) {
+      int buf_idx;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_free.wait(lk, [&] { return stop.load() || !free_bufs.empty(); });
+        if (stop.load()) return;
+        buf_idx = free_bufs.front();
+        free_bufs.pop();
+      }
+      float* out = img_bufs[buf_idx].data();
+      float* lbl = lbl_bufs[buf_idx].data();
+      for (int b = 0; b < batch; ++b) {
+        int64_t idx;
+        if (train) {
+          idx = static_cast<int64_t>(rng() % uint64_t(n));
+        } else {
+          idx = cursor.fetch_add(1) % n;
+        }
+        lbl[b] = labels[idx];
+        const float* src = images + idx * int64_t(c) * h * w;
+        int off_y = 0, off_x = 0;
+        bool do_flip = false;
+        if (train) {
+          off_y = int(rng() % uint64_t(h + 2 * pad - crop_h + 1)) - pad;
+          off_x = int(rng() % uint64_t(w + 2 * pad - crop_w + 1)) - pad;
+          do_flip = flip && (rng() & 1);
+        } else {
+          off_y = (h - crop_h) / 2;
+          off_x = (w - crop_w) / 2;
+        }
+        float* dst = out + b * out_px;
+        for (int ch = 0; ch < c; ++ch) {
+          const float m = mean[ch], s = std_[ch];
+          for (int y = 0; y < crop_h; ++y) {
+            int sy = y + off_y;
+            for (int x = 0; x < crop_w; ++x) {
+              int sx = do_flip ? (crop_w - 1 - x) + off_x : x + off_x;
+              float v = 0.0f;
+              if (sy >= 0 && sy < h && sx >= 0 && sx < w)
+                v = src[(int64_t(ch) * h + sy) * w + sx];
+              dst[(int64_t(ch) * crop_h + y) * crop_w + x] = (v - m) / s;
+            }
+          }
+        }
+      }
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        ready.push(buf_idx);
+      }
+      cv_ready.notify_one();
+    }
+  }
+};
+
+void* bigdl_loader_create(const float* images, const float* labels,
+                          int64_t n, int c, int h, int w, int crop_h,
+                          int crop_w, int pad, int batch, int flip,
+                          int train, const float* mean, const float* std_,
+                          int num_threads, int prefetch, uint64_t seed) {
+  if (n <= 0 || c <= 0 || c > 8 || batch <= 0 || prefetch <= 0 ||
+      num_threads <= 0)
+    return nullptr;
+  auto* L = new Loader();
+  L->images = images;
+  L->labels = labels;
+  L->n = n;
+  L->c = c; L->h = h; L->w = w;
+  L->crop_h = crop_h; L->crop_w = crop_w;
+  L->pad = pad;
+  L->batch = batch;
+  L->flip = flip != 0;
+  L->train = train != 0;
+  for (int i = 0; i < c && i < 8; ++i) {
+    L->mean[i] = mean ? mean[i] : 0.0f;
+    L->std_[i] = (std_ && std_[i] != 0.0f) ? std_[i] : 1.0f;
+  }
+  L->seed = seed;
+  const int64_t out_px = int64_t(c) * crop_h * crop_w;
+  for (int i = 0; i < prefetch; ++i) {
+    L->img_bufs.emplace_back(size_t(batch) * out_px);
+    L->lbl_bufs.emplace_back(size_t(batch));
+    L->free_bufs.push(i);
+  }
+  for (int t = 0; t < num_threads; ++t)
+    L->workers.emplace_back(&Loader::worker, L, t);
+  return L;
+}
+
+// Copies the next ready batch into out_images/out_labels. Blocks until one
+// is available. Returns the batch size.
+int bigdl_loader_next(void* handle, float* out_images, float* out_labels) {
+  auto* L = static_cast<Loader*>(handle);
+  int buf_idx;
+  {
+    std::unique_lock<std::mutex> lk(L->mu);
+    L->cv_ready.wait(lk, [&] { return !L->ready.empty(); });
+    buf_idx = L->ready.front();
+    L->ready.pop();
+  }
+  std::memcpy(out_images, L->img_bufs[buf_idx].data(),
+              L->img_bufs[buf_idx].size() * sizeof(float));
+  std::memcpy(out_labels, L->lbl_bufs[buf_idx].data(),
+              L->lbl_bufs[buf_idx].size() * sizeof(float));
+  {
+    std::lock_guard<std::mutex> lk(L->mu);
+    L->free_bufs.push(buf_idx);
+  }
+  L->cv_free.notify_one();
+  return L->batch;
+}
+
+void bigdl_loader_destroy(void* handle) {
+  auto* L = static_cast<Loader*>(handle);
+  L->stop.store(true);
+  L->cv_free.notify_all();
+  L->cv_ready.notify_all();
+  for (auto& t : L->workers) t.join();
+  delete L;
+}
+
+}  // extern "C"

@@ -377,6 +377,11 @@ def load_tf_graph(path: str, inputs: Optional[Sequence[str]] = None,
     """Module.loadTF equivalent: read a frozen .pb GraphDef."""
     with open(path, "rb") as f:
         data = f.read()
-    if not parse_graphdef(data):
+    nodes = parse_graphdef(data)
+    if not nodes:
         raise ValueError(f"no nodes parsed from {path}")
-    return TFModule(data, inputs, outputs)
+    m = TFModule(nodes, inputs, outputs)
+    # serialize via the raw bytes, not the parsed TFNode objects
+    m._init_args = (data, inputs, outputs)
+    m._init_kwargs = {}
+    return m

@@ -46,12 +46,16 @@ _crc_impl = _crc_py
 
 
 def _try_native():
-    """Swap in the C++ crc32c from bigdl_tpu.native when the .so is built."""
+    """Swap in the C++ crc32c when the .so is ALREADY built (never compile
+    on this path) and verify it actually works before binding it."""
     global _crc_impl
     try:
-        from bigdl_tpu.native import native_crc32c
-        if native_crc32c is not None:
-            _crc_impl = native_crc32c
+        from bigdl_tpu import native
+        if native.load_library(build=False) is None:
+            return
+        if native.native_crc32c(b"123456789") != 0xE3069283:
+            return
+        _crc_impl = native.native_crc32c
     except Exception:
         pass
 
