@@ -1,0 +1,204 @@
+"""Image transformers (reference: dataset/image/ — BytesToBGRImg,
+BGRImgCropper, BGRImgNormalizer, HFlip, ColorJitter, Lighting,
+BGRImgToSample; SURVEY.md §1 L3).
+
+Transformers operate on Samples whose feature is a CHW float array (the
+reference's BGRImage is HWC bytes; decoded arrays here are channel-first
+to match the nn layers). All composable with ``->`` like the reference
+(``transformer_a -> transformer_b``).
+"""
+from __future__ import annotations
+
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.dataset.transformer import Transformer
+
+
+class BytesToImg(Transformer):
+    """Raw HWC uint8 bytes -> CHW float Sample (BytesToBGRImg analogue;
+    JPEG decode is delegated to PIL/np upstream — record format is
+    (bytes, label))."""
+
+    def __init__(self, height: int, width: int, channels: int = 3):
+        self.height, self.width, self.channels = height, width, channels
+
+    def apply(self, it: Iterator) -> Iterator[Sample]:
+        for record in it:
+            data, label = record
+            arr = np.frombuffer(data, np.uint8).reshape(
+                self.height, self.width, self.channels)
+            yield Sample(arr.transpose(2, 0, 1).astype(np.float32), label)
+
+
+class ImgNormalizer(Transformer):
+    """Per-channel (x - mean) / std (BGRImgNormalizer)."""
+
+    def __init__(self, mean: Sequence[float], std: Sequence[float]):
+        self.mean = np.asarray(mean, np.float32).reshape(-1, 1, 1)
+        self.std = np.asarray(std, np.float32).reshape(-1, 1, 1)
+
+    def apply(self, it):
+        for s in it:
+            yield Sample((np.asarray(s.features[0], np.float32) - self.mean)
+                         / self.std, s.labels[0] if s.labels else None)
+
+
+class ImgCropper(Transformer):
+    """Random (train) or center crop to (crop_h, crop_w) (BGRImgCropper),
+    with optional zero padding first (CIFAR recipe)."""
+
+    def __init__(self, crop_h: int, crop_w: int, pad: int = 0,
+                 random: bool = True, seed: int = 0):
+        self.crop_h, self.crop_w = crop_h, crop_w
+        self.pad = pad
+        self.random = random
+        self.rng = np.random.RandomState(seed)
+
+    def apply(self, it):
+        for s in it:
+            img = np.asarray(s.features[0], np.float32)
+            c, h, w = img.shape
+            if self.pad:
+                padded = np.zeros((c, h + 2 * self.pad, w + 2 * self.pad),
+                                  np.float32)
+                padded[:, self.pad:self.pad + h, self.pad:self.pad + w] = img
+                img = padded
+                h, w = img.shape[1:]
+            if self.random:
+                oy = self.rng.randint(0, h - self.crop_h + 1)
+                ox = self.rng.randint(0, w - self.crop_w + 1)
+            else:
+                oy = (h - self.crop_h) // 2
+                ox = (w - self.crop_w) // 2
+            yield Sample(img[:, oy:oy + self.crop_h, ox:ox + self.crop_w],
+                         s.labels[0] if s.labels else None)
+
+
+class HFlip(Transformer):
+    """Random horizontal flip (HFlip)."""
+
+    def __init__(self, threshold: float = 0.5, seed: int = 0):
+        self.threshold = threshold
+        self.rng = np.random.RandomState(seed)
+
+    def apply(self, it):
+        for s in it:
+            img = np.asarray(s.features[0])
+            if self.rng.rand() < self.threshold:
+                img = img[:, :, ::-1].copy()
+            yield Sample(img, s.labels[0] if s.labels else None)
+
+
+class ColorJitter(Transformer):
+    """Random brightness/contrast/saturation in the reference's order-
+    shuffled style (dataset/image/ColorJitter.scala)."""
+
+    def __init__(self, brightness: float = 0.4, contrast: float = 0.4,
+                 saturation: float = 0.4, seed: int = 0):
+        self.brightness = brightness
+        self.contrast = contrast
+        self.saturation = saturation
+        self.rng = np.random.RandomState(seed)
+
+    def _adjust(self, img, kind, alpha):
+        if kind == "brightness":
+            return img * alpha
+        if kind == "contrast":
+            mean = img.mean()
+            return img * alpha + mean * (1 - alpha)
+        # saturation: blend with per-pixel gray
+        gray = img.mean(axis=0, keepdims=True)
+        return img * alpha + gray * (1 - alpha)
+
+    def apply(self, it):
+        kinds = [("brightness", self.brightness),
+                 ("contrast", self.contrast),
+                 ("saturation", self.saturation)]
+        for s in it:
+            img = np.asarray(s.features[0], np.float32)
+            order = self.rng.permutation(len(kinds))
+            for i in order:
+                kind, mag = kinds[i]
+                if mag > 0:
+                    alpha = 1.0 + self.rng.uniform(-mag, mag)
+                    img = self._adjust(img, kind, alpha)
+            yield Sample(img, s.labels[0] if s.labels else None)
+
+
+class Lighting(Transformer):
+    """AlexNet-style PCA lighting noise (dataset/image/Lighting.scala);
+    eigen vectors/values default to the ImageNet RGB statistics."""
+
+    _EIGVAL = np.array([0.2175, 0.0188, 0.0045], np.float32)
+    _EIGVEC = np.array([[-0.5675, 0.7192, 0.4009],
+                        [-0.5808, -0.0045, -0.8140],
+                        [-0.5836, -0.6948, 0.4203]], np.float32)
+
+    def __init__(self, alpha_std: float = 0.1, seed: int = 0):
+        self.alpha_std = alpha_std
+        self.rng = np.random.RandomState(seed)
+
+    def apply(self, it):
+        for s in it:
+            img = np.asarray(s.features[0], np.float32)
+            alpha = self.rng.normal(0, self.alpha_std, 3).astype(np.float32)
+            rgb_shift = (self._EIGVEC * alpha * self._EIGVAL).sum(axis=1)
+            img = img + rgb_shift.reshape(3, 1, 1)
+            yield Sample(img, s.labels[0] if s.labels else None)
+
+
+# -------------------------------------------------------- dataset readers
+
+def load_mnist(images_path: str, labels_path: str):
+    """Read MNIST idx files -> (images [N,1,28,28] float, labels [N]
+    1-based float). Uses the native idx parser when built."""
+    import gzip
+
+    def read(path):
+        op = gzip.open if path.endswith(".gz") else open
+        with op(path, "rb") as f:
+            return f.read()
+
+    try:
+        from bigdl_tpu import native
+        imgs = native.parse_idx(read(images_path))
+        lbls = native.parse_idx(read(labels_path))
+    except Exception:
+        imgs = _parse_idx_py(read(images_path))
+        lbls = _parse_idx_py(read(labels_path))
+    return imgs.reshape(-1, 1, 28, 28).astype(np.float32), \
+        lbls.astype(np.float32) + 1.0
+
+
+def _parse_idx_py(buf: bytes) -> np.ndarray:
+    import struct
+    assert buf[0] == 0 and buf[1] == 0 and buf[2] == 0x08
+    ndim = buf[3]
+    dims = struct.unpack(f">{ndim}I", buf[4:4 + 4 * ndim])
+    return np.frombuffer(buf, np.uint8, count=int(np.prod(dims)),
+                         offset=4 + 4 * ndim).reshape(dims) \
+        .astype(np.float32)
+
+
+def load_cifar10(bin_paths: Sequence[str]):
+    """Read CIFAR-10 binary batches -> ([N,3,32,32] float, [N] 1-based)."""
+    imgs_all, lbls_all = [], []
+    for p in bin_paths:
+        with open(p, "rb") as f:
+            data = f.read()
+        try:
+            from bigdl_tpu import native
+            imgs, lbls = native.parse_cifar(data)
+        except Exception:
+            rec = 1 + 3 * 32 * 32
+            n = len(data) // rec
+            arr = np.frombuffer(data, np.uint8,
+                                count=n * rec).reshape(n, rec)
+            lbls = arr[:, 0].astype(np.float32) + 1.0
+            imgs = arr[:, 1:].reshape(n, 3, 32, 32).astype(np.float32)
+        imgs_all.append(imgs)
+        lbls_all.append(lbls)
+    return np.concatenate(imgs_all), np.concatenate(lbls_all)

@@ -65,3 +65,6 @@ from bigdl_tpu.nn.attention import MultiHeadAttention, dot_product_attention
 from bigdl_tpu.nn.moe import MoE
 from bigdl_tpu.nn.norm import LayerNorm, RMSNorm
 from bigdl_tpu.nn.sparse import DenseToSparse, SparseLinear, SparseJoinTable
+from bigdl_tpu.nn.tree_lstm import BinaryTreeLSTM, TreeLSTM
+from bigdl_tpu.nn.conv import SpatialConvolutionMap
+from bigdl_tpu.nn.shape import Nms

@@ -369,3 +369,58 @@ class VolumetricFullConvolution(Module):
         if self.with_bias:
             y = y + params["bias"].reshape(1, -1, 1, 1, 1)
         return y[0] if squeeze else y
+
+
+class SpatialConvolutionMap(Module):
+    """Convolution with an explicit input→output connection table
+    (nn/SpatialConvolutionMap.scala — Torch legacy, used by LeNet-style
+    partial connectivity). ``conn_table`` is [n_connections, 2] of
+    (input_plane, output_plane), 1-based like Torch.
+
+    TPU-first: implemented as a full conv with a fixed binary mask on the
+    weight — XLA folds the mask; the MXU sees one dense conv.
+    """
+
+    def __init__(self, conn_table, kernel_w: int, kernel_h: int,
+                 stride_w: int = 1, stride_h: int = 1,
+                 pad_w: int = 0, pad_h: int = 0):
+        super().__init__()
+        import numpy as _np
+        table = _np.asarray(conn_table, _np.int64)
+        self.conn_table = table
+        self.n_input_plane = int(table[:, 0].max())
+        self.n_output_plane = int(table[:, 1].max())
+        self.kernel_w, self.kernel_h = kernel_w, kernel_h
+        self.stride_w, self.stride_h = stride_w, stride_h
+        self.pad_w, self.pad_h = pad_w, pad_h
+        mask = _np.zeros((self.n_output_plane, self.n_input_plane, 1, 1),
+                         _np.float32)
+        for i, o in table:
+            mask[int(o) - 1, int(i) - 1, 0, 0] = 1.0
+        self._mask = mask
+
+    def init(self, rng):
+        dtype = Engine.default_dtype()
+        kw, kb = jax.random.split(rng)
+        # Torch fan-in for conv maps: connections-per-output * k*k
+        n_in_per_out = max(1, int((self._mask.sum(axis=(1, 2, 3))).max()))
+        fan_in = n_in_per_out * self.kernel_h * self.kernel_w
+        wshape = (self.n_output_plane, self.n_input_plane,
+                  self.kernel_h, self.kernel_w)
+        return {"weight": _default_conv_init(kw, wshape, fan_in, dtype),
+                "bias": _default_conv_init(kb, (self.n_output_plane,),
+                                           fan_in, dtype)}
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        x = input
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        w = params["weight"] * jnp.asarray(self._mask)
+        y = lax.conv_general_dilated(
+            x, w, window_strides=(self.stride_h, self.stride_w),
+            padding=[(self.pad_h, self.pad_h), (self.pad_w, self.pad_w)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            preferred_element_type=x.dtype)
+        y = y + params["bias"].reshape(1, -1, 1, 1)
+        return y[0] if squeeze else y

@@ -483,3 +483,50 @@ class ResizeBilinear(Module):
                + g(y0, x1) * (1 - wy) * wx + g(y1, x1) * wy * wx)
         return out
 
+
+
+class Nms(Module):
+    """Non-max suppression for detection boxes (reference: nn/Nms.scala).
+
+    Input: T(boxes [N,4] (x1,y1,x2,y2), scores [N]). Output: int32 indices
+    [max_output] of kept boxes, padded with -1 — static shape under jit.
+    """
+
+    def __init__(self, iou_threshold: float = 0.5, max_output: int = 100):
+        super().__init__()
+        self.iou_threshold = iou_threshold
+        self.max_output = max_output
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        boxes, scores = list(input)[:2]
+        n = boxes.shape[0]
+        order = jnp.argsort(-scores)
+        boxes_s = boxes[order]
+        x1, y1, x2, y2 = (boxes_s[:, 0], boxes_s[:, 1], boxes_s[:, 2],
+                          boxes_s[:, 3])
+        areas = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+        ix1 = jnp.maximum(x1[:, None], x1[None, :])
+        iy1 = jnp.maximum(y1[:, None], y1[None, :])
+        ix2 = jnp.minimum(x2[:, None], x2[None, :])
+        iy2 = jnp.minimum(y2[:, None], y2[None, :])
+        inter = (jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0))
+        union = areas[:, None] + areas[None, :] - inter
+        iou = inter / jnp.maximum(union, 1e-9)
+
+        def body(i, keep):
+            # suppressed if any higher-scored KEPT box overlaps too much
+            over = jnp.where(jnp.arange(n) < i,
+                             (iou[i] > self.iou_threshold) & keep, False)
+            return keep.at[i].set(~jnp.any(over))
+
+        keep = jax.lax.fori_loop(0, n, body,
+                                 jnp.ones((n,), bool))
+        kept_sorted_idx = jnp.where(keep, order, -1)
+        # compact kept indices to the front, pad with -1
+        rank = jnp.cumsum(keep) - 1
+        out = jnp.full((self.max_output,), -1, jnp.int32)
+        valid = keep & (rank < self.max_output)
+        out = out.at[jnp.where(valid, rank, self.max_output)].set(
+            jnp.where(valid, kept_sorted_idx, -1).astype(jnp.int32),
+            mode="drop")
+        return out

@@ -1,0 +1,113 @@
+"""Tree-structured LSTMs (reference: nn/TreeLSTM.scala /
+nn/BinaryTreeLSTM.scala — used by the treeLSTMSentiment example).
+
+TPU-first encoding: a tree is flattened to a topologically-sorted node
+table (children indices per node, -1 = leaf slot), and the composition
+runs as ONE ``lax.scan`` over nodes — no Python recursion under jit, and
+batched trees share the compiled step.
+
+Tree input convention (per sample):
+    embeddings : [n_nodes, in_dim]   (leaf embeddings; internal rows
+                                      ignored)
+    children   : [n_nodes, 2] int32  (indices into the node table,
+                                      -1 for none; topological order —
+                                      children appear before parents)
+The root is the LAST node.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.utils.engine import Engine
+from bigdl_tpu.utils.table import T
+
+
+def _uniform(key, shape, stdv, dtype):
+    return jax.random.uniform(key, shape, dtype, -stdv, stdv)
+
+
+class BinaryTreeLSTM(Module):
+    """Constituency (binary) TreeLSTM. Output: per-node hidden states
+    [n_nodes, hidden] (root = last row); use Select(-1) for the root."""
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 gate_output: bool = True):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.gate_output = gate_output
+
+    def init(self, rng):
+        dtype = Engine.default_dtype()
+        H, I = self.hidden_size, self.input_size
+        ks = jax.random.split(rng, 6)
+        stdv = 1.0 / math.sqrt(H)
+        return {
+            # leaf transform: input -> (i, o, u) gates
+            "w_leaf": _uniform(ks[0], (3 * H, I), stdv, dtype),
+            "b_leaf": jnp.zeros((3 * H,), dtype),
+            # composer: [h_l, h_r] -> i, l-forget, r-forget, update, output
+            "w_comp": _uniform(ks[1], (5 * H, 2 * H), stdv, dtype),
+            "b_comp": jnp.zeros((5 * H,), dtype),
+        }
+
+    def _leaf(self, params, e):
+        H = self.hidden_size
+        g = e @ params["w_leaf"].T + params["b_leaf"]
+        i = jax.nn.sigmoid(g[..., :H])
+        o = jax.nn.sigmoid(g[..., H:2 * H])
+        u = jnp.tanh(g[..., 2 * H:])
+        c = i * u
+        h = (o * jnp.tanh(c)) if self.gate_output else jnp.tanh(c)
+        return h, c
+
+    def _compose(self, params, hl, cl, hr, cr):
+        H = self.hidden_size
+        g = jnp.concatenate([hl, hr], -1) @ params["w_comp"].T \
+            + params["b_comp"]
+        i = jax.nn.sigmoid(g[..., :H])
+        fl = jax.nn.sigmoid(g[..., H:2 * H])
+        fr = jax.nn.sigmoid(g[..., 2 * H:3 * H])
+        u = jnp.tanh(g[..., 3 * H:4 * H])
+        o = jax.nn.sigmoid(g[..., 4 * H:])
+        c = i * u + fl * cl + fr * cr
+        h = (o * jnp.tanh(c)) if self.gate_output else jnp.tanh(c)
+        return h, c
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        emb, children = list(input)[:2]
+        emb = jnp.asarray(emb)
+        children = jnp.asarray(children).astype(jnp.int32)  # [n, 2]
+        n = emb.shape[0]
+        H = self.hidden_size
+        h0 = jnp.zeros((n, H), emb.dtype)
+        c0 = jnp.zeros((n, H), emb.dtype)
+
+        def step(carry, idx):
+            hs, cs = carry
+            kids = children[idx]
+            is_leaf = kids[0] < 0
+            e = emb[idx]
+            hl = hs[jnp.maximum(kids[0], 0)]
+            cl = cs[jnp.maximum(kids[0], 0)]
+            hr = hs[jnp.maximum(kids[1], 0)]
+            cr = cs[jnp.maximum(kids[1], 0)]
+            h_leaf, c_leaf = self._leaf(params, e)
+            h_comp, c_comp = self._compose(params, hl, cl, hr, cr)
+            h = jnp.where(is_leaf, h_leaf, h_comp)
+            c = jnp.where(is_leaf, c_leaf, c_comp)
+            hs = hs.at[idx].set(h)
+            cs = cs.at[idx].set(c)
+            return (hs, cs), None
+
+        (hs, _), _ = jax.lax.scan(step, (h0, c0), jnp.arange(n))
+        return hs
+
+
+class TreeLSTM(BinaryTreeLSTM):
+    """Alias family root (reference TreeLSTM.scala is the abstract base;
+    the shipped concrete composer is binary)."""

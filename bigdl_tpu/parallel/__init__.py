@@ -8,3 +8,4 @@ from bigdl_tpu.parallel.ring_attention import (
 from bigdl_tpu.parallel.tp import (
     shard_params, shard_opt_state_zero1, spec_for, tree_shardings,
     validate_rules)
+from bigdl_tpu.parallel.pipeline import pipeline_forward, spmd_pipeline

@@ -1,0 +1,96 @@
+"""Pipeline parallelism (GPipe-style) over a ``pipe`` mesh axis — net-new
+vs the reference (SURVEY.md §2.3: PP absent). TPU-first design: each
+device owns a contiguous stage of stacked homogeneous blocks; microbatches
+stream through the ring via ``ppermute`` inside a ``lax.scan`` (the
+classic SPMD pipeline pattern), so XLA overlaps the per-stage compute
+with the ICI transfer of activations.
+
+Use inside ``shard_map``: params sharded [n_stages, layers/stage, ...]
+over ``pipe`` dim 0, inputs microbatched [M, mb, ...] (replicated), output
+replicated [M, mb, ...].
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def spmd_pipeline(block_fn: Callable, stage_params, x, *,
+                  axis_name: str = "pipe", n_stages: int):
+    """Run microbatches through the pipeline. Call under shard_map.
+
+    block_fn(layer_params, x) -> x : one block's forward.
+    stage_params: pytree with leading dim [layers_per_stage] — THIS
+        stage's shard.
+    x: [M, mb, ...] microbatched input (replicated across stages).
+    Returns [M, mb, ...] outputs (replicated).
+    """
+    stage = jax.lax.axis_index(axis_name)
+    m = x.shape[0]
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def apply_stage(xx):
+        def body(h, layer_params):
+            return block_fn(layer_params, h), None
+        out, _ = jax.lax.scan(body, xx, stage_params)
+        return out
+
+    buf0 = jnp.zeros(x.shape[1:], x.dtype)
+    out0 = jnp.zeros_like(x)
+    if hasattr(jax.lax, "pcast"):
+        buf0, out0 = jax.lax.pcast((buf0, out0), (axis_name,),
+                                   to="varying")
+    elif hasattr(jax.lax, "pvary"):
+        buf0, out0 = jax.lax.pvary((buf0, out0), (axis_name,))
+
+    def step(carry, t):
+        buf, out = carry
+        # stage 0 ingests microbatch t (clamped; tail steps flush)
+        inject = jax.lax.dynamic_index_in_dim(
+            x, jnp.clip(t, 0, m - 1), axis=0, keepdims=False)
+        buf = jnp.where(stage == 0, inject, buf)
+        y = apply_stage(buf)
+        # last stage writes microbatch (t - (n_stages-1))
+        widx = t - (n_stages - 1)
+        should = jnp.logical_and(stage == n_stages - 1, widx >= 0)
+        upd = jax.lax.dynamic_update_index_in_dim(
+            out, y, jnp.clip(widx, 0, m - 1), 0)
+        out = jnp.where(should, upd, out)
+        # rotate activations one stage down the ring
+        y = jax.lax.ppermute(y, axis_name, perm)
+        return (y, out), None
+
+    (_, out), _ = jax.lax.scan(step, (buf0, out0),
+                               jnp.arange(m + n_stages - 1))
+    # replicate the last stage's outputs to every shard
+    out = jnp.where(stage == n_stages - 1, out, jnp.zeros_like(out))
+    return jax.lax.psum(out, axis_name)
+
+
+def pipeline_forward(block_fn: Callable, stacked_params, x, mesh: Mesh, *,
+                     axis_name: str = "pipe", n_microbatches: int):
+    """Full-array convenience wrapper.
+
+    stacked_params: pytree with leading dim [n_layers] (n_layers divisible
+    by the pipe axis size); x: [batch, ...] (batch divisible by
+    n_microbatches). Returns [batch, ...].
+    """
+    from jax.experimental.shard_map import shard_map
+    n_stages = mesh.shape[axis_name]
+    b = x.shape[0]
+    assert b % n_microbatches == 0, (b, n_microbatches)
+    mb = b // n_microbatches
+    xm = x.reshape((n_microbatches, mb) + x.shape[1:])
+
+    fn = functools.partial(spmd_pipeline, block_fn, axis_name=axis_name,
+                           n_stages=n_stages)
+    pspec = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    out = shard_map(
+        fn, mesh=mesh,
+        in_specs=(pspec, P()),
+        out_specs=P(), check_rep=False)(stacked_params, xm)
+    return out.reshape((b,) + out.shape[2:])

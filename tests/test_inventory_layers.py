@@ -1,0 +1,111 @@
+"""Tests for the final inventory layers: SpatialConvolutionMap, Nms,
+BinaryTreeLSTM (reference: nn/SpatialConvolutionMap.scala, nn/Nms.scala,
+nn/BinaryTreeLSTM.scala) + the complete SURVEY §2.2 inventory check."""
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+
+
+def test_full_inventory_present():
+    names = """Sequential Container Graph Input Concat ConcatTable
+    ParallelTable MapTable NarrowTable Bottle MixtureTable Linear
+    SparseLinear Bilinear CMul CAdd Mul Add MulConstant AddConstant MM MV
+    Cosine Euclidean DotProduct PairwiseDistance CosineDistance
+    SpatialConvolution SpatialShareConvolution SpatialDilatedConvolution
+    SpatialFullConvolution SpatialConvolutionMap TemporalConvolution
+    VolumetricConvolution VolumetricFullConvolution LookupTable
+    SpatialMaxPooling SpatialAveragePooling TemporalMaxPooling
+    VolumetricMaxPooling RoiPooling BatchNormalization
+    SpatialBatchNormalization SpatialCrossMapLRN SpatialWithinChannelLRN
+    SpatialContrastiveNormalization SpatialDivisiveNormalization
+    SpatialSubtractiveNormalization Normalize ReLU ReLU6 PReLU RReLU
+    LeakyReLU ELU Tanh TanhShrink Sigmoid LogSigmoid SoftMax SoftMin
+    LogSoftMax SoftPlus SoftSign SoftShrink HardShrink HardTanh Threshold
+    BinaryThreshold Clamp Power Square Sqrt Log Exp Abs Negative
+    GradientReversal GaussianSampler Reshape InferReshape View Squeeze
+    Unsqueeze Transpose Contiguous Replicate Padding SpatialZeroPadding
+    Narrow Select SelectTable MaskedSelect Index Max Min Mean Sum Scale
+    Tile Pack Reverse SplitTable BifurcateSplitTable JoinTable
+    SparseJoinTable FlattenTable DenseToSparse ResizeBilinear Nms
+    CAddTable CSubTable CMulTable CDivTable CMaxTable CMinTable Dropout
+    L1Penalty Recurrent RecurrentDecoder RnnCell LSTM LSTMPeephole GRU
+    ConvLSTMPeephole ConvLSTMPeephole3D BiRecurrent TimeDistributed
+    TreeLSTM BinaryTreeLSTM ClassNLLCriterion CrossEntropyCriterion
+    BCECriterion MSECriterion AbsCriterion SmoothL1Criterion
+    MarginCriterion MarginRankingCriterion MultiMarginCriterion
+    MultiLabelMarginCriterion MultiLabelSoftMarginCriterion
+    HingeEmbeddingCriterion L1HingeEmbeddingCriterion
+    CosineEmbeddingCriterion CosineDistanceCriterion DistKLDivCriterion
+    KLDCriterion GaussianCriterion ClassSimplexCriterion
+    DiceCoefficientCriterion SoftmaxWithCriterion SoftMarginCriterion
+    L1Cost ParallelCriterion MultiCriterion TimeDistributedCriterion
+    MultiHeadAttention MoE LayerNorm RMSNorm QuantizedLinear
+    QuantizedSpatialConvolution SequenceCrossEntropyCriterion"""
+    missing = [n_ for n_ in names.split() if not hasattr(nn, n_)]
+    assert missing == [], f"missing layers: {missing}"
+
+
+def test_spatial_convolution_map():
+    # LeNet-style partial connectivity: plane 1 -> out 1,2; plane 2 -> out 2
+    table = [[1, 1], [1, 2], [2, 2]]
+    m = nn.SpatialConvolutionMap(table, 3, 3, 1, 1, 1, 1)
+    x = np.random.randn(2, 2, 6, 6).astype(np.float32)
+    out = np.asarray(m.forward(x))
+    assert out.shape == (2, 2, 6, 6)
+    # output 1 must NOT depend on input plane 2
+    x2 = x.copy()
+    x2[:, 1] += 10.0
+    out2 = np.asarray(m.forward(x2))
+    np.testing.assert_allclose(out[:, 0], out2[:, 0], atol=1e-5)
+    assert np.abs(out[:, 1] - out2[:, 1]).max() > 0.1
+
+
+def test_nms():
+    boxes = np.array([[0, 0, 10, 10],
+                      [1, 1, 11, 11],     # heavy overlap with 0
+                      [20, 20, 30, 30],   # separate
+                      [21, 21, 29, 29]],  # overlaps 2
+                     np.float32)
+    scores = np.array([0.9, 0.8, 0.7, 0.95], np.float32)
+    m = nn.Nms(iou_threshold=0.5, max_output=4)
+    kept = np.asarray(m.forward([boxes, scores]))
+    kept = kept[kept >= 0]
+    # order by score: 3, 0, 1(suppressed by 0), 2(suppressed by 3)
+    assert list(kept) == [3, 0]
+
+
+def test_binary_tree_lstm():
+    # tree: leaves 0,1 -> node 2; leaves 3 -> just a leaf; root 4 = (2, 3)
+    emb = np.random.randn(5, 8).astype(np.float32)
+    children = np.array([[-1, -1], [-1, -1], [0, 1], [-1, -1], [2, 3]],
+                        np.int32)
+    m = nn.BinaryTreeLSTM(8, 16)
+    hs = np.asarray(m.forward([emb, children]))
+    assert hs.shape == (5, 16)
+    assert np.isfinite(hs).all()
+    # root depends on leaf 0's embedding
+    emb2 = emb.copy()
+    emb2[0] += 1.0
+    hs2 = np.asarray(m.forward([emb2, children]))
+    assert np.abs(hs2[4] - hs[4]).max() > 1e-5
+    # ...but node 3 (a leaf) does not
+    np.testing.assert_allclose(hs[3], hs2[3], atol=1e-6)
+
+
+def test_binary_tree_lstm_gradients():
+    import jax
+    emb = np.random.randn(3, 4).astype(np.float32)
+    children = np.array([[-1, -1], [-1, -1], [0, 1]], np.int32)
+    m = nn.BinaryTreeLSTM(4, 6)
+    m.ensure_initialized()
+    p = m.get_parameters()
+
+    def loss(p):
+        from bigdl_tpu.utils.table import T
+        hs = m.forward_fn(p, T(np.asarray(emb), np.asarray(children)))
+        return hs[-1].sum()
+
+    g = jax.grad(loss)(p)
+    assert float(np.abs(np.asarray(g["w_comp"])).max()) > 0
+    assert float(np.abs(np.asarray(g["w_leaf"])).max()) > 0
