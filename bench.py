@@ -7,16 +7,19 @@ prints ONE JSON line:
 
     {"metric": ..., "value": imgs/sec, "unit": "images/sec", "vs_baseline": r}
 
+Each timed call scans BENCH_SCAN full training steps on-device (params,
+optimizer state and BN statistics threaded step to step, a fresh random
+batch generated per step) so the measurement is pure device throughput, not
+per-dispatch host round-trips. Set BENCH_SCAN=1 for the old
+one-step-per-dispatch behavior.
+
 Baseline: the reference publishes no absolute numbers (BASELINE.md); the
 working Xeon baseline recorded there is 56 img/s/node (BigDL-paper-era
 dual-socket Xeon ResNet-50 estimate) until a measured value replaces it.
 """
 import json
 import os
-import sys
 import time
-
-import numpy as np
 
 # BASELINE.md "working baseline" — see §North star.
 REFERENCE_BASELINE_IMGS_PER_SEC = 56.0
@@ -25,6 +28,7 @@ REFERENCE_BASELINE_IMGS_PER_SEC = 56.0
 def main():
     import jax
     import jax.numpy as jnp
+    from jax import lax
 
     import bigdl_tpu.nn as nn
     from bigdl_tpu.models import ResNet
@@ -34,8 +38,9 @@ def main():
     from bigdl_tpu.utils.random import RandomGenerator
 
     batch = int(os.environ.get("BENCH_BATCH", 256))
-    iters = int(os.environ.get("BENCH_ITERS", 10))
-    warmup = int(os.environ.get("BENCH_WARMUP", 3))
+    iters = int(os.environ.get("BENCH_ITERS", 6))
+    warmup = int(os.environ.get("BENCH_WARMUP", 1))
+    scan = int(os.environ.get("BENCH_SCAN", 8))
 
     platform = jax.devices()[0].platform
     # bf16 compute on accelerators (TPU-native analogue of the reference's
@@ -55,25 +60,35 @@ def main():
     opt_state = optim.init_state(params)
     step = build_train_step(model, criterion, optim)
 
-    rng = jax.random.PRNGKey(0)
-    x = jnp.asarray(np.random.RandomState(0).rand(batch, 3, 224, 224),
-                    jnp.float32)
-    y = jnp.asarray(np.random.RandomState(1).randint(1, 1001, size=(batch,)),
-                    jnp.float32)
-
-    for _ in range(warmup):
+    def scan_body(carry, key):
+        params, opt_state, mstate = carry
+        kx, ky, kr = jax.random.split(key, 3)
+        x = jax.random.uniform(kx, (batch, 3, 224, 224), jnp.float32)
+        y = jax.random.randint(ky, (batch,), 1, 1001).astype(jnp.float32)
         params, opt_state, mstate, loss = step(params, opt_state, mstate,
-                                               rng, 0.1, x, y)
-    float(loss)  # sync: the loss depends on every prior step's params
+                                               kr, 0.1, x, y)
+        return (params, opt_state, mstate), loss
+
+    @jax.jit
+    def run_chunk(carry, keys):
+        return lax.scan(scan_body, carry, keys)
+
+    root = jax.random.PRNGKey(0)
+    carry = (params, opt_state, mstate)
+    for i in range(warmup):
+        keys = jax.random.split(jax.random.fold_in(root, i), scan)
+        carry, losses = run_chunk(carry, keys)
+    if warmup:
+        float(losses.sum())  # sync: losses depend on every prior params
 
     t0 = time.time()
-    for _ in range(iters):
-        params, opt_state, mstate, loss = step(params, opt_state, mstate,
-                                               rng, 0.1, x, y)
-    float(loss)  # data dependency forces completion of the whole chain
+    for i in range(iters):
+        keys = jax.random.split(jax.random.fold_in(root, 1000 + i), scan)
+        carry, losses = run_chunk(carry, keys)
+    float(losses.sum())  # data dependency forces completion of the chain
     dt = time.time() - t0
 
-    imgs_per_sec = batch * iters / dt
+    imgs_per_sec = batch * scan * iters / dt
     result = {
         "metric": "resnet50_imagenet_train_imgs_per_sec_per_chip",
         "value": round(imgs_per_sec, 2),

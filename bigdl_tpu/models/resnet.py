@@ -21,8 +21,9 @@ class DatasetType:
     ImageNet = "ImageNet"
 
 
-def _conv(cin, cout, kw, kh, sw=1, sh=1, pw=0, ph=0):
-    c = nn.SpatialConvolution(cin, cout, kw, kh, sw, sh, pw, ph)
+def _conv(cin, cout, kw, kh, sw=1, sh=1, pw=0, ph=0, propagate_back=True):
+    c = nn.SpatialConvolution(cin, cout, kw, kh, sw, sh, pw, ph,
+                              propagate_back=propagate_back)
     # MSRA init, zero bias (ResNet.modelInit)
     c.set_init_method(MsraFiller(var_in_count=False), Zeros())
     return c
@@ -109,7 +110,8 @@ def ResNet(class_num: int, depth: int = 18,
             raise ValueError(f"Invalid depth {depth}")
         loop, n_features, block = cfg[depth]
         st.i_channels = 64
-        model.add(_conv(3, 64, 7, 7, 2, 2, 3, 3)) \
+        # stem conv: propagateBack=false (ResNet.scala:234) — no data grad
+        model.add(_conv(3, 64, 7, 7, 2, 2, 3, 3, propagate_back=False)) \
             .add(_bn(64)) \
             .add(nn.ReLU(True)) \
             .add(nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1)) \
@@ -126,7 +128,8 @@ def ResNet(class_num: int, depth: int = 18,
             raise ValueError("depth should be one of 20, 32, 44, 56, 110")
         n = (depth - 2) // 6
         st.i_channels = 16
-        model.add(_conv(3, 16, 3, 3, 1, 1, 1, 1)) \
+        # stem conv: propagateBack=false (ResNet.scala:252)
+        model.add(_conv(3, 16, 3, 3, 1, 1, 1, 1, propagate_back=False)) \
             .add(_bn(16)) \
             .add(nn.ReLU(True)) \
             .add(layer(basic_block, 16, n)) \

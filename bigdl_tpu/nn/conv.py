@@ -48,6 +48,11 @@ class SpatialConvolution(Module):
         self.pad_w, self.pad_h = pad_w, pad_h
         self.n_group = n_group
         self.with_bias = with_bias
+        # propagateBack=false (SpatialConvolution.scala) skips the input
+        # gradient — used on stem convs fed by raw data; stop_gradient on
+        # the input is the autodiff equivalent and saves the (large) data-
+        # grad conv in the backward pass.
+        self.propagate_back = propagate_back
         self.w_regularizer = w_regularizer
         self.b_regularizer = b_regularizer
         self.weight_init = init_weight
@@ -91,6 +96,8 @@ class SpatialConvolution(Module):
         squeeze = x.ndim == 3
         if squeeze:
             x = x[None]
+        if not self.propagate_back:
+            x = lax.stop_gradient(x)
         y = lax.conv_general_dilated(
             x, params["weight"],
             window_strides=(self.stride_h, self.stride_w),
