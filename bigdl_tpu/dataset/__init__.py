@@ -6,3 +6,13 @@ from bigdl_tpu.dataset.dataset import (
     AbstractDataSet, LocalDataSet, TransformedDataSet, ShardedDataSet,
     DataSet, array_to_samples)
 from bigdl_tpu.dataset.native_dataset import NativeArrayDataSet, native_available
+from bigdl_tpu.dataset.imagenet import (
+    ImageFolderDataSet, ImageRecordWriter, list_image_folder, decode_image,
+    read_image_records, write_image_record_shards,
+    IMAGENET_MEAN, IMAGENET_STD)
+from bigdl_tpu.dataset.prefetch import device_prefetch
+from bigdl_tpu.dataset.device_dataset import DeviceCachedArrayDataSet
+from bigdl_tpu.dataset.text import (
+    Dictionary, LabeledSentence, LabeledSentenceToSample, SentenceBiPadding,
+    SentenceSplitter, SentenceTokenizer, TextToLabeledSentence, load_ptb,
+    ptb_arrays, read_words, tokenize, SENTENCE_START, SENTENCE_END)

@@ -1,0 +1,114 @@
+"""Device-resident cached dataset with ON-DEVICE augmentation.
+
+The reference caches *decoded* images in executor memory across epochs
+(dataset/DataSet.scala CachedDistriDataSet:240) and re-augments each
+epoch on CPU threads. The TPU-native version moves that cache into HBM:
+the whole decoded dataset lives on device as uint8 (CIFAR-10 train is
+184 MB, MNIST 47 MB — trivial next to 16 GB HBM; ImageNet shards across
+a pod), and the random pad-crop / horizontal-flip / normalize runs
+INSIDE the jitted train step. Per-step host->device traffic drops to
+zero — on tunneled or NIC-limited hosts this removes the input wall
+entirely, and on any TPU it frees the host for real IO.
+
+Augmentation is implemented with static-shape ops only (pad once,
+``lax.dynamic_slice`` for the crop, ``jnp.where`` on a reversed view for
+the flip) so XLA fuses it into the step.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class DeviceCachedArrayDataSet:
+    """uint8 [N,C,H,W] images + labels resident on device; produces a
+    jittable ``batch_fn(rng) -> (x, y)`` with the CIFAR-style random
+    pad-crop + flip + per-channel normalize (the augmentations of
+    dataset/image/BGRImgCropper + HFlip + BGRImgNormalizer)."""
+
+    def __init__(self, images: np.ndarray, labels: np.ndarray,
+                 batch_size: int, *, crop: Optional[Tuple[int, int]] = None,
+                 pad: int = 0, flip: bool = True,
+                 mean: Sequence[float] = (0.0, 0.0, 0.0),
+                 std: Sequence[float] = (1.0, 1.0, 1.0),
+                 sharding=None):
+        images = np.ascontiguousarray(images)
+        if images.dtype != np.uint8:
+            if images.max() <= 1.0:
+                images = (images * 255).astype(np.uint8)
+            else:
+                images = images.astype(np.uint8)
+        n, c, h, w = images.shape
+        if len(labels) < n:
+            raise ValueError("labels shorter than images")
+        ch, cw = crop or (h, w)
+        if ch > h + 2 * pad or cw > w + 2 * pad:
+            raise ValueError("crop larger than padded source")
+        self.n, self.c = n, c
+        self.h, self.w = h, w
+        self.crop_h, self.crop_w = ch, cw
+        self.pad = pad
+        self.flip = flip
+        self.batch_size = batch_size
+        self._mean = jnp.asarray(mean, jnp.float32).reshape(1, -1, 1, 1)
+        self._std = jnp.asarray(std, jnp.float32).reshape(1, -1, 1, 1)
+        put = (lambda a: jax.device_put(a, sharding)) if sharding \
+            else jax.device_put
+        # pad ONCE at cache-build time; crops then need no bounds logic
+        if pad:
+            images = np.pad(images,
+                            ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+        self.images = put(images)   # resident uint8 cache
+        self.labels = put(np.ascontiguousarray(labels, np.float32))
+
+    def size(self) -> int:
+        return self.n
+
+    # ---------------------------------------------------------- batch fns
+
+    def batch_fn(self, rng):
+        """Jittable: sample a random augmented training batch.
+
+        Gathers B source images from the resident cache, random-crops via
+        one dynamic_slice per image (vmap), randomly flips, normalizes.
+        """
+        b = self.batch_size
+        kidx, kyx, kflip = jax.random.split(rng, 3)
+        idx = jax.random.randint(kidx, (b,), 0, self.n)
+        imgs = jnp.take(self.images, idx, axis=0)  # (B, C, H+2p, W+2p) u8
+        max_oy = self.h + 2 * self.pad - self.crop_h + 1
+        max_ox = self.w + 2 * self.pad - self.crop_w + 1
+        oys = jax.random.randint(kyx, (b,), 0, max_oy)
+        oxs = jax.random.randint(jax.random.fold_in(kyx, 1), (b,), 0,
+                                 max_ox)
+
+        def crop_one(img, oy, ox):
+            return jax.lax.dynamic_slice(
+                img, (0, oy, ox), (self.c, self.crop_h, self.crop_w))
+
+        crops = jax.vmap(crop_one)(imgs, oys, oxs)
+        if self.flip:
+            do = jax.random.bernoulli(kflip, 0.5, (b,))
+            crops = jnp.where(do[:, None, None, None],
+                              crops[:, :, :, ::-1], crops)
+        x = (crops.astype(jnp.float32) - self._mean) / self._std
+        y = jnp.take(self.labels, idx, axis=0)
+        return x, y
+
+    def eval_batch_fn(self, start: int):
+        """Jittable: deterministic center-crop batch starting at ``start``
+        (host passes the offset; shapes stay static)."""
+        b = self.batch_size
+        idx = (start + jnp.arange(b)) % self.n
+        imgs = jnp.take(self.images, idx, axis=0)
+        oy = (self.h + 2 * self.pad - self.crop_h) // 2
+        ox = (self.w + 2 * self.pad - self.crop_w) // 2
+        crops = jax.lax.dynamic_slice(
+            imgs, (0, 0, oy, ox),
+            (b, self.c, self.crop_h, self.crop_w))
+        x = (crops.astype(jnp.float32) - self._mean) / self._std
+        y = jnp.take(self.labels, idx, axis=0)
+        return x, y

@@ -1,0 +1,364 @@
+"""ImageNet-scale input pipeline.
+
+The reference feeds ImageNet two ways (SURVEY.md §1 L3):
+
+- ``DataSet.ImageFolder`` (dataset/DataSet.scala:408): a directory of
+  ``<class>/<image>.jpg`` folders, decoded and augmented per epoch;
+- ``DataSet.SeqFileFolder`` (dataset/DataSet.scala:470-552): pre-packed
+  Hadoop SequenceFiles of JPEG bytes (written by
+  models/utils/ImageNetSeqFileGenerator.scala) for cluster-rate IO;
+
+with batch assembly done off the critical path by a thread pool
+(dataset/image/MTLabeledBGRImgToBatch.scala).
+
+The TPU build mirrors all three: :func:`list_image_folder` scans a class
+directory tree; :class:`ImageRecordWriter`/:func:`read_image_records` are
+the SequenceFile analogue (a flat shardable record format, crc32c-guarded
+like TFRecord); :class:`ImageFolderDataSet` runs PIL JPEG decode +
+augmentation on a pool of Python threads (PIL releases the GIL while
+decoding) filling a bounded prefetch queue so host IO overlaps device
+compute, and shards the file list by process for multi-host input. Device
+transfer overlap is :func:`bigdl_tpu.dataset.prefetch.device_prefetch`.
+
+Augmentation matches the reference recipe (models/inception/Train.scala,
+dataset/image/BGRImgCropper.scala): resize shorter side to ``scale``,
+random (train) / center (eval) crop, random horizontal flip, per-channel
+normalize. Batches are NCHW float32, labels 1-based by sorted class-folder
+name (DataSet.scala:425-430).
+"""
+from __future__ import annotations
+
+import os
+import queue
+import struct
+import threading
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bigdl_tpu.dataset.dataset import AbstractDataSet
+from bigdl_tpu.dataset.sample import MiniBatch
+from bigdl_tpu.visualization.crc32c import masked_crc32c
+
+_IMG_EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".ppm")
+
+# ImageNet RGB statistics (0-255 scale), the reference's defaults
+# (dataset/image/BGRImgNormalizer usage in models/inception/Train.scala).
+IMAGENET_MEAN = (123.68, 116.779, 103.939)
+IMAGENET_STD = (58.393, 57.12, 57.375)
+
+
+def list_image_folder(root: str) -> Tuple[List[str], np.ndarray, List[str]]:
+    """Scan ``root/<class>/<img>`` -> (paths, labels[1-based], class_names).
+
+    Class folders are sorted by name and numbered from 1, matching the
+    reference's LocalImageFiles labeling (DataSet.scala:425-430).
+    """
+    classes = sorted(d for d in os.listdir(root)
+                     if os.path.isdir(os.path.join(root, d)))
+    if not classes:
+        raise ValueError(f"no class directories under {root}")
+    paths, labels = [], []
+    for ci, cls in enumerate(classes):
+        cdir = os.path.join(root, cls)
+        for fn in sorted(os.listdir(cdir)):
+            if fn.lower().endswith(_IMG_EXTS):
+                paths.append(os.path.join(cdir, fn))
+                labels.append(ci + 1)
+    return paths, np.asarray(labels, np.float32), classes
+
+
+def decode_image(data_or_path, *, scale: Optional[int] = None) -> np.ndarray:
+    """JPEG/PNG bytes or path -> RGB HWC uint8, shorter side resized to
+    ``scale`` when given (BGRImage.read's smallest-side resize)."""
+    from PIL import Image
+    import io
+
+    if isinstance(data_or_path, (bytes, bytearray, memoryview)):
+        img = Image.open(io.BytesIO(data_or_path))
+    else:
+        img = Image.open(data_or_path)
+    img = img.convert("RGB")
+    if scale is not None:
+        w, h = img.size
+        if w < h:
+            nw, nh = scale, max(1, round(h * scale / w))
+        else:
+            nh, nw = scale, max(1, round(w * scale / h))
+        img = img.resize((nw, nh), Image.BILINEAR)
+    return np.asarray(img, np.uint8)
+
+
+# ------------------------------------------------- record format (SeqFile)
+
+_RECORD_MAGIC = b"BTIR"  # BigDL-TPU Image Records
+
+
+class ImageRecordWriter:
+    """Pack (jpeg_bytes, label) records into a flat shard file — the
+    SequenceFile/ImageNetSeqFileGenerator analogue.
+
+    Layout: magic, then per record
+    ``[u32 payload_len][u32 masked_crc32c(payload)][payload]`` where
+    payload = ``[f32 label][u32 name_len][name utf8][jpeg bytes]``.
+    Length+crc framing follows the TFRecord convention so torn shards are
+    detected on read.
+    """
+
+    def __init__(self, path: str):
+        self.f = open(path, "wb")
+        self.f.write(_RECORD_MAGIC)
+
+    def write(self, data: bytes, label: float, name: str = ""):
+        nb = name.encode("utf-8")
+        payload = struct.pack("<fI", float(label), len(nb)) + nb + bytes(data)
+        self.f.write(struct.pack("<II", len(payload),
+                                 masked_crc32c(payload)))
+        self.f.write(payload)
+
+    def close(self):
+        self.f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def read_image_records(path: str, *, verify: bool = True
+                       ) -> Iterator[Tuple[bytes, float, str]]:
+    """Yield (jpeg_bytes, label, name) from an ImageRecordWriter shard."""
+    with open(path, "rb") as f:
+        if f.read(4) != _RECORD_MAGIC:
+            raise ValueError(f"{path}: not an image record file")
+        while True:
+            hdr = f.read(8)
+            if not hdr:
+                return
+            if len(hdr) < 8:
+                raise ValueError(f"{path}: truncated record header")
+            ln, crc = struct.unpack("<II", hdr)
+            payload = f.read(ln)
+            if len(payload) < ln:
+                raise ValueError(f"{path}: truncated record payload")
+            if verify and masked_crc32c(payload) != crc:
+                raise ValueError(f"{path}: record crc mismatch")
+            label, name_len = struct.unpack("<fI", payload[:8])
+            name = payload[8:8 + name_len].decode("utf-8")
+            yield payload[8 + name_len:], label, name
+
+
+def write_image_record_shards(folder: str, out_dir: str, *,
+                              num_shards: int = 8,
+                              prefix: str = "imagenet") -> List[str]:
+    """ImageFolder -> record shards (ImageNetSeqFileGenerator.scala)."""
+    paths, labels, _ = list_image_folder(folder)
+    os.makedirs(out_dir, exist_ok=True)
+    shard_paths = [os.path.join(out_dir, f"{prefix}-{i:05d}-of-"
+                                f"{num_shards:05d}.btir")
+                   for i in range(num_shards)]
+    writers = [ImageRecordWriter(p) for p in shard_paths]
+    try:
+        for i, (p, lbl) in enumerate(zip(paths, labels)):
+            with open(p, "rb") as f:
+                writers[i % num_shards].write(f.read(), float(lbl),
+                                              os.path.basename(p))
+    finally:
+        for w in writers:
+            w.close()
+    return shard_paths
+
+
+# ---------------------------------------------- multi-threaded folder feed
+
+
+class _Augmenter:
+    """Per-sample decode + augment: resize-shorter-side, crop, flip,
+    normalize -> CHW float32 (BGRImgCropper + HFlip + BGRImgNormalizer)."""
+
+    def __init__(self, crop: int, scale: int, train: bool,
+                 mean: Sequence[float], std: Sequence[float]):
+        self.crop, self.scale, self.train = crop, scale, train
+        self.mean = np.asarray(mean, np.float32).reshape(3, 1, 1)
+        self.std = np.asarray(std, np.float32).reshape(3, 1, 1)
+
+    def __call__(self, raw, rng: np.random.RandomState) -> np.ndarray:
+        img = decode_image(raw, scale=self.scale)
+        h, w = img.shape[:2]
+        c = self.crop
+        if self.train:
+            oy = rng.randint(0, h - c + 1)
+            ox = rng.randint(0, w - c + 1)
+        else:
+            oy, ox = (h - c) // 2, (w - c) // 2
+        img = img[oy:oy + c, ox:ox + c]
+        if self.train and rng.rand() < 0.5:
+            img = img[:, ::-1]
+        chw = img.transpose(2, 0, 1).astype(np.float32)
+        return (chw - self.mean) / self.std
+
+
+class ImageFolderDataSet(AbstractDataSet):
+    """Threaded JPEG decode/augment pipeline over an image folder or
+    record shards (MTLabeledBGRImgToBatch.scala analogue).
+
+    Worker threads each assemble whole MiniBatches into a bounded queue;
+    the training iterator never touches the filesystem. ``process_index``/
+    ``process_count`` shard the file list for multi-host input (the role
+    Spark partitioning played for SeqFileFolder).
+    """
+
+    def __init__(self, folder: Optional[str] = None, *,
+                 record_shards: Optional[Sequence[str]] = None,
+                 batch_size: int = 32, crop: int = 224, scale: int = 256,
+                 mean: Sequence[float] = IMAGENET_MEAN,
+                 std: Sequence[float] = IMAGENET_STD,
+                 num_threads: int = 8, prefetch: int = 8,
+                 process_index: int = 0, process_count: int = 1,
+                 seed: int = 0):
+        if (folder is None) == (record_shards is None):
+            raise ValueError("pass exactly one of folder / record_shards")
+        if folder is not None:
+            paths, labels, self.classes = list_image_folder(folder)
+            self._items: List = list(zip(paths, labels))
+        else:
+            self.classes = None
+            self._items = []
+            for shard in record_shards:
+                for data, label, _ in read_image_records(shard):
+                    self._items.append((data, label))
+        self._total = len(self._items)
+        self._items = self._items[process_index::process_count]
+        if not self._items:
+            raise ValueError("empty input shard")
+        self.batch_size = batch_size
+        self.num_threads = num_threads
+        self.prefetch = prefetch
+        self.seed = seed
+        self._mean, self._std = mean, std
+        self._crop, self._scale = crop, scale
+        self._train_pool: Optional[_BatchPool] = None
+
+    def size(self) -> int:
+        return self._total
+
+    def local_size(self) -> int:
+        return len(self._items)
+
+    def shuffle(self):
+        pass  # train workers sample randomly each batch
+
+    def data(self, train: bool = True):
+        if train:
+            if self._train_pool is None:
+                self._train_pool = _BatchPool(
+                    self._items, self.batch_size,
+                    _Augmenter(self._crop, self._scale, True,
+                               self._mean, self._std),
+                    num_threads=self.num_threads, prefetch=self.prefetch,
+                    seed=self.seed)
+            pool = self._train_pool
+
+            def it():
+                while True:
+                    yield pool.next_batch()
+            return it()
+
+        aug = _Augmenter(self._crop, self._scale, False,
+                         self._mean, self._std)
+        rng = np.random.RandomState(0)
+
+        def eval_it():
+            n = len(self._items)
+            for start in range(0, n, self.batch_size):
+                chunk = self._items[start:start + self.batch_size]
+                imgs = np.stack([aug(raw, rng) for raw, _ in chunk])
+                lbls = np.asarray([lbl for _, lbl in chunk], np.float32)
+                yield MiniBatch(imgs, lbls)
+        return eval_it()
+
+    def close(self):
+        if self._train_pool is not None:
+            self._train_pool.close()
+            self._train_pool = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class _BatchPool:
+    """N worker threads, each building whole batches of randomly sampled
+    items into a bounded ready queue (same scheme as the native C++ loader,
+    bigdl_tpu/native/src/dataloader.cpp)."""
+
+    def __init__(self, items, batch_size, augmenter, *, num_threads,
+                 prefetch, seed):
+        self.items = items
+        self.batch_size = batch_size
+        self.augmenter = augmenter
+        self.ready: queue.Queue = queue.Queue(maxsize=max(2, prefetch))
+        self.stop = threading.Event()
+        self.threads = [
+            threading.Thread(target=self._worker, args=(seed + t,),
+                             daemon=True)
+            for t in range(num_threads)]
+        for t in self.threads:
+            t.start()
+
+    def _worker(self, seed):
+        rng = np.random.RandomState(seed)
+        n = len(self.items)
+        while not self.stop.is_set():
+            idxs = rng.randint(0, n, size=self.batch_size)
+            imgs, lbls = [], []
+            for i in idxs:
+                raw, lbl = self.items[i]
+                # unreadable image: resample (the reference logs and
+                # skips bad JPEGs); cap retries so a fully-corrupt
+                # dataset fails loudly instead of killing the worker
+                last_err = None
+                for _attempt in range(10):
+                    try:
+                        imgs.append(self.augmenter(raw, rng))
+                        last_err = None
+                        break
+                    except Exception as e:
+                        last_err = e
+                        j = int(rng.randint(0, n))
+                        raw, lbl = self.items[j]
+                if last_err is not None:
+                    raise RuntimeError(
+                        "10 consecutive unreadable images") from last_err
+                lbls.append(lbl)
+            batch = MiniBatch(np.stack(imgs),
+                              np.asarray(lbls, np.float32))
+            while not self.stop.is_set():
+                try:
+                    self.ready.put(batch, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+
+    def next_batch(self) -> MiniBatch:
+        while True:
+            try:
+                return self.ready.get(timeout=1.0)
+            except queue.Empty:
+                if self.stop.is_set() or not any(
+                        t.is_alive() for t in self.threads):
+                    raise RuntimeError("batch pool stopped")
+
+    def close(self):
+        self.stop.set()
+        # drain so producers blocked on put() observe stop
+        try:
+            while True:
+                self.ready.get_nowait()
+        except queue.Empty:
+            pass
+        for t in self.threads:
+            t.join(timeout=2.0)

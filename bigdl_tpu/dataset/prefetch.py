@@ -1,0 +1,70 @@
+"""Host→device transfer overlap.
+
+The reference overlapped batch building with compute via
+MTLabeledBGRImgToBatch worker threads; on TPU the equivalent win is
+keeping the chip fed: stage the next MiniBatch onto the device (or across
+a mesh, sharded along the batch axis) while the current step runs.
+``device_prefetch`` is that double-buffer — jax transfers are async, so
+``device_put`` of batch k+1 overlaps the dispatched step k.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, Optional
+
+import jax
+
+from bigdl_tpu.dataset.sample import MiniBatch
+
+
+def _put(batch: MiniBatch, sharding) -> MiniBatch:
+    def tx(x):
+        if x is None:
+            return None
+        if isinstance(x, (list, tuple)):
+            return type(x)(tx(e) for e in x)
+        return jax.device_put(x, sharding) if sharding is not None \
+            else jax.device_put(x)
+    return MiniBatch(tx(batch.input), tx(batch.target))
+
+
+def device_prefetch(it: Iterator[MiniBatch], *, size: int = 2,
+                    sharding=None) -> Iterator[MiniBatch]:
+    """Wrap a MiniBatch iterator so batches are staged to device ``size``
+    steps ahead. ``sharding`` (e.g. ``NamedSharding(mesh, P('data'))``)
+    lays each array out across the mesh batch-dim for multi-chip feeding.
+
+    The staging thread only calls ``device_put`` (async in jax) and
+    queue ops, so it cannot race the consumer's computation.
+
+    Caveat: on tunneled/virtualized single-chip setups a host->device
+    transfer issued while a step is executing can stall both (observed on
+    the axon tunnel: 26x). There, stage numpy batches on the host thread
+    instead and ``device_put`` between compute calls on the consumer side
+    (see bench.py's fed mode).
+    """
+    q: queue.Queue = queue.Queue(maxsize=size)
+    _END = object()
+    error: list = []
+
+    def stage():
+        try:
+            for batch in it:
+                q.put(_put(batch, sharding))
+        except BaseException as e:  # re-raised in the consumer
+            error.append(e)
+        finally:
+            q.put(_END)
+
+    t = threading.Thread(target=stage, daemon=True)
+    t.start()
+    while True:
+        item = q.get()
+        if item is _END:
+            if error:
+                # a device_put/iterator failure must not masquerade as
+                # normal end-of-dataset
+                raise error[0]
+            return
+        yield item

@@ -1,0 +1,252 @@
+"""Text pipeline (reference: dataset/text/ — SentenceTokenizer.scala:35,
+SentenceSplitter.scala, Dictionary.scala, TextToLabeledSentence.scala,
+LabeledSentenceToSample.scala, utils/SentenceToken.scala; consumed by
+models/rnn/Train.scala and example/languagemodel/PTBWordLM.scala).
+
+The reference tokenized with OpenNLP and carried sentences through
+``LabeledSentence`` (data = current-token indices, label = next-token
+indices) into Samples. Here tokenization is a small regex (no JVM NLP
+dependency); everything downstream is numpy, composable with the same
+``->`` Transformer algebra, and feeds LookupTable-based LMs with 1-based
+indices like the reference.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+from collections import Counter
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bigdl_tpu.dataset.sample import Sample
+from bigdl_tpu.dataset.transformer import Transformer
+
+SENTENCE_START = "SENTENCESTART"
+SENTENCE_END = "SENTENCEEND"
+
+_TOKEN_RE = re.compile(r"[A-Za-z0-9'<>$#-]+|[^\sA-Za-z0-9]")
+
+
+def tokenize(line: str) -> List[str]:
+    """Word tokenizer: lowercased words (apostrophes/hyphens kept, so
+    "don't" survives) plus standalone punctuation — the role
+    SimpleTokenizer/OpenNLP played in SentenceTokenizer.scala:35."""
+    return _TOKEN_RE.findall(line.lower())
+
+
+class SentenceSplitter(Transformer):
+    """Paragraph string -> sentence strings (SentenceSplitter.scala);
+    splits on ./!/? keeping it trivially rule-based."""
+
+    _SPLIT_RE = re.compile(r"(?<=[.!?])\s+")
+
+    def apply(self, it: Iterator[str]) -> Iterator[str]:
+        for text in it:
+            for s in self._SPLIT_RE.split(text.strip()):
+                if s:
+                    yield s
+
+
+class SentenceTokenizer(Transformer):
+    """Sentence string -> token list (SentenceTokenizer.scala:35)."""
+
+    def apply(self, it: Iterator[str]) -> Iterator[List[str]]:
+        for line in it:
+            toks = tokenize(line)
+            if toks:
+                yield toks
+
+
+class SentenceBiPadding(Transformer):
+    """Wrap token lists in SENTENCESTART/SENTENCEEND markers
+    (dataset/text/utils SentenceToken + models/rnn/Utils readSentence)."""
+
+    def __init__(self, start: bool = True, end: bool = True):
+        self.start, self.end = start, end
+
+    def apply(self, it: Iterator[List[str]]) -> Iterator[List[str]]:
+        for toks in it:
+            out = list(toks)
+            if self.start:
+                out = [SENTENCE_START] + out
+            if self.end:
+                out = out + [SENTENCE_END]
+            yield out
+
+
+class Dictionary:
+    """Vocabulary with 1-based indices (Dictionary.scala).
+
+    Kept words are the ``vocab_size`` most frequent; everything else maps
+    to one out-of-vocabulary index (the reference's "discard" percent +
+    unk). Indices are 1-based so they feed LookupTable directly.
+    """
+
+    def __init__(self, sentences_or_words=None,
+                 vocab_size: Optional[int] = None):
+        self.word2index = {}
+        self.index2word = {}
+        if sentences_or_words is not None:
+            words: List[str] = []
+            for el in sentences_or_words:
+                if isinstance(el, str):
+                    words.append(el)
+                else:
+                    words.extend(el)
+            counts = Counter(words)
+            keep = counts.most_common(vocab_size)
+            # ties broken by frequency then first-seen (Counter is stable)
+            for i, (w, _) in enumerate(keep):
+                self.word2index[w] = i + 1
+                self.index2word[i + 1] = w
+
+    def vocab_size(self) -> int:
+        """Kept words + 1 unk slot (Dictionary.getVocabSize semantics)."""
+        return len(self.word2index) + 1
+
+    def unk_index(self) -> int:
+        return len(self.word2index) + 1
+
+    def get_index(self, word: str) -> int:
+        return self.word2index.get(word, self.unk_index())
+
+    def get_word(self, index: int) -> str:
+        return self.index2word.get(int(index), "<unk>")
+
+    def __contains__(self, word: str) -> bool:
+        return word in self.word2index
+
+    def save(self, path: str):
+        """Persist as json (Dictionary.save wrote dictionary.txt +
+        discard.txt; one json carries both)."""
+        with open(path, "w") as f:
+            json.dump({"word2index": self.word2index}, f)
+
+    @classmethod
+    def load(cls, path: str) -> "Dictionary":
+        with open(path) as f:
+            data = json.load(f)
+        d = cls()
+        d.word2index = {w: int(i) for w, i in data["word2index"].items()}
+        d.index2word = {i: w for w, i in d.word2index.items()}
+        return d
+
+
+class LabeledSentence:
+    """Token-index sequence pair: data[t] predicts label[t]
+    (dataset/text/LabeledSentence.scala)."""
+
+    def __init__(self, data: Sequence[int], label: Sequence[int]):
+        self.data = np.asarray(data, np.float32)
+        self.label = np.asarray(label, np.float32)
+
+    def __len__(self):
+        return len(self.data)
+
+
+class TextToLabeledSentence(Transformer):
+    """Token list -> LabeledSentence of (current, next) indices
+    (TextToLabeledSentence.scala)."""
+
+    def __init__(self, dictionary: Dictionary):
+        self.dictionary = dictionary
+
+    def apply(self, it: Iterator[List[str]]) -> Iterator[LabeledSentence]:
+        for toks in it:
+            idx = [self.dictionary.get_index(w) for w in toks]
+            if len(idx) < 2:
+                continue
+            yield LabeledSentence(idx[:-1], idx[1:])
+
+
+class LabeledSentenceToSample(Transformer):
+    """LabeledSentence -> Sample (LabeledSentenceToSample.scala).
+
+    ``one_hot`` expands indices to one-hot vectors (the SimpleRNN path,
+    input layer is a Linear); otherwise features stay as indices for
+    LookupTable (the PTB path). ``fixed_length`` pads (repeating the end
+    index, like the reference's padding value) or truncates.
+    """
+
+    def __init__(self, one_hot_size: Optional[int] = None,
+                 fixed_length: Optional[int] = None):
+        self.one_hot_size = one_hot_size
+        self.fixed_length = fixed_length
+
+    def apply(self, it: Iterator[LabeledSentence]) -> Iterator[Sample]:
+        for ls in it:
+            data, label = ls.data, ls.label
+            if self.fixed_length is not None:
+                n = self.fixed_length
+                if len(data) >= n:
+                    data, label = data[:n], label[:n]
+                else:
+                    pad = n - len(data)
+                    data = np.concatenate(
+                        [data, np.full(pad, data[-1], np.float32)])
+                    label = np.concatenate(
+                        [label, np.full(pad, label[-1], np.float32)])
+            if self.one_hot_size is not None:
+                eye = np.zeros((len(data), self.one_hot_size), np.float32)
+                eye[np.arange(len(data)), data.astype(int) - 1] = 1.0
+                yield Sample(eye, label)
+            else:
+                yield Sample(data, label)
+
+
+# ------------------------------------------------------------- PTB loader
+
+def read_words(path: str) -> List[str]:
+    """PTB-style raw text -> flat word list with <eos> per line
+    (PTBWordLM.scala readWords; PTB files are pre-tokenized so splitting
+    on whitespace preserves tokens like ``<unk>`` and ``n't``)."""
+    words: List[str] = []
+    with open(path) as f:
+        for line in f:
+            toks = line.strip().split()
+            if toks:
+                words.extend(toks)
+                words.append("<eos>")
+    return words
+
+
+def ptb_arrays(words: Sequence[int], batch_size: int, num_steps: int
+               ) -> Tuple[np.ndarray, np.ndarray]:
+    """Flat index stream -> (x, y) of shape [n, num_steps]: the
+    contiguous-batch LM layout of PTBWordLM.scala:90-120 where stream
+    position advances within each batch row.
+
+    Returns 1-based index arrays; y is x shifted by one word.
+    """
+    data = np.asarray(words, np.float32)
+    n_batches = (len(data) - 1) // (batch_size * num_steps)
+    if n_batches <= 0:
+        raise ValueError("corpus too small for batch_size*num_steps")
+    span = n_batches * num_steps
+    xs = data[:batch_size * span].reshape(batch_size, span)
+    ys = data[1:batch_size * span + 1].reshape(batch_size, span)
+    x = np.concatenate([xs[:, i * num_steps:(i + 1) * num_steps]
+                        for i in range(n_batches)])
+    y = np.concatenate([ys[:, i * num_steps:(i + 1) * num_steps]
+                        for i in range(n_batches)])
+    return x, y
+
+
+def load_ptb(train_path: str, *, vocab_size: int = 10000,
+             valid_path: Optional[str] = None,
+             test_path: Optional[str] = None):
+    """Read PTB text file(s) and build the shared Dictionary from the
+    training split (PTBWordLM.scala:70-88). Returns (dict of split ->
+    1-based index array, Dictionary)."""
+    train_words = read_words(train_path)
+    dictionary = Dictionary([train_words], vocab_size=vocab_size)
+    out = {"train": np.asarray([dictionary.get_index(w)
+                                for w in train_words], np.float32)}
+    for name, path in (("valid", valid_path), ("test", test_path)):
+        if path is not None and os.path.exists(path):
+            out[name] = np.asarray(
+                [dictionary.get_index(w) for w in read_words(path)],
+                np.float32)
+    return out, dictionary

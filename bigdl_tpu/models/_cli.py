@@ -1,0 +1,129 @@
+"""Shared plumbing for the per-model Train/Test entry points (reference:
+models/*/Train.scala + models/*/Utils.scala scopt parsers).
+
+Every model package exposes ``python -m bigdl_tpu.models.<name>.train`` and
+``.test`` mains whose flags mirror the reference recipes (-f folder,
+-b batchSize, -e maxEpoch, -r learningRate, --model/--state snapshots,
+--checkpoint). A ``--synthetic N`` flag substitutes N random samples for
+the dataset so every recipe is runnable without downloads (the role
+DistriOptimizerPerf's synthetic data played, models/utils/).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+def base_parser(desc: str) -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(description=desc)
+    ap.add_argument("-f", "--folder", default="./",
+                    help="where the dataset lives")
+    ap.add_argument("-b", "--batchSize", type=int, default=None)
+    ap.add_argument("-e", "--maxEpoch", type=int, default=None)
+    ap.add_argument("-r", "--learningRate", type=float, default=None)
+    ap.add_argument("-d", "--learningRateDecay", type=float, default=None)
+    ap.add_argument("--model", default=None,
+                    help="model snapshot to resume/test")
+    ap.add_argument("--state", default=None,
+                    help="optim-method state snapshot to resume")
+    ap.add_argument("--checkpoint", default=None,
+                    help="directory to write checkpoints")
+    ap.add_argument("--overWrite", action="store_true",
+                    help="overwrite checkpoint files")
+    ap.add_argument("--maxIterations", type=int, default=None,
+                    help="stop after N iterations (overrides maxEpoch)")
+    ap.add_argument("--synthetic", type=int, default=0, metavar="N",
+                    help="train on N random samples instead of -f data")
+    return ap
+
+
+def load_model_or(args, build):
+    """--model snapshot beats the fresh builder (Train.scala pattern)."""
+    if args.model:
+        from bigdl_tpu.utils.serialization import load_module
+        return load_module(args.model)
+    return build()
+
+
+def wire_optimizer(opt, args, optim_method, val_ds=None,
+                   val_methods=None, default_epochs: int = 1):
+    """setCheckpoint/setValidation/setEndWhen in the reference shape."""
+    from bigdl_tpu.optim import every_epoch, max_epoch, max_iteration
+
+    if args.state:
+        import pickle
+        with open(args.state, "rb") as f:
+            optim_method.load_state(pickle.load(f))
+    opt.set_optim_method(optim_method)
+    if args.checkpoint:
+        opt.set_checkpoint(args.checkpoint, every_epoch())
+    if val_ds is not None and val_methods:
+        opt.set_validation(every_epoch(), val_ds, val_methods)
+    if args.maxIterations:
+        opt.set_end_when(max_iteration(args.maxIterations))
+    else:
+        opt.set_end_when(max_epoch(args.maxEpoch or default_epochs))
+    return opt
+
+
+# ------------------------------------------------------------ dataset glue
+
+def mnist_arrays(folder: str, train: bool,
+                 synthetic: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    """MNIST idx files -> normalized [N,1,28,28] + 1-based labels
+    (lenet/Utils.scala train/test mean+std)."""
+    if synthetic:
+        rng = np.random.RandomState(0 if train else 1)
+        return (rng.rand(synthetic, 1, 28, 28).astype(np.float32),
+                rng.randint(1, 11, synthetic).astype(np.float32))
+    from bigdl_tpu.dataset.image import load_mnist
+    prefix = "train" if train else "t10k"
+    imgs, lbls = load_mnist(
+        os.path.join(folder, f"{prefix}-images-idx3-ubyte"),
+        os.path.join(folder, f"{prefix}-labels-idx1-ubyte"))
+    mean, std = ((0.13066047, 0.3081078) if train
+                 else (0.13251461, 0.31048024))
+    return ((imgs / 255.0 - mean) / std).astype(np.float32), lbls
+
+
+def cifar10_arrays(folder: str, train: bool, synthetic: int = 0):
+    """CIFAR-10 binary batches -> normalized [N,3,32,32] + 1-based labels
+    (vgg/resnet recipes' per-channel stats)."""
+    if synthetic:
+        rng = np.random.RandomState(0 if train else 1)
+        return (rng.rand(synthetic, 3, 32, 32).astype(np.float32),
+                rng.randint(1, 11, synthetic).astype(np.float32))
+    from bigdl_tpu.dataset.image import load_cifar10
+    if train:
+        paths = [os.path.join(folder, f"data_batch_{i}.bin")
+                 for i in range(1, 6)]
+    else:
+        paths = [os.path.join(folder, "test_batch.bin")]
+    imgs, lbls = load_cifar10(paths)
+    mean = np.array([125.3, 123.0, 113.9], np.float32).reshape(3, 1, 1)
+    std = np.array([63.0, 62.1, 66.7], np.float32).reshape(3, 1, 1)
+    return ((imgs - mean) / std).astype(np.float32), lbls
+
+
+def arrays_to_dataset(imgs, lbls, batch_size: int):
+    from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+    samples = [Sample(imgs[i], lbls[i]) for i in range(len(imgs))]
+    return DataSet.array(samples).transform(SampleToMiniBatch(batch_size))
+
+
+def evaluate_cli(args, build, val_data, default_batch: int = 128):
+    """Shared Test.scala main: load snapshot (or fresh), evaluate Top1."""
+    from bigdl_tpu.optim import Evaluator, Top1Accuracy, Top5Accuracy
+
+    model = load_model_or(args, build).evaluate()
+    imgs, lbls = val_data
+    bs = args.batchSize or default_batch
+    ds = arrays_to_dataset(imgs, lbls, bs)
+    results = Evaluator(model).test(
+        ds, [Top1Accuracy(), Top5Accuracy()], batch_size=bs)
+    for name, r in results.items():
+        print(f"{name}: {r}")
+    return results

@@ -1,0 +1,40 @@
+"""Autoencoder MNIST training recipe (models/autoencoder/Train.scala —
+Adagrad lr 0.01, MSE against the input image).
+
+    python -m bigdl_tpu.models.autoencoder.train -f /path/to/mnist
+    python -m bigdl_tpu.models.autoencoder.train --synthetic 256 -e 1
+"""
+from __future__ import annotations
+
+
+def main(argv=None):
+    from bigdl_tpu.models._cli import (arrays_to_dataset, base_parser,
+                                       load_model_or, mnist_arrays,
+                                       wire_optimizer)
+
+    args = base_parser("Train the MNIST autoencoder").parse_args(argv)
+
+    import numpy as np
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+    from bigdl_tpu.models.autoencoder import Autoencoder
+    from bigdl_tpu.optim import Adagrad, LocalOptimizer
+
+    bs = args.batchSize or 150
+    imgs, _ = mnist_arrays(args.folder, True, args.synthetic)
+    flat = imgs.reshape(len(imgs), -1).astype(np.float32)
+    samples = [Sample(flat[i], flat[i]) for i in range(len(flat))]
+    ds = DataSet.array(samples).transform(SampleToMiniBatch(bs))
+
+    model = load_model_or(args, lambda: Autoencoder(class_num=32))
+    optim = Adagrad(learning_rate=args.learningRate or 0.01)
+    opt = LocalOptimizer(model, ds, nn.MSECriterion(), batch_size=bs)
+    wire_optimizer(opt, args, optim, default_epochs=10)
+    opt.optimize()
+    print(f"final loss: {opt.driver_state['Loss']:.4f}")
+    return model
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+"""Inception-v1 ImageNet training recipe (models/inception/Train.scala:34-120
+— SGD lr 0.01, Poly(0.5, ceil(1281167/batchSize)*maxEpoch) over the
+ImageFolder/SeqFile pipeline; BASELINE config 4's training side).
+
+    python -m bigdl_tpu.models.inception.train -f /imagenet/train -b 128
+    python -m bigdl_tpu.models.inception.train --synthetic 64 -e 1
+"""
+from __future__ import annotations
+
+import math
+
+
+def main(argv=None):
+    from bigdl_tpu.models._cli import (arrays_to_dataset, base_parser,
+                                       load_model_or, wire_optimizer)
+
+    ap = base_parser("Train Inception-v1 on ImageNet")
+    ap.add_argument("--weightDecay", type=float, default=1e-4)
+    ap.add_argument("--classNum", type=int, default=1000)
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.models.inception import Inception_v1_NoAuxClassifier
+    from bigdl_tpu.optim import (LocalOptimizer, Poly, SGD, Top1Accuracy,
+                                 Top5Accuracy)
+
+    bs = args.batchSize or 32
+    epochs = args.maxEpoch or 1
+    if args.synthetic:
+        rng = np.random.RandomState(0)
+        imgs = rng.rand(args.synthetic, 3, 224, 224).astype(np.float32)
+        lbls = rng.randint(1, args.classNum + 1,
+                           args.synthetic).astype(np.float32)
+        ds = arrays_to_dataset(imgs, lbls, bs)
+        n_train = args.synthetic
+        val_ds = None
+    else:
+        from bigdl_tpu.dataset import ImageFolderDataSet
+        ds = ImageFolderDataSet(args.folder, batch_size=bs, crop=224,
+                                scale=256)
+        n_train = ds.size()
+        val_ds = None
+
+    model = load_model_or(
+        args, lambda: Inception_v1_NoAuxClassifier(args.classNum))
+    max_iter = int(math.ceil(n_train / bs)) * epochs
+    optim = SGD(learning_rate=args.learningRate or 0.01,
+                learning_rate_decay=0.0, weight_decay=args.weightDecay,
+                momentum=0.9, dampening=0.0,
+                learning_rate_schedule=Poly(0.5, max_iter))
+    opt = LocalOptimizer(model, ds, nn.ClassNLLCriterion(), batch_size=bs)
+    wire_optimizer(opt, args, optim, val_ds=val_ds,
+                   val_methods=[Top1Accuracy(), Top5Accuracy()],
+                   default_epochs=epochs)
+    opt.optimize()
+    print(f"final loss: {opt.driver_state['Loss']:.4f}")
+    return model
+
+
+if __name__ == "__main__":
+    main()

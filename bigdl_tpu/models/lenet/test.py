@@ -1,0 +1,18 @@
+"""LeNet-5 MNIST evaluation (models/lenet/Test.scala).
+
+    python -m bigdl_tpu.models.lenet.test -f /path/to/mnist --model snap
+"""
+from __future__ import annotations
+
+
+def main(argv=None):
+    from bigdl_tpu.models._cli import base_parser, evaluate_cli, mnist_arrays
+
+    args = base_parser("Test LeNet-5 on MNIST").parse_args(argv)
+    from bigdl_tpu.models.lenet import LeNet5
+    return evaluate_cli(args, lambda: LeNet5(10),
+                        mnist_arrays(args.folder, False, args.synthetic))
+
+
+if __name__ == "__main__":
+    main()

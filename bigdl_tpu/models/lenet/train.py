@@ -1,0 +1,44 @@
+"""LeNet-5 MNIST training recipe (models/lenet/Train.scala:29-90,
+Utils.scala flags; BASELINE config 1).
+
+    python -m bigdl_tpu.models.lenet.train -f /path/to/mnist -b 12 -e 15
+    python -m bigdl_tpu.models.lenet.train --synthetic 256 -e 1
+"""
+from __future__ import annotations
+
+
+def main(argv=None):
+    from bigdl_tpu.models._cli import (
+        arrays_to_dataset, base_parser, load_model_or, mnist_arrays,
+        wire_optimizer)
+
+    ap = base_parser("Train LeNet-5 on MNIST")
+    ap.add_argument("-g", "--graphModel", action="store_true",
+                    help="use the Graph form of LeNet-5")
+    args = ap.parse_args(argv)
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.models.lenet import LeNet5, LeNet5_graph
+    from bigdl_tpu.optim import (LocalOptimizer, Loss, SGD, Top1Accuracy,
+                                 Top5Accuracy)
+
+    bs = args.batchSize or 12
+    tr = mnist_arrays(args.folder, True, args.synthetic)
+    va = mnist_arrays(args.folder, False, args.synthetic or 0)
+    model = load_model_or(
+        args, lambda: (LeNet5_graph(10) if args.graphModel else LeNet5(10)))
+    optim = SGD(learning_rate=args.learningRate or 0.05,
+                learning_rate_decay=args.learningRateDecay or 0.0)
+    opt = LocalOptimizer(model, arrays_to_dataset(*tr, bs),
+                         nn.ClassNLLCriterion(), batch_size=bs)
+    wire_optimizer(opt, args, optim,
+                   val_ds=arrays_to_dataset(*va, bs),
+                   val_methods=[Top1Accuracy(), Top5Accuracy(), Loss()],
+                   default_epochs=15)
+    opt.optimize()
+    print(f"final loss: {opt.driver_state['Loss']:.4f}")
+    return model
+
+
+if __name__ == "__main__":
+    main()

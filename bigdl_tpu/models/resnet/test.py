@@ -1,0 +1,19 @@
+"""ResNet CIFAR-10 evaluation (models/resnet/Test.scala)."""
+from __future__ import annotations
+
+
+def main(argv=None):
+    from bigdl_tpu.models._cli import (base_parser, cifar10_arrays,
+                                       evaluate_cli)
+
+    ap = base_parser("Test ResNet on CIFAR-10")
+    ap.add_argument("--depth", type=int, default=20)
+    args = ap.parse_args(argv)
+    from bigdl_tpu.models.resnet import ResNet
+    return evaluate_cli(
+        args, lambda: ResNet(10, depth=args.depth, dataset="CIFAR10"),
+        cifar10_arrays(args.folder, False, args.synthetic))
+
+
+if __name__ == "__main__":
+    main()

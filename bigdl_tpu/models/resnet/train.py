@@ -1,0 +1,58 @@
+"""ResNet CIFAR-10 training recipe (models/resnet/Train.scala:46-99 —
+SGD lr 0.1, wd 1e-4, momentum 0.9, nesterov, EpochDecay(cifar10Decay:
+x0.1 at epochs 81 and 122), batch 448, 165 epochs; models/resnet/README
+BASELINE config 3's CIFAR variant).
+
+    python -m bigdl_tpu.models.resnet.train -f /path/to/cifar10 --depth 20
+    python -m bigdl_tpu.models.resnet.train --synthetic 256 -e 1
+"""
+from __future__ import annotations
+
+
+def cifar10_decay(epoch: int) -> float:
+    """resnet/Train.scala:34 cifar10Decay."""
+    if epoch >= 122:
+        return 2.0
+    if epoch >= 81:
+        return 1.0
+    return 0.0
+
+
+def main(argv=None):
+    from bigdl_tpu.models._cli import (
+        arrays_to_dataset, base_parser, cifar10_arrays, load_model_or,
+        wire_optimizer)
+
+    ap = base_parser("Train ResNet on CIFAR-10")
+    ap.add_argument("--depth", type=int, default=20)
+    ap.add_argument("--weightDecay", type=float, default=1e-4)
+    ap.add_argument("--nesterov", action="store_true", default=True)
+    args = ap.parse_args(argv)
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.models.resnet import ResNet
+    from bigdl_tpu.optim import (EpochDecay, LocalOptimizer, Loss, SGD,
+                                 Top1Accuracy, Top5Accuracy)
+
+    bs = args.batchSize or 448
+    tr = cifar10_arrays(args.folder, True, args.synthetic)
+    va = cifar10_arrays(args.folder, False, args.synthetic or 0)
+    model = load_model_or(
+        args, lambda: ResNet(10, depth=args.depth, dataset="CIFAR10"))
+    optim = SGD(learning_rate=args.learningRate or 0.1,
+                learning_rate_decay=0.0, weight_decay=args.weightDecay,
+                momentum=0.9, dampening=0.0, nesterov=args.nesterov,
+                learning_rate_schedule=EpochDecay(cifar10_decay))
+    opt = LocalOptimizer(model, arrays_to_dataset(*tr, bs),
+                         nn.CrossEntropyCriterion(), batch_size=bs)
+    wire_optimizer(opt, args, optim,
+                   val_ds=arrays_to_dataset(*va, bs),
+                   val_methods=[Top1Accuracy(), Top5Accuracy(), Loss()],
+                   default_epochs=165)
+    opt.optimize()
+    print(f"final loss: {opt.driver_state['Loss']:.4f}")
+    return model
+
+
+if __name__ == "__main__":
+    main()

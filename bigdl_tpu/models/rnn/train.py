@@ -1,0 +1,71 @@
+"""RNN LM training recipe (models/rnn/Train.scala:60-133 — tokenize with
+SentenceTokenizer, Dictionary(vocab 4000), SGD lr 0.1, TimeDistributed
+CrossEntropy; BASELINE config 5 via the PTB path).
+
+    python -m bigdl_tpu.models.rnn.train -f dir_with_train.txt
+    python -m bigdl_tpu.models.rnn.train --synthetic 2000 -e 2
+"""
+from __future__ import annotations
+
+import os
+
+
+def main(argv=None):
+    from bigdl_tpu.models._cli import (arrays_to_dataset, base_parser,
+                                       load_model_or, wire_optimizer)
+
+    ap = base_parser("Train the RNN language model")
+    ap.add_argument("--vocabSize", type=int, default=4000)
+    ap.add_argument("--hiddenSize", type=int, default=40)
+    ap.add_argument("--numSteps", type=int, default=20)
+    ap.add_argument("--ptb", action="store_true",
+                    help="use the stacked-LSTM PTBModel instead of "
+                         "SimpleRNN")
+    ap.add_argument("--momentum", type=float, default=0.0)
+    ap.add_argument("--weightDecay", type=float, default=0.0)
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import Dictionary, load_ptb, ptb_arrays
+    from bigdl_tpu.models.rnn import PTBModel, SimpleRNN
+    from bigdl_tpu.optim import LocalOptimizer, SGD
+
+    bs = args.batchSize or 32
+    if args.synthetic:
+        rng = np.random.RandomState(0)
+        stream = rng.randint(1, args.vocabSize + 1,
+                             args.synthetic).astype(np.float32)
+        vocab = args.vocabSize
+    else:
+        train_txt = args.folder if os.path.isfile(args.folder) else \
+            os.path.join(args.folder, "train.txt")
+        splits, d = load_ptb(train_txt, vocab_size=args.vocabSize)
+        stream, vocab = splits["train"], d.vocab_size()
+    x, y = ptb_arrays(stream, bs, args.numSteps)
+    ds = arrays_to_dataset(x, y, bs)
+
+    if args.ptb:
+        build = lambda: PTBModel(vocab, args.hiddenSize, vocab)
+    else:
+        build = lambda: nn.Sequential() \
+            .add(nn.LookupTable(vocab, args.hiddenSize)) \
+            .add(nn.Recurrent(nn.RnnCell(args.hiddenSize, args.hiddenSize,
+                                         nn.Tanh()))) \
+            .add(nn.TimeDistributed(nn.Linear(args.hiddenSize, vocab)))
+    model = load_model_or(args, build)
+    optim = SGD(learning_rate=args.learningRate or 0.1,
+                learning_rate_decay=args.learningRateDecay or 0.0,
+                weight_decay=args.weightDecay, momentum=args.momentum)
+    crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion())
+    opt = LocalOptimizer(model, ds, crit, batch_size=bs)
+    wire_optimizer(opt, args, optim, default_epochs=2)
+    opt.optimize()
+    loss = opt.driver_state["Loss"]
+    print(f"final loss: {loss:.4f} perplexity: {np.exp(loss):.2f}")
+    return model
+
+
+if __name__ == "__main__":
+    main()

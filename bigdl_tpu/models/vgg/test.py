@@ -1,0 +1,16 @@
+"""VGG-16 CIFAR-10 evaluation (models/vgg/Test.scala)."""
+from __future__ import annotations
+
+
+def main(argv=None):
+    from bigdl_tpu.models._cli import (base_parser, cifar10_arrays,
+                                       evaluate_cli)
+
+    args = base_parser("Test VGG-16 on CIFAR-10").parse_args(argv)
+    from bigdl_tpu.models.vgg import VggForCifar10
+    return evaluate_cli(args, lambda: VggForCifar10(10),
+                        cifar10_arrays(args.folder, False, args.synthetic))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,45 @@
+"""VGG-16 CIFAR-10 training recipe (models/vgg/Train.scala:30-80 —
+SGD lr 0.01, wd 5e-4, momentum 0.9, EpochStep(25, 0.5), maxEpoch 90;
+BASELINE config 2).
+
+    python -m bigdl_tpu.models.vgg.train -f /path/to/cifar10 -b 112
+    python -m bigdl_tpu.models.vgg.train --synthetic 256 -e 1
+"""
+from __future__ import annotations
+
+
+def main(argv=None):
+    from bigdl_tpu.models._cli import (
+        arrays_to_dataset, base_parser, cifar10_arrays, load_model_or,
+        wire_optimizer)
+
+    ap = base_parser("Train VGG-16 on CIFAR-10")
+    ap.add_argument("--weightDecay", type=float, default=5e-4)
+    args = ap.parse_args(argv)
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.models.vgg import VggForCifar10
+    from bigdl_tpu.optim import (EpochStep, LocalOptimizer, Loss, SGD,
+                                 Top1Accuracy, Top5Accuracy)
+
+    bs = args.batchSize or 112
+    tr = cifar10_arrays(args.folder, True, args.synthetic)
+    va = cifar10_arrays(args.folder, False, args.synthetic or 0)
+    model = load_model_or(args, lambda: VggForCifar10(10))
+    optim = SGD(learning_rate=args.learningRate or 0.01,
+                learning_rate_decay=0.0, weight_decay=args.weightDecay,
+                momentum=0.9, dampening=0.0, nesterov=False,
+                learning_rate_schedule=EpochStep(25, 0.5))
+    opt = LocalOptimizer(model, arrays_to_dataset(*tr, bs),
+                         nn.ClassNLLCriterion(), batch_size=bs)
+    wire_optimizer(opt, args, optim,
+                   val_ds=arrays_to_dataset(*va, bs),
+                   val_methods=[Top1Accuracy(), Top5Accuracy(), Loss()],
+                   default_epochs=90)
+    opt.optimize()
+    print(f"final loss: {opt.driver_state['Loss']:.4f}")
+    return model
+
+
+if __name__ == "__main__":
+    main()

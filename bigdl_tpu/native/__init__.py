@@ -13,9 +13,14 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+import threading
 from typing import Optional
 
 import numpy as np
+
+# fallback for close() on partially-constructed loaders (init raised
+# before _lock existed)
+_NULL_LOCK = threading.Lock()
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _LIB_PATH = os.path.join(_DIR, "libbigdl_native.so")
@@ -55,6 +60,11 @@ def load_library(build: bool = True) -> Optional[ctypes.CDLL]:
     lib.bigdl_loader_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                       ctypes.c_void_p]
     lib.bigdl_loader_destroy.argtypes = [ctypes.c_void_p]
+    lib.bigdl_loader_u8_create.restype = ctypes.c_void_p
+    lib.bigdl_loader_u8_next.restype = ctypes.c_int
+    lib.bigdl_loader_u8_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                         ctypes.c_void_p]
+    lib.bigdl_loader_u8_destroy.argtypes = [ctypes.c_void_p]
     _lib = lib
     return lib
 
@@ -126,6 +136,10 @@ class NativeBatchLoader:
         n, c, h, w = self.images.shape
         if n <= 0:
             raise ValueError("NativeBatchLoader needs a non-empty dataset")
+        if len(self.labels) < n:
+            raise ValueError(
+                f"labels ({len(self.labels)}) shorter than images ({n}) "
+                "— C++ workers index labels[0:n)")
         if c > 8:
             raise ValueError("NativeBatchLoader supports at most 8 "
                              "channels (mean/std are fixed-size in C++)")
@@ -145,14 +159,20 @@ class NativeBatchLoader:
             num_threads, prefetch, ctypes.c_uint64(seed))
         if not self._handle:
             raise ValueError("bigdl_loader_create rejected the config")
+        self._lock = threading.Lock()  # serializes next_batch vs close
 
     def next_batch(self):
         imgs = np.empty(self.out_shape, np.float32)
         lbls = np.empty((self.batch_size,), np.float32)
-        self._lib.bigdl_loader_next(
-            self._handle,
-            imgs.ctypes.data_as(ctypes.c_void_p),
-            lbls.ctypes.data_as(ctypes.c_void_p))
+        with self._lock:
+            if not self._handle:
+                raise RuntimeError("loader is closed")
+            got = self._lib.bigdl_loader_next(
+                self._handle,
+                imgs.ctypes.data_as(ctypes.c_void_p),
+                lbls.ctypes.data_as(ctypes.c_void_p))
+        if got == 0:  # loader is stopping; the buffers are uninitialized
+            raise RuntimeError("loader stopped")
         return imgs, lbls
 
     def __iter__(self):
@@ -160,9 +180,79 @@ class NativeBatchLoader:
             yield self.next_batch()
 
     def close(self):
-        if self._handle:
-            self._lib.bigdl_loader_destroy(self._handle)
-            self._handle = None
+        with getattr(self, "_lock", _NULL_LOCK):
+            if getattr(self, "_handle", None):
+                self._lib.bigdl_loader_destroy(self._handle)
+                self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeBatchLoaderU8:
+    """uint8 variant of NativeBatchLoader: crop+flip only, NO normalize.
+
+    Batches cross the host->device link at 1/4 the float32 bytes (the link
+    is the feed bottleneck on tunneled TPUs); do ``(x - mean) / std`` on
+    device, where XLA fuses it into the first conv.
+    """
+
+    def __init__(self, images: np.ndarray, labels: np.ndarray,
+                 batch_size: int, *, crop: Optional[tuple] = None,
+                 pad: int = 0, flip: bool = True, train: bool = True,
+                 num_threads: int = 4, prefetch: int = 4, seed: int = 0):
+        lib = load_library()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self.images = np.ascontiguousarray(images, np.uint8)
+        self.labels = np.ascontiguousarray(labels, np.float32)
+        n, c, h, w = self.images.shape
+        if n <= 0:
+            raise ValueError("NativeBatchLoaderU8 needs a non-empty dataset")
+        if len(self.labels) < n:
+            raise ValueError(
+                f"labels ({len(self.labels)}) shorter than images ({n}) "
+                "— C++ workers index labels[0:n)")
+        ch, cw = crop or (h, w)
+        self.batch_size = batch_size
+        self.out_shape = (batch_size, c, ch, cw)
+        self._handle = lib.bigdl_loader_u8_create(
+            self.images.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            self.labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            ctypes.c_int64(n), c, h, w, ch, cw, pad, batch_size,
+            int(flip), int(train), num_threads, prefetch,
+            ctypes.c_uint64(seed))
+        if not self._handle:
+            raise ValueError("bigdl_loader_u8_create rejected the config")
+        self._lock = threading.Lock()  # serializes next_batch vs close
+
+    def next_batch(self):
+        imgs = np.empty(self.out_shape, np.uint8)
+        lbls = np.empty((self.batch_size,), np.float32)
+        with self._lock:
+            if not self._handle:
+                raise RuntimeError("loader is closed")
+            got = self._lib.bigdl_loader_u8_next(
+                self._handle,
+                imgs.ctypes.data_as(ctypes.c_void_p),
+                lbls.ctypes.data_as(ctypes.c_void_p))
+        if got == 0:  # loader is stopping; the buffers are uninitialized
+            raise RuntimeError("loader stopped")
+        return imgs, lbls
+
+    def __iter__(self):
+        while True:
+            yield self.next_batch()
+
+    def close(self):
+        with getattr(self, "_lock", _NULL_LOCK):
+            if getattr(self, "_handle", None):
+                self._lib.bigdl_loader_u8_destroy(self._handle)
+                self._handle = None
 
     def __del__(self):
         try:

@@ -14,6 +14,7 @@ from bigdl_tpu.nn.container import (
     Container, Sequential, ConcatTable, ParallelTable, Concat, MapTable,
     Bottle, NarrowTable, MixtureTable)
 from bigdl_tpu.nn.graph import Graph, Input
+from bigdl_tpu.nn.control_ops import SwitchOps, MergeOps, IfThenElse
 from bigdl_tpu.nn.activation import (
     ReLU, ReLU6, Tanh, TanhShrink, Sigmoid, LogSigmoid, SoftMax, SoftMin,
     LogSoftMax, SoftPlus, SoftSign, ELU, LeakyReLU, PReLU, RReLU, SoftShrink,

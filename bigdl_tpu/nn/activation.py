@@ -313,7 +313,9 @@ class GaussianSampler(Module):
     T(mean, log_var)."""
 
     def forward_fn(self, params, input, *, training=False, rng=None):
-        mean, log_var = input[1], input[2]
+        mean, log_var = list(input)[:2]  # Table (1-based) or plain list
+        mean = jnp.asarray(mean)
+        log_var = jnp.asarray(log_var)
         if rng is None:
             raise ValueError("GaussianSampler requires an rng")
         eps = jax.random.normal(rng, mean.shape, mean.dtype)

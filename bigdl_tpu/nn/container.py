@@ -228,12 +228,13 @@ class MixtureTable(Module):
         self.dim = dim
 
     def forward_fn(self, params, input, *, training=False, rng=None):
-        gater = input[1]
-        experts = input[2]
-        if isinstance(experts, Table):
-            stacked = jnp.stack(list(experts), axis=1)  # [B, E, ...]
+        gater, experts = list(input)[:2]  # Table (1-based) or plain list
+        gater = jnp.asarray(gater)
+        if isinstance(experts, (Table, list, tuple)):
+            stacked = jnp.stack([jnp.asarray(e) for e in experts],
+                                axis=1)  # [B, E, ...]
         else:
-            stacked = experts
+            stacked = jnp.asarray(experts)
         g = gater
         extra = stacked.ndim - g.ndim
         g = g.reshape(g.shape + (1,) * extra)

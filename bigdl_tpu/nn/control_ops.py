@@ -1,0 +1,126 @@
+"""Graph control-flow ops (reference: nn/ops/ControlOps.scala — SwitchOps
+:69, MergeOps :91 — plus the Scheduler's control-flow handling,
+nn/Scheduler.scala:118-130).
+
+The reference's Scheduler is a runtime dataflow walk: a Switch makes only
+one of its two outputs "available" and downstream nodes fire when their
+inputs arrive. Under XLA the graph is traced ONCE, so availability cannot
+be decided at runtime; the TPU-native lowering is:
+
+- ``SwitchOps``: pass-through that exposes its (data, pred) input on both
+  branch outputs; the *selection* moves to the matching Merge.
+- ``MergeOps``: ``lax.select`` between its branch inputs, driven by the
+  predicate of the Switch that controls each input (resolved by
+  ``Graph`` at build time via a backward walk). Both branches are traced
+  and computed — they are pure functions, so select-at-merge is
+  semantics-preserving, and XLA fuses the untaken side's ops with the
+  select (or DCEs them when the predicate folds to a constant).
+- ``IfThenElse``: user-facing conditional running exactly ONE branch via
+  ``lax.cond`` — use when the branches are expensive and skipping the
+  untaken one matters (the compiled-cost behavior the Scheduler's
+  dataflow walk gave the reference).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.module import Module, adopt_or_init, adopt_state
+from bigdl_tpu.utils.table import Table, T
+
+
+class SwitchOps(Module):
+    """nn/ops/ControlOps.scala:69 — input T(data, pred); output table where
+    index 0 is the false branch and index 1 the true branch (the reference
+    routes element 1/2 the same way, 1-based)."""
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        data, pred = list(input)[:2]
+        del pred  # selection happens at the matching MergeOps
+        return T(data, data)
+
+
+class MergeOps(Module):
+    """nn/ops/ControlOps.scala:91 — emits whichever branch the controlling
+    Switch predicate selects. ``apply`` is called by Graph with the
+    predicate threaded in; standalone use takes T(false_val, true_val,
+    pred)."""
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        xs = list(input)
+        if len(xs) == 3:
+            false_v, true_v, pred = xs
+            return self.select(pred, true_v, false_v)
+        raise ValueError(
+            "MergeOps outside a Graph needs T(false_val, true_val, pred)")
+
+    @staticmethod
+    def select(pred, true_v, false_v):
+        pred = jnp.asarray(pred)
+        return lax.select(
+            jnp.broadcast_to(pred.astype(bool), jnp.shape(true_v)),
+            jnp.asarray(true_v), jnp.asarray(false_v))
+
+
+class IfThenElse(Module):
+    """Conditional container: runs ONE branch via lax.cond.
+
+    Input is T(pred, x); output is then(x) when pred is true else els(x).
+    Both branches must produce the same output structure/shapes (an XLA
+    requirement — the reference's Scheduler had no such constraint but
+    also gave no compiled graph).
+    """
+
+    def __init__(self, then_branch: Module, else_branch: Module):
+        super().__init__()
+        self.then_branch = then_branch
+        self.else_branch = else_branch
+        self.modules = [then_branch, else_branch]
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        return {"then": adopt_or_init(self.then_branch, k1),
+                "else": adopt_or_init(self.else_branch, k2)}
+
+    def initial_state(self):
+        return {"then": adopt_state(self.then_branch),
+                "else": adopt_state(self.else_branch)}
+
+    def regularization_loss(self, params):
+        return (self.then_branch.regularization_loss(params["then"])
+                + self.else_branch.regularization_loss(params["else"]))
+
+    def training(self):
+        super().training()
+        self.then_branch.training()
+        self.else_branch.training()
+        return self
+
+    def evaluate(self):
+        super().evaluate()
+        self.then_branch.evaluate()
+        self.else_branch.evaluate()
+        return self
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        pred, x = list(input)[:2]
+        keys = (jax.random.split(rng) if rng is not None else (None, None))
+
+        def run_then(operand):
+            p, s, xx = operand
+            out, st = self.then_branch.apply(p["then"], s["then"], xx,
+                                             training=training, rng=keys[0])
+            return out, {"then": st, "else": s["else"]}
+
+        def run_else(operand):
+            p, s, xx = operand
+            out, st = self.else_branch.apply(p["else"], s["else"], xx,
+                                             training=training, rng=keys[1])
+            return out, {"then": s["then"], "else": st}
+
+        pred_scalar = jnp.asarray(pred).astype(bool).reshape(())
+        return lax.cond(pred_scalar, run_then, run_else,
+                        (params, state, x))

@@ -164,7 +164,7 @@ class MarginRankingCriterion(Criterion):
         self.size_average = size_average
 
     def apply(self, input, target):
-        x1, x2 = input[1], input[2]
+        x1, x2 = list(input)[:2]  # Table (1-based) or plain list
         y = target[1] if isinstance(target, Table) else target
         l = jnp.maximum(0.0, -y * (x1 - x2) + self.margin)
         return _reduce(l, self.size_average)
@@ -213,8 +213,11 @@ class MultiLabelMarginCriterion(Criterion):
         B, C = x.shape
         valid = t > 0  # zero-terminated
         tidx = jnp.clip(t - 1, 0, C - 1)
+        # additive scatter: invalid entries all clip to index 0, and a
+        # plain .set would let a trailing False overwrite a real target
         is_target = jax.vmap(
-            lambda ti, vi: jnp.zeros((C,), bool).at[ti].set(vi))(tidx, valid)
+            lambda ti, vi: jnp.zeros((C,), jnp.int32)
+            .at[ti].add(vi.astype(jnp.int32)) > 0)(tidx, valid)
 
         def per_sample(xi, ti, vi, it):
             # sum over target labels j and non-target k of max(0, 1 - (x_j - x_k))
@@ -279,7 +282,8 @@ class L1HingeEmbeddingCriterion(Criterion):
         self.margin = margin
 
     def apply(self, input, target):
-        d = jnp.sum(jnp.abs(input[1] - input[2]))
+        a, b = (jnp.asarray(v) for v in list(input)[:2])
+        d = jnp.sum(jnp.abs(a - b))
         y = jnp.asarray(target).reshape(())
         return jnp.where(y > 0, d, jnp.maximum(0.0, self.margin - d))
 
@@ -293,7 +297,7 @@ class CosineEmbeddingCriterion(Criterion):
         self.size_average = size_average
 
     def apply(self, input, target):
-        x1, x2 = input[1], input[2]
+        x1, x2 = list(input)[:2]  # Table (1-based) or plain list
         if x1.ndim == 1:
             x1, x2 = x1[None], x2[None]
         y = jnp.asarray(target[1] if isinstance(target, Table) else target
@@ -342,7 +346,7 @@ class KLDCriterion(Criterion):
     (nn/KLDCriterion.scala)."""
 
     def apply(self, input, target=None):
-        mean, log_var = input[1], input[2]
+        mean, log_var = (jnp.asarray(v) for v in list(input)[:2])
         kl = 0.5 * jnp.sum(mean ** 2 + jnp.exp(log_var) - 1.0 - log_var,
                            axis=-1)
         return jnp.mean(kl)
@@ -357,7 +361,8 @@ class GaussianCriterion(Criterion):
     (nn/GaussianCriterion.scala)."""
 
     def apply(self, input, target):
-        mean, log_var = input[1], input[2]
+        mean, log_var = (jnp.asarray(v) for v in list(input)[:2])
+        target = jnp.asarray(target)
         nll = 0.5 * (jnp.log(2 * jnp.pi) + log_var
                      + (target - mean) ** 2 / jnp.exp(log_var))
         return jnp.sum(nll)
@@ -409,8 +414,10 @@ class DiceCoefficientCriterion(Criterion):
             else input[None]
         t = target.reshape(x.shape)
         inter = jnp.sum(x * t, axis=-1)
+        # w1 = 2*sum(x*y) + eps, w2 = sum(x) + sum(y) + eps
+        # (DiceCoefficientCriterion.scala:69-81 — eps in BOTH terms)
         denom = jnp.sum(x, axis=-1) + jnp.sum(t, axis=-1) + self.epsilon
-        dice = 1.0 - 2.0 * inter / denom
+        dice = 1.0 - (2.0 * inter + self.epsilon) / denom
         return _reduce(dice, self.size_average)
 
 
@@ -479,7 +486,9 @@ class ParallelCriterion(Criterion):
         if self.repeat_target:
             targets = [target] * len(inputs)
         else:
-            targets = list(target) if isinstance(target, Table) else [target]
+            targets = (list(target)
+                       if isinstance(target, (Table, list, tuple))
+                       else [target])
         for c, w, i, t in zip(self.criterions, self.weights, inputs, targets):
             total = total + w * c.apply(i, t)
         return total

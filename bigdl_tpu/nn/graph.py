@@ -52,6 +52,9 @@ class Graph(Module):
         self.input_nodes = _as_list(input)
         self.output_nodes = _as_list(output)
         self.exec_order = self._topo_sort()
+        # control flow (Scheduler.scala:118-130): resolve each MergeOps
+        # input to its controlling Switch + branch at build time
+        self.merge_controls = self._resolve_merges()
         # stable unique names for the params pytree — deterministic across
         # processes (no id()-derived parts) so saved params reload cleanly
         self.node_names = {}
@@ -86,6 +89,60 @@ class Graph(Module):
         for out in self.output_nodes:
             visit(out, [])
         return order
+
+    def _resolve_merges(self):
+        """For each MergeOps node, map each input edge to its controlling
+        (SwitchOps node, branch index) via a backward walk — the build-time
+        equivalent of the reference Scheduler's runtime availability
+        tracking (nn/Scheduler.scala:118-130)."""
+        from bigdl_tpu.nn.control_ops import MergeOps, SwitchOps
+
+        def find_switch(node, edge, seen):
+            # returns (switch_node, branch) for the path ending at `node`
+            # via `edge`, or None when the path has no Switch. Branches are
+            # 1-based like the reference: 1=false output, 2=true output.
+            # `seen` caps the walk at O(nodes) (diamond ancestry would
+            # otherwise revisit shared nodes once per path).
+            if id(node) in seen:
+                return None
+            seen.add(id(node))
+            if isinstance(node.element, SwitchOps):
+                return (node, edge.from_index if edge.from_index is not None
+                        else 1)
+            for p, e in node.prevs:
+                found = find_switch(p, e, seen)
+                if found is not None:
+                    return found
+            return None
+
+        controls = {}
+        for n in self.exec_order:
+            if isinstance(n.element, MergeOps):
+                info = [find_switch(p, e, set()) for p, e in n.prevs]
+                if len(info) != 2 or any(i is None for i in info):
+                    raise ValueError(
+                        "MergeOps in a Graph needs exactly two inputs, "
+                        "each reachable from a SwitchOps branch")
+                def pred_node(sw):
+                    return sw.prevs[1][0] if len(sw.prevs) > 1 else None
+
+                if info[0][0] is not info[1][0] and (
+                        pred_node(info[0][0]) is None
+                        or pred_node(info[0][0]) is not
+                        pred_node(info[1][0])):
+                    # nearest-Switch-per-path is only sound when both
+                    # paths answer to the same PREDICATE; nested conds
+                    # would otherwise silently select on the wrong one
+                    raise ValueError(
+                        "MergeOps inputs resolve to two different "
+                        "predicates (nested conditionals): restructure "
+                        "with IfThenElse, which nests safely via lax.cond")
+                if {info[0][1], info[1][1]} != {1, 2}:
+                    raise ValueError(
+                        "MergeOps inputs must come from the two distinct "
+                        "branches (1=false, 2=true) of a Switch")
+                controls[id(n)] = info
+        return controls
 
     # -- functional core ---------------------------------------------------
     def init(self, rng):
@@ -124,7 +181,9 @@ class Graph(Module):
             inputs = [input]
         else:
             inputs = list(input) if isinstance(input, Table) else list(input)
+        from bigdl_tpu.nn.control_ops import MergeOps, SwitchOps
         values = {}
+        switch_preds = {}
         keys = (jax.random.split(rng, max(1, len(self.exec_order)))
                 if rng is not None else [None] * len(self.exec_order))
         new_state = {}
@@ -144,8 +203,19 @@ class Graph(Module):
                         v = v[e.from_index]
                     gathered.append(v)
                 node_in = gathered[0] if len(gathered) == 1 else T(*gathered)
-            out, s = n.element.apply(params[name], state[name], node_in,
-                                     training=training, rng=k)
+            if isinstance(n.element, SwitchOps):
+                switch_preds[id(n)] = list(node_in)[1]
+            if isinstance(n.element, MergeOps):
+                info = self.merge_controls[id(n)]
+                pred = switch_preds[id(info[0][0])]
+                branch_vals = list(node_in)
+                true_i = 0 if info[0][1] == 2 else 1
+                out = MergeOps.select(pred, branch_vals[true_i],
+                                      branch_vals[1 - true_i])
+                s = state[name]
+            else:
+                out, s = n.element.apply(params[name], state[name], node_in,
+                                         training=training, rng=k)
             values[id(n)] = out
             new_state[name] = s
         outs = [values[id(n)] for n in self.output_nodes]

@@ -101,7 +101,7 @@ class Bilinear(Module):
         return p
 
     def forward_fn(self, params, input, *, training=False, rng=None):
-        x1, x2 = input[1], input[2]
+        x1, x2 = list(input)[:2]  # Table (1-based) or plain list
         y = jnp.einsum("bi,kij,bj->bk", x1, params["weight"], x2)
         if self.bias_res:
             y = y + params["bias"]
@@ -213,7 +213,7 @@ class MM(Module):
         self.trans_b = trans_b
 
     def forward_fn(self, params, input, *, training=False, rng=None):
-        a, b = input[1], input[2]
+        a, b = list(input)[:2]  # Table (1-based) or plain list
         if self.trans_a:
             a = jnp.swapaxes(a, -1, -2)
         if self.trans_b:
@@ -229,7 +229,7 @@ class MV(Module):
         self.trans = trans
 
     def forward_fn(self, params, input, *, training=False, rng=None):
-        m, v = input[1], input[2]
+        m, v = list(input)[:2]  # Table (1-based) or plain list
         if self.trans:
             m = jnp.swapaxes(m, -1, -2)
         return jnp.einsum("...ij,...j->...i", m, v)
@@ -283,7 +283,7 @@ class DotProduct(Module):
     """Row-wise dot product of a 2-tensor table (nn/DotProduct.scala)."""
 
     def forward_fn(self, params, input, *, training=False, rng=None):
-        a, b = input[1], input[2]
+        a, b = list(input)[:2]  # Table (1-based) or plain list
         return jnp.sum(a * b, axis=-1)
 
 
@@ -295,7 +295,7 @@ class PairwiseDistance(Module):
         self.norm = norm
 
     def forward_fn(self, params, input, *, training=False, rng=None):
-        a, b = input[1], input[2]
+        a, b = list(input)[:2]  # Table (1-based) or plain list
         d = jnp.abs(a - b)
         return jnp.power(jnp.sum(jnp.power(d, self.norm), axis=-1),
                          1.0 / self.norm)
@@ -305,7 +305,7 @@ class CosineDistance(Module):
     """Row-wise cosine similarity of a table (nn/CosineDistance.scala)."""
 
     def forward_fn(self, params, input, *, training=False, rng=None):
-        a, b = input[1], input[2]
+        a, b = list(input)[:2]  # Table (1-based) or plain list
         na = jnp.clip(jnp.linalg.norm(a, axis=-1), 1e-12)
         nb = jnp.clip(jnp.linalg.norm(b, axis=-1), 1e-12)
         return jnp.sum(a * b, axis=-1) / (na * nb)

@@ -177,7 +177,7 @@ class RoiPooling(Module):
 
     def forward_fn(self, params, input, *, training=False, rng=None):
         import jax
-        data, rois = input[1], input[2]
+        data, rois = (jnp.asarray(v) for v in list(input)[:2])
         N, C, H, W = data.shape
 
         def pool_one(roi):

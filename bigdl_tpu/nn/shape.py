@@ -196,11 +196,14 @@ class Narrow(Module):
 
     def forward_fn(self, params, input, *, training=False, rng=None):
         axis = self.dimension - 1
+        offset = self.offset
+        if offset < 0:  # negative offset counts from the end (Narrow.scala)
+            offset = input.shape[axis] + offset + 1
         length = self.length
         if length < 0:
-            length = input.shape[axis] - self.offset + 1 + length + 1
-        return jax.lax.slice_in_dim(input, self.offset - 1,
-                                    self.offset - 1 + length, axis=axis)
+            length = input.shape[axis] - offset + 1 + length + 1
+        return jax.lax.slice_in_dim(input, offset - 1,
+                                    offset - 1 + length, axis=axis)
 
 
 class Select(Module):
@@ -237,7 +240,7 @@ class MaskedSelect(Module):
     eager path returns the compacted vector like the reference."""
 
     def forward_fn(self, params, input, *, training=False, rng=None):
-        x, mask = input[1], input[2]
+        x, mask = list(input)[:2]  # Table (1-based) or plain list
         import numpy as np
         if isinstance(x, jax.core.Tracer):
             raise NotImplementedError(
@@ -255,8 +258,9 @@ class Index(Module):
         self.dimension = dimension
 
     def forward_fn(self, params, input, *, training=False, rng=None):
-        x, idx = input[1], input[2]
-        return jnp.take(x, idx.astype(jnp.int32) - 1,
+        x, idx = list(input)[:2]  # Table (1-based) or plain list
+        return jnp.take(jnp.asarray(x),
+                        jnp.asarray(idx).astype(jnp.int32) - 1,
                         axis=self.dimension - 1)
 
 
@@ -366,8 +370,10 @@ class Pack(Module):
         self.dimension = dimension
 
     def forward_fn(self, params, input, *, training=False, rng=None):
-        entries = list(input) if isinstance(input, Table) else [input]
-        return jnp.stack(entries, axis=self.dimension - 1)
+        entries = (list(input) if isinstance(input, (Table, list, tuple))
+                   else [input])
+        return jnp.stack([jnp.asarray(e) for e in entries],
+                         axis=self.dimension - 1)
 
 
 class Reverse(Module):

@@ -10,7 +10,8 @@ from bigdl_tpu.optim.trigger import (
     max_score, min_loss)
 from bigdl_tpu.optim.validation import (
     ValidationMethod, ValidationResult, AccuracyResult, LossResult,
-    Top1Accuracy, Top5Accuracy, Loss, MAE)
+    Top1Accuracy, Top5Accuracy, TreeNNAccuracy, Loss, MAE)
+from bigdl_tpu.optim.lbfgs import LBFGS, strong_wolfe
 from bigdl_tpu.optim.optimizer import (
     Optimizer, LocalOptimizer, DistriOptimizer, Metrics, build_train_step,
     build_eval_step)

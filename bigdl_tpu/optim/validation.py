@@ -76,6 +76,39 @@ class Top1Accuracy(ValidationMethod):
         return AccuracyResult(correct, t.shape[0])
 
 
+class TreeNNAccuracy(ValidationMethod):
+    """optim/ValidationMethod.scala:118 — accuracy of a Tree/Recursive NN,
+    scored on the FIRST node's output (the tree root) only.
+
+    output: [batch, nodes, classes] (or [nodes, classes] for one sample);
+    target: [batch, nodes] (or [nodes]) — only column 1 is compared.
+    Binary outputs (classes == 1) threshold at 0.5; otherwise argmax,
+    1-based like the reference.
+    """
+
+    name = "TreeNNAccuracy"
+
+    def __call__(self, output, target):
+        out = np.asarray(output)
+        t = np.asarray(target)
+        if out.ndim == 3:
+            root = out[:, 0, :]       # _output.select(2, 1)
+            tgt = t[:, 0]             # _target.select(2, 1)
+            count = out.shape[0]
+        elif out.ndim == 2:
+            root = out[0, :][None]    # _output.select(1, 1)
+            tgt = t.reshape(-1)[:1]
+            count = 1
+        else:
+            raise ValueError("TreeNNAccuracy needs 2-d or 3-d output")
+        if root.shape[-1] == 1:
+            pred = (root[..., 0] >= 0.5).astype(np.int64)
+        else:
+            pred = root.argmax(axis=-1) + 1  # 1-based
+        correct = int((pred == tgt.astype(np.int64)).sum())
+        return AccuracyResult(correct, count)
+
+
 class Top5Accuracy(ValidationMethod):
     """optim/ValidationMethod.scala:218"""
 

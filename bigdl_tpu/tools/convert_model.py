@@ -44,6 +44,20 @@ def convert(src: str, dst: str, input_path: str, output_path: str) -> str:
         names = save_tf_graph(output_path, model)
         return f"saved {output_path} (input={names['input']}, " \
                f"output={names['output']})"
+    elif dst == "caffe":
+        from bigdl_tpu.utils.caffe_persister import save_caffe
+        parts = output_path.split(",")
+        if len(parts) == 1:  # prefix form: out -> out.prototxt+.caffemodel
+            def_path = parts[0] + ".prototxt"
+            model_path = parts[0] + ".caffemodel"
+        else:
+            def_path = next((p for p in parts if p.endswith(".prototxt")),
+                            parts[0] + ".prototxt")
+            model_path = next((p for p in parts
+                               if not p.endswith(".prototxt")),
+                              parts[0] + ".caffemodel")
+        save_caffe(model, def_path, model_path)
+        return f"saved {def_path} + {model_path}"
     else:
         raise ValueError(f"unsupported target format {dst}")
     return f"saved {output_path}"
@@ -54,7 +68,7 @@ def main(argv=None):
     ap.add_argument("--from", dest="src", required=True,
                     choices=["bigdl", "caffe", "torch", "tf", "tensorflow"])
     ap.add_argument("--to", dest="dst", default="bigdl",
-                    choices=["bigdl", "tf", "tensorflow"])
+                    choices=["bigdl", "tf", "tensorflow", "caffe"])
     ap.add_argument("--input", required=True,
                     help="source path ('def.prototxt,weights.caffemodel' "
                          "for caffe)")

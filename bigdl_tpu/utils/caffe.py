@@ -578,6 +578,22 @@ class CaffeLoader:
             op = ep.get("operation", 1)
             if isinstance(op, str):
                 op = {"PROD": 0, "SUM": 1, "MAX": 2}.get(op, 1)
+            coeff = ep.get("coeff", [])
+            if not isinstance(coeff, (list, tuple)):
+                coeff = [coeff]
+            coeff = [float(c) for c in coeff]
+            if coeff and any(c != 1.0 for c in coeff):
+                if int(op) != 1:
+                    raise ValueError(
+                        "Eltwise coeff is only defined for SUM "
+                        "(caffe.proto EltwiseParameter)")
+                # SUM with coefficients: scale each input, then add
+                # (CaffeLoader Converter Eltwise; coeff otherwise silently
+                # changes the math).
+                scaled = nn.ParallelTable()
+                for c in coeff:
+                    scaled.add(nn.MulConstant(c))
+                return nn.Sequential().add(scaled).add(nn.CAddTable())
             return {0: nn.CMulTable(), 1: nn.CAddTable(),
                     2: nn.CMaxTable()}[int(op)]
         if t == "Flatten":
@@ -612,7 +628,11 @@ class CaffeLoader:
                 if blobs:
                     set_wb(cm, blobs[0].reshape(1, n, 1, 1))
                     if len(blobs) > 1:
-                        set_wb(ca, blobs[1].reshape(1, n, 1, 1))
+                        # CAdd's parameter is named "bias" (nn/CAdd.scala)
+                        ca.ensure_initialized()
+                        ca.set_parameters(
+                            {"bias": blobs[1].reshape(1, n, 1, 1)
+                             .astype(np.float32)})
                 return seq.add(cm).add(ca)
             if blobs:
                 set_wb(m, blobs[0].reshape(1, n, 1, 1))

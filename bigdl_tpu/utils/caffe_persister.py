@@ -1,0 +1,287 @@
+"""Caffe model export (reference: utils/caffe/CaffePersister.scala:47 —
+writes .prototxt topology + .caffemodel binary weights so a bigdl model
+can round-trip into Caffe tooling).
+
+Inverse of utils/caffe.py: the prototxt carries the full topology+params
+(the importer gives it priority), the caffemodel carries V2 ``layer``
+messages with name/type/bottom/top + weight blobs encoded through the
+in-repo protobuf wire codec (utils/proto.py) with public caffe.proto
+field numbers. Round-trip contract: ``load_caffe(prototxt, caffemodel)``
+rebuilds a Graph computing the same function.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from bigdl_tpu.utils import proto
+
+
+# ------------------------------------------------------------ blob encode
+
+def encode_blob(arr: np.ndarray) -> bytes:
+    """BlobProto: shape=7 (BlobShape packed dims field 1), data=5 packed
+    float32 — the layout parse_blob reads back."""
+    arr = np.asarray(arr, np.float32)
+    dims = b"".join(proto.encode_varint(int(d)) for d in arr.shape)
+    shape_msg = proto.encode_message(1, dims)
+    payload = proto.encode_message(7, shape_msg)
+    payload += proto.encode_message(5, arr.reshape(-1).tobytes())
+    return payload
+
+
+# -------------------------------------------------------- prototxt encode
+
+def _fmt_value(v) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, float):
+        s = repr(v)
+        return s
+    if isinstance(v, str):
+        return v if v.isupper() else f'"{v}"'
+    return str(v)
+
+
+def _emit(lines: List[str], indent: int, key: str, value):
+    pad = "  " * indent
+    if isinstance(value, dict):
+        lines.append(f"{pad}{key} {{")
+        for k, v in value.items():
+            if isinstance(v, list):
+                for el in v:
+                    _emit(lines, indent + 1, k, el)
+            else:
+                _emit(lines, indent + 1, k, v)
+        lines.append(f"{pad}}}")
+    else:
+        lines.append(f"{pad}{key}: {_fmt_value(value)}")
+
+
+class _Spec:
+    """One exported Caffe layer: prototxt message + weight blobs."""
+
+    def __init__(self, name: str, type_: str, bottoms: Sequence[str],
+                 top: str, params: Optional[Dict] = None,
+                 blobs: Sequence[np.ndarray] = ()):
+        self.name, self.type = name, type_
+        self.bottoms, self.top = list(bottoms), top
+        self.params = params or {}
+        self.blobs = list(blobs)
+
+    def prototxt(self) -> str:
+        msg: Dict = {"name": self.name, "type": self.type}
+        lines: List[str] = ["layer {"]
+        _emit(lines, 1, "name", self.name)
+        _emit(lines, 1, "type", self.type)
+        for b in self.bottoms:
+            _emit(lines, 1, "bottom", b)
+        _emit(lines, 1, "top", self.top)
+        for k, v in self.params.items():
+            _emit(lines, 1, k, v)
+        lines.append("}")
+        return "\n".join(lines)
+
+    def binary(self) -> bytes:
+        out = proto.encode_message(1, self.name.encode())
+        out += proto.encode_message(2, self.type.encode())
+        for b in self.bottoms:
+            out += proto.encode_message(3, b.encode())
+        out += proto.encode_message(4, self.top.encode())
+        for blob in self.blobs:
+            out += proto.encode_message(7, encode_blob(blob))
+        return out
+
+
+# -------------------------------------------------------- module -> layer
+
+def _convert_module(module, name: str, bottoms: List[str],
+                    params: Dict, state: Dict) -> List[_Spec]:
+    """bigdl_tpu module -> one (or two, for BN+Scale) Caffe layers.
+    Mirrors the importer's CaffeLoader._convert table in reverse.
+
+    ``params``/``state`` are the CONTAINER's subtrees for this module —
+    asking the child for its own get_parameters() would lazily
+    self-initialize it with fresh weights, silently exporting different
+    numbers than the container computes with.
+    """
+    import bigdl_tpu.nn as nn
+
+    t = type(module).__name__
+    p = {k: np.asarray(v) for k, v in (params or {}).items()
+         if not isinstance(v, dict)}
+
+    if isinstance(module, nn.SpatialFullConvolution):
+        w = p["weight"]  # stored (in, out/g, kh, kw)
+        group = module.n_group
+        cp = {"num_output": module.n_output_plane,
+              "kernel_h": module.kh, "kernel_w": module.kw,
+              "stride_h": module.dh, "stride_w": module.dw,
+              "pad_h": module.pad_h, "pad_w": module.pad_w,
+              "group": group, "bias_term": "bias" in p}
+        blobs = [w] + ([p["bias"]] if "bias" in p else [])
+        return [_Spec(name, "Deconvolution", bottoms, name,
+                      {"convolution_param": cp}, blobs)]
+    if isinstance(module, nn.SpatialConvolution):
+        w = p["weight"]  # (out, in/g, kh, kw)
+        cp = {"num_output": module.n_output_plane,
+              "kernel_h": module.kernel_h, "kernel_w": module.kernel_w,
+              "stride_h": module.stride_h, "stride_w": module.stride_w,
+              "pad_h": module.pad_h, "pad_w": module.pad_w,
+              "group": module.n_group, "bias_term": "bias" in p}
+        blobs = [w] + ([p["bias"]] if "bias" in p else [])
+        return [_Spec(name, "Convolution", bottoms, name,
+                      {"convolution_param": cp}, blobs)]
+    if isinstance(module, nn.Linear):
+        w = p["weight"]  # (out, in)
+        ip = {"num_output": w.shape[0], "bias_term": "bias" in p}
+        blobs = [w] + ([p["bias"]] if "bias" in p else [])
+        return [_Spec(name, "InnerProduct", bottoms, name,
+                      {"inner_product_param": ip}, blobs)]
+    if isinstance(module, (nn.SpatialMaxPooling, nn.SpatialAveragePooling)):
+        pool = "MAX" if isinstance(module, nn.SpatialMaxPooling) else "AVE"
+        pp = {"pool": pool, "kernel_h": module.kh, "kernel_w": module.kw,
+              "stride_h": module.dh, "stride_w": module.dw,
+              "pad_h": module.pad_h, "pad_w": module.pad_w}
+        return [_Spec(name, "Pooling", bottoms, name, {"pooling_param": pp})]
+    if isinstance(module, nn.SpatialCrossMapLRN):
+        lp = {"local_size": module.size, "alpha": module.alpha,
+              "beta": module.beta, "k": module.k,
+              "norm_region": "ACROSS_CHANNELS"}
+        return [_Spec(name, "LRN", bottoms, name, {"lrn_param": lp})]
+    if isinstance(module, nn.SpatialWithinChannelLRN):
+        lp = {"local_size": module.size, "alpha": module.alpha,
+              "beta": module.beta, "norm_region": "WITHIN_CHANNEL"}
+        return [_Spec(name, "LRN", bottoms, name, {"lrn_param": lp})]
+    if isinstance(module, (nn.SpatialBatchNormalization,
+                           nn.BatchNormalization)):
+        st = state or {}
+        mean = np.asarray(st["running_mean"], np.float32)
+        var = np.asarray(st["running_var"], np.float32)
+        specs = [_Spec(name, "BatchNorm", bottoms, name,
+                       {"batch_norm_param": {"use_global_stats": True,
+                                             "eps": module.eps}},
+                       [mean, var, np.ones((1,), np.float32)])]
+        if module.affine:
+            specs.append(_Spec(f"{name}_scale", "Scale", [name], name,
+                               {"scale_param": {"bias_term": True}},
+                               [p["weight"], p["bias"]]))
+        return specs
+    if isinstance(module, nn.Power):
+        return [_Spec(name, "Power", bottoms, name,
+                      {"power_param": {"power": module.power,
+                                       "scale": module.scale,
+                                       "shift": module.shift}})]
+    if isinstance(module, nn.Dropout):
+        return [_Spec(name, "Dropout", bottoms, name,
+                      {"dropout_param": {"dropout_ratio": module.p}})]
+    if isinstance(module, nn.JoinTable):
+        return [_Spec(name, "Concat", bottoms, name,
+                      {"concat_param": {"axis": module.dimension - 1}})]
+    if isinstance(module, nn.CAddTable):
+        return [_Spec(name, "Eltwise", bottoms, name,
+                      {"eltwise_param": {"operation": "SUM"}})]
+    if isinstance(module, nn.CMulTable):
+        return [_Spec(name, "Eltwise", bottoms, name,
+                      {"eltwise_param": {"operation": "PROD"}})]
+    if isinstance(module, nn.CMaxTable):
+        return [_Spec(name, "Eltwise", bottoms, name,
+                      {"eltwise_param": {"operation": "MAX"}})]
+    if isinstance(module, nn.InferReshape):
+        if tuple(module.size) == (0, -1):
+            return [_Spec(name, "Flatten", bottoms, name)]
+        return [_Spec(name, "Reshape", bottoms, name,
+                      {"reshape_param":
+                       {"shape": {"dim": list(module.size)}}})]
+    simple = {"ReLU": "ReLU", "Sigmoid": "Sigmoid", "Tanh": "TanH",
+              "SoftMax": "Softmax", "Abs": "AbsVal"}
+    if t in simple:
+        return [_Spec(name, simple[t], bottoms, name)]
+    raise ValueError(
+        f"cannot export {t} to Caffe (CaffePersister supports the layer "
+        "types CaffeLoader can read back)")
+
+
+# ---------------------------------------------------------------- persist
+
+class CaffePersister:
+    """Export a Graph/Sequential to prototxt + caffemodel
+    (CaffePersister.scala:47 saveToCaffe)."""
+
+    def __init__(self, model, *, input_shapes: Optional[List] = None,
+                 net_name: str = "bigdl_tpu"):
+        self.model = model
+        self.input_shapes = input_shapes
+        self.net_name = net_name
+
+    def _specs(self) -> Tuple[List[_Spec], List[str]]:
+        import bigdl_tpu.nn as nn
+
+        specs: List[_Spec] = []
+        input_names: List[str] = []
+        self.model.ensure_initialized()
+        tree = dict(self.model.get_parameters())
+        stree = dict(self.model.get_state())
+
+        if isinstance(self.model, nn.Graph):
+            g = self.model
+            blob_of: Dict[int, str] = {}
+            for i, n in enumerate(g.input_nodes):
+                blob = "data" if len(g.input_nodes) == 1 else f"data{i}"
+                blob_of[id(n)] = blob
+                input_names.append(blob)
+            for n in g.exec_order:
+                if id(n) in blob_of:
+                    continue
+                name = g.node_names[id(n)]
+                bottoms = [blob_of[id(p)] for p, _ in n.prevs]
+                out = _convert_module(n.element, name, bottoms,
+                                      tree.get(name, {}),
+                                      stree.get(name, {}))
+                specs.extend(out)
+                blob_of[id(n)] = out[-1].top
+        elif isinstance(self.model, nn.Sequential):
+            input_names.append("data")
+            prev = "data"
+            for i, m in enumerate(self.model.modules):
+                name = m.get_name() or f"{type(m).__name__.lower()}{i}"
+                out = _convert_module(m, name, [prev],
+                                      tree.get(str(i), {}),
+                                      stree.get(str(i), {}))
+                specs.extend(out)
+                prev = out[-1].top
+        else:
+            raise ValueError("CaffePersister exports Graph or Sequential")
+        return specs, input_names
+
+    def save(self, def_path: str, model_path: str):
+        specs, input_names = self._specs()
+        # prototxt: Input layers first, then the net
+        lines = [f'name: "{self.net_name}"']
+        for i, blob in enumerate(input_names):
+            shape = None
+            if self.input_shapes is not None:
+                shape = list(self.input_shapes[i])
+            msg: Dict = {}
+            if shape is not None:
+                msg["input_param"] = {"shape": {"dim": shape}}
+            spec = _Spec(blob, "Input", [], blob, msg)
+            lines.append(spec.prototxt())
+        lines += [s.prototxt() for s in specs]
+        with open(def_path, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        # caffemodel: NetParameter {1: name, 100: layer...}
+        blob_bin = proto.encode_message(1, self.net_name.encode())
+        for s in specs:
+            blob_bin += proto.encode_message(100, s.binary())
+        with open(model_path, "wb") as f:
+            f.write(blob_bin)
+
+
+def save_caffe(model, def_path: str, model_path: str, *,
+               input_shapes: Optional[List] = None):
+    """Module.saveCaffe equivalent (AbstractModule.saveCaffe)."""
+    CaffePersister(model, input_shapes=input_shapes).save(def_path,
+                                                          model_path)

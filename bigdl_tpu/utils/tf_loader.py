@@ -203,6 +203,7 @@ def _matmul(node, xs):
 
 _OPS: Dict[str, Callable] = {
     "Identity": lambda n, xs: xs[0],
+    "ReadVariableOp": lambda n, xs: xs[0],
     "StopGradient": lambda n, xs: jax.lax.stop_gradient(xs[0]),
     "MatMul": _matmul,
     "BatchMatMulV2": lambda n, xs: jnp.matmul(xs[0], xs[1]),
@@ -311,19 +312,95 @@ class TFModule(Module):
         self.by_name = {n.name: n for n in self.nodes}
         self.input_names = list(inputs) if inputs else [
             n.name for n in self.nodes if n.op == "Placeholder"]
+        self.consts = {n.name: _ensure_array(n.attrs.get("value"))
+                       for n in self.nodes if n.op == "Const"}
+        # Variables (unfrozen v1 graphs): VariableV2 nodes become trainable
+        # parameters; their Assign initializers give the initial values
+        # (the role TFTrainingHelper's weight extraction plays in the
+        # reference utils/tf/Session.scala:104).
+        self.variable_init: Dict[str, np.ndarray] = {}
+        assign_of = {}
+        for n in self.nodes:
+            # ref variables use Assign; resource variables (TF2 compat.v1)
+            # use AssignVariableOp
+            if n.op in ("Assign", "AssignVariableOp") and \
+                    len(n.inputs) >= 2:
+                assign_of[n.inputs[0].split(":")[0]] = \
+                    n.inputs[1].split(":")[0]
+        for n in self.nodes:
+            if n.op in ("VariableV2", "Variable", "VarHandleOp"):
+                init_name = assign_of.get(n.name)
+                if init_name is None:
+                    shape = n.attrs.get("shape")
+                    self.variable_init[n.name] = np.zeros(
+                        tuple(shape) if shape else (), np.float32)
+                else:
+                    self.variable_init[n.name] = np.asarray(
+                        self._eval_initializer(init_name), np.float32)
         if outputs:
             self.output_names = list(outputs)
         else:
             consumed = {inp.split(":")[0].lstrip("^")
                         for n in self.nodes for inp in n.inputs}
             # orphan Consts/Placeholders (pruning leftovers) are not
-            # outputs
+            # outputs; neither is variable-initialization machinery
             self.output_names = [n.name for n in self.nodes
                                  if n.name not in consumed
                                  and n.op not in ("NoOp", "Const",
-                                                  "Placeholder")]
-        self.consts = {n.name: _ensure_array(n.attrs.get("value"))
-                       for n in self.nodes if n.op == "Const"}
+                                                  "Placeholder", "Assign",
+                                                  "AssignVariableOp",
+                                                  "VarIsInitializedOp",
+                                                  "VariableV2", "Variable",
+                                                  "VarHandleOp")]
+
+    def _eval_initializer(self, name: str) -> np.ndarray:
+        """Evaluate a variable-initializer subgraph on host numpy —
+        Const chains plus the standard random-init ops (the reference's
+        Session evaluates these through the graph too). Raises on
+        anything else rather than silently zero-initializing."""
+        # seed per-initializer: same-shape variables must NOT share a
+        # stream (identical inits would train symmetrically)
+        rng = np.random.RandomState(
+            int.from_bytes(name.encode()[-4:].rjust(4, b"\0"), "big"))
+
+        def ev(nm: str) -> np.ndarray:
+            nm = nm.split(":")[0].lstrip("^")
+            if nm in self.consts:
+                return self.consts[nm]
+            node = self.by_name[nm]
+            if node.op in ("Identity", "ReadVariableOp"):
+                return ev(node.inputs[0])
+            if node.op in ("TruncatedNormal", "RandomStandardNormal"):
+                shape = tuple(int(v) for v in
+                              np.asarray(ev(node.inputs[0])).ravel())
+                vals = rng.standard_normal(shape)
+                if node.op == "TruncatedNormal":
+                    vals = np.clip(vals, -2.0, 2.0)
+                return vals.astype(np.float32)
+            if node.op == "RandomUniform":
+                shape = tuple(int(v) for v in
+                              np.asarray(ev(node.inputs[0])).ravel())
+                return rng.uniform(size=shape).astype(np.float32)
+            if node.op in ("Add", "AddV2"):
+                return ev(node.inputs[0]) + ev(node.inputs[1])
+            if node.op == "Sub":
+                return ev(node.inputs[0]) - ev(node.inputs[1])
+            if node.op == "Mul":
+                return ev(node.inputs[0]) * ev(node.inputs[1])
+            if node.op == "Fill":
+                shape = tuple(int(v) for v in
+                              np.asarray(ev(node.inputs[0])).ravel())
+                return np.full(shape, np.asarray(ev(node.inputs[1])))
+            raise ValueError(
+                f"cannot evaluate variable initializer op {node.op} "
+                f"(node {nm}); freeze the graph or initialize with "
+                "constants")
+
+        return ev(name)
+
+    def init(self, rng):
+        import jax.numpy as _jnp
+        return {k: _jnp.asarray(v) for k, v in self.variable_init.items()}
 
     def forward_fn(self, params, input, *, training=False, rng=None):
         from bigdl_tpu.utils.table import Table, T
@@ -334,27 +411,123 @@ class TFModule(Module):
             feed = {self.input_names[0]: input}
         values: Dict[str, Any] = {}
 
-        def evaluate(ref: str):
+        def resolve(ref: str):
             name = ref.split(":")[0].lstrip("^")
             out_idx = int(ref.split(":")[1]) if ":" in ref else 0
-            if name in values:
-                v = values[name]
-            elif name in feed:
-                v = values[name] = jnp.asarray(feed[name])
-            elif name in self.consts:
-                v = values[name] = jnp.asarray(self.consts[name])
-            else:
+            v = values[name]
+            return v[out_idx] if isinstance(v, tuple) else v
+
+        def controlling_switch(ref: str):
+            """Walk a Merge input back to its Switch: returns (switch_node,
+            branch out_idx) — the trace-time equivalent of the reference
+            Scheduler's control-flow availability (Scheduler.scala:118).
+            DFS over ALL data inputs: the Switch ancestry may sit on any
+            operand (e.g. Add(const, switch_out))."""
+            seen = set()
+            work = [ref]
+            while work:
+                r = work.pop()
+                name = r.split(":")[0].lstrip("^")
+                if name in seen:
+                    continue
+                seen.add(name)
+                node = self.by_name.get(name)
+                if node is None:
+                    continue
+                if node.op == "Switch":
+                    out_idx = int(r.split(":")[1]) if ":" in r else 0
+                    return node, out_idx
+                work.extend(i for i in node.inputs
+                            if not i.startswith("^"))
+            return None
+
+        def evaluate(ref: str):
+            # Explicit work stack — deep sequential graphs (large
+            # ResNet/Inception exports) overflow Python recursion limits.
+            in_progress: Dict[str, bool] = {}
+            stack = [ref.split(":")[0].lstrip("^")]
+            while stack:
+                name = stack[-1]
+                if name in values:
+                    stack.pop()
+                    continue
+                if name in feed:
+                    values[name] = jnp.asarray(feed[name])
+                    stack.pop()
+                    continue
+                if name in self.variable_init:
+                    values[name] = jnp.asarray(
+                        params[name] if params and name in params
+                        else self.variable_init[name])
+                    stack.pop()
+                    continue
+                if name in self.consts:
+                    # keep consts as NUMPY: under jit, jnp.asarray would
+                    # make them tracers, breaking ops that need concrete
+                    # shape/axis operands (Reshape, Mean, Transpose, ...)
+                    values[name] = self.consts[name]
+                    stack.pop()
+                    continue
                 node = self.by_name[name]
-                xs = [evaluate(i) for i in node.inputs
+                deps = [i.split(":")[0].lstrip("^") for i in node.inputs
+                        if not i.startswith("^")]
+                pending = [d for d in deps if d not in values]
+                if pending:
+                    # revisiting an in-progress node with deps still
+                    # unresolved = a data cycle (v1 tf.while_loop's
+                    # NextIteration); fail loudly instead of spinning
+                    if in_progress.get(name):
+                        raise ValueError(
+                            f"graph cycle through node {name} "
+                            "(v1 while_loop is not supported)")
+                    in_progress[name] = True
+                    stack.extend(pending)
+                    continue
+                xs = [resolve(i) for i in node.inputs
                       if not i.startswith("^")]
-                fn = _OPS.get(node.op)
-                if fn is None:
-                    raise ValueError(
-                        f"unsupported TF op {node.op} (node {name})")
-                v = values[name] = fn(node, xs)
-            if isinstance(v, tuple):
-                return v[out_idx]
-            return v
+                if node.op == "Switch":
+                    # outputs: (output_false, output_true); selection is
+                    # deferred to the matching Merge (ControlOps.scala:69)
+                    values[name] = (xs[0], xs[0])
+                elif node.op == "Merge":
+                    from bigdl_tpu.nn.control_ops import MergeOps
+                    data_refs = [i for i in node.inputs
+                                 if not i.startswith("^")]
+                    def pred_ref(sw):
+                        r = [i for i in sw.inputs
+                             if not i.startswith("^")][1]
+                        name = r.split(":")[0]
+                        idx = int(r.split(":")[1]) if ":" in r else 0
+                        return (name, idx)
+
+                    # TF v1 cond makes one Switch per external tensor per
+                    # branch; what must match is the PREDICATE, not the
+                    # Switch node (nested conds have different predicates)
+                    ctl = [controlling_switch(r) for r in data_refs]
+                    if len(xs) == 2 and all(c is not None for c in ctl) \
+                            and pred_ref(ctl[0][0]) == pred_ref(ctl[1][0]) \
+                            and {ctl[0][1], ctl[1][1]} == {0, 1}:
+                        sw = ctl[0][0]
+                        pred = resolve([i for i in sw.inputs
+                                        if not i.startswith("^")][1])
+                        ti = 0 if ctl[0][1] == 1 else 1
+                        out = MergeOps.select(pred, xs[ti], xs[1 - ti])
+                        idx = jnp.where(jnp.asarray(pred).astype(bool),
+                                        ti, 1 - ti)
+                        values[name] = (out, idx)  # (output, value_index)
+                    else:
+                        raise ValueError(
+                            f"Merge node {name}: could not resolve a "
+                            "single two-branch Switch (nested v1 conds "
+                            "are not supported)")
+                else:
+                    fn = _OPS.get(node.op)
+                    if fn is None:
+                        raise ValueError(
+                            f"unsupported TF op {node.op} (node {name})")
+                    values[name] = fn(node, xs)
+                stack.pop()
+            return resolve(ref)
 
         outs = [evaluate(o) for o in self.output_names]
         return outs[0] if len(outs) == 1 else T(*outs)
@@ -385,3 +558,79 @@ def load_tf_graph(path: str, inputs: Optional[Sequence[str]] = None,
     m._init_args = (data, inputs, outputs)
     m._init_kwargs = {}
     return m
+
+
+class Session:
+    """Train an imported (unfrozen) TF graph — the reference's
+    BigDLSessionImpl.train (utils/tf/Session.scala:53,104-110): Variables
+    become trainable parameters, the graph's own loss node is minimized,
+    Placeholders are fed from MiniBatches.
+
+    ``inputs`` are the feature/label placeholder names in MiniBatch order
+    (features first, then targets); ``loss`` is the scalar loss node.
+    """
+
+    def __init__(self, nodes_or_bytes, inputs: Sequence[str], loss: str):
+        self.module = TFModule(nodes_or_bytes, inputs=inputs,
+                               outputs=[loss])
+        if not self.module.variable_init:
+            raise ValueError(
+                "graph has no Variables to train (frozen graph?)")
+        self.loss_name = loss
+
+    def train(self, batches, optim_method, *, end_trigger=None,
+              max_iterations: Optional[int] = None,
+              epoch_size: Optional[int] = None):
+        """batches: iterable of MiniBatch (or (x, y) tuples). Returns the
+        trained TFModule (params updated in place).
+
+        ``epoch_size`` (iterations per epoch) makes epoch-based triggers
+        (max_epoch/every_epoch) meaningful on infinite batch iterables —
+        without it only iteration-count triggers can fire.
+        """
+        import jax as _jax
+
+        from bigdl_tpu.dataset.sample import MiniBatch
+        from bigdl_tpu.optim.trigger import max_iteration as _max_iter
+
+        module = self.module
+        module.ensure_initialized()
+        params = module.get_parameters()
+        opt_state = optim_method.init_state(params)
+        if end_trigger is None:
+            end_trigger = _max_iter(max_iterations or 100)
+
+        @_jax.jit
+        def step(p, o, lr, xs):
+            def loss_fn(pp):
+                out, _ = module.apply(pp, {}, xs, training=True)
+                return jnp.asarray(out).reshape(())
+
+            loss, grads = _jax.value_and_grad(loss_fn)(p)
+            p2, o2 = optim_method.update(grads, o, p, lr)
+            return p2, o2, loss
+
+        state = {"epoch": 1, "neval": 1}
+        loss_val = None
+        for b in batches:
+            if end_trigger(state):  # endWhen fires -> stop
+                break
+            if isinstance(b, MiniBatch):
+                xs = ([b.input] if not isinstance(b.input, (list, tuple))
+                      else list(b.input))
+                if b.target is not None:
+                    xs += ([b.target]
+                           if not isinstance(b.target, (list, tuple))
+                           else list(b.target))
+            else:
+                xs = list(b)
+            lr = optim_method.update_hyper_parameter()
+            params, opt_state, loss_val = step(params, opt_state, lr, xs)
+            state["neval"] += 1
+            optim_method.state["neval"] = state["neval"]
+            if epoch_size and (state["neval"] - 1) % epoch_size == 0:
+                state["epoch"] += 1
+                optim_method.state["epoch"] = state["epoch"]
+        module.set_parameters(params)
+        self.last_loss = float(loss_val) if loss_val is not None else None
+        return module

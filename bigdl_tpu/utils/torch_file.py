@@ -182,10 +182,10 @@ class _Writer:
     def write_object(self, obj):
         if obj is None:
             self.write_int(TYPE_NIL)
-        elif isinstance(obj, bool):
+        elif isinstance(obj, (bool, np.bool_)):
             self.write_int(TYPE_BOOLEAN)
             self.write_int(1 if obj else 0)
-        elif isinstance(obj, (int, float)):
+        elif isinstance(obj, (int, float, np.integer, np.floating)):
             self.write_int(TYPE_NUMBER)
             self.write_double(float(obj))
         elif isinstance(obj, str):

@@ -1,0 +1,115 @@
+"""Caffe export round-trip (reference: utils/caffe/CaffePersister.scala:47):
+export through CaffePersister, re-import through the own CaffeLoader, and
+check the rebuilt Graph computes the same function."""
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.utils.caffe import load_caffe
+from bigdl_tpu.utils.caffe_persister import save_caffe
+
+
+def _roundtrip(model, x, tmp_path, input_shapes=None, train=False):
+    model.ensure_initialized()
+    want, _ = model.apply(model.get_parameters(), model.get_state(), x,
+                          training=False)
+    dp, mp = str(tmp_path / "net.prototxt"), str(tmp_path / "net.caffemodel")
+    save_caffe(model, dp, mp, input_shapes=input_shapes or [list(x.shape)])
+    back = load_caffe(def_path=dp, model_path=mp).evaluate()
+    back.ensure_initialized()
+    got, _ = back.apply(back.get_parameters(), back.get_state(), x,
+                        training=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-4, rtol=1e-4)
+    return back
+
+
+def test_conv_pool_relu_fc_roundtrip(tmp_path):
+    m = (nn.Sequential()
+         .add(nn.SpatialConvolution(3, 6, 3, 3, 1, 1, 1, 1).set_name("c1"))
+         .add(nn.ReLU().set_name("r1"))
+         .add(nn.SpatialMaxPooling(2, 2, 2, 2).set_name("p1"))
+         .add(nn.InferReshape((0, -1)).set_name("fl"))
+         .add(nn.Linear(6 * 4 * 4, 5).set_name("fc")))
+    x = np.random.RandomState(0).randn(2, 3, 8, 8).astype(np.float32)
+    _roundtrip(m, x, tmp_path)
+
+
+def test_lrn_power_abs_softmax_roundtrip(tmp_path):
+    m = (nn.Sequential()
+         .add(nn.SpatialCrossMapLRN(5, 1e-4, 0.75, 1.0).set_name("lrn"))
+         .add(nn.Abs().set_name("abs"))
+         .add(nn.Power(2.0, 1.5, 0.5).set_name("pw")))
+    x = np.random.RandomState(1).randn(2, 4, 6, 6).astype(np.float32)
+    _roundtrip(m, x, tmp_path)
+
+
+def test_batchnorm_scale_roundtrip(tmp_path):
+    m = nn.Sequential().add(
+        nn.SpatialBatchNormalization(4).set_name("bn"))
+    m.ensure_initialized()
+    # give running stats + affine params non-trivial values — through the
+    # CONTAINER tree (child-level set wouldn't reach the adopted params)
+    st = dict(m.get_state())
+    st["0"] = dict(st["0"])
+    st["0"]["running_mean"] = np.asarray([0.5, -0.5, 1.0, 0.0], np.float32)
+    st["0"]["running_var"] = np.asarray([1.5, 0.5, 2.0, 1.0], np.float32)
+    m.set_state(st)
+    pp = dict(m.get_parameters())
+    pp["0"] = dict(pp["0"])
+    pp["0"]["weight"] = np.asarray([1.1, 0.9, 1.2, 0.8], np.float32)
+    pp["0"]["bias"] = np.asarray([0.1, -0.1, 0.2, 0.0], np.float32)
+    m.set_parameters(pp)
+    m.evaluate()
+    x = np.random.RandomState(2).randn(3, 4, 5, 5).astype(np.float32)
+    _roundtrip(m, x, tmp_path)
+
+
+def test_graph_with_concat_and_eltwise_roundtrip(tmp_path):
+    inp = nn.Input()()
+    c1 = nn.SpatialConvolution(3, 4, 1, 1).set_name("b1")(inp)
+    c2 = nn.SpatialConvolution(3, 4, 1, 1).set_name("b2")(inp)
+    add = nn.CAddTable().set_name("sum")(c1, c2)
+    g = nn.Graph(inp, add)
+    x = np.random.RandomState(3).randn(2, 3, 5, 5).astype(np.float32)
+    _roundtrip(g, x, tmp_path)
+
+
+def test_deconv_roundtrip(tmp_path):
+    m = nn.Sequential().add(
+        nn.SpatialFullConvolution(3, 5, 3, 3, 2, 2, 1, 1).set_name("dc"))
+    x = np.random.RandomState(4).randn(2, 3, 6, 6).astype(np.float32)
+    _roundtrip(m, x, tmp_path)
+
+
+def test_convert_model_cli_bidirectional(tmp_path):
+    """ConvertModel is now bidirectional for Caffe
+    (utils/ConvertModel.scala:24)."""
+    from bigdl_tpu.tools.convert_model import convert
+    from bigdl_tpu.utils.serialization import save_module
+
+    m = (nn.Sequential()
+         .add(nn.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1).set_name("c"))
+         .add(nn.ReLU().set_name("r")))
+    m.ensure_initialized()
+    saved = str(tmp_path / "saved.bigdl")
+    save_module(saved, m)
+    out = convert("bigdl", "caffe", saved,
+                  str(tmp_path / "net.prototxt") + ","
+                  + str(tmp_path / "net.caffemodel"))
+    assert "net.prototxt" in out
+    back = load_caffe(def_path=str(tmp_path / "net.prototxt"),
+                      model_path=str(tmp_path / "net.caffemodel"))
+    x = np.random.RandomState(5).randn(1, 3, 6, 6).astype(np.float32)
+    want, _ = m.apply(m.get_parameters(), m.get_state(), x, training=False)
+    back.ensure_initialized()
+    got, _ = back.apply(back.get_parameters(), back.get_state(), x,
+                        training=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-4)
+
+
+def test_unsupported_layer_raises(tmp_path):
+    m = nn.Sequential().add(nn.GradientReversal())
+    with pytest.raises(ValueError, match="cannot export"):
+        save_caffe(m, str(tmp_path / "a.prototxt"),
+                   str(tmp_path / "a.caffemodel"))

@@ -1,0 +1,135 @@
+"""Graph control flow: Switch/Merge + IfThenElse (reference:
+nn/ops/ControlOps.scala:69,91; nn/Scheduler.scala:118-130), including a
+TF-imported v1 control-flow graph (utils/tf/ loaders Merge/Switch)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+
+
+def _cond_graph():
+    """x -> Switch(pred); false: x*2 ; true: x+10 ; Merge."""
+    data = nn.Input()()
+    pred = nn.Input()()
+    sw = nn.SwitchOps()(data, pred)
+    # 1-based branch outputs like the reference: 1=false, 2=true
+    f_branch = nn.MulConstant(2.0)((sw, 1))
+    t_branch = nn.AddConstant(10.0)((sw, 2))
+    merge = nn.MergeOps()(f_branch, t_branch)
+    return nn.Graph([data, pred], merge)
+
+
+def test_graph_switch_merge_false_and_true():
+    g = _cond_graph()
+    x = np.asarray([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    out_false = np.asarray(g.forward([x, np.asarray(False)]))
+    np.testing.assert_allclose(out_false, x * 2)
+    out_true = np.asarray(g.forward([x, np.asarray(True)]))
+    np.testing.assert_allclose(out_true, x + 10)
+
+
+def test_graph_switch_merge_under_jit():
+    g = _cond_graph()
+    g.ensure_initialized()
+    params, state = g.get_parameters(), g.get_state()
+
+    @jax.jit
+    def fn(p, s, x, pred):
+        out, _ = g.apply(p, s, [x, pred], training=False)
+        return out
+
+    x = np.ones((2, 2), np.float32)
+    np.testing.assert_allclose(np.asarray(fn(params, state, x, True)),
+                               x + 10)
+    np.testing.assert_allclose(np.asarray(fn(params, state, x, False)),
+                               x * 2)
+
+
+def test_merge_requires_two_distinct_branches():
+    data = nn.Input()()
+    pred = nn.Input()()
+    sw = nn.SwitchOps()(data, pred)
+    b1 = nn.MulConstant(2.0)((sw, 1))
+    b2 = nn.MulConstant(3.0)((sw, 1))  # same branch twice: invalid
+    merge = nn.MergeOps()(b1, b2)
+    with pytest.raises(ValueError, match="distinct branches"):
+        nn.Graph([data, pred], merge)
+
+
+def test_if_then_else_lax_cond():
+    m = nn.IfThenElse(nn.Linear(4, 3), nn.Linear(4, 3))
+    m.ensure_initialized()
+    params, state = m.get_parameters(), m.get_state()
+    x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+
+    out_t, _ = m.apply(params, state, [np.asarray(True), x], training=False)
+    out_f, _ = m.apply(params, state, [np.asarray(False), x],
+                       training=False)
+    # each branch has its own weights -> outputs differ
+    assert not np.allclose(np.asarray(out_t), np.asarray(out_f))
+    want_t = x @ np.asarray(params["then"]["weight"]).T \
+        + np.asarray(params["then"]["bias"])
+    np.testing.assert_allclose(np.asarray(out_t), want_t, atol=1e-5)
+
+    @jax.jit
+    def fn(p, s, pred, x):
+        out, _ = m.apply(p, s, [pred, x], training=False)
+        return out
+
+    np.testing.assert_allclose(np.asarray(fn(params, state, True, x)),
+                               np.asarray(out_t), atol=1e-6)
+
+
+def test_tf_imported_cond_graph():
+    tf = pytest.importorskip("tensorflow")
+    from bigdl_tpu.utils.tf_loader import TFModule, parse_graphdef
+
+    tf.compat.v1.disable_control_flow_v2()  # force Switch/Merge lowering
+    with tf.compat.v1.Graph().as_default() as g:
+        x = tf.compat.v1.placeholder(tf.float32, [2, 3], name="x")
+        p = tf.compat.v1.placeholder(tf.bool, [], name="p")
+        out = tf.cond(p, lambda: x + 10.0, lambda: x * 2.0)
+        out = tf.identity(out, name="out")
+        gd = g.as_graph_def()
+    tf.compat.v1.enable_control_flow_v2()
+    ops = {n.op for n in gd.node}
+    assert "Switch" in ops and "Merge" in ops  # v1 lowering happened
+
+    nodes = parse_graphdef(gd.SerializeToString())
+    mod = TFModule(nodes, inputs=["x", "p"], outputs=["out"]).evaluate()
+    xv = np.random.RandomState(1).randn(2, 3).astype(np.float32)
+    with tf.compat.v1.Session(graph=g) as sess:
+        want_t = sess.run("out:0", {"x:0": xv, "p:0": True})
+        want_f = sess.run("out:0", {"x:0": xv, "p:0": False})
+    got_t = np.asarray(mod.forward([xv, np.asarray(True)]))
+    got_f = np.asarray(mod.forward([xv, np.asarray(False)]))
+    np.testing.assert_allclose(got_t, want_t, atol=1e-5)
+    np.testing.assert_allclose(got_f, want_f, atol=1e-5)
+
+
+def test_nested_switch_merge_rejected():
+    """Nested Switch/Merge conds resolve to different Switches — the
+    nearest-Switch walk cannot select soundly, so Graph must refuse
+    (IfThenElse nests safely instead)."""
+    data = nn.Input()()
+    p_out = nn.Input()()
+    p_in = nn.Input()()
+    sw_o = nn.SwitchOps()(data, p_out)
+    sw_i = nn.SwitchOps()((sw_o, 1), p_in)
+    inner_f = nn.MulConstant(2.0)((sw_i, 1))
+    inner_t = nn.AddConstant(5.0)((sw_i, 2))
+    inner_merge = nn.MergeOps()(inner_f, inner_t)
+    outer_t = nn.AddConstant(10.0)((sw_o, 2))
+    outer_merge = nn.MergeOps()(inner_merge, outer_t)
+    with pytest.raises(ValueError, match="different"):
+        nn.Graph([data, p_out, p_in], outer_merge)
+
+
+def test_nested_if_then_else_works():
+    m = nn.IfThenElse(nn.MulConstant(3.0), nn.MulConstant(5.0))
+    m.ensure_initialized()
+    p, s = m.get_parameters(), m.get_state()
+    out, _ = m.apply(p, s, [np.asarray(True), np.ones((2,), np.float32)])
+    np.testing.assert_allclose(np.asarray(out), 3.0)

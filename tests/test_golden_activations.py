@@ -1,0 +1,167 @@
+"""Golden forward+gradient checks for the activation family against real
+PyTorch (the role the reference's torch/ suite of 127 specs plays,
+SURVEY.md §4.2). Every layer gets a numeric forward assertion and a
+gradient assertion via jax.grad vs torch.autograd.
+"""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as F  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+import bigdl_tpu.nn as nn  # noqa: E402
+
+
+def _x(shape=(3, 5), seed=0, lo=-2.0, hi=2.0):
+    rng = np.random.RandomState(seed)
+    return (rng.uniform(lo, hi, shape)).astype(np.float32)
+
+
+# (name, build bigdl module, torch fn, input kwargs)
+CASES = [
+    ("ReLU", lambda: nn.ReLU(), lambda t: F.relu(t), {}),
+    ("ReLU6", lambda: nn.ReLU6(), lambda t: F.relu6(t), {}),
+    ("Tanh", lambda: nn.Tanh(), torch.tanh, {}),
+    ("TanhShrink", lambda: nn.TanhShrink(),
+     lambda t: t - torch.tanh(t), {}),
+    ("Sigmoid", lambda: nn.Sigmoid(), torch.sigmoid, {}),
+    ("LogSigmoid", lambda: nn.LogSigmoid(), F.logsigmoid, {}),
+    ("SoftMax", lambda: nn.SoftMax(), lambda t: F.softmax(t, -1), {}),
+    ("SoftMin", lambda: nn.SoftMin(), lambda t: F.softmin(t, -1), {}),
+    ("LogSoftMax", lambda: nn.LogSoftMax(),
+     lambda t: F.log_softmax(t, -1), {}),
+    ("SoftPlus", lambda: nn.SoftPlus(), F.softplus, {}),
+    ("SoftPlusBeta2", lambda: nn.SoftPlus(2.0),
+     lambda t: F.softplus(t, beta=2.0), {}),
+    ("SoftSign", lambda: nn.SoftSign(), F.softsign, {}),
+    ("ELU", lambda: nn.ELU(), F.elu, {}),
+    ("ELUAlpha", lambda: nn.ELU(0.5),
+     lambda t: F.elu(t, alpha=0.5), {}),
+    ("LeakyReLU", lambda: nn.LeakyReLU(0.1),
+     lambda t: F.leaky_relu(t, 0.1), {}),
+    ("SoftShrink", lambda: nn.SoftShrink(0.5),
+     lambda t: F.softshrink(t, 0.5), {}),
+    ("HardShrink", lambda: nn.HardShrink(0.5),
+     lambda t: F.hardshrink(t, 0.5), {}),
+    ("HardTanh", lambda: nn.HardTanh(-0.7, 1.2),
+     lambda t: F.hardtanh(t, -0.7, 1.2), {}),
+    ("Clamp", lambda: nn.Clamp(-1.0, 0.5),
+     lambda t: torch.clamp(t, -1.0, 0.5), {}),
+    ("Threshold", lambda: nn.Threshold(0.3, -7.0),
+     lambda t: F.threshold(t, 0.3, -7.0), {}),
+    ("Square", lambda: nn.Square(), lambda t: t * t, {}),
+    ("Sqrt", lambda: nn.Sqrt(), torch.sqrt, {"lo": 0.1, "hi": 4.0}),
+    ("Log", lambda: nn.Log(), torch.log, {"lo": 0.1, "hi": 4.0}),
+    ("Log1p", lambda: nn.Log1p(), torch.log1p, {"lo": -0.5, "hi": 4.0}),
+    ("Exp", lambda: nn.Exp(), torch.exp, {}),
+    ("Abs", lambda: nn.Abs(), torch.abs, {}),
+    ("Negative", lambda: nn.Negative(), torch.neg, {}),
+    ("Power", lambda: nn.Power(2.0, 1.5, 0.1),
+     lambda t: (0.1 + 1.5 * t) ** 2.0, {"lo": 0.1, "hi": 2.0}),
+    ("HardSigmoid", lambda: nn.HardSigmoid(),
+     lambda t: torch.clamp(0.2 * t + 0.5, 0.0, 1.0), {}),
+]
+
+
+@pytest.mark.parametrize("name,build,tfn,kw",
+                         CASES, ids=[c[0] for c in CASES])
+def test_activation_forward_and_grad(name, build, tfn, kw):
+    x = _x(**kw)
+    m = build().evaluate()
+    m.ensure_initialized()
+    params, state = m.get_parameters(), m.get_state()
+
+    got = np.asarray(m.apply(params, state, x, training=False)[0])
+    tx = torch.tensor(x, requires_grad=True)
+    want = tfn(tx)
+    np.testing.assert_allclose(got, want.detach().numpy(),
+                               atol=1e-5, rtol=1e-5)
+
+    # gradient of sum(output) wrt input
+    g = jax.grad(lambda xx: jnp.sum(
+        m.apply(params, state, xx, training=False)[0]))(jnp.asarray(x))
+    want.sum().backward()
+    np.testing.assert_allclose(np.asarray(g), tx.grad.numpy(),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_prelu_shared_and_per_channel():
+    # shared single weight (n_output_plane=0)
+    m = nn.PReLU().evaluate()
+    m.ensure_initialized()
+    p = dict(m.get_parameters())
+    key = next(iter(p))
+    p[key] = np.asarray(p[key]) * 0 + 0.3
+    x = _x((2, 4))
+    got = np.asarray(m.apply(p, m.get_state(), x, training=False)[0])
+    want = F.prelu(torch.tensor(x), torch.tensor([0.3]))
+    np.testing.assert_allclose(got, want.numpy(), atol=1e-6)
+    # per-channel over NCHW
+    m2 = nn.PReLU(3).evaluate()
+    m2.ensure_initialized()
+    p2 = dict(m2.get_parameters())
+    key2 = next(iter(p2))
+    w = np.asarray([0.1, 0.2, 0.3], np.float32)
+    p2[key2] = w.reshape(np.asarray(p2[key2]).shape)
+    x2 = _x((2, 3, 4, 4), seed=1)
+    got2 = np.asarray(m2.apply(p2, m2.get_state(), x2, training=False)[0])
+    want2 = F.prelu(torch.tensor(x2), torch.tensor(w))
+    np.testing.assert_allclose(got2, want2.numpy(), atol=1e-6)
+
+
+def test_binary_threshold():
+    m = nn.BinaryThreshold(0.5)
+    x = np.asarray([[0.2, 0.5, 0.7], [-1.0, 0.51, 2.0]], np.float32)
+    got = np.asarray(m.forward(x))
+    np.testing.assert_array_equal(got, (x > 0.5).astype(np.float32))
+
+
+def test_rrelu_eval_matches_torch_and_train_bounds():
+    lower, upper = 1 / 8, 1 / 3
+    m = nn.RReLU(lower, upper)
+    x = _x((4, 6), seed=2)
+    # eval: deterministic slope (lower+upper)/2, torch semantics
+    m.evaluate()
+    m.ensure_initialized()
+    got = np.asarray(m.apply(m.get_parameters(), m.get_state(), x,
+                             training=False)[0])
+    want = F.rrelu(torch.tensor(x), lower, upper, training=False)
+    np.testing.assert_allclose(got, want.numpy(), atol=1e-6)
+    # train: negatives scaled by a per-element slope within [lower, upper]
+    out = np.asarray(m.apply(m.get_parameters(), m.get_state(), x,
+                             training=True,
+                             rng=jax.random.PRNGKey(0))[0])
+    neg = x < 0
+    slopes = out[neg] / x[neg]
+    assert slopes.min() >= lower - 1e-6
+    assert slopes.max() <= upper + 1e-6
+    np.testing.assert_allclose(out[~neg], x[~neg], atol=1e-6)
+
+
+def test_gradient_reversal():
+    m = nn.GradientReversal(0.7)
+    x = _x((3, 3))
+    m.ensure_initialized()
+    got = np.asarray(m.apply(m.get_parameters(), m.get_state(), x)[0])
+    np.testing.assert_allclose(got, x)  # identity forward
+    g = jax.grad(lambda xx: jnp.sum(
+        m.apply(m.get_parameters(), m.get_state(), xx)[0] * 2.0))(
+        jnp.asarray(x))
+    # gradient is reversed and scaled by lambda (GradientReversal.scala)
+    np.testing.assert_allclose(np.asarray(g), -0.7 * 2.0 * np.ones_like(x),
+                               atol=1e-6)
+
+
+def test_gaussian_sampler_statistics():
+    m = nn.GaussianSampler()
+    m.ensure_initialized()
+    mean = np.full((2000, 2), 3.0, np.float32)
+    logvar = np.full((2000, 2), np.log(0.25), np.float32)
+    out, _ = m.apply(m.get_parameters(), m.get_state(), [mean, logvar],
+                     training=True, rng=jax.random.PRNGKey(0))
+    out = np.asarray(out)
+    assert abs(out.mean() - 3.0) < 0.05
+    assert abs(out.std() - 0.5) < 0.05

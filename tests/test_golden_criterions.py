@@ -1,0 +1,248 @@
+"""Golden checks for the criterion family against real PyTorch losses
+(the reference torch/ suite role, SURVEY.md §4.2). Targets follow BigDL
+conventions: class labels 1-based; hinge/margin labels ±1."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as F  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+import bigdl_tpu.nn as nn  # noqa: E402
+
+
+def _r(shape, seed=0, lo=-2.0, hi=2.0):
+    return np.random.RandomState(seed).uniform(
+        lo, hi, shape).astype(np.float32)
+
+
+def _loss(crit, out, tgt):
+    return float(crit.apply(out, tgt))
+
+
+def test_bce_criterion():
+    p = _r((4, 3), lo=0.05, hi=0.95)
+    t = (np.random.RandomState(1).rand(4, 3) > 0.5).astype(np.float32)
+    got = _loss(nn.BCECriterion(), p, t)
+    want = F.binary_cross_entropy(torch.tensor(p), torch.tensor(t))
+    assert got == pytest.approx(float(want), rel=1e-5)
+
+
+def test_abs_criterion():
+    a, b = _r((4, 3)), _r((4, 3), 1)
+    got = _loss(nn.AbsCriterion(), a, b)
+    want = F.l1_loss(torch.tensor(a), torch.tensor(b))
+    assert got == pytest.approx(float(want), rel=1e-5)
+
+
+def test_smooth_l1():
+    a, b = _r((4, 3)), _r((4, 3), 1)
+    got = _loss(nn.SmoothL1Criterion(), a, b)
+    want = F.smooth_l1_loss(torch.tensor(a), torch.tensor(b))
+    assert got == pytest.approx(float(want), rel=1e-5)
+
+
+def test_margin_criterion():
+    """Hinge loss: mean(max(0, margin - y*x)) (MarginCriterion.scala)."""
+    x = _r((6,))
+    y = np.sign(_r((6,), 3)).astype(np.float32)
+    got = _loss(nn.MarginCriterion(1.0), x, y)
+    want = np.maximum(0.0, 1.0 - y * x).mean()
+    assert got == pytest.approx(float(want), rel=1e-5)
+
+
+def test_margin_ranking_criterion():
+    x1, x2 = _r((5,)), _r((5,), 1)
+    y = np.sign(_r((5,), 2)).astype(np.float32)
+    got = _loss(nn.MarginRankingCriterion(0.5), [x1, x2], y)
+    want = F.margin_ranking_loss(torch.tensor(x1), torch.tensor(x2),
+                                 torch.tensor(y), margin=0.5)
+    assert got == pytest.approx(float(want), rel=1e-4)
+
+
+def test_multi_margin_criterion():
+    x = _r((4, 5))
+    t = np.asarray([1, 3, 5, 2], np.float32)  # 1-based
+    got = _loss(nn.MultiMarginCriterion(1, margin=1.0), x, t)
+    want = F.multi_margin_loss(torch.tensor(x),
+                               torch.tensor(t).long() - 1, p=1, margin=1.0)
+    assert got == pytest.approx(float(want), rel=1e-4)
+
+
+def test_multi_label_margin_criterion():
+    x = _r((2, 4))
+    # 1-based label lists, 0-terminated (MultiLabelMarginCriterion.scala)
+    t = np.asarray([[3, 1, 0, 0], [4, 0, 0, 0]], np.float32)
+    got = _loss(nn.MultiLabelMarginCriterion(), x, t)
+    tt = torch.tensor([[2, 0, -1, -1], [3, -1, -1, -1]])
+    want = F.multilabel_margin_loss(torch.tensor(x), tt)
+    assert got == pytest.approx(float(want), rel=1e-4)
+
+
+def test_multi_label_soft_margin():
+    x = _r((3, 4))
+    t = (np.random.RandomState(5).rand(3, 4) > 0.5).astype(np.float32)
+    got = _loss(nn.MultiLabelSoftMarginCriterion(), x, t)
+    want = F.multilabel_soft_margin_loss(torch.tensor(x), torch.tensor(t))
+    assert got == pytest.approx(float(want), rel=1e-4)
+
+
+def test_soft_margin():
+    x = _r((3, 4))
+    y = np.sign(_r((3, 4), 7)).astype(np.float32)
+    got = _loss(nn.SoftMarginCriterion(), x, y)
+    want = F.soft_margin_loss(torch.tensor(x), torch.tensor(y))
+    assert got == pytest.approx(float(want), rel=1e-4)
+
+
+def test_hinge_embedding():
+    x = _r((6,), lo=0.1, hi=2.0)
+    y = np.asarray([1, -1, 1, -1, 1, -1], np.float32)
+    got = _loss(nn.HingeEmbeddingCriterion(1.0), x, y)
+    want = F.hinge_embedding_loss(torch.tensor(x), torch.tensor(y),
+                                  margin=1.0)
+    assert got == pytest.approx(float(want), rel=1e-4)
+
+
+def test_l1_hinge_embedding():
+    """L1 distance between pair, hinged for dissimilar
+    (L1HingeEmbeddingCriterion.scala)."""
+    a, b = _r((5,)), _r((5,), 1)
+    d = float(np.abs(a - b).sum())
+    got_sim = _loss(nn.L1HingeEmbeddingCriterion(2.0), [a, b],
+                    np.asarray(1.0, np.float32))
+    assert got_sim == pytest.approx(d, rel=1e-5)
+    got_dis = _loss(nn.L1HingeEmbeddingCriterion(2.0), [a, b],
+                    np.asarray(-1.0, np.float32))
+    assert got_dis == pytest.approx(max(0.0, 2.0 - d), abs=1e-5)
+
+
+def test_cosine_embedding():
+    a, b = _r((4, 6)), _r((4, 6), 1)
+    y = np.asarray([1, -1, 1, -1], np.float32)
+    got = _loss(nn.CosineEmbeddingCriterion(0.3), [a, b], y)
+    want = F.cosine_embedding_loss(torch.tensor(a), torch.tensor(b),
+                                   torch.tensor(y), margin=0.3)
+    assert got == pytest.approx(float(want), rel=1e-4)
+
+
+def test_cosine_distance_criterion():
+    a, b = _r((4, 6)), _r((4, 6), 1)
+    got = _loss(nn.CosineDistanceCriterion(), a, b)
+    cos = F.cosine_similarity(torch.tensor(a), torch.tensor(b))
+    want = (1.0 - cos).mean()
+    assert got == pytest.approx(float(want), rel=1e-4)
+
+
+def test_dist_kl_div():
+    logp = np.log(_r((3, 5), lo=0.05, hi=1.0))
+    t = _r((3, 5), 1, lo=0.0, hi=1.0)
+    t = t / t.sum(axis=1, keepdims=True)
+    got = _loss(nn.DistKLDivCriterion(), logp, t)
+    want = F.kl_div(torch.tensor(logp), torch.tensor(t),
+                    reduction="batchmean")
+    assert got == pytest.approx(float(want), rel=1e-4)
+
+
+def test_kld_criterion_vae():
+    """KL(q(z|x) || N(0,1)) from (mean, log_var) (KLDCriterion.scala)."""
+    mean, logv = _r((4, 3)), _r((4, 3), 1, lo=-1, hi=1)
+    got = _loss(nn.KLDCriterion(), [mean, logv], np.zeros((4, 3)))
+    want = 0.5 * np.sum(mean ** 2 + np.exp(logv) - 1.0 - logv) / 4
+    # the reference sums over latent dims and averages over batch OR sums;
+    # accept either normalization
+    want_sum = 0.5 * np.sum(mean ** 2 + np.exp(logv) - 1.0 - logv)
+    assert got == pytest.approx(float(want), rel=1e-3) or \
+        got == pytest.approx(float(want_sum), rel=1e-3)
+
+
+def test_gaussian_criterion():
+    """-log N(target; mean, exp(log_var)) (GaussianCriterion.scala)."""
+    mean, logv = _r((4, 3)), _r((4, 3), 1, lo=-1, hi=1)
+    t = _r((4, 3), 2)
+    got = _loss(nn.GaussianCriterion(), [mean, logv], t)
+    want = 0.5 * np.sum(np.log(2 * np.pi) + logv
+                        + (t - mean) ** 2 / np.exp(logv))
+    assert got == pytest.approx(float(want), rel=1e-3) or \
+        got == pytest.approx(float(want) / 4, rel=1e-3)
+
+
+def test_l1_cost():
+    x = _r((4, 3))
+    got = _loss(nn.L1Cost(), x, None)
+    assert got == pytest.approx(float(np.abs(x).sum()), rel=1e-5)
+
+
+def test_class_simplex_criterion():
+    """MSE against simplex-embedded class targets
+    (ClassSimplexCriterion.scala)."""
+    x = _r((3, 4))
+    t = np.asarray([1, 2, 4], np.float32)
+    crit = nn.ClassSimplexCriterion(4)
+    got = _loss(crit, x, t)
+    assert np.isfinite(got) and got >= 0
+    # perfect prediction of the simplex target gives ~0 loss
+    # (recover the embedded targets through the criterion's own table)
+    m = crit
+    if hasattr(m, "simplex"):
+        tgt = np.asarray(m.simplex)[[0, 1, 3]]
+        assert _loss(crit, tgt, t) == pytest.approx(0.0, abs=1e-5)
+
+
+def test_dice_coefficient():
+    p = _r((2, 6), lo=0.0, hi=1.0)
+    t = (np.random.RandomState(9).rand(2, 6) > 0.5).astype(np.float32)
+    got = _loss(nn.DiceCoefficientCriterion(epsilon=1.0), p, t)
+    eps = 1.0
+    per = 1.0 - (2 * (p * t).sum(1) + eps) / (p.sum(1) + t.sum(1) + eps)
+    assert got == pytest.approx(float(per.mean()), rel=1e-3)
+
+
+def test_softmax_with_criterion():
+    x = _r((2, 5))
+    t = np.asarray([2, 4], np.float32)
+    got = _loss(nn.SoftmaxWithCriterion(), x, t)
+    want = F.cross_entropy(torch.tensor(x), torch.tensor(t).long() - 1)
+    assert got == pytest.approx(float(want), rel=1e-4)
+
+
+def test_parallel_and_multi_criterion():
+    a, b = _r((3, 4)), _r((3, 4), 1)
+    t1, t2 = _r((3, 4), 2), _r((3, 4), 3)
+    pc = nn.ParallelCriterion()
+    pc.add(nn.MSECriterion(), 0.5).add(nn.AbsCriterion(), 2.0)
+    got = _loss(pc, [a, b], [t1, t2])
+    want = 0.5 * float(F.mse_loss(torch.tensor(a), torch.tensor(t1))) \
+        + 2.0 * float(F.l1_loss(torch.tensor(b), torch.tensor(t2)))
+    assert got == pytest.approx(want, rel=1e-4)
+
+    mc = nn.MultiCriterion()
+    mc.add(nn.MSECriterion(), 1.0).add(nn.AbsCriterion(), 3.0)
+    got2 = _loss(mc, a, t1)
+    want2 = float(F.mse_loss(torch.tensor(a), torch.tensor(t1))) \
+        + 3.0 * float(F.l1_loss(torch.tensor(a), torch.tensor(t1)))
+    assert got2 == pytest.approx(want2, rel=1e-4)
+
+
+def test_criterion_gradients_match_torch():
+    """Spot-check backward for a few criterions via jax.grad vs torch."""
+    cases = [
+        (nn.BCECriterion(),
+         _r((3, 4), lo=0.05, hi=0.95),
+         (np.random.RandomState(2).rand(3, 4) > 0.5).astype(np.float32),
+         lambda o, t: F.binary_cross_entropy(o, t)),
+        (nn.SmoothL1Criterion(), _r((3, 4)), _r((3, 4), 1),
+         lambda o, t: F.smooth_l1_loss(o, t)),
+        (nn.SoftMarginCriterion(), _r((3, 4)),
+         np.sign(_r((3, 4), 7)).astype(np.float32),
+         lambda o, t: F.soft_margin_loss(o, t)),
+    ]
+    for crit, out, tgt, tfn in cases:
+        g = jax.grad(lambda o: jnp.asarray(
+            crit.apply(o, tgt)).reshape(()))(jnp.asarray(out))
+        to = torch.tensor(out, requires_grad=True)
+        tfn(to, torch.tensor(tgt)).backward()
+        np.testing.assert_allclose(np.asarray(g), to.grad.numpy(),
+                                   atol=1e-5, rtol=1e-4)

@@ -1,0 +1,182 @@
+"""Golden checks for the recurrent stack against real PyTorch RNN/LSTM/GRU
+with COPIED weights (reference torch/ suite role, SURVEY.md §4.2):
+sequence outputs must match step-for-step, not just shapes."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+import bigdl_tpu.nn as nn  # noqa: E402
+
+
+def _x(shape, seed=0):
+    return np.random.RandomState(seed).randn(*shape).astype(np.float32)
+
+
+def _run_recurrent(cell, x):
+    m = nn.Recurrent(cell)
+    m.ensure_initialized()
+    p = m.get_parameters()
+    out, _ = m.apply(p, m.get_state(), x, training=False)
+    return np.asarray(out), {k: np.asarray(v)
+                             for k, v in dict(p["cell"]).items()}
+
+
+def test_rnn_cell_matches_torch_rnn():
+    B, T, I, H = 2, 5, 3, 4
+    x = _x((B, T, I))
+    out, p = _run_recurrent(nn.RnnCell(I, H, nn.Tanh()), x)
+    ref = torch.nn.RNN(I, H, batch_first=True)
+    with torch.no_grad():
+        ref.weight_ih_l0.copy_(torch.tensor(p["w_ih"]))
+        ref.weight_hh_l0.copy_(torch.tensor(p["w_hh"]))
+        ref.bias_ih_l0.copy_(torch.tensor(p["bias"]))
+        ref.bias_hh_l0.zero_()
+    want, _ = ref(torch.tensor(x))
+    np.testing.assert_allclose(out, want.detach().numpy(), atol=1e-5)
+
+
+def test_lstm_matches_torch_lstm():
+    B, T, I, H = 2, 6, 3, 5
+    x = _x((B, T, I), 1)
+    out, p = _run_recurrent(nn.LSTM(I, H, 0.0), x)
+    ref = torch.nn.LSTM(I, H, batch_first=True)
+    with torch.no_grad():  # both use gate order (i, f, g, o)
+        ref.weight_ih_l0.copy_(torch.tensor(p["w_ih"]))
+        ref.weight_hh_l0.copy_(torch.tensor(p["w_hh"]))
+        ref.bias_ih_l0.copy_(torch.tensor(p["bias"]))
+        ref.bias_hh_l0.zero_()
+    want, _ = ref(torch.tensor(x))
+    np.testing.assert_allclose(out, want.detach().numpy(), atol=1e-5)
+
+
+def test_gru_matches_torch_gru():
+    B, T, I, H = 2, 5, 4, 3
+    x = _x((B, T, I), 2)
+    out, p = _run_recurrent(nn.GRU(I, H, 0.0), x)
+    ref = torch.nn.GRU(I, H, batch_first=True)
+    with torch.no_grad():  # torch packs (r, z, n); ours is (r,z) + n
+        ref.weight_ih_l0.copy_(torch.tensor(
+            np.concatenate([p["w_ih"], p["w_ih_n"]], axis=0)))
+        ref.weight_hh_l0.copy_(torch.tensor(
+            np.concatenate([p["w_hh"], p["w_hh_n"]], axis=0)))
+        ref.bias_ih_l0.copy_(torch.tensor(
+            np.concatenate([p["bias"], p["bias_n"]])))
+        ref.bias_hh_l0.zero_()
+    want, _ = ref(torch.tensor(x))
+    np.testing.assert_allclose(out, want.detach().numpy(), atol=1e-5)
+
+
+def test_lstm_peephole_manual_step():
+    """No torch analogue: verify one step against the written-out math
+    (LSTMPeephole.scala gate equations)."""
+    I, H = 3, 4
+    cell = nn.LSTMPeephole(I, H)
+    cell.ensure_initialized()
+    p = {k: np.asarray(v) for k, v in dict(cell.get_parameters()).items()}
+    x = _x((2, I), 3)
+    h = _x((2, H), 4) * 0.1
+    c = _x((2, H), 5) * 0.1
+
+    def sig(v):
+        return 1 / (1 + np.exp(-v))
+
+    gates = x @ p["w_ih"].T + h @ p["w_hh"].T + p["bias"]
+    gi, gf, gg, go = np.split(gates, 4, axis=-1)
+    i = sig(gi + p["w_ci"] * c)
+    f = sig(gf + p["w_cf"] * c)
+    g = np.tanh(gg)
+    c2 = f * c + i * g
+    o = sig(go + p["w_co"] * c2)
+    want_h = o * np.tanh(c2)
+
+    from bigdl_tpu.utils.table import T
+    out, hid = cell.step(cell.get_parameters(), jnp.asarray(x),
+                         T(jnp.asarray(h), jnp.asarray(c)))
+    np.testing.assert_allclose(np.asarray(out), want_h, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(hid[2]), c2, atol=1e-5)
+
+
+def test_bi_recurrent_concat_of_directions():
+    B, T, I, H = 2, 4, 3, 4
+    x = _x((B, T, I), 6)
+    m = nn.BiRecurrent().add(nn.RnnCell(I, H, nn.Tanh()))
+    m.ensure_initialized()
+    p = m.get_parameters()
+    out, _ = m.apply(p, m.get_state(), x, training=False)
+    out = np.asarray(out)
+    assert out.shape == (B, T, 2 * H)
+    # forward half equals a plain Recurrent with the fwd params
+    fwd = nn.Recurrent(nn.RnnCell(I, H, nn.Tanh()))
+    fwd.ensure_initialized()
+    yf, _ = fwd.apply(p["fwd"], {}, x, training=False)
+    np.testing.assert_allclose(out[:, :, :H], np.asarray(yf), atol=1e-5)
+    # backward half equals running on the reversed sequence, reversed back
+    yb, _ = fwd.apply(p["bwd"], {}, np.ascontiguousarray(x[:, ::-1]),
+                      training=False)
+    np.testing.assert_allclose(out[:, :, H:],
+                               np.asarray(yb)[:, ::-1], atol=1e-5)
+
+
+def test_recurrent_decoder_feeds_back_output():
+    I = H = 3  # feedback needs out_dim == in_dim
+    cell = nn.RnnCell(I, H, nn.Tanh())
+    m = nn.RecurrentDecoder(4, cell)
+    m.ensure_initialized()
+    p = m.get_parameters()
+    x0 = _x((2, I), 7)
+    out, _ = m.apply(p, m.get_state(), x0, training=False)
+    out = np.asarray(out)
+    assert out.shape == (2, 4, H)
+    # manual feedback loop
+    pc = {k: np.asarray(v) for k, v in dict(p["cell"]).items()}
+    h = np.zeros((2, H), np.float32)
+    xin = x0
+    for t in range(4):
+        h = np.tanh(xin @ pc["w_ih"].T + h @ pc["w_hh"].T + pc["bias"])
+        np.testing.assert_allclose(out[:, t], h, atol=1e-5)
+        xin = h
+
+
+def test_conv_lstm_peephole_shapes_and_state():
+    B, T, C, Hh, Ww = 2, 3, 2, 5, 5
+    x = _x((B, T, C, Hh, Ww), 8)
+    m = nn.Recurrent(nn.ConvLSTMPeephole(C, 4, 3, 3, 1))
+    m.ensure_initialized()
+    out, _ = m.apply(m.get_parameters(), m.get_state(), x, training=False)
+    out = np.asarray(out)
+    assert out.shape == (B, T, 4, Hh, Ww)
+    assert np.isfinite(out).all()
+    # the sequence must actually depend on earlier frames (stateful)
+    x2 = x.copy()
+    x2[:, 0] += 1.0
+    out2, _ = m.apply(m.get_parameters(), m.get_state(), x2,
+                      training=False)
+    assert not np.allclose(np.asarray(out2)[:, -1], out[:, -1])
+
+
+def test_time_distributed_equals_per_step():
+    B, T, F_ = 2, 5, 4
+    x = _x((B, T, F_), 9)
+    m = nn.TimeDistributed(nn.Linear(F_, 3))
+    m.ensure_initialized()
+    p = m.get_parameters()
+    out, _ = m.apply(p, m.get_state(), x, training=False)
+    w = np.asarray(p["layer"]["weight"])
+    b = np.asarray(p["layer"]["bias"])
+    want = x @ w.T + b
+    np.testing.assert_allclose(np.asarray(out), want, atol=1e-5)
+
+
+def test_conv_lstm_peephole_3d_shapes():
+    B, T, C, D, Hh, Ww = 1, 2, 2, 4, 4, 4
+    x = _x((B, T, C, D, Hh, Ww), 10)
+    m = nn.Recurrent(nn.ConvLSTMPeephole3D(C, 3, 3, 3, 1))
+    m.ensure_initialized()
+    out, _ = m.apply(m.get_parameters(), m.get_state(), x, training=False)
+    out = np.asarray(out)
+    assert out.shape == (B, T, 3, D, Hh, Ww)
+    assert np.isfinite(out).all()

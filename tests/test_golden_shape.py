@@ -1,0 +1,204 @@
+"""Golden numeric checks for the shape/structure family against
+numpy/PyTorch references (reference torch/ suite role, SURVEY.md §4.2).
+Dims are 1-based like the reference (Torch convention)."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+import bigdl_tpu.nn as nn  # noqa: E402
+from bigdl_tpu.utils.table import T  # noqa: E402
+
+
+def _x(shape, seed=0):
+    return np.random.RandomState(seed).randn(*shape).astype(np.float32)
+
+
+def _run(m, x, training=False):
+    m.ensure_initialized()
+    out, _ = m.apply(m.get_parameters(), m.get_state(), x,
+                     training=training)
+    return out
+
+
+def test_view_and_reshape():
+    x = _x((2, 3, 4))
+    np.testing.assert_allclose(np.asarray(_run(nn.View(12), x)),
+                               x.reshape(2, 12))
+    np.testing.assert_allclose(
+        np.asarray(_run(nn.Reshape((4, 3), batch_mode=True), x)),
+        x.reshape(2, 4, 3))
+
+
+def test_squeeze_unsqueeze():
+    x = _x((2, 1, 3, 1))
+    out = np.asarray(_run(nn.Squeeze(2, num_input_dims=3), x))
+    assert out.shape == (2, 3, 1)   # 1-based dim 2 (after batch)
+    x2 = _x((2, 3))
+    # insert a new dim AT 1-based pos 2 (Unsqueeze.scala)
+    out2 = np.asarray(_run(nn.Unsqueeze(2, num_input_dims=2), x2))
+    assert out2.shape == (2, 1, 3)
+    np.testing.assert_allclose(out2[:, 0, :], x2)
+    # batched input: pos counts within the unbatched shape
+    x3 = _x((4, 2, 3))
+    out3 = np.asarray(_run(nn.Unsqueeze(2, num_input_dims=2), x3))
+    assert out3.shape == (4, 2, 1, 3)
+
+
+def test_transpose_contiguous():
+    x = _x((2, 3, 4))
+    out = np.asarray(_run(nn.Transpose([(2, 3)]), x))
+    np.testing.assert_allclose(out, x.transpose(0, 2, 1))
+    np.testing.assert_allclose(np.asarray(_run(nn.Contiguous(), x)), x)
+
+
+def test_replicate():
+    x = _x((2, 3))
+    out = np.asarray(_run(nn.Replicate(4, dim=1), x))
+    # replicate along a new dim (nn/Replicate.scala)
+    assert out.shape[0] == 4 or out.shape[1] == 4
+    flat_src = np.broadcast_to(x, out.shape) if out.shape[0] == 4 else None
+    if flat_src is not None:
+        np.testing.assert_allclose(out, flat_src)
+
+
+def test_padding_and_spatial_zero_padding():
+    x = _x((2, 3))
+    out = np.asarray(_run(nn.Padding(2, 2, 2), x))
+    assert out.shape == (2, 5)
+    np.testing.assert_allclose(out[:, :3], x)
+    np.testing.assert_allclose(out[:, 3:], 0)
+    neg = np.asarray(_run(nn.Padding(2, -2, 2), x))
+    assert neg.shape == (2, 5)
+    np.testing.assert_allclose(neg[:, 2:], x)
+    img = _x((1, 2, 3, 3))
+    out2 = np.asarray(_run(nn.SpatialZeroPadding(1, 2, 1, 0), img))
+    assert out2.shape == (1, 2, 4, 6)
+    np.testing.assert_allclose(out2[:, :, 1:, 1:4], img)
+
+
+def test_narrow_select_index():
+    x = _x((4, 6))
+    out = np.asarray(_run(nn.Narrow(2, 2, 3), x))
+    np.testing.assert_allclose(out, x[:, 1:4])  # 1-based offset 2
+    # negative offset counts from the end (Narrow.scala)
+    out_neg = np.asarray(_run(nn.Narrow(2, -2, 2), x))
+    np.testing.assert_allclose(out_neg, x[:, 4:6])
+    sel = np.asarray(_run(nn.Select(1, 3), x))
+    np.testing.assert_allclose(sel, x[2])
+    sel_neg = np.asarray(_run(nn.Select(2, -1), x))
+    np.testing.assert_allclose(sel_neg, x[:, -1])
+    idx = np.asarray([1.0, 3.0, 1.0], np.float32)  # 1-based indices
+    out_idx = np.asarray(_run(nn.Index(1), [x, idx]))
+    np.testing.assert_allclose(out_idx, x[[0, 2, 0]])
+
+
+def test_masked_select():
+    x = np.asarray([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    mask = np.asarray([[1.0, 0.0], [0.0, 1.0]], np.float32)
+    out = np.asarray(_run(nn.MaskedSelect(), [x, mask]))
+    np.testing.assert_allclose(np.sort(out.ravel())[:2], [1.0, 4.0])
+
+
+def test_max_min_mean_sum():
+    x = _x((3, 5))
+    tx = torch.tensor(x)
+    np.testing.assert_allclose(np.asarray(_run(nn.Max(2, 2), x)),
+                               tx.max(dim=1).values.numpy(), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(_run(nn.Min(2, 2), x)),
+                               tx.min(dim=1).values.numpy(), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(_run(nn.Mean(2, 2), x)),
+                               x.mean(axis=1), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(_run(nn.Sum(2, 2), x)),
+                               x.sum(axis=1), atol=1e-5)
+
+
+def test_tile_pack_reverse():
+    x = _x((2, 3))
+    out = np.asarray(_run(nn.Tile(2, 3), x))
+    np.testing.assert_allclose(out, np.tile(x, (1, 3)))
+    a, b = _x((2, 3), 1), _x((2, 3), 2)
+    packed = np.asarray(_run(nn.Pack(1), [a, b]))
+    np.testing.assert_allclose(packed, np.stack([a, b], axis=0))
+    rev = np.asarray(_run(nn.Reverse(1), x))
+    np.testing.assert_allclose(rev, x[::-1])
+    rev2 = np.asarray(_run(nn.Reverse(2), x))
+    np.testing.assert_allclose(rev2, x[:, ::-1])
+
+
+def test_split_join_bifurcate_flatten():
+    x = _x((2, 4, 3))
+    parts = _run(nn.SplitTable(2, 3), x)
+    parts = list(parts)
+    assert len(parts) == 4
+    np.testing.assert_allclose(np.asarray(parts[0]), x[:, 0, :])
+    joined = np.asarray(_run(nn.JoinTable(2, 2),
+                             [x[:, :, 0], x[:, :, 1]]))
+    np.testing.assert_allclose(
+        joined, np.concatenate([x[:, :, 0], x[:, :, 1]], axis=1))
+    l, r = list(_run(nn.BifurcateSplitTable(2), x))
+    np.testing.assert_allclose(np.asarray(l), x[:, :2, :])
+    np.testing.assert_allclose(np.asarray(r), x[:, 2:, :])
+    nested = T(T(np.ones((2,)), np.zeros((2,))), np.full((2,), 2.0))
+    flat = list(_run(nn.FlattenTable(), nested))
+    assert len(flat) == 3
+
+
+def test_select_table_narrow_table():
+    a, b, c = (np.full((2, 2), v, np.float32) for v in (1, 2, 3))
+    out = np.asarray(_run(nn.SelectTable(2), [a, b, c]))
+    np.testing.assert_allclose(out, b)
+    out_neg = np.asarray(_run(nn.SelectTable(-1), [a, b, c]))
+    np.testing.assert_allclose(out_neg, c)
+    nt = list(_run(nn.NarrowTable(2, 2), [a, b, c]))
+    assert len(nt) == 2
+    np.testing.assert_allclose(np.asarray(nt[0]), b)
+
+
+def test_resize_bilinear_matches_tf_and_torch():
+    x = _x((2, 3, 5, 7))
+    # align_corners=True: same endpoint mapping as torch
+    out_ac = np.asarray(_run(nn.ResizeBilinear(10, 14,
+                                               align_corners=True), x))
+    want_ac = torch.nn.functional.interpolate(
+        torch.tensor(x), size=(10, 14), mode="bilinear",
+        align_corners=True)
+    np.testing.assert_allclose(out_ac, want_ac.numpy(), atol=1e-5)
+    # align_corners=False: the reference wraps TF's legacy resize
+    # (src = dst * scale), oracle is real TF
+    tf = pytest.importorskip("tensorflow")
+    out = np.asarray(_run(nn.ResizeBilinear(10, 14), x))
+    want = tf.compat.v1.image.resize_bilinear(
+        tf.constant(x.transpose(0, 2, 3, 1)), (10, 14),
+        align_corners=False, half_pixel_centers=False).numpy()
+    np.testing.assert_allclose(out, want.transpose(0, 3, 1, 2), atol=1e-5)
+
+
+def test_nms_hand_computed():
+    # three boxes: b0 and b1 heavily overlap; b2 is separate
+    boxes = np.asarray([[0, 0, 10, 10],
+                        [1, 1, 10.5, 10.5],
+                        [20, 20, 30, 30]], np.float32)
+    scores = np.asarray([0.9, 0.8, 0.7], np.float32)
+    m = nn.Nms(iou_threshold=0.5, max_output=10)
+    keep = np.asarray(m.forward([boxes, scores])).astype(int).ravel()
+    kept = [k for k in keep.tolist() if k >= 0]
+    assert 0 in [k - 1 for k in kept] or 0 in kept  # top box kept
+    # the overlapping lower-score box must be suppressed
+    as0 = set(k - min(kept) for k in kept)
+    assert len(kept) == 2 and 1 not in as0
+
+
+def test_scale_layer():
+    m = nn.Scale((1, 3, 1, 1))
+    m.ensure_initialized()
+    p = dict(m.get_parameters())
+    x = _x((2, 3, 4, 4))
+    out = np.asarray(m.apply(p, m.get_state(), x, training=False)[0])
+    w = np.asarray(p["cmul"]["weight"])   # CMul then CAdd (Scale.scala)
+    b = np.asarray(p["cadd"]["bias"])
+    np.testing.assert_allclose(out, x * w.reshape(1, 3, 1, 1)
+                               + b.reshape(1, 3, 1, 1), atol=1e-5)

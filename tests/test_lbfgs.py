@@ -1,0 +1,97 @@
+"""LBFGS + LineSearch and TreeNNAccuracy (reference: optim/LBFGS.scala:48,
+optim/LineSearch.scala, optim/ValidationMethod.scala:118)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu.optim import LBFGS, TreeNNAccuracy
+
+
+def test_lbfgs_quadratic_converges():
+    """f(x) = (x-c)'A(x-c): LBFGS must reach the exact minimum."""
+    A = jnp.asarray(np.diag([1.0, 10.0, 100.0]), jnp.float32)
+    c = jnp.asarray([1.0, -2.0, 3.0], jnp.float32)
+
+    def f(x):
+        d = x - c
+        return d @ A @ d
+
+    feval = jax.jit(jax.value_and_grad(f))
+    opt = LBFGS(max_iter=50, max_eval=200)
+    x0 = jnp.zeros(3, jnp.float32)
+    x_star, f_hist = opt.optimize(feval, x0)
+    assert f_hist[0] == pytest.approx(float(f(x0)), rel=1e-5)
+    assert f_hist[-1] < f_hist[0]
+    np.testing.assert_allclose(np.asarray(x_star), np.asarray(c), atol=1e-3)
+
+
+def test_lbfgs_rosenbrock_converges():
+    def rosen(x):
+        return jnp.sum(100.0 * (x[1:] - x[:-1] ** 2) ** 2
+                       + (1 - x[:-1]) ** 2)
+
+    feval = jax.jit(jax.value_and_grad(rosen))
+    opt = LBFGS(max_iter=200, max_eval=1000, tol_fun=1e-10, tol_x=1e-12)
+    x0 = jnp.asarray([-1.2, 1.0, -1.2, 1.0], jnp.float32)
+    x_star, f_hist = opt.optimize(feval, x0)
+    assert f_hist[-1] < 1e-4
+    np.testing.assert_allclose(np.asarray(x_star), 1.0, atol=2e-2)
+
+
+def test_lbfgs_no_line_search_fixed_step():
+    def f(x):
+        return jnp.sum(x ** 2)
+
+    feval = jax.jit(jax.value_and_grad(f))
+    opt = LBFGS(max_iter=30, learning_rate=0.3, line_search=None)
+    x_star, f_hist = opt.optimize(feval, jnp.ones(4, jnp.float32) * 3)
+    assert f_hist[-1] < 1e-4
+
+
+def test_lbfgs_pytree_params():
+    """Pytree parameters (a tiny linear regression) are supported."""
+    rng = np.random.RandomState(0)
+    X = jnp.asarray(rng.randn(32, 5), jnp.float32)
+    w_true = jnp.asarray(rng.randn(5), jnp.float32)
+    y = X @ w_true + 0.7
+
+    def loss(p):
+        pred = X @ p["w"] + p["b"]
+        return jnp.mean((pred - y) ** 2)
+
+    feval = jax.jit(jax.value_and_grad(loss))
+    opt = LBFGS(max_iter=100, max_eval=500, tol_fun=1e-12)
+    p0 = {"w": jnp.zeros(5, jnp.float32), "b": jnp.zeros((), jnp.float32)}
+    p_star, f_hist = opt.optimize(feval, p0)
+    assert f_hist[-1] < 1e-6
+    np.testing.assert_allclose(np.asarray(p_star["w"]),
+                               np.asarray(w_true), atol=1e-2)
+    assert float(p_star["b"]) == pytest.approx(0.7, abs=1e-2)
+
+
+def test_tree_nn_accuracy_hand_computed():
+    """3-d case: only the root node (index 0 along dim 1) is scored."""
+    # batch=3, nodes=2, classes=3
+    out = np.zeros((3, 2, 3), np.float32)
+    out[0, 0] = [0.9, 0.05, 0.05]   # root pred class 1
+    out[1, 0] = [0.1, 0.8, 0.1]     # root pred class 2
+    out[2, 0] = [0.2, 0.2, 0.6]     # root pred class 3
+    out[:, 1] = [0, 0, 1]           # non-root nodes must be ignored
+    target = np.asarray([[1, 9], [2, 9], [1, 9]], np.float32)
+    r = TreeNNAccuracy()(out, target)
+    value, count = r.result()
+    assert count == 3
+    assert value == pytest.approx(2 / 3)
+
+
+def test_tree_nn_accuracy_binary_and_2d():
+    # binary (classes == 1): threshold at 0.5 -> labels 0/1
+    out = np.asarray([[[0.8], [0.0]], [[0.3], [0.0]]], np.float32)
+    target = np.asarray([[1, 9], [0, 9]], np.float32)
+    value, count = TreeNNAccuracy()(out, target).result()
+    assert count == 2 and value == 1.0
+    # 2-d single sample: first row is the root
+    out2 = np.asarray([[0.1, 0.9], [0.9, 0.1]], np.float32)
+    value2, count2 = TreeNNAccuracy()(out2, np.asarray([[2.0]])).result()
+    assert count2 == 1 and value2 == 1.0

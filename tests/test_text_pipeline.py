@@ -1,0 +1,109 @@
+"""Text pipeline tests (reference: dataset/text/ SentenceTokenizer.scala:35,
+Dictionary.scala, TextToLabeledSentence.scala, LabeledSentenceToSample.scala;
+PTB path of example/languagemodel/PTBWordLM.scala)."""
+import numpy as np
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.dataset import (
+    DataSet, Dictionary, LabeledSentenceToSample, Sample, SampleToMiniBatch,
+    SentenceBiPadding, SentenceSplitter, SentenceTokenizer,
+    TextToLabeledSentence, load_ptb, ptb_arrays, tokenize,
+    SENTENCE_START, SENTENCE_END)
+
+CORPUS = """the quick brown fox jumps over the lazy dog .
+the dog barks at the quick fox .
+a lazy cat sleeps near the brown dog ."""
+
+
+def test_tokenize_basic():
+    assert tokenize("Don't stop, World!") == \
+        ["don't", "stop", ",", "world", "!"]
+
+
+def test_sentence_splitter_and_tokenizer():
+    text = "First one. Second two!  Third three?"
+    sents = list(SentenceSplitter().apply(iter([text])))
+    assert len(sents) == 3
+    toks = list(SentenceTokenizer().apply(iter(sents)))
+    assert toks[0] == ["first", "one", "."]
+    padded = list(SentenceBiPadding().apply(iter(toks)))
+    assert padded[0][0] == SENTENCE_START
+    assert padded[0][-1] == SENTENCE_END
+
+
+def test_dictionary_vocab_limit_and_unk():
+    sents = [tokenize(l) for l in CORPUS.splitlines()]
+    d = Dictionary(sents, vocab_size=5)
+    assert len(d.word2index) == 5
+    # "the" is the most frequent word -> index 1
+    assert d.get_index("the") == 1
+    # out-of-vocab words share the single unk index = vocab_size
+    assert d.get_index("zebra") == d.unk_index() == d.vocab_size()
+    assert d.get_word(d.get_index("the")) == "the"
+
+
+def test_dictionary_save_load(tmp_path):
+    d = Dictionary([tokenize(l) for l in CORPUS.splitlines()])
+    p = str(tmp_path / "dict.json")
+    d.save(p)
+    d2 = Dictionary.load(p)
+    assert d2.word2index == d.word2index
+    assert d2.get_word(d.get_index("fox")) == "fox"
+
+
+def test_text_to_labeled_sentence_and_sample():
+    sents = [tokenize(l) for l in CORPUS.splitlines()]
+    d = Dictionary(sents)
+    ls = list(TextToLabeledSentence(d).apply(iter(sents)))
+    # label is data shifted by one
+    np.testing.assert_array_equal(ls[0].data[1:], ls[0].label[:-1])
+    samples = list(LabeledSentenceToSample(fixed_length=6).apply(iter(ls)))
+    assert all(s.feature().shape == (6,) for s in samples)
+    onehots = list(LabeledSentenceToSample(
+        one_hot_size=d.vocab_size(), fixed_length=6).apply(iter(ls)))
+    f = onehots[0].feature()
+    assert f.shape == (6, d.vocab_size())
+    np.testing.assert_allclose(f.sum(axis=1), 1.0)
+    # one-hot position encodes the 1-based index
+    assert np.argmax(f[0]) + 1 == ls[0].data[0]
+
+
+def test_ptb_arrays_contiguity():
+    # stream 1..25, batch 2, steps 3
+    x, y = ptb_arrays(np.arange(1, 26, dtype=np.float32), 2, 3)
+    assert x.shape == y.shape == (8, 3)
+    np.testing.assert_array_equal(y, x + 1)  # next-word labels
+    # row 0 of consecutive batches continues the same stream position
+    np.testing.assert_array_equal(x[0], [1, 2, 3])
+    np.testing.assert_array_equal(x[2], [4, 5, 6])  # continuation of row 0
+
+
+def test_load_ptb_end_to_end_lm_training(tmp_path):
+    """PTB LSTM trains end-to-end from raw text (BASELINE config 5 shape;
+    PTBWordLM.scala) — loss (log-perplexity) must drop."""
+    p = tmp_path / "ptb.train.txt"
+    p.write_text("\n".join([CORPUS] * 8))
+    splits, d = load_ptb(str(p), vocab_size=50)
+    V = d.vocab_size()
+    num_steps, batch = 5, 4
+    x, y = ptb_arrays(splits["train"], batch, num_steps)
+    samples = [Sample(x[i], y[i]) for i in range(len(x))]
+    ds = DataSet.array(samples).transform(SampleToMiniBatch(batch))
+
+    from bigdl_tpu.models import PTBModel
+    from bigdl_tpu.optim import LocalOptimizer, SGD, max_epoch
+
+    model = PTBModel(V, 16, V, num_layers=1, keep_prob=2.0)
+    crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion())
+    model.ensure_initialized()
+    out, _ = model.apply(model.get_parameters(), model.get_state(), x,
+                         training=False)
+    initial_loss = float(crit.apply(out, y))
+
+    opt = LocalOptimizer(model, ds, crit, batch_size=batch)
+    opt.set_optim_method(SGD(learning_rate=0.5))
+    opt.set_end_when(max_epoch(8))
+    opt.optimize()
+    final_loss = opt.driver_state["Loss"]
+    assert final_loss < initial_loss  # perplexity exp(loss) improves
+    assert np.exp(final_loss) < d.vocab_size()  # beats uniform guessing

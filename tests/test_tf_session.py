@@ -1,0 +1,140 @@
+"""TF Session training: train an imported (unfrozen) GraphDef with
+Variables (reference: utils/tf/Session.scala:53,104-110 BigDLSessionImpl
+— Variables become trainable weights, the graph's loss node is
+minimized)."""
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from bigdl_tpu.dataset.sample import MiniBatch
+from bigdl_tpu.optim import SGD
+from bigdl_tpu.utils.tf_loader import Session, TFModule, parse_graphdef
+
+
+def _linear_graph():
+    """v1 graph: loss = mean((x @ W + b - y)^2) with Variable W, b."""
+    with tf.compat.v1.Graph().as_default() as g:
+        x = tf.compat.v1.placeholder(tf.float32, [32, 4], name="x")
+        y = tf.compat.v1.placeholder(tf.float32, [32, 1], name="y")
+        W = tf.compat.v1.get_variable(
+            "W", initializer=tf.constant(np.zeros((4, 1), np.float32)))
+        b = tf.compat.v1.get_variable(
+            "b", initializer=tf.constant(np.zeros((1,), np.float32)))
+        pred = tf.add(tf.matmul(x, W), b, name="pred")
+        tf.reduce_mean(tf.square(pred - y), name="loss")
+        return g.as_graph_def().SerializeToString()
+
+
+def _toy_data(n=256, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 4).astype(np.float32)
+    w_true = np.asarray([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+    Y = X @ w_true + 0.7
+    return X, Y, w_true
+
+
+def test_session_imports_variables():
+    nodes = parse_graphdef(_linear_graph())
+    mod = TFModule(nodes, inputs=["x", "y"], outputs=["loss"])
+    assert set(mod.variable_init) == {"W", "b"}
+    assert mod.variable_init["W"].shape == (4, 1)
+
+
+def test_session_trains_imported_graph_to_lower_loss():
+    X, Y, w_true = _toy_data()
+    sess = Session(_linear_graph(), inputs=["x", "y"], loss="loss")
+
+    def batches():
+        while True:
+            for i in range(0, len(X), 32):
+                yield MiniBatch(X[i:i + 32], Y[i:i + 32])
+
+    mod = sess.train(batches(), SGD(learning_rate=0.1),
+                     max_iterations=200)
+    assert sess.last_loss is not None and sess.last_loss < 1e-2
+    # learned weights approach the generating ones
+    W = np.asarray(mod.get_parameters()["W"])
+    np.testing.assert_allclose(W, w_true, atol=0.05)
+    b = float(np.asarray(mod.get_parameters()["b"]).reshape(()))
+    assert b == pytest.approx(0.7, abs=0.05)
+
+
+def test_trained_graph_predicts_through_pred_node():
+    X, Y, _ = _toy_data()
+    sess = Session(_linear_graph(), inputs=["x", "y"], loss="loss")
+
+    def batches():
+        while True:
+            for i in range(0, len(X), 32):
+                yield MiniBatch(X[i:i + 32], Y[i:i + 32])
+
+    sess.train(batches(), SGD(learning_rate=0.1), max_iterations=200)
+    # rebuild an inference view on the SAME trained params
+    infer = TFModule(parse_graphdef(_linear_graph()), inputs=["x"],
+                     outputs=["pred"])
+    infer.set_parameters(sess.module.get_parameters())
+    infer.ensure_initialized()
+    pred = np.asarray(infer.forward([X[:32], np.zeros((32, 1), np.float32)]))
+    np.testing.assert_allclose(pred, Y[:32], atol=0.1)
+
+
+def test_session_rejects_frozen_graph():
+    @tf.function
+    def f(x):
+        return x * 2.0
+
+    from tensorflow.python.framework.convert_to_constants import (
+        convert_variables_to_constants_v2)
+    conc = f.get_concrete_function(tf.TensorSpec([2], tf.float32))
+    gd = convert_variables_to_constants_v2(conc).graph.as_graph_def()
+    with pytest.raises(ValueError, match="no Variables"):
+        Session(gd.SerializeToString(), inputs=["x"], loss="Identity")
+
+
+def test_random_initializer_is_evaluated_not_zeroed():
+    """tf.truncated_normal initializers must produce non-zero inits (a
+    silent zeros fallback would make training fail symmetrically)."""
+    with tf.compat.v1.Graph().as_default() as g:
+        x = tf.compat.v1.placeholder(tf.float32, [8, 4], name="x")
+        W = tf.compat.v1.get_variable(
+            "W", initializer=tf.random.truncated_normal([4, 3],
+                                                        stddev=0.5))
+        tf.matmul(x, W, name="out")
+        gd = g.as_graph_def().SerializeToString()
+    mod = TFModule(parse_graphdef(gd), inputs=["x"], outputs=["out"])
+    W0 = mod.variable_init["W"]
+    assert W0.shape == (4, 3)
+    assert np.abs(W0).max() > 0  # not the zeros fallback
+
+
+def test_session_epoch_size_enables_epoch_trigger():
+    from bigdl_tpu.optim import max_epoch
+
+    X, Y, _ = _toy_data(64)
+    sess = Session(_linear_graph(), inputs=["x", "y"], loss="loss")
+
+    def batches():
+        while True:
+            for i in range(0, len(X), 32):
+                yield MiniBatch(X[i:i + 32], Y[i:i + 32])
+
+    sess.train(batches(), SGD(learning_rate=0.05),
+               end_trigger=max_epoch(3), epoch_size=2)
+    # 2 iters/epoch * 3 epochs = 6 steps, then the trigger fires
+    assert sess.module is not None
+
+
+def test_while_loop_cycle_raises():
+    tf.compat.v1.disable_control_flow_v2()
+    with tf.compat.v1.Graph().as_default() as g:
+        x = tf.compat.v1.placeholder(tf.float32, [], name="x")
+        tf.while_loop(lambda v: v < 10.0, lambda v: v + 1.0, [x],
+                      name="loop")
+        gd = g.as_graph_def().SerializeToString()
+    tf.compat.v1.enable_control_flow_v2()
+    nodes = parse_graphdef(gd)
+    out = [n.name for n in nodes if n.op == "Exit"][0]
+    mod = TFModule(nodes, inputs=["x"], outputs=[out]).evaluate()
+    with pytest.raises(ValueError, match="cycle|Merge"):
+        mod.forward(np.asarray(0.0, np.float32))

@@ -120,6 +120,30 @@ def test_validation_and_triggers():
     assert "score" in opt.driver_state
 
 
+def test_validation_score_uses_first_method():
+    """driver_state['score'] must be the FIRST validation method's result
+    (DistriOptimizer.scala:382-397 uses head) — not a max() across
+    heterogeneous methods, which with Loss in the set would exceed any
+    accuracy and corrupt maxScore/Plateau decisions."""
+    from bigdl_tpu.optim import Loss, every_epoch
+
+    X, y = _toy_classification(n=128)
+    samples = [Sample(X[i], y[i]) for i in range(len(X))]
+    ds = DataSet.array(samples).transform(SampleToMiniBatch(32))
+    val = DataSet.array(samples)
+
+    model = nn.Sequential().add(nn.Linear(8, 3)).add(nn.LogSoftMax())
+    opt = LocalOptimizer(model, ds, nn.ClassNLLCriterion(), batch_size=32)
+    opt.set_optim_method(SGD(learning_rate=0.1))
+    opt.set_end_when(max_epoch(1))
+    # First method is Top1 (<=1.0); Loss of an untrained 3-class model is
+    # ~ln(3) > 1, so max() across both would pick the Loss value.
+    opt.set_validation(every_epoch(), val,
+                       [Top1Accuracy(), Loss(nn.ClassNLLCriterion())])
+    opt.optimize()
+    assert opt.driver_state["score"] <= 1.0
+
+
 def test_failure_retry_from_checkpoint(tmp_path):
     """Fault injection (reference ExceptionTest / DistriOptimizerSpec:461):
     a layer that throws at a scripted iteration; training must resume from
