@@ -2,6 +2,9 @@
 DLEstimator.scala:54, DLClassifier.scala:37 — Spark ML wrappers whose
 fit() runs the Optimizer and whose model transform() does batched
 predict). The TPU build exposes the same contract sklearn-style."""
-from bigdl_tpu.ml.estimator import DLClassifier, DLClassifierModel, DLEstimator, DLModel
+from bigdl_tpu.ml.estimator import (DLClassifier, DLClassifierModel,
+                                    DLEstimator, DLModel,
+                                    VectorAssembler)
 
-__all__ = ["DLEstimator", "DLModel", "DLClassifier", "DLClassifierModel"]
+__all__ = ["DLEstimator", "DLModel", "DLClassifier",
+           "DLClassifierModel", "VectorAssembler"]

@@ -16,14 +16,38 @@ import numpy as np
 from bigdl_tpu.nn.module import Criterion, Module
 
 
+class VectorAssembler:
+    """Assemble named columns into one feature matrix — the role
+    org.apache.spark.ml.feature.VectorAssembler plays ahead of
+    DLEstimator in reference pipelines. Accepts a dict of name->column,
+    a pandas DataFrame, or a numpy structured array."""
+
+    def __init__(self, input_cols: Sequence[str]):
+        self.input_cols = list(input_cols)
+
+    def transform(self, data) -> np.ndarray:
+        cols = []
+        for name in self.input_cols:
+            col = np.asarray(data[name], np.float32)
+            cols.append(col.reshape(len(col), -1))
+        return np.concatenate(cols, axis=1)
+
+
 class DLEstimator:
-    """Trains ``model`` against ``criterion`` on (X, y) arrays."""
+    """Trains ``model`` against ``criterion`` on (X, y) arrays.
+
+    ``feature_cols``/``label_col`` enable column-wise input (dicts,
+    DataFrames) assembled via :class:`VectorAssembler`, mirroring the
+    reference's ML-pipeline column handling (DLEstimator.scala:54's
+    featuresCol/labelCol params)."""
 
     def __init__(self, model: Module, criterion: Criterion,
                  feature_size: Optional[Sequence[int]] = None,
                  label_size: Optional[Sequence[int]] = None,
                  batch_size: int = 32, max_epoch: int = 10,
-                 learning_rate: float = 1e-3, optim_method=None):
+                 learning_rate: float = 1e-3, optim_method=None,
+                 feature_cols: Optional[Sequence[str]] = None,
+                 label_col: Optional[str] = None):
         self.model = model
         self.criterion = criterion
         self.feature_size = list(feature_size) if feature_size else None
@@ -32,6 +56,16 @@ class DLEstimator:
         self.max_epoch = max_epoch
         self.learning_rate = learning_rate
         self.optim_method = optim_method
+        self.feature_cols = list(feature_cols) if feature_cols else None
+        self.label_col = label_col
+
+    def _columns(self, X, y):
+        if self.feature_cols is not None:
+            assembled = VectorAssembler(self.feature_cols).transform(X)
+            if y is None and self.label_col is not None:
+                y = np.asarray(X[self.label_col], np.float32)
+            return assembled, y
+        return X, y
 
     # -- sklearn plumbing ---------------------------------------------------
     def get_params(self, deep: bool = True):
@@ -40,7 +74,9 @@ class DLEstimator:
                 "label_size": self.label_size,
                 "batch_size": self.batch_size, "max_epoch": self.max_epoch,
                 "learning_rate": self.learning_rate,
-                "optim_method": self.optim_method}
+                "optim_method": self.optim_method,
+                "feature_cols": self.feature_cols,
+                "label_col": self.label_col}
 
     def set_params(self, **kw):
         for k, v in kw.items():
@@ -48,11 +84,12 @@ class DLEstimator:
         return self
 
     # -- training -----------------------------------------------------------
-    def fit(self, X, y) -> "DLModel":
+    def fit(self, X, y=None) -> "DLModel":
         from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
         from bigdl_tpu.optim import SGD
         from bigdl_tpu.optim.optimizer import LocalOptimizer
         from bigdl_tpu.optim.trigger import max_epoch as max_epoch_trigger
+        X, y = self._columns(X, y)
         X = np.asarray(X, np.float32)
         y = np.asarray(y, np.float32)
         if self.feature_size:
@@ -72,7 +109,8 @@ class DLEstimator:
 
     def _make_model(self, trained: Module) -> "DLModel":
         return DLModel(trained, feature_size=self.feature_size,
-                       batch_size=self.batch_size)
+                       batch_size=self.batch_size,
+                       feature_cols=self.feature_cols)
 
 
 class DLModel:
@@ -80,12 +118,16 @@ class DLModel:
 
     def __init__(self, model: Module,
                  feature_size: Optional[Sequence[int]] = None,
-                 batch_size: int = 32):
+                 batch_size: int = 32,
+                 feature_cols: Optional[Sequence[str]] = None):
         self.model = model
         self.feature_size = list(feature_size) if feature_size else None
         self.batch_size = batch_size
+        self.feature_cols = list(feature_cols) if feature_cols else None
 
     def transform(self, X) -> np.ndarray:
+        if self.feature_cols is not None and not isinstance(X, np.ndarray):
+            X = VectorAssembler(self.feature_cols).transform(X)
         X = np.asarray(X, np.float32)
         if self.feature_size:
             X = X.reshape([-1] + self.feature_size)
@@ -105,7 +147,8 @@ class DLClassifier(DLEstimator):
 
     def _make_model(self, trained: Module) -> "DLClassifierModel":
         return DLClassifierModel(trained, feature_size=self.feature_size,
-                                 batch_size=self.batch_size)
+                                 batch_size=self.batch_size,
+                                 feature_cols=self.feature_cols)
 
 
 class DLClassifierModel(DLModel):

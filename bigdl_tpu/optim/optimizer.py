@@ -196,7 +196,8 @@ class Optimizer:
         return self
 
     def set_checkpoint(self, path: str, trigger: Trigger) -> "Optimizer":
-        os.makedirs(path, exist_ok=True)
+        from bigdl_tpu.utils import file_io
+        file_io.makedirs(path)
         self.checkpoint_path = path
         self.checkpoint_trigger = trigger
         return self
@@ -390,7 +391,25 @@ class Optimizer:
 
         ds_size = self.dataset.size()
         state = self.driver_state
-        data_iter = self.dataset.data(train=True)
+        # Device-cached feed (DeviceCachedArrayDataSet): the batch is
+        # sampled + augmented INSIDE the jitted step — zero per-step
+        # host->device traffic (the HBM form of the reference's decoded
+        # executor cache, DataSet.scala CachedDistriDataSet:240).
+        device_feed = hasattr(self.dataset, "batch_fn")
+        if device_feed:
+            ds = self.dataset
+
+            def _fused(p, o, m, key, lr):
+                kb, kr = jax.random.split(key)
+                x, y = ds.batch_fn(kb)
+                return step(p, o, m, kr, lr, x, y)
+
+            # donate like build_train_step does — inner-jit donation is
+            # ignored when traced inside an outer jit
+            fused_step = jax.jit(_fused, donate_argnums=(0, 1, 2))
+            data_iter = None
+        else:
+            data_iter = self.dataset.data(train=True)
         end_when = self.end_when
         if end_when is None:
             from bigdl_tpu.optim.trigger import max_epoch
@@ -399,19 +418,26 @@ class Optimizer:
         wall_start = time.time()
         while not end_when(state):
             t0 = time.time()
-            batch = next(data_iter)
-            if not isinstance(batch, MiniBatch):
-                raise ValueError(
-                    "dataset must yield MiniBatch; add SampleToMiniBatch")
-            inp, tgt = self._prep_io(batch)
-            bsz = batch.size()
+            if device_feed:
+                bsz = self.dataset.batch_size
+                step_args = ()
+                run_step = fused_step
+            else:
+                batch = next(data_iter)
+                if not isinstance(batch, MiniBatch):
+                    raise ValueError(
+                        "dataset must yield MiniBatch; add SampleToMiniBatch")
+                inp, tgt = self._prep_io(batch)
+                bsz = batch.size()
+                step_args = (inp, tgt)
+                run_step = step
             t_data = time.time() - t0
 
             lr = self.optim_method.update_hyper_parameter()
             rng = RandomGenerator.next_key()
             t1 = time.time()
-            params, opt_state, model_state, loss = step(
-                params, opt_state, model_state, rng, lr, inp, tgt)
+            params, opt_state, model_state, loss = run_step(
+                params, opt_state, model_state, rng, lr, *step_args)
             loss_f = float(loss)
             t_compute = time.time() - t1
 
@@ -454,8 +480,9 @@ class Optimizer:
                 state["epoch"] += 1
                 self.optim_method.state["epoch"] = state["epoch"]
                 state["recordsProcessedThisEpoch"] = 0
-                self.dataset.shuffle()
-                data_iter = self.dataset.data(train=True)
+                if not device_feed:  # cached feed samples fresh each step
+                    self.dataset.shuffle()
+                    data_iter = self.dataset.data(train=True)
 
             # validation / checkpoint triggers (:382-411)
             if (self.validation_trigger is not None

@@ -1,0 +1,65 @@
+"""Scheme-aware file IO (reference: utils/File.scala — checkpoint and
+model files on local disk, HDFS or S3).
+
+Local paths use the standard library; any path with a ``scheme://``
+(hdfs://, s3://, gs://, ...) routes through fsspec, whose installed
+filesystem implementations provide the transport. All checkpoint and
+module save/load paths in bigdl_tpu funnel through these helpers, so
+remote storage works everywhere the reference's File.saveToHdfs did.
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import List
+
+_SCHEME_RE = re.compile(r"^[a-zA-Z][a-zA-Z0-9+.-]*://")
+
+
+def is_remote(path: str) -> bool:
+    return bool(_SCHEME_RE.match(path)) and not path.startswith("file://")
+
+
+def _fs(path: str):
+    import fsspec
+    return fsspec.core.url_to_fs(path)[0]
+
+
+def open_file(path: str, mode: str = "r"):
+    if is_remote(path):
+        import fsspec
+        return fsspec.open(path, mode).open()
+    return open(path, mode)
+
+
+def makedirs(path: str) -> None:
+    if is_remote(path):
+        _fs(path).makedirs(path, exist_ok=True)
+    else:
+        os.makedirs(path, exist_ok=True)
+
+
+def exists(path: str) -> bool:
+    if is_remote(path):
+        return _fs(path).exists(path)
+    return os.path.exists(path)
+
+
+def isdir(path: str) -> bool:
+    if is_remote(path):
+        return _fs(path).isdir(path)
+    return os.path.isdir(path)
+
+
+def listdir(path: str) -> List[str]:
+    """Base names of entries in a directory (local or remote)."""
+    if is_remote(path):
+        return [p.rstrip("/").rsplit("/", 1)[-1]
+                for p in _fs(path).ls(path, detail=False)]
+    return os.listdir(path)
+
+
+def join(path: str, *parts: str) -> str:
+    if is_remote(path):
+        return "/".join([path.rstrip("/")] + [p.strip("/") for p in parts])
+    return os.path.join(path, *parts)

@@ -16,6 +16,8 @@ from typing import Any, Dict, Optional
 import jax
 import numpy as np
 
+from bigdl_tpu.utils import file_io
+
 
 def _flatten_with_paths(tree):
     flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
@@ -69,19 +71,22 @@ def _flatten_leaves(tree, prefix=""):
 
 
 def save_tree(path_prefix: str, tree) -> None:
-    """Save a pytree as <prefix>.json + <prefix>.npz."""
+    """Save a pytree as <prefix>.json + <prefix>.npz (local or remote —
+    utils/File.scala's HDFS/S3 role via file_io)."""
     arrays = _flatten_leaves(tree)
     template = _tree_to_template(tree)
-    with open(path_prefix + ".json", "w") as f:
+    with file_io.open_file(path_prefix + ".json", "w") as f:
         json.dump(template, f)
-    np.savez(path_prefix + ".npz", **arrays)
+    with file_io.open_file(path_prefix + ".npz", "wb") as f:
+        np.savez(f, **arrays)
 
 
 def load_tree(path_prefix: str):
-    with open(path_prefix + ".json") as f:
+    with file_io.open_file(path_prefix + ".json") as f:
         template = json.load(f)
-    with np.load(path_prefix + ".npz") as z:
-        arrays = {k: z[k] for k in z.files}
+    with file_io.open_file(path_prefix + ".npz", "rb") as f:
+        with np.load(f) as z:
+            arrays = {k: z[k] for k in z.files}
     return _rebuild(template, arrays)
 
 
@@ -89,23 +94,23 @@ def save_checkpoint(path: str, *, params, opt_state, model_state,
                     optim_host_state: Dict[str, Any],
                     driver_state: Dict[str, Any]) -> None:
     """Checkpoint a training run (DistriOptimizer.checkpoint :433-463)."""
-    os.makedirs(path, exist_ok=True)
-    save_tree(os.path.join(path, "params"), params)
-    save_tree(os.path.join(path, "opt_state"), opt_state)
-    save_tree(os.path.join(path, "model_state"), model_state)
+    file_io.makedirs(path)
+    save_tree(file_io.join(path, "params"), params)
+    save_tree(file_io.join(path, "opt_state"), opt_state)
+    save_tree(file_io.join(path, "model_state"), model_state)
     host = {"optim_host_state": optim_host_state,
             "driver_state": driver_state}
-    with open(os.path.join(path, "host_state.json"), "w") as f:
+    with file_io.open_file(file_io.join(path, "host_state.json"), "w") as f:
         json.dump(host, f)
 
 
 def load_checkpoint(path: str) -> Dict[str, Any]:
-    with open(os.path.join(path, "host_state.json")) as f:
+    with file_io.open_file(file_io.join(path, "host_state.json")) as f:
         host = json.load(f)
     return {
-        "params": load_tree(os.path.join(path, "params")),
-        "opt_state": load_tree(os.path.join(path, "opt_state")),
-        "model_state": load_tree(os.path.join(path, "model_state")),
+        "params": load_tree(file_io.join(path, "params")),
+        "opt_state": load_tree(file_io.join(path, "opt_state")),
+        "model_state": load_tree(file_io.join(path, "model_state")),
         "optim_host_state": host["optim_host_state"],
         "driver_state": host["driver_state"],
     }
@@ -113,12 +118,12 @@ def load_checkpoint(path: str) -> Dict[str, Any]:
 
 def find_latest_checkpoint(directory: str) -> Optional[str]:
     """Latest ``checkpoint.N`` dir (DistriOptimizer.getLatestFile :867-880)."""
-    if not os.path.isdir(directory):
+    if not file_io.isdir(directory):
         return None
     best, best_n = None, -1
-    for name in os.listdir(directory):
-        full = os.path.join(directory, name)
-        if not os.path.isdir(full):
+    for name in file_io.listdir(directory):
+        full = file_io.join(directory, name)
+        if not file_io.isdir(full):
             continue
         if name == "checkpoint":
             n = 0
@@ -127,8 +132,8 @@ def find_latest_checkpoint(directory: str) -> Optional[str]:
             if not m:
                 continue
             n = int(m.group(1))
-        if n >= best_n and os.path.exists(
-                os.path.join(full, "host_state.json")):
+        if n >= best_n and file_io.exists(
+                file_io.join(full, "host_state.json")):
             best, best_n = full, n
     return best
 
@@ -144,20 +149,20 @@ def save_module(path: str, module) -> None:
     ``Module.loadModule`` (utils/serializer/ModuleLoader.scala).
     """
     from bigdl_tpu.utils.module_serializer import to_spec
-    os.makedirs(path, exist_ok=True)
+    file_io.makedirs(path)
     module.ensure_initialized()
-    save_tree(os.path.join(path, "params"), module.get_parameters())
-    save_tree(os.path.join(path, "state"), module.get_state())
+    save_tree(file_io.join(path, "params"), module.get_parameters())
+    save_tree(file_io.join(path, "state"), module.get_state())
     meta = {"class": type(module).__name__, "name": module.get_name(),
             "spec": to_spec(module), "format_version": 1}
-    with open(os.path.join(path, "module.json"), "w") as f:
+    with file_io.open_file(file_io.join(path, "module.json"), "w") as f:
         json.dump(meta, f)
 
 
 def load_module(path: str):
     """Rebuild a module (topology + weights) saved by ``save_module``."""
     from bigdl_tpu.utils.module_serializer import from_spec
-    with open(os.path.join(path, "module.json")) as f:
+    with file_io.open_file(file_io.join(path, "module.json")) as f:
         meta = json.load(f)
     module = from_spec(meta["spec"])
     return load_module_weights(path, module)
@@ -165,6 +170,6 @@ def load_module(path: str):
 
 def load_module_weights(path: str, module):
     """Load params/state saved by save_module into a compatible module."""
-    module.set_parameters(load_tree(os.path.join(path, "params")))
-    module.set_state(load_tree(os.path.join(path, "state")))
+    module.set_parameters(load_tree(file_io.join(path, "params")))
+    module.set_state(load_tree(file_io.join(path, "state")))
     return module

@@ -50,3 +50,35 @@ def test_sklearn_params_contract():
     assert params["batch_size"] == 32
     est.set_params(batch_size=64)
     assert est.batch_size == 64
+
+
+def test_vector_assembler_and_column_fit():
+    """VectorAssembler-style column handling (reference ML-pipeline
+    featuresCol/labelCol params, DLEstimator.scala:54)."""
+    import numpy as np
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.ml import DLClassifier, VectorAssembler
+
+    rng = np.random.RandomState(0)
+    n = 96
+    data = {
+        "age": rng.rand(n).astype(np.float32),
+        "income": rng.rand(n, 2).astype(np.float32),  # multi-dim column
+    }
+    # label depends on the assembled features
+    feats = VectorAssembler(["age", "income"]).transform(data)
+    assert feats.shape == (n, 3)
+    label = 1.0 + (feats.sum(axis=1) > 1.5).astype(np.float32)
+    data["label"] = label
+
+    model = nn.Sequential().add(nn.Linear(3, 2)).add(nn.LogSoftMax())
+    est = DLClassifier(model, nn.ClassNLLCriterion(),
+                       feature_cols=["age", "income"], label_col="label",
+                       batch_size=16, max_epoch=30, learning_rate=0.5)
+    fitted = est.fit(data)  # label pulled from the label_col
+    acc = fitted.score(feats, label)
+    assert acc > 0.85
+    # the fitted model accepts the SAME column-wise input
+    acc2 = fitted.score(data, label)
+    assert acc2 == acc
