@@ -1,0 +1,74 @@
+"""Model validation example (reference: example/loadmodel/ModelValidator.scala
+— load a BigDL/Caffe/Torch/TF model and evaluate Top1/Top5 on a labeled
+image folder).
+
+    python examples/load_model.py --model-type caffe \
+        --def net.prototxt --model net.caffemodel -f /data/val
+    python examples/load_model.py --model-type bigdl --model saved_dir \
+        --synthetic 64 --classes 10 --size 32
+"""
+from __future__ import annotations
+
+import argparse
+
+
+def load(model_type, model_path, def_path=None):
+    if model_type == "bigdl":
+        from bigdl_tpu.utils.serialization import load_module
+        return load_module(model_path)
+    if model_type == "caffe":
+        from bigdl_tpu.utils.caffe import load_caffe
+        return load_caffe(def_path=def_path, model_path=model_path)
+    if model_type == "torch":
+        from bigdl_tpu.utils.torch_file import load_torch_model
+        return load_torch_model(model_path)
+    if model_type in ("tf", "tensorflow"):
+        from bigdl_tpu.utils.tf_loader import load_tf_graph
+        return load_tf_graph(model_path)
+    raise ValueError(model_type)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--model-type", required=True,
+                    choices=["bigdl", "caffe", "torch", "tf", "tensorflow"])
+    ap.add_argument("--model", required=True)
+    ap.add_argument("--def", dest="def_path", default=None)
+    ap.add_argument("-f", "--folder", default=None,
+                    help="labeled image folder (class subdirs)")
+    ap.add_argument("-b", "--batchSize", type=int, default=32)
+    ap.add_argument("--crop", type=int, default=224)
+    ap.add_argument("--scale", type=int, default=256)
+    ap.add_argument("--synthetic", type=int, default=0)
+    ap.add_argument("--classes", type=int, default=1000)
+    ap.add_argument("--size", type=int, default=224)
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    from bigdl_tpu.optim import Evaluator, Top1Accuracy, Top5Accuracy
+
+    model = load(args.model_type, args.model, args.def_path).evaluate()
+
+    if args.synthetic:
+        from bigdl_tpu.dataset import DataSet, Sample
+        rng = np.random.RandomState(0)
+        samples = [Sample(rng.rand(3, args.size, args.size)
+                          .astype(np.float32),
+                          float(rng.randint(1, args.classes + 1)))
+                   for _ in range(args.synthetic)]
+        ds = DataSet.array(samples)
+    else:
+        from bigdl_tpu.dataset import ImageFolderDataSet
+        ds = ImageFolderDataSet(args.folder, batch_size=args.batchSize,
+                                crop=args.crop, scale=args.scale)
+
+    results = Evaluator(model).test(
+        ds, [Top1Accuracy(), Top5Accuracy()], batch_size=args.batchSize)
+    for name, r in results.items():
+        print(f"{name}: {r}")
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,117 @@
+"""Text classification example (reference: example/textclassification —
+GloVe-embedding + CNN over 20-newsgroups; TextClassifier.scala).
+
+Pipeline: SentenceTokenizer -> Dictionary -> index sequences ->
+LookupTable embedding -> TemporalConvolution -> max-over-time pooling ->
+Linear -> LogSoftMax.
+
+    python examples/text_classification.py --synthetic 400
+    python examples/text_classification.py -f /data/20news --classes 20
+"""
+from __future__ import annotations
+
+import argparse
+import os
+
+
+def encode_text_ids(tokens, dictionary, seq_len: int):
+    """tokenize->index->truncate->pad encoding shared by training and the
+    UDF server; pads with the last id (or the unk index when empty)."""
+    import numpy as np
+    V = dictionary.vocab_size()
+    ids = [dictionary.get_index(w) for w in tokens][:seq_len]
+    ids += [ids[-1] if ids else V] * (seq_len - len(ids))
+    return np.asarray(ids, np.float32)
+
+
+def build_model(vocab_size: int, embed_dim: int, class_num: int):
+    import bigdl_tpu.nn as nn
+    m = nn.Sequential()
+    m.add(nn.LookupTable(vocab_size, embed_dim))          # (B,T,E)
+    m.add(nn.TemporalConvolution(embed_dim, 128, 5))      # (B,T-4,128)
+    m.add(nn.ReLU())
+    m.add(nn.Max(2, 3))                                   # max over time
+    m.add(nn.Linear(128, class_num))
+    m.add(nn.LogSoftMax())
+    return m
+
+
+def synthetic_corpus(n, classes, rng):
+    """Class-correlated word streams: class c prefers tokens c*40..c*40+39."""
+    texts, labels = [], []
+    for i in range(n):
+        c = i % classes
+        base = ["w%d" % (c * 40 + int(v)) for v in rng.randint(0, 40, 30)]
+        noise = ["w%d" % int(v) for v in rng.randint(0, classes * 40, 10)]
+        words = list(rng.permutation(base + noise))
+        texts.append(" ".join(words))
+        labels.append(float(c + 1))
+    return texts, labels
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-f", "--folder", default=None,
+                    help="folder of <class>/<file>.txt documents")
+    ap.add_argument("--classes", type=int, default=4)
+    ap.add_argument("--synthetic", type=int, default=0)
+    ap.add_argument("-b", "--batchSize", type=int, default=32)
+    ap.add_argument("-e", "--maxEpoch", type=int, default=5)
+    ap.add_argument("--vocabSize", type=int, default=5000)
+    ap.add_argument("--seqLen", type=int, default=40)
+    ap.add_argument("--embedDim", type=int, default=32)
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import (DataSet, Dictionary, Sample,
+                                   SampleToMiniBatch, tokenize)
+    from bigdl_tpu.optim import (LocalOptimizer, SGD, Top1Accuracy,
+                                 every_epoch, max_epoch)
+
+    rng = np.random.RandomState(0)
+    if args.synthetic:
+        texts, labels = synthetic_corpus(args.synthetic, args.classes, rng)
+    else:
+        texts, labels = [], []
+        classes = sorted(d for d in os.listdir(args.folder)
+                         if os.path.isdir(os.path.join(args.folder, d)))
+        for ci, cls in enumerate(classes):
+            cdir = os.path.join(args.folder, cls)
+            for fn in sorted(os.listdir(cdir)):
+                with open(os.path.join(cdir, fn), errors="replace") as f:
+                    texts.append(f.read())
+                labels.append(float(ci + 1))
+        args.classes = len(classes)
+
+    token_lists = [tokenize(t) for t in texts]
+    d = Dictionary(token_lists, vocab_size=args.vocabSize)
+    V = d.vocab_size()
+
+    X = np.stack([encode_text_ids(t, d, args.seqLen)
+                  for t in token_lists])
+    y = np.asarray(labels, np.float32)
+    perm = rng.permutation(len(X))
+    X, y = X[perm], y[perm]
+    n_val = max(1, len(X) // 5)
+    ds = DataSet.array([Sample(x, t) for x, t in
+                        zip(X[n_val:], y[n_val:])]) \
+        .transform(SampleToMiniBatch(args.batchSize))
+    val = DataSet.array([Sample(x, t) for x, t in
+                         zip(X[:n_val], y[:n_val])])
+
+    model = build_model(V + 1, args.embedDim, args.classes)
+    opt = LocalOptimizer(model, ds, nn.ClassNLLCriterion(),
+                         batch_size=args.batchSize)
+    opt.set_optim_method(SGD(learning_rate=0.5))
+    opt.set_end_when(max_epoch(args.maxEpoch))
+    opt.set_validation(every_epoch(), val, [Top1Accuracy()])
+    opt.optimize()
+    print(f"final loss {opt.driver_state['Loss']:.4f} "
+          f"val score {opt.driver_state.get('score', float('nan')):.4f}")
+    return opt.driver_state
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,79 @@
+"""UDF predictor example (reference: example/udfpredictor —
+DataframePredictor.scala:25 serves a trained text classifier as a SQL
+UDF). Without Spark SQL, the analogue is a plain predict function
+applied over a column of raw strings — usable from any dataframe
+library (pandas .apply, etc.).
+
+    python examples/udf_predictor.py --demo
+"""
+from __future__ import annotations
+
+import argparse
+from typing import Callable, List, Sequence
+
+
+def make_text_udf(model, dictionary, seq_len: int) -> Callable:
+    """Returns predict(texts) -> 1-based class labels; the UDF closure
+    captures the trained model + vocabulary like the reference's
+    broadcast model."""
+    import numpy as np
+
+    from bigdl_tpu.dataset import tokenize
+    from examples.text_classification import encode_text_ids
+
+    model.evaluate()  # serving: dropout etc. must be inert
+
+    def predict(texts: Sequence[str]) -> List[int]:
+        X = np.stack([encode_text_ids(tokenize(t), dictionary, seq_len)
+                      for t in texts])
+        out = np.asarray(model.forward(X))
+        return (out.argmax(-1) + 1).tolist()
+
+    return predict
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--demo", action="store_true")
+    args = ap.parse_args(argv)
+    if not args.demo:
+        ap.print_help()
+        return
+
+    # train a tiny classifier on the synthetic corpus, then serve it
+    from examples.text_classification import (build_model, encode_text_ids,
+                                              synthetic_corpus)
+    import numpy as np
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import (DataSet, Dictionary, Sample,
+                                   SampleToMiniBatch, tokenize)
+    from bigdl_tpu.optim import LocalOptimizer, SGD, max_epoch
+
+    rng = np.random.RandomState(0)
+    texts, labels = synthetic_corpus(200, 2, rng)
+    token_lists = [tokenize(t) for t in texts]
+    d = Dictionary(token_lists, vocab_size=200)
+    seq_len = 40
+
+    X = np.stack([encode_text_ids(t, d, seq_len) for t in token_lists])
+    y = np.asarray(labels, np.float32)
+    ds = DataSet.array([Sample(x, t) for x, t in zip(X, y)]) \
+        .transform(SampleToMiniBatch(32))
+    model = build_model(d.vocab_size() + 1, 16, 2)
+    opt = LocalOptimizer(model, ds, nn.ClassNLLCriterion(), batch_size=32)
+    opt.set_optim_method(SGD(learning_rate=0.5))
+    opt.set_end_when(max_epoch(6))
+    opt.optimize()
+
+    udf = make_text_udf(model, d, seq_len)
+    demo_texts, demo_labels = synthetic_corpus(8, 2, np.random.RandomState(7))
+    preds = udf(demo_texts)
+    hits = sum(int(p == int(l)) for p, l in zip(preds, demo_labels))
+    print(f"udf predictions: {preds} (labels {[int(l) for l in demo_labels]}"
+          f", {hits}/8 correct)")
+    return preds
+
+
+if __name__ == "__main__":
+    main()
