@@ -129,3 +129,17 @@ class DataSet:
     def sharded(elements, process_index: int = 0, process_count: int = 1
                 ) -> ShardedDataSet:
         return ShardedDataSet(elements, process_index, process_count)
+
+    @staticmethod
+    def image_folder(path: str, **kw):
+        """`DataSet.ImageFolder` (DataSet.scala:408): threaded JPEG
+        decode/augment over a <class>/<img> directory tree."""
+        from bigdl_tpu.dataset.imagenet import ImageFolderDataSet
+        return ImageFolderDataSet(path, **kw)
+
+    @staticmethod
+    def record_shards(shards, **kw):
+        """`DataSet.SeqFileFolder` analogue (DataSet.scala:470-552):
+        feed from packed image-record shard files."""
+        from bigdl_tpu.dataset.imagenet import ImageFolderDataSet
+        return ImageFolderDataSet(record_shards=list(shards), **kw)

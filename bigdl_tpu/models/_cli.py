@@ -37,6 +37,9 @@ def base_parser(desc: str) -> argparse.ArgumentParser:
                     help="stop after N iterations (overrides maxEpoch)")
     ap.add_argument("--synthetic", type=int, default=0, metavar="N",
                     help="train on N random samples instead of -f data")
+    ap.add_argument("--quantize", action="store_true",
+                    help="int8-quantize the model before evaluation "
+                         "(AbstractModule.quantize :708)")
     return ap
 
 
@@ -119,6 +122,8 @@ def evaluate_cli(args, build, val_data, default_batch: int = 128):
     from bigdl_tpu.optim import Evaluator, Top1Accuracy, Top5Accuracy
 
     model = load_model_or(args, build).evaluate()
+    if getattr(args, "quantize", False):
+        model = model.quantize()
     imgs, lbls = val_data
     bs = args.batchSize or default_batch
     ds = arrays_to_dataset(imgs, lbls, bs)

@@ -65,9 +65,18 @@ def _parse_tensor(buf: bytes) -> np.ndarray:
                 else:
                     vals.append(proto.as_sint(raw))
         arr = np.asarray(vals, dtype=dtype)
-    n = int(np.prod(shape)) if shape else arr.size
+    if shape:
+        n = int(np.prod(shape))
+    elif 4 in f and f[4][0]:
+        n = arr.size  # shapeless content-only tensor
+    else:
+        n = max(arr.size, 1)  # no shape field = scalar
     if arr.size == 1 and n > 1:  # scalar splat
         arr = np.full(n, arr[0], dtype=dtype)
+    elif arr.size == 0 and n > 0:
+        # proto3 omits zero values entirely: an all-zeros tensor (incl.
+        # scalar 0.0) arrives with no content
+        arr = np.zeros(n, dtype=dtype)
     return arr.reshape(shape) if shape else (
         arr.reshape(()) if arr.size == 1 else arr)
 
@@ -201,6 +210,23 @@ def _matmul(node, xs):
     return a @ b
 
 
+def _reduce_op(fn):
+    def run(node, xs):
+        return fn(xs[0],
+                  axis=tuple(int(v) for v in np.asarray(xs[1]).ravel()),
+                  keepdims=bool(node.attrs.get("keep_dims", False)))
+    return run
+
+
+def _select_v1(node, xs):
+    cond, t, e = xs
+    cond = jnp.asarray(cond)
+    if cond.ndim == 1 and jnp.ndim(t) > 1:
+        # v1 Select broadcasts a vector cond along axis 0 (row select)
+        cond = cond.reshape((cond.shape[0],) + (1,) * (jnp.ndim(t) - 1))
+    return jnp.where(cond, t, e)
+
+
 _OPS: Dict[str, Callable] = {
     "Identity": lambda n, xs: xs[0],
     "ReadVariableOp": lambda n, xs: xs[0],
@@ -246,15 +272,9 @@ _OPS: Dict[str, Callable] = {
     "PadV2": lambda n, xs: jnp.pad(
         xs[0], [(int(a), int(b)) for a, b in np.asarray(xs[1])],
         constant_values=float(np.asarray(xs[2]))),
-    "Mean": lambda n, xs: jnp.mean(
-        xs[0], axis=tuple(int(v) for v in np.asarray(xs[1]).ravel()),
-        keepdims=bool(n.attrs.get("keep_dims", False))),
-    "Sum": lambda n, xs: jnp.sum(
-        xs[0], axis=tuple(int(v) for v in np.asarray(xs[1]).ravel()),
-        keepdims=bool(n.attrs.get("keep_dims", False))),
-    "Max": lambda n, xs: jnp.max(
-        xs[0], axis=tuple(int(v) for v in np.asarray(xs[1]).ravel()),
-        keepdims=bool(n.attrs.get("keep_dims", False))),
+    "Mean": _reduce_op(jnp.mean),
+    "Sum": _reduce_op(jnp.sum),
+    "Max": _reduce_op(jnp.max),
     "Cast": lambda n, xs: xs[0].astype(n.attrs.get("DstT", np.float32)),
     "Shape": lambda n, xs: jnp.asarray(xs[0].shape, jnp.int32),
     "Conv2D": _conv2d,
@@ -269,6 +289,49 @@ _OPS: Dict[str, Callable] = {
                                        axis=int(xs[2])),
     "Rank": lambda n, xs: jnp.asarray(xs[0].ndim, jnp.int32),
     "NoOp": lambda n, xs: None,
+    # arithmetic/rounding/comparison tail (utils/tf/loaders per-op
+    # importers: Floor.scala, Pow.scala, Greater.scala, Select.scala, ...)
+    "Floor": lambda n, xs: jnp.floor(xs[0]),
+    "Ceil": lambda n, xs: jnp.ceil(xs[0]),
+    "Round": lambda n, xs: jnp.round(xs[0]),
+    "Sign": lambda n, xs: jnp.sign(xs[0]),
+    "Pow": lambda n, xs: jnp.power(xs[0], xs[1]),
+    "SquaredDifference": lambda n, xs: jnp.square(xs[0] - xs[1]),
+    "FloorDiv": lambda n, xs: jnp.floor_divide(xs[0], xs[1]),
+    "FloorMod": lambda n, xs: jnp.mod(xs[0], xs[1]),
+    "Greater": lambda n, xs: xs[0] > xs[1],
+    "GreaterEqual": lambda n, xs: xs[0] >= xs[1],
+    "Less": lambda n, xs: xs[0] < xs[1],
+    "LessEqual": lambda n, xs: xs[0] <= xs[1],
+    "Equal": lambda n, xs: xs[0] == xs[1],
+    "NotEqual": lambda n, xs: xs[0] != xs[1],
+    "LogicalAnd": lambda n, xs: jnp.logical_and(xs[0], xs[1]),
+    "LogicalOr": lambda n, xs: jnp.logical_or(xs[0], xs[1]),
+    "LogicalNot": lambda n, xs: jnp.logical_not(xs[0]),
+    "Select": _select_v1,
+    "SelectV2": lambda n, xs: jnp.where(xs[0], xs[1], xs[2]),
+    "Fill": lambda n, xs: jnp.full(
+        tuple(int(v) for v in np.asarray(xs[0]).ravel()), xs[1]),
+    "Range": lambda n, xs: jnp.arange(np.asarray(xs[0]).item(),
+                                      np.asarray(xs[1]).item(),
+                                      np.asarray(xs[2]).item()),
+    "Tile": lambda n, xs: jnp.tile(
+        xs[0], tuple(int(v) for v in np.asarray(xs[1]).ravel())),
+    "Slice": lambda n, xs: jax.lax.dynamic_slice(
+        xs[0], tuple(int(v) for v in np.asarray(xs[1]).ravel()),
+        tuple(dim - int(b) if int(sz) == -1 else int(sz)  # -1 = to end
+              for dim, b, sz in zip(xs[0].shape,
+                                    np.asarray(xs[1]).ravel(),
+                                    np.asarray(xs[2]).ravel()))),
+    "OneHot": lambda n, xs: jax.nn.one_hot(
+        jnp.asarray(xs[0]).astype(jnp.int32),
+        int(np.asarray(xs[1]))) * (xs[2] - xs[3]) + xs[3],
+    "ZerosLike": lambda n, xs: jnp.zeros_like(xs[0]),
+    "OnesLike": lambda n, xs: jnp.ones_like(xs[0]),
+    "ArgMax": lambda n, xs: jnp.argmax(xs[0], axis=int(np.asarray(xs[1]))),
+    "ArgMin": lambda n, xs: jnp.argmin(xs[0], axis=int(np.asarray(xs[1]))),
+    "Min": _reduce_op(jnp.min),
+    "Prod": _reduce_op(jnp.prod),
 }
 
 

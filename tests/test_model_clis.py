@@ -83,3 +83,12 @@ def test_snapshot_resume_flow(tmp_path):
     model2 = main(["--synthetic", "32", "-b", "16", "--maxIterations", "1",
                    "--model", snap])
     assert model2 is not None
+
+
+def test_lenet_test_cli_quantized(capsys):
+    """--quantize evaluates the int8-rewritten model (ModelValidator's
+    quantized path, example/loadmodel)."""
+    from bigdl_tpu.models.lenet.test import main
+    results = main(["--synthetic", "32", "-b", "16", "--quantize"])
+    out = capsys.readouterr().out
+    assert "Top1Accuracy" in out and results
