@@ -26,6 +26,7 @@ class Engine:
     """
 
     _initialized = False
+    _distributed_started = False
     _mesh: Optional[jax.sharding.Mesh] = None
     _node_number = 1
     _core_number = 1
@@ -53,6 +54,26 @@ class Engine:
         cls._mesh = jax.sharding.Mesh(mesh_devices, tuple(mesh_axes))
         cls._initialized = True
         return cls
+
+    @classmethod
+    def init_distributed(cls, coordinator_address: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None,
+                         **init_kwargs) -> "Engine":
+        """Multi-host bring-up: ``jax.distributed.initialize`` then
+        ``init()`` — the role the reference's Engine.init played on Spark
+        (executor discovery, Engine.scala:100-103). Parameters default to
+        the standard JAX env vars (JAX_COORDINATOR_ADDRESS etc.), so a
+        pod launcher only needs to set the environment.
+        """
+        if not cls._distributed_started:
+            # jax.distributed.initialize is once-per-process and cannot
+            # be undone by Engine.reset()
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes, process_id=process_id)
+            cls._distributed_started = True
+        return cls.init(**init_kwargs)
 
     @classmethod
     def is_initialized(cls) -> bool:

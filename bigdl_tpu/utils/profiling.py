@@ -1,0 +1,74 @@
+"""Profiling utilities (reference: per-module wall-clock accumulation in
+AbstractModule.forward/backward — getTimes :205, resetTimes :209 — and the
+driver-side Metrics dump, SURVEY.md §5).
+
+Under XLA the per-layer forward isn't observable at runtime (the whole
+step is one fused program), so the timing surface splits in two:
+
+- :func:`module_times` — the getTimes analogue: times each child of a
+  Sequential/Graph with an EAGER forward, layer by layer, for quick
+  "where is this model slow" answers. Numbers are eager-mode costs, not
+  fused-step costs.
+- :func:`trace` — the real thing for compiled steps: a context manager
+  around ``jax.profiler`` writing a TensorBoard-loadable trace of the
+  actual fused XLA execution.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import List, Tuple
+
+
+def module_times(model, x, *, repeats: int = 3) -> List[Tuple[str, float]]:
+    """Eager per-child forward times, best-of-``repeats`` seconds.
+
+    Walks one level of a Sequential (or Graph exec order), feeding each
+    child the previous child's output — the reference's getTimes view.
+    """
+    import jax
+
+    import bigdl_tpu.nn as nn
+
+    def best_time(fn):
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            out = fn()
+            jax.block_until_ready(out)
+            best = min(best, time.perf_counter() - t0)
+        return best, out
+
+    results: List[Tuple[str, float]] = []
+    if isinstance(model, nn.Sequential):
+        children = [(m.get_name() or f"{type(m).__name__}#{i}", m)
+                    for i, m in enumerate(model.modules)]
+        cur = x
+        for name, m in children:
+            m.ensure_initialized()
+            dt, cur = best_time(lambda m=m, cur=cur: m.forward(cur))
+            results.append((name, dt))
+    elif isinstance(model, nn.Graph):
+        # whole-graph time only: per-node inputs are graph-internal
+        model.ensure_initialized()
+        dt, _ = best_time(lambda: model.forward(x))
+        results.append((model.get_name() or "Graph", dt))
+    else:
+        model.ensure_initialized()
+        dt, _ = best_time(lambda: model.forward(x))
+        results.append((model.get_name() or type(model).__name__, dt))
+    return results
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Profile the enclosed (compiled) computation with jax.profiler;
+    the trace loads in TensorBoard/Perfetto. This is the fused-step
+    truth the eager getTimes view cannot give."""
+    import jax.profiler
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

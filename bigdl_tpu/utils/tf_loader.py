@@ -65,18 +65,14 @@ def _parse_tensor(buf: bytes) -> np.ndarray:
                 else:
                     vals.append(proto.as_sint(raw))
         arr = np.asarray(vals, dtype=dtype)
-    if shape:
-        n = int(np.prod(shape))
-    elif 4 in f and f[4][0]:
-        n = arr.size  # shapeless content-only tensor
-    else:
-        n = max(arr.size, 1)  # no shape field = scalar
-    if arr.size == 1 and n > 1:  # scalar splat
-        arr = np.full(n, arr[0], dtype=dtype)
-    elif arr.size == 0 and n > 0:
-        # proto3 omits zero values entirely: an all-zeros tensor (incl.
-        # scalar 0.0) arrives with no content
-        arr = np.zeros(n, dtype=dtype)
+    n = int(np.prod(shape)) if shape else max(arr.size, 1)
+    if arr.size < n:
+        # TensorProto compresses trailing repeats: pad with the LAST
+        # stored value (tensor_util.MakeNdarray semantics); an entirely
+        # omitted value list means all zeros (proto3 drops zeros)
+        fill = arr[-1] if arr.size else np.zeros((), dtype=dtype)
+        arr = np.concatenate(
+            [arr, np.full(n - arr.size, fill, dtype=dtype)])
     return arr.reshape(shape) if shape else (
         arr.reshape(()) if arr.size == 1 else arr)
 
@@ -323,9 +319,10 @@ _OPS: Dict[str, Callable] = {
               for dim, b, sz in zip(xs[0].shape,
                                     np.asarray(xs[1]).ravel(),
                                     np.asarray(xs[2]).ravel()))),
-    "OneHot": lambda n, xs: jax.nn.one_hot(
-        jnp.asarray(xs[0]).astype(jnp.int32),
-        int(np.asarray(xs[1]))) * (xs[2] - xs[3]) + xs[3],
+    "OneHot": lambda n, xs: jnp.moveaxis(
+        jax.nn.one_hot(jnp.asarray(xs[0]).astype(jnp.int32),
+                       int(np.asarray(xs[1]))) * (xs[2] - xs[3]) + xs[3],
+        -1, n.attrs.get("axis", -1)),
     "ZerosLike": lambda n, xs: jnp.zeros_like(xs[0]),
     "OnesLike": lambda n, xs: jnp.ones_like(xs[0]),
     "ArgMax": lambda n, xs: jnp.argmax(xs[0], axis=int(np.asarray(xs[1]))),
