@@ -18,11 +18,44 @@ from bigdl_tpu.nn.module import Module
 from bigdl_tpu.utils.engine import Engine
 
 
+def _flash_attention_tpu(q, k, v, causal: bool):
+    """Pallas flash attention — O(S) memory, no materialized [S,S] score
+    matrix (the pallas-kernel fast path the reference's BigQuant C++
+    played for its hot ops). Returns None when the kernel is absent or
+    rejects the shapes at TRACE time; a Mosaic failure at jit-compile
+    time surfaces to the caller (pass use_flash=False to bypass)."""
+    try:
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention)
+    except Exception:
+        return None
+    d = q.shape[-1]
+    try:
+        return flash_attention(q, k, v, causal=causal,
+                               sm_scale=1.0 / math.sqrt(d))
+    except Exception:
+        return None  # shape/platform not supported by the kernel
+
+
 def dot_product_attention(q, k, v, *, causal: bool = False, mask=None,
                           dropout_rate: float = 0.0, rng=None,
-                          training: bool = False):
-    """Scaled dot-product attention. q,k,v: [B, H, S, D]."""
+                          training: bool = False, use_flash: bool = True):
+    """Scaled dot-product attention. q,k,v: [B, H, S, D].
+
+    On TPU, long sequences route to the pallas flash kernel (eligible
+    when there's no mask/dropout and the head dim tiles onto the MXU);
+    everything else uses the einsum form, which XLA fuses well at short
+    sequence lengths.
+    """
     d = q.shape[-1]
+    seq = q.shape[-2]
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if (use_flash and on_tpu and mask is None
+            and not (training and dropout_rate > 0.0)
+            and seq >= 1024 and seq % 128 == 0 and d % 128 == 0):
+        out = _flash_attention_tpu(q, k, v, causal)
+        if out is not None:
+            return out
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(d)
     if causal:
         sq, sk = scores.shape[-2], scores.shape[-1]

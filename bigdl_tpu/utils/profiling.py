@@ -29,34 +29,41 @@ def module_times(model, x, *, repeats: int = 3) -> List[Tuple[str, float]]:
     import jax
 
     import bigdl_tpu.nn as nn
+    from bigdl_tpu.utils.random import RandomGenerator
 
-    def best_time(fn):
+    if repeats < 1:
+        raise ValueError("repeats must be >= 1")
+
+    def best_time(m, feed):
+        # read-only contract: the repeats must not advance BatchNorm
+        # running stats or drain the global RNG stream
+        saved_state = m._state
+        saved_counter = RandomGenerator._counter
         best = float("inf")
-        for _ in range(repeats):
-            t0 = time.perf_counter()
-            out = fn()
-            jax.block_until_ready(out)
-            best = min(best, time.perf_counter() - t0)
+        out = None
+        try:
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                out = m.forward(feed)
+                jax.block_until_ready(out)
+                best = min(best, time.perf_counter() - t0)
+        finally:
+            m._state = saved_state
+            RandomGenerator._counter = saved_counter
         return best, out
 
     results: List[Tuple[str, float]] = []
     if isinstance(model, nn.Sequential):
-        children = [(m.get_name() or f"{type(m).__name__}#{i}", m)
-                    for i, m in enumerate(model.modules)]
         cur = x
-        for name, m in children:
+        for m in model.modules:
             m.ensure_initialized()
-            dt, cur = best_time(lambda m=m, cur=cur: m.forward(cur))
-            results.append((name, dt))
-    elif isinstance(model, nn.Graph):
-        # whole-graph time only: per-node inputs are graph-internal
-        model.ensure_initialized()
-        dt, _ = best_time(lambda: model.forward(x))
-        results.append((model.get_name() or "Graph", dt))
+            dt, cur = best_time(m, cur)
+            results.append((m.get_name(), dt))
     else:
+        # Graph/leaf: whole-model time (per-node inputs are internal)
         model.ensure_initialized()
-        dt, _ = best_time(lambda: model.forward(x))
-        results.append((model.get_name() or type(model).__name__, dt))
+        dt, _ = best_time(model, x)
+        results.append((model.get_name(), dt))
     return results
 
 

@@ -67,9 +67,15 @@ def _parse_tensor(buf: bytes) -> np.ndarray:
         arr = np.asarray(vals, dtype=dtype)
     n = int(np.prod(shape)) if shape else max(arr.size, 1)
     if arr.size < n:
-        # TensorProto compresses trailing repeats: pad with the LAST
-        # stored value (tensor_util.MakeNdarray semantics); an entirely
-        # omitted value list means all zeros (proto3 drops zeros)
+        if 4 in f and f[4][0]:
+            # tensor_content is never repeat-compressed: short content
+            # means a truncated/corrupt buffer, not compression
+            raise ValueError(
+                f"tensor_content holds {arr.size} elements, shape needs "
+                f"{n}")
+        # the VALUE-LIST form compresses trailing repeats: pad with the
+        # LAST stored value (tensor_util.MakeNdarray semantics); an
+        # entirely omitted list means all zeros (proto3 drops zeros)
         fill = arr[-1] if arr.size else np.zeros((), dtype=dtype)
         arr = np.concatenate(
             [arr, np.full(n - arr.size, fill, dtype=dtype)])

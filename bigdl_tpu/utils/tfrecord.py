@@ -1,0 +1,147 @@
+"""TFRecord reading/writing + tf.Example parsing (reference:
+utils/tf/TFRecordIterator.scala, the ParseExample op in nn/ops/, and
+FixedLengthRecordReader — the input side of executing TF data pipelines).
+
+TFRecord wire format per record:
+    [u64 length][u32 masked_crc32c(length)][data][u32 masked_crc32c(data)]
+
+tf.Example is a protobuf: Example{features: Features{feature:
+map<string, Feature>}} where Feature is one of bytes_list/float_list/
+int64_list — decoded here with the in-repo wire codec (utils/proto.py),
+no TF dependency.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.utils import proto
+from bigdl_tpu.visualization.crc32c import masked_crc32c
+
+
+def read_tfrecord(path: str, *, verify: bool = True) -> Iterator[bytes]:
+    """Yield raw record payloads (TFRecordIterator.scala)."""
+    with open(path, "rb") as f:
+        while True:
+            hdr = f.read(12)
+            if not hdr:
+                return
+            if len(hdr) < 12:
+                raise ValueError(f"{path}: truncated record header")
+            (length,), crc = struct.unpack("<Q", hdr[:8]), \
+                struct.unpack("<I", hdr[8:])[0]
+            if verify and masked_crc32c(hdr[:8]) != crc:
+                raise ValueError(f"{path}: length crc mismatch")
+            data = f.read(length)
+            if len(data) < length:
+                raise ValueError(f"{path}: truncated record")
+            dcrc = struct.unpack("<I", f.read(4))[0]
+            if verify and masked_crc32c(data) != dcrc:
+                raise ValueError(f"{path}: data crc mismatch")
+            yield data
+
+
+def write_tfrecord(path: str, records: Sequence[bytes]) -> None:
+    """Write records in TFRecord framing (round-trip/test support)."""
+    with open(path, "wb") as f:
+        for data in records:
+            hdr = struct.pack("<Q", len(data))
+            f.write(hdr)
+            f.write(struct.pack("<I", masked_crc32c(hdr)))
+            f.write(data)
+            f.write(struct.pack("<I", masked_crc32c(data)))
+
+
+# --------------------------------------------------------------- Example
+
+def parse_example(data: bytes) -> Dict[str, Any]:
+    """tf.Example bytes -> {feature name: list/bytes/ndarray}.
+
+    Example proto: features=1 -> Features{feature=1 (map entry:
+    key=1 string, value=2 Feature)}; Feature: bytes_list=1, float_list=2,
+    int64_list=3, each with repeated value=1 (the schema the reference's
+    ParseExample op consumed, nn/ops/ParseExample).
+    """
+    out: Dict[str, Any] = {}
+    ex = proto.parse_message(data)
+    if 1 not in ex:
+        return out
+    features = proto.parse_message(ex[1][0])
+    for entry_raw in features.get(1, []):
+        entry = proto.parse_message(entry_raw)
+        name = proto.as_string(entry[1][0])
+        feat = proto.parse_message(entry[2][0])
+        if 1 in feat:  # bytes_list
+            bl = proto.parse_message(feat[1][0])
+            vals = list(bl.get(1, []))
+            out[name] = vals[0] if len(vals) == 1 else vals
+        elif 2 in feat:  # float_list (packed or unpacked floats)
+            fl = proto.parse_message(feat[2][0])
+            vals: List[float] = []
+            for raw in fl.get(1, []):
+                if isinstance(raw, bytes):
+                    if len(raw) % 4 == 0 and len(raw) > 4:
+                        vals.extend(proto.unpack_packed_floats(raw))
+                    else:
+                        vals.append(proto.as_float(raw))
+                else:
+                    vals.append(float(raw))
+            out[name] = np.asarray(vals, np.float32)
+        elif 3 in feat:  # int64_list (packed or unpacked varints)
+            il = proto.parse_message(feat[3][0])
+            vals = []
+            for raw in il.get(1, []):
+                if isinstance(raw, bytes):
+                    vals.extend(proto.as_sint(v)
+                                for v in proto.unpack_packed_varints(raw))
+                else:
+                    vals.append(proto.as_sint(raw))
+            out[name] = np.asarray(vals, np.int64)
+        else:
+            out[name] = None
+    return out
+
+
+def encode_example(features: Dict[str, Any]) -> bytes:
+    """Inverse of parse_example (for tests and export pipelines)."""
+    entries = b""
+    for name, value in features.items():
+        if isinstance(value, (bytes, bytearray)):
+            inner = proto.encode_message(1, bytes(value))
+            feat = proto.encode_message(1, inner)
+        else:
+            arr = np.asarray(value)
+            if np.issubdtype(arr.dtype, np.floating):
+                inner = b"".join(
+                    proto.encode_float32(1, float(v)) for v in arr.ravel())
+                feat = proto.encode_message(2, inner)
+            else:
+                inner = b"".join(
+                    proto.encode_field(1, int(v)) for v in arr.ravel())
+                feat = proto.encode_message(3, inner)
+        entry = proto.encode_message(1, name.encode()) \
+            + proto.encode_message(2, feat)
+        entries += proto.encode_message(1, entry)
+    return proto.encode_message(1, entries)
+
+
+def example_dataset(path: str, *, feature: str = "image/raw",
+                    label: str = "label",
+                    shape: Optional[Sequence[int]] = None):
+    """Read a TFRecord of Examples into (features, labels) arrays — the
+    TFRecord input path of the reference's Session pipelines."""
+    feats, labels = [], []
+    for rec in read_tfrecord(path):
+        ex = parse_example(rec)
+        v = ex[feature]
+        if isinstance(v, (bytes, bytearray)):
+            v = np.frombuffer(v, np.uint8).astype(np.float32)
+        feats.append(np.asarray(v, np.float32))
+        lv = ex[label]
+        labels.append(float(np.asarray(lv).ravel()[0]))
+    X = np.stack(feats)
+    if shape is not None:
+        X = X.reshape((len(X),) + tuple(shape))
+    return X, np.asarray(labels, np.float32)

@@ -1,0 +1,117 @@
+"""TFRecord + tf.Example codec (reference: utils/tf/TFRecordIterator.scala,
+nn/ops/ParseExample) validated against the reference's own
+mnist_train.tfrecord fixture and real TF parsing."""
+import os
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.utils.tfrecord import (encode_example, example_dataset,
+                                      parse_example, read_tfrecord,
+                                      write_tfrecord)
+
+FIXTURE = ("/root/reference/spark/dl/src/test/resources/tf/"
+           "mnist_train.tfrecord")
+
+needs_fixture = pytest.mark.skipif(not os.path.exists(FIXTURE),
+                                   reason="reference fixture absent")
+
+
+@needs_fixture
+def test_reads_reference_mnist_fixture():
+    recs = list(read_tfrecord(FIXTURE))
+    assert len(recs) == 10
+    ex = parse_example(recs[0])
+    assert ex["image/format"] == b"png"
+    assert int(ex["image/width"][0]) == 28
+    assert int(ex["image/height"][0]) == 28
+    assert 0 <= int(ex["image/class/label"][0]) <= 10
+    # the embedded PNG decodes to a 28x28 grayscale image
+    from bigdl_tpu.dataset.imagenet import decode_image
+    img = decode_image(ex["image/encoded"])
+    assert img.shape[:2] == (28, 28)
+
+
+@needs_fixture
+def test_parse_matches_real_tensorflow():
+    tf = pytest.importorskip("tensorflow")
+    recs = list(read_tfrecord(FIXTURE))
+    for rec in recs[:3]:
+        ours = parse_example(rec)
+        theirs = tf.train.Example.FromString(rec)
+        fmap = theirs.features.feature
+        assert set(ours) == set(fmap)
+        assert ours["image/encoded"] == fmap["image/encoded"].bytes_list \
+            .value[0]
+        assert int(ours["image/class/label"][0]) == \
+            fmap["image/class/label"].int64_list.value[0]
+
+
+def test_tfrecord_roundtrip_and_crc(tmp_path):
+    p = str(tmp_path / "x.tfrecord")
+    recs = [b"hello", b"", b"world" * 100]
+    write_tfrecord(p, recs)
+    assert list(read_tfrecord(p)) == recs
+    # corrupt a payload byte -> crc failure
+    data = bytearray(open(p, "rb").read())
+    data[-6] ^= 0xFF
+    open(p, "wb").write(bytes(data))
+    with pytest.raises(ValueError, match="crc"):
+        list(read_tfrecord(p))
+
+
+def test_example_roundtrip():
+    feats = {"img": np.arange(6, dtype=np.float32),
+             "label": np.asarray([3], np.int64),
+             "name": b"abc"}
+    back = parse_example(encode_example(feats))
+    np.testing.assert_allclose(back["img"], feats["img"])
+    assert int(back["label"][0]) == 3
+    assert back["name"] == b"abc"
+
+
+def test_example_roundtrip_vs_tf():
+    tf = pytest.importorskip("tensorflow")
+    feats = {"x": np.asarray([1.5, -2.0], np.float32),
+             "y": np.asarray([7, 8, 9], np.int64)}
+    data = encode_example(feats)
+    theirs = tf.train.Example.FromString(data)
+    np.testing.assert_allclose(
+        list(theirs.features.feature["x"].float_list.value), feats["x"])
+    assert list(theirs.features.feature["y"].int64_list.value) == [7, 8, 9]
+
+
+@needs_fixture
+def test_example_dataset_trains(tmp_path):
+    """End-to-end: the reference fixture -> arrays -> a training step."""
+    recs = list(read_tfrecord(FIXTURE))
+    from bigdl_tpu.dataset.imagenet import decode_image
+
+    # repack with raw pixels so example_dataset's frombuffer path is used
+    out = []
+    for rec in recs:
+        ex = parse_example(rec)
+        img = decode_image(ex["image/encoded"])[:, :, 0]
+        out.append(encode_example({
+            "image/raw": img.astype(np.uint8).tobytes(),
+            "label": np.asarray([int(ex["image/class/label"][0]) + 1],
+                                np.int64)}))
+    p = str(tmp_path / "mnist.tfrecord")
+    write_tfrecord(p, out)
+    X, y = example_dataset(p, shape=(1, 28, 28))
+    assert X.shape == (10, 1, 28, 28) and y.shape == (10,)
+    assert y.min() >= 1
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+    from bigdl_tpu.optim import LocalOptimizer, SGD, max_iteration
+
+    ds = DataSet.array([Sample(X[i] / 255.0, y[i]) for i in range(10)]) \
+        .transform(SampleToMiniBatch(5))
+    model = (nn.Sequential().add(nn.Reshape((784,)))
+             .add(nn.Linear(784, 11)).add(nn.LogSoftMax()))
+    opt = LocalOptimizer(model, ds, nn.ClassNLLCriterion(), batch_size=5)
+    opt.set_optim_method(SGD(learning_rate=0.1))
+    opt.set_end_when(max_iteration(10))
+    opt.optimize()
+    assert np.isfinite(opt.driver_state["Loss"])
