@@ -1,0 +1,107 @@
+"""Tree-LSTM sentiment example (reference: example/treeLSTMSentiment —
+constituency BinaryTreeLSTM over embedded tokens, classified at the root,
+scored with TreeNNAccuracy).
+
+Trees are full binary trees over token leaves; sentiment is the majority
+polarity of the leaf tokens (synthetic stand-in for the SST data the
+reference example downloads). The tree forward is vmapped over the batch
+and the whole step is one jit.
+
+    python examples/tree_lstm_sentiment.py --trees 200
+"""
+from __future__ import annotations
+
+import argparse
+
+
+def build_full_tree(n_leaves):
+    """Children table of a full binary tree, nodes topologically ordered
+    leaves-first, root LAST (BinaryTreeLSTM's contract); -1 = leaf."""
+    import numpy as np
+    children = [[-1, -1] for _ in range(n_leaves)]
+    frontier = list(range(n_leaves))
+    while len(frontier) > 1:
+        nxt = []
+        for i in range(0, len(frontier) - 1, 2):
+            children.append([frontier[i], frontier[i + 1]])
+            nxt.append(len(children) - 1)
+        if len(frontier) % 2 == 1:
+            nxt.append(frontier[-1])
+        frontier = nxt
+    return np.asarray(children, np.int32)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--trees", type=int, default=200)
+    ap.add_argument("--leaves", type=int, default=8)
+    ap.add_argument("--vocab", type=int, default=40)
+    ap.add_argument("--hidden", type=int, default=24)
+    ap.add_argument("--epochs", type=int, default=120)
+    ap.add_argument("--lr", type=float, default=0.5)
+    args = ap.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.optim import SGD, TreeNNAccuracy
+
+    rng = np.random.RandomState(0)
+    V, L, H = args.vocab, args.leaves, args.hidden
+    children = build_full_tree(L)          # same topology for the batch
+    n_nodes = len(children)
+
+    # tokens 1..V/2 = negative polarity, V/2+1..V = positive; sentiment =
+    # majority leaf polarity (labels 1/2, 1-based like the reference)
+    tokens = rng.randint(1, V + 1, (args.trees, L)).astype(np.int32)
+    labels = 1.0 + ((tokens > V // 2).mean(axis=1) > 0.5)
+
+    tree = nn.BinaryTreeLSTM(H, H)
+    embed = nn.LookupTable(V, H)
+    head = nn.Linear(H, 2)
+    for m in (tree, embed, head):
+        m.ensure_initialized()
+    params = {"tree": tree.get_parameters(),
+              "embed": embed.get_parameters(),
+              "head": head.get_parameters()}
+    crit = nn.CrossEntropyCriterion()
+    optim = SGD(learning_rate=args.lr, momentum=0.9)
+    opt_state = optim.init_state(params)
+
+    def tree_logits(p, toks):
+        # leaves embed their token; internal nodes get zero input
+        leaf_emb = embed.forward_fn(p["embed"], toks)
+        emb = jnp.concatenate(
+            [leaf_emb, jnp.zeros((n_nodes - L, H), leaf_emb.dtype)])
+        hs = tree.forward_fn(p["tree"], [emb, children])
+        return head.forward_fn(p["head"], hs[-1])  # root = last node
+
+    def loss_fn(p, toks, y):
+        logits = jax.vmap(lambda t: tree_logits(p, t))(toks)
+        return crit.apply(logits, y), logits
+
+    @jax.jit
+    def step(p, o, toks, y, lr):
+        (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            p, toks, y)
+        p, o = optim.update(grads, o, p, lr)
+        return p, o, loss
+
+    toks_j = jnp.asarray(tokens)
+    y_j = jnp.asarray(labels, jnp.float32)
+    for epoch in range(args.epochs):
+        lr = optim.update_hyper_parameter()
+        params, opt_state, loss = step(params, opt_state, toks_j, y_j, lr)
+    _, logits = loss_fn(params, toks_j, y_j)
+    # TreeNNAccuracy scores the first/root output column
+    acc, n = TreeNNAccuracy()(
+        np.asarray(logits)[:, None, :],
+        np.asarray(labels)[:, None]).result()
+    print(f"final loss {float(loss):.4f} TreeNNAccuracy {acc:.3f} ({n})")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

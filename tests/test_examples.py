@@ -34,3 +34,9 @@ def test_udf_predictor_demo():
     preds = main(["--demo"])
     assert isinstance(preds, list) and len(preds) == 8
     assert set(preds).issubset({1, 2})
+
+
+def test_tree_lstm_sentiment_example():
+    from examples.tree_lstm_sentiment import main
+    acc = main(["--trees", "120"])
+    assert acc > 0.8  # majority-polarity sentiment is learnable
