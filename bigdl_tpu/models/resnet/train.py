@@ -19,6 +19,8 @@ def cifar10_decay(epoch: int) -> float:
 
 
 def main(argv=None):
+    import argparse
+
     from bigdl_tpu.models._cli import (
         arrays_to_dataset, base_parser, cifar10_arrays, load_model_or,
         wire_optimizer)
@@ -26,7 +28,8 @@ def main(argv=None):
     ap = base_parser("Train ResNet on CIFAR-10")
     ap.add_argument("--depth", type=int, default=20)
     ap.add_argument("--weightDecay", type=float, default=1e-4)
-    ap.add_argument("--nesterov", action="store_true", default=True)
+    ap.add_argument("--nesterov", action=argparse.BooleanOptionalAction,
+                    default=True)
     args = ap.parse_args(argv)
 
     import bigdl_tpu.nn as nn

@@ -182,7 +182,9 @@ class LBFGS(OptimMethod):
             return (x_flat if is_flat else unravel(x_flat)), f_hist
 
         d = st.get("dir", None)
-        t = self.learning_rate
+        # re-entry: the first (s, y) pair below uses s = d * t, so t must
+        # be the step length actually taken last call, not the default lr
+        t = st.get("stepLen", self.learning_rate)
         g_prev = st.get("prevGrad", None)
         h_diag = st.get("Hdiag", 1.0)
 
@@ -258,6 +260,7 @@ class LBFGS(OptimMethod):
                 break
 
         st.update({"dir": d, "prevGrad": g_prev, "Hdiag": h_diag,
+                   "stepLen": t,
                    "nIter": n_iter_total, "funcEval": func_evals})
         return (x_flat if is_flat else unravel(x_flat)), f_hist
 

@@ -34,14 +34,21 @@ def encode_blob(arr: np.ndarray) -> bytes:
 
 # -------------------------------------------------------- prototxt encode
 
-def _fmt_value(v) -> str:
+# prototxt keys whose string values are protobuf enums (written bare);
+# everything else — name/bottom/top/type… — must be quoted, or an
+# all-caps layer name would emit invalid prototxt
+_ENUM_KEYS = frozenset({"pool", "operation", "norm_region", "phase",
+                        "backend", "db", "variance_norm", "eltwise_op"})
+
+
+def _fmt_value(v, key: str = "") -> str:
     if isinstance(v, bool):
         return "true" if v else "false"
     if isinstance(v, float):
         s = repr(v)
         return s
     if isinstance(v, str):
-        return v if v.isupper() else f'"{v}"'
+        return v if (key in _ENUM_KEYS and v.isupper()) else f'"{v}"'
     return str(v)
 
 
@@ -57,7 +64,7 @@ def _emit(lines: List[str], indent: int, key: str, value):
                 _emit(lines, indent + 1, k, v)
         lines.append(f"{pad}}}")
     else:
-        lines.append(f"{pad}{key}: {_fmt_value(value)}")
+        lines.append(f"{pad}{key}: {_fmt_value(value, key)}")
 
 
 class _Spec:

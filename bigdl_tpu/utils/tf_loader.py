@@ -10,6 +10,7 @@ the TPU-native analogue of the reference's nn/ops graph execution.
 from __future__ import annotations
 
 import struct
+import zlib
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
@@ -425,9 +426,10 @@ class TFModule(Module):
         Session evaluates these through the graph too). Raises on
         anything else rather than silently zero-initializing."""
         # seed per-initializer: same-shape variables must NOT share a
-        # stream (identical inits would train symmetrically)
+        # stream (identical inits would train symmetrically); hash the
+        # FULL name — suffix bytes collide (layer1/kernel vs layer2/kernel)
         rng = np.random.RandomState(
-            int.from_bytes(name.encode()[-4:].rjust(4, b"\0"), "big"))
+            zlib.crc32(name.encode()) & 0xFFFFFFFF)
 
         def ev(nm: str) -> np.ndarray:
             nm = nm.split(":")[0].lstrip("^")

@@ -113,3 +113,22 @@ def test_unsupported_layer_raises(tmp_path):
     with pytest.raises(ValueError, match="cannot export"):
         save_caffe(m, str(tmp_path / "a.prototxt"),
                    str(tmp_path / "a.caffemodel"))
+
+
+def test_all_caps_layer_name_is_quoted(tmp_path):
+    """An all-caps layer name (e.g. BN1) must still emit quoted
+    name/bottom/top strings — only enum parameter values (pool: MAX) are
+    written bare (advisor r2, caffe_persister.py:44)."""
+    m = (nn.Sequential()
+         .add(nn.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1)
+              .set_name("CONV1"))
+         .add(nn.ReLU().set_name("RELU1"))
+         .add(nn.SpatialMaxPooling(2, 2, 2, 2).set_name("POOL1")))
+    x = np.random.RandomState(0).randn(1, 3, 8, 8).astype(np.float32)
+    _roundtrip(m, x, tmp_path)
+    text = (tmp_path / "net.prototxt").read_text()
+    assert 'name: "CONV1"' in text and 'top: "CONV1"' in text
+    assert 'bottom: "CONV1"' in text
+    assert "name: CONV1" not in text
+    # enum values stay bare
+    assert "pool: MAX" in text

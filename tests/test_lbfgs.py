@@ -95,3 +95,30 @@ def test_tree_nn_accuracy_binary_and_2d():
     out2 = np.asarray([[0.1, 0.9], [0.9, 0.1]], np.float32)
     value2, count2 = TreeNNAccuracy()(out2, np.asarray([[2.0]])).result()
     assert count2 == 1 and value2 == 1.0
+
+
+def test_lbfgs_reentry_matches_single_run():
+    """Persisted-state re-entry: two optimize() calls of N iterations must
+    follow the SAME trajectory as one call of 2N — requires the last
+    line-search step length to be persisted (state["stepLen"]), since the
+    first curvature pair on re-entry is s = d * t."""
+    A = jnp.asarray(np.diag([1.0, 25.0, 400.0]), jnp.float32)
+    c = jnp.asarray([0.5, -1.5, 2.0], jnp.float32)
+
+    def f(x):
+        d = x - c
+        return d @ A @ d
+
+    feval = jax.jit(jax.value_and_grad(f))
+    x0 = jnp.asarray([4.0, 4.0, 4.0], jnp.float32)
+
+    whole = LBFGS(max_iter=8, max_eval=400)
+    x_whole, _ = whole.optimize(feval, x0)
+
+    split = LBFGS(max_iter=4, max_eval=400)
+    x_mid, _ = split.optimize(feval, x0)
+    assert "stepLen" in split.state
+    x_split, _ = split.optimize(feval, x_mid)
+
+    np.testing.assert_allclose(np.asarray(x_split), np.asarray(x_whole),
+                               atol=1e-5)

@@ -138,3 +138,25 @@ def test_while_loop_cycle_raises():
     mod = TFModule(nodes, inputs=["x"], outputs=[out]).evaluate()
     with pytest.raises(ValueError, match="cycle|Merge"):
         mod.forward(np.asarray(0.0, np.float32))
+
+
+def test_same_shape_variables_get_distinct_random_inits():
+    """Initializer seeding must hash the FULL node name: layer1/kernel vs
+    layer2/kernel share their last path component, and suffix-byte seeding
+    made them train symmetrically (advisor r2, tf_loader.py:430)."""
+    with tf.compat.v1.Graph().as_default() as g:
+        tf.compat.v1.placeholder(tf.float32, [8, 4], name="x")
+        k1 = tf.compat.v1.get_variable(
+            "layer1/kernel", shape=[4, 4],
+            initializer=tf.compat.v1.truncated_normal_initializer())
+        k2 = tf.compat.v1.get_variable(
+            "layer2/kernel", shape=[4, 4],
+            initializer=tf.compat.v1.truncated_normal_initializer())
+        tf.add(k1, k2, name="out")
+        data = g.as_graph_def().SerializeToString()
+    mod = TFModule(parse_graphdef(data), inputs=["x"], outputs=["out"])
+    v1 = mod.variable_init["layer1/kernel"]
+    v2 = mod.variable_init["layer2/kernel"]
+    assert v1.shape == v2.shape == (4, 4)
+    assert not np.allclose(v1, v2), \
+        "same-shape variables received identical random inits"
