@@ -156,20 +156,25 @@ def main():
             pool, labels, batch, crop=(224, 224), flip=True,
             mean=IMAGENET_MEAN, std=IMAGENET_STD)
 
-        def scan_body_cached(carry, key):
-            params, opt_state, mstate = carry
-            kb, kr = jax.random.split(key)
-            x, y = ds.batch_fn(kb)
+        def scan_body_cached(carry, key_it):
+            params, opt_state, mstate, ep, pos = carry
+            kb, kr = jax.random.split(key_it)
+            # epoch-exact permutation walk; the (epoch, pos) cursor stays
+            # < 2n so it never overflows int32 however long the run
+            x, y = ds.batch_fn(kb, epoch=ep, pos=pos)
             params, opt_state, mstate, loss = step(
                 params, opt_state, mstate, kr, 0.1, x, y)
-            return (params, opt_state, mstate), loss
+            pos = pos + batch
+            ep = ep + pos // ds.n
+            pos = pos % ds.n
+            return (params, opt_state, mstate, ep, pos), loss
 
         @jax.jit
         def run_chunk_cached(carry, keys):
             return lax.scan(scan_body_cached, carry, keys)
 
         root = jax.random.PRNGKey(0)
-        carry = (params, opt_state, mstate)
+        carry = (params, opt_state, mstate, jnp.int32(0), jnp.int32(0))
         for i in range(warmup):
             keys = jax.random.split(jax.random.fold_in(root, i), scan)
             carry, losses = run_chunk_cached(carry, keys)

@@ -66,6 +66,12 @@ class TransformedDataSet(AbstractDataSet):
         self.base = base
         self.transformer = transformer
 
+    @property
+    def continuous_stream(self) -> bool:
+        # forward the base's stream semantics so the optimizer's epoch
+        # rollover accounting stays correct through .transform() wrapping
+        return getattr(self.base, "continuous_stream", False)
+
     def size(self) -> int:
         return self.base.size()
 

@@ -34,7 +34,7 @@ class DeviceCachedArrayDataSet:
                  pad: int = 0, flip: bool = True,
                  mean: Sequence[float] = (0.0, 0.0, 0.0),
                  std: Sequence[float] = (1.0, 1.0, 1.0),
-                 sharding=None):
+                 sharding=None, shuffle_seed: int = 0):
         images = np.ascontiguousarray(images)
         if images.dtype != np.uint8:
             if images.max() <= 1.0:
@@ -63,21 +63,114 @@ class DeviceCachedArrayDataSet:
                             ((0, 0), (0, 0), (pad, pad), (pad, pad)))
         self.images = put(images)   # resident uint8 cache
         self.labels = put(np.ascontiguousarray(labels, np.float32))
+        # base key of the per-epoch shuffle (fold_in(key, epoch) -> perm),
+        # the device-side form of CachedDistriDataSet.shuffle
+        # (dataset/DataSet.scala:240)
+        self._perm_key = jax.random.PRNGKey(shuffle_seed)
 
     def size(self) -> int:
         return self.n
 
     # ---------------------------------------------------------- batch fns
 
-    def batch_fn(self, rng):
-        """Jittable: sample a random augmented training batch.
+    def _permute_in_epoch(self, pos, epoch):
+        """Bijective map of positions [0, n) -> sample indices for one
+        epoch, O(batch) per call: a 4-round Feistel network over the
+        smallest even-bit-width domain covering n, cycle-walked back into
+        range. A Feistel pass is a bijection on its domain for ANY round
+        function, and cycle-walking a bijection stays a bijection on
+        [0, n) — so every epoch is a true permutation, computed per
+        element with no dataset-sized sort in the jitted hot path.
+        Round keys derive from fold_in(key, epoch): each epoch reshuffles,
+        and the map stays a pure function of (seed, epoch, pos).
+        """
+        half = max(1, ((self.n - 1).bit_length() + 1) // 2)
+        mask = jnp.uint32((1 << half) - 1)
+        kd = jax.random.fold_in(self._perm_key, epoch)
+        keys = jax.random.bits(kd, (4,), jnp.uint32)
+        n = jnp.uint32(self.n)
 
-        Gathers B source images from the resident cache, random-crops via
-        one dynamic_slice per image (vmap), randomly flips, normalizes.
+        def mix(x, k):
+            x = (x + k) * jnp.uint32(0x9E3779B1)
+            x = x ^ (x >> 15)
+            x = x * jnp.uint32(0x85EBCA6B)
+            return x ^ (x >> 13)
+
+        def feistel(x):
+            hi, lo = (x >> half) & mask, x & mask
+            for i in range(4):
+                hi, lo = lo, hi ^ (mix(lo, keys[i]) & mask)
+            return (hi << jnp.uint32(half)) | lo
+
+        x = feistel(pos.astype(jnp.uint32))
+        x = jax.lax.while_loop(
+            lambda v: jnp.any(v >= n),
+            lambda v: jnp.where(v >= n, feistel(v), v), x)
+        return x.astype(jnp.int32)
+
+    def sample_indices(self, step=None, *, epoch=None, pos=None):
+        """Jittable epoch-exact sample indices.
+
+        The index stream is the concatenation of per-epoch permutations,
+        so every sample is visited exactly once per epoch — the
+        reference's shuffle semantics (dataset/DataSet.scala:240) — and
+        the stream is a pure function of the global step: resuming from a
+        checkpointed iteration continues the exact same visit order.
+        Batches may straddle an epoch boundary; each element maps through
+        its own epoch's permutation (at most two are live per batch).
+
+        Pass EITHER ``step`` (the global iteration index) or the
+        decomposed ``(epoch, pos)`` stream cursor with ``pos`` in
+        [0, n). A host-int ``step`` is decomposed exactly with Python
+        integers; a traced ``step`` computes ``step * b`` in int32, which
+        wraps after 2^31 samples — long-running loops should carry
+        ``(epoch, pos)`` instead (advance: ``pos += b; epoch += pos // n;
+        pos %= n`` — all values stay < 2n, no overflow ever).
+        """
+        b = self.batch_size
+        if step is None and (epoch is None or pos is None):
+            raise ValueError(
+                "pass step, or BOTH epoch and pos (the decomposed cursor)")
+        if step is not None:
+            if isinstance(step, (int, np.integer)):
+                epoch, pos = divmod(int(step) * b, self.n)  # exact
+            else:
+                j0 = jnp.asarray(step, jnp.int32) * b
+                epoch, pos = j0 // self.n, j0 % self.n
+        epoch = jnp.asarray(epoch, jnp.int32)
+        offs = jnp.asarray(pos, jnp.int32) + jnp.arange(b, dtype=jnp.int32)
+        ep = epoch + offs // self.n
+        pp = offs % self.n
+        if b > self.n:
+            # batch larger than dataset: repeats are unavoidable; walk a
+            # single permutation modulo n
+            return self._permute_in_epoch(pp, epoch)
+        # both per-epoch maps are O(b) Feistel evaluations — cheap enough
+        # to compute unconditionally (straddle picks per element)
+        return jnp.where(ep == epoch,
+                         self._permute_in_epoch(pp, epoch),
+                         self._permute_in_epoch(pp, epoch + 1))
+
+    def batch_fn(self, rng, step=None, *, epoch=None, pos=None):
+        """Jittable: one augmented training batch.
+
+        With ``step`` (the global iteration index) or a decomposed
+        ``(epoch, pos)`` cursor the batch visits samples epoch-exactly
+        via :meth:`sample_indices`; with neither, sampling is i.i.d.
+        with replacement (kept for pure-throughput benchmarks).
+        Random-crops via one dynamic_slice per image (vmap), randomly
+        flips, normalizes.
         """
         b = self.batch_size
         kidx, kyx, kflip = jax.random.split(rng, 3)
-        idx = jax.random.randint(kidx, (b,), 0, self.n)
+        if (epoch is None) != (pos is None):
+            raise ValueError(
+                "pass epoch and pos together (the decomposed cursor), "
+                "or step alone")
+        if step is None and epoch is None:
+            idx = jax.random.randint(kidx, (b,), 0, self.n)
+        else:
+            idx = self.sample_indices(step, epoch=epoch, pos=pos)
         imgs = jnp.take(self.images, idx, axis=0)  # (B, C, H+2p, W+2p) u8
         max_oy = self.h + 2 * self.pad - self.crop_h + 1
         max_ox = self.w + 2 * self.pad - self.crop_w + 1

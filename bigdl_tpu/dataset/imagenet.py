@@ -32,6 +32,7 @@ import os
 import queue
 import struct
 import threading
+import zlib
 from typing import Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -209,6 +210,11 @@ class ImageFolderDataSet(AbstractDataSet):
     Spark partitioning played for SeqFileFolder).
     """
 
+    # the train pool's _IndexStream never restarts across the optimizer's
+    # epoch rollover, so the driver carries straddle overshoot instead of
+    # resetting its record counter (optim/optimizer.py rollover)
+    continuous_stream = True
+
     def __init__(self, folder: Optional[str] = None, *,
                  record_shards: Optional[Sequence[str]] = None,
                  batch_size: int = 32, crop: int = 224, scale: int = 256,
@@ -247,7 +253,9 @@ class ImageFolderDataSet(AbstractDataSet):
         return len(self._items)
 
     def shuffle(self):
-        pass  # train workers sample randomly each batch
+        # the train pool's _IndexStream re-permutes itself at each epoch
+        # boundary; nothing to do at the optimizer's rollover
+        pass
 
     def data(self, train: bool = True):
         if train:
@@ -290,10 +298,45 @@ class ImageFolderDataSet(AbstractDataSet):
             pass
 
 
+class _IndexStream:
+    """Thread-safe walk over concatenated per-epoch permutations: every
+    item index appears exactly once per epoch (the reference's
+    CachedDistriDataSet.shuffle semantics, dataset/DataSet.scala:240),
+    regardless of how many worker threads pull from the stream."""
+
+    def __init__(self, n: int, seed: int):
+        self.n, self.seed = n, seed
+        self.lock = threading.Lock()
+        self.epoch = 0
+        self.pos = 0
+        self.perm = self._epoch_perm(0)
+
+    def _epoch_perm(self, epoch: int) -> np.ndarray:
+        # namespace the stream seed so it can never alias the worker
+        # augmentation RNGs (seeded seed + thread_index in _BatchPool)
+        s = zlib.crc32(f"perm:{self.seed}:{epoch}".encode()) & 0xFFFFFFFF
+        return np.random.RandomState(s).permutation(self.n)
+
+    def next(self, k: int) -> np.ndarray:
+        out = []
+        with self.lock:
+            while k > 0:
+                take = min(k, self.n - self.pos)
+                out.append(self.perm[self.pos:self.pos + take])
+                self.pos += take
+                k -= take
+                if self.pos == self.n:
+                    self.epoch += 1
+                    self.perm = self._epoch_perm(self.epoch)
+                    self.pos = 0
+        return np.concatenate(out) if len(out) > 1 else out[0]
+
+
 class _BatchPool:
-    """N worker threads, each building whole batches of randomly sampled
-    items into a bounded ready queue (same scheme as the native C++ loader,
-    bigdl_tpu/native/src/dataloader.cpp)."""
+    """N worker threads, each building whole batches into a bounded ready
+    queue (same scheme as the native C++ loader,
+    bigdl_tpu/native/src/dataloader.cpp). Sample order comes from a shared
+    :class:`_IndexStream`, so an epoch visits each item exactly once."""
 
     def __init__(self, items, batch_size, augmenter, *, num_threads,
                  prefetch, seed):
@@ -302,6 +345,7 @@ class _BatchPool:
         self.augmenter = augmenter
         self.ready: queue.Queue = queue.Queue(maxsize=max(2, prefetch))
         self.stop = threading.Event()
+        self.stream = _IndexStream(len(items), seed)
         self.threads = [
             threading.Thread(target=self._worker, args=(seed + t,),
                              daemon=True)
@@ -313,7 +357,7 @@ class _BatchPool:
         rng = np.random.RandomState(seed)
         n = len(self.items)
         while not self.stop.is_set():
-            idxs = rng.randint(0, n, size=self.batch_size)
+            idxs = self.stream.next(self.batch_size)
             imgs, lbls = [], []
             for i in idxs:
                 raw, lbl = self.items[i]

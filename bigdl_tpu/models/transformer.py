@@ -20,7 +20,8 @@ import jax.numpy as jnp
 import bigdl_tpu.nn as nn
 from bigdl_tpu.nn.attention import MultiHeadAttention
 from bigdl_tpu.nn.moe import MoE
-from bigdl_tpu.nn.module import Module, adopt_or_init, adopt_state
+from bigdl_tpu.nn.module import (AUX_LOSS_KEY, Module, adopt_or_init,
+                                  adopt_state)
 from bigdl_tpu.nn.norm import LayerNorm
 from bigdl_tpu.utils.engine import Engine
 
@@ -165,8 +166,8 @@ class TransformerLM(Module):
         total = jnp.zeros((), jnp.float32)
         for st in state.values():
             mlp = st.get("mlp", {}) if isinstance(st, dict) else {}
-            if "aux_loss" in mlp:
-                total = total + mlp["aux_loss"]
+            if AUX_LOSS_KEY in mlp:
+                total = total + mlp[AUX_LOSS_KEY]
         return total
 
     # ---- sharding (megatron-style rules consumed by parallel.shard_params)

@@ -4,7 +4,8 @@ Layer inventory mirrors SURVEY.md §2.2; semantics follow the reference
 (1-based dims, NCHW convs, 1-based class labels) while compute is pure
 JAX traced through ``Module.apply``.
 """
-from bigdl_tpu.nn.module import Module, Criterion, Params, State
+from bigdl_tpu.nn.module import (AUX_LOSS_KEY, Module, Criterion, Params,
+                                 State)
 from bigdl_tpu.nn.initialization import (
     InitializationMethod, Zeros, Ones, ConstInitMethod, RandomUniform,
     RandomNormal, Xavier, MsraFiller, BilinearFiller)

@@ -37,6 +37,12 @@ from bigdl_tpu.utils.table import Table
 Params = Dict[str, Any]
 State = Dict[str, Any]
 
+# Reserved state-leaf name for auxiliary losses a layer wants added to the
+# training objective (MoE load balancing, nn/moe.py). The dunder namespace
+# guarantees a user state entry innocently called "aux_loss" can never
+# silently join the loss — only layers that opt into this contract do.
+AUX_LOSS_KEY = "__bigdl_aux_loss__"
+
 
 def _to_jax(x):
     def coerce(leaf):

@@ -17,15 +17,16 @@ import math
 import jax
 import jax.numpy as jnp
 
-from bigdl_tpu.nn.module import Module
+from bigdl_tpu.nn.module import AUX_LOSS_KEY, Module
 from bigdl_tpu.utils.engine import Engine
 
 
 class MoE(Module):
     """Top-k routed mixture of expert FFNs over [B, S, E_model] input.
 
-    aux_loss (load-balancing, Switch-style) is stored in the state pytree
-    so the training loop can add ``aux_loss_weight * state["aux_loss"]``.
+    The load-balancing loss (Switch-style) is stored in the state pytree
+    under the reserved ``AUX_LOSS_KEY`` leaf so the training loop adds
+    ``aux_loss_weight * state[AUX_LOSS_KEY]`` to the objective.
     """
 
     def __init__(self, hidden_size: int, ffn_size: int, num_experts: int,
@@ -54,7 +55,7 @@ class MoE(Module):
         }
 
     def initial_state(self):
-        return {"aux_loss": jnp.zeros((), jnp.float32)}
+        return {AUX_LOSS_KEY: jnp.zeros((), jnp.float32)}
 
     def apply(self, params, state, input, *, training=False, rng=None):
         x = input                                     # [B,S,Em]
@@ -79,4 +80,4 @@ class MoE(Module):
             jax.nn.one_hot(top_idx[..., 0], self.num_experts), axis=(0, 1))
         mean_prob = jnp.mean(probs, axis=(0, 1))
         aux = self.num_experts * jnp.sum(frac_routed * mean_prob)
-        return out, {"aux_loss": aux.astype(jnp.float32)}
+        return out, {AUX_LOSS_KEY: aux.astype(jnp.float32)}

@@ -31,7 +31,7 @@ import numpy as np
 
 from bigdl_tpu.dataset.dataset import AbstractDataSet
 from bigdl_tpu.dataset.sample import MiniBatch
-from bigdl_tpu.nn.module import Criterion, Module
+from bigdl_tpu.nn.module import AUX_LOSS_KEY, Criterion, Module
 from bigdl_tpu.optim.optim_method import OptimMethod, SGD
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation import ValidationMethod
@@ -59,13 +59,15 @@ class Metrics:
 
 
 def _collect_aux_losses(state_tree):
-    """Sum every "aux_loss" leaf in a model-state tree (MoE load-balance
-    terms, nn/moe.py). Differentiable — called inside loss_fn."""
+    """Sum every reserved ``AUX_LOSS_KEY`` leaf in a model-state tree (MoE
+    load-balance terms, nn/moe.py). Only the dunder-namespaced key joins
+    the objective — a user state entry named "aux_loss" does not.
+    Differentiable — called inside loss_fn."""
     total = 0.0
     flat, _ = jax.tree_util.tree_flatten_with_path(state_tree)
     for path, leaf in flat:
         keys = [str(getattr(k, "key", getattr(k, "idx", k))) for k in path]
-        if keys and keys[-1] == "aux_loss":
+        if keys and keys[-1] == AUX_LOSS_KEY:
             total = total + leaf
     return total
 
@@ -398,10 +400,16 @@ class Optimizer:
         device_feed = hasattr(self.dataset, "batch_fn")
         if device_feed:
             ds = self.dataset
+            # epoch-exact feed: the global iteration index drives a
+            # per-epoch permutation inside batch_fn (DataSet.scala:240
+            # shuffle semantics); datasets without sample_indices keep
+            # the rng-only contract
+            epoch_exact = hasattr(ds, "sample_indices")
 
-            def _fused(p, o, m, key, lr):
+            def _fused(p, o, m, key, lr, ep, pos):
                 kb, kr = jax.random.split(key)
-                x, y = ds.batch_fn(kb)
+                x, y = ds.batch_fn(kb, epoch=ep, pos=pos) if epoch_exact \
+                    else ds.batch_fn(kb)
                 return step(p, o, m, kr, lr, x, y)
 
             # donate like build_train_step does — inner-jit donation is
@@ -420,7 +428,13 @@ class Optimizer:
             t0 = time.time()
             if device_feed:
                 bsz = self.dataset.batch_size
-                step_args = ()
+                # neval starts at 1 (reference convention); the sample
+                # stream is 0-based so epoch boundaries line up with
+                # recordsProcessedThisEpoch rollover. The (epoch, pos)
+                # cursor is decomposed HERE with exact Python integers,
+                # so no device-int overflow however long the run.
+                e0, p0 = divmod((state["neval"] - 1) * bsz, ds_size)
+                step_args = (jnp.int32(e0), jnp.int32(p0))
                 run_step = fused_step
             else:
                 batch = next(data_iter)
@@ -475,12 +489,26 @@ class Optimizer:
                         self.train_summary.add_histogram(
                             tag, np.asarray(leaf), state["neval"])
 
-            # epoch rollover (DistriOptimizer.scala:368-380)
-            if state["recordsProcessedThisEpoch"] >= ds_size:
+            # epoch rollover (DistriOptimizer.scala:368-380). Carry the
+            # overshoot: when batch_size does not divide ds_size a batch
+            # straddles the epoch boundary, and resetting to 0 would make
+            # the driver's epoch drift from the sample stream's true
+            # permutation epochs (epoch-driven lr schedules / triggers
+            # would fire progressively late)
+            while state["recordsProcessedThisEpoch"] >= ds_size:
+                # while, not if: one batch can span several epochs when
+                # batch_size > ds_size
                 state["epoch"] += 1
                 self.optim_method.state["epoch"] = state["epoch"]
-                state["recordsProcessedThisEpoch"] = 0
-                if not device_feed:  # cached feed samples fresh each step
+                state["recordsProcessedThisEpoch"] -= ds_size
+                if not device_feed and not getattr(
+                        self.dataset, "continuous_stream", False):
+                    # a restartable iterator begins a FRESH permutation,
+                    # so the overshoot carry would skip its tail — reset
+                    # to 0; continuous streams (device feed, the
+                    # ImageFolder _IndexStream) keep the carry, which
+                    # tracks their true permutation boundary exactly
+                    state["recordsProcessedThisEpoch"] = 0
                     self.dataset.shuffle()
                     data_iter = self.dataset.data(train=True)
 

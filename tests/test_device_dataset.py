@@ -130,7 +130,16 @@ def test_local_optimizer_accepts_device_cached_dataset():
              .add(nn.LogSoftMax()))
     opt = LocalOptimizer(model, ds, nn.ClassNLLCriterion(), batch_size=16)
     opt.set_optim_method(SGD(learning_rate=0.1))
-    opt.set_end_when(max_epoch(8))
+    opt.set_end_when(max_epoch(12))
     opt.optimize()
-    assert opt.driver_state["Loss"] < 0.5
+    # assert on the full-dataset eval loss, not the (noisy) last-batch
+    # train loss — with epoch-exact ordering the final batch is arbitrary
+    crit = nn.ClassNLLCriterion()
+    total = 0.0
+    for s in range(0, 64, 16):
+        x, y = ds.eval_batch_fn(s)
+        out, _ = model.apply(model.get_parameters(), model.get_state(), x,
+                             training=False)
+        total += float(crit.apply(out, y)) * 16
+    assert total / 64 < 0.5, total / 64
     assert opt.driver_state["epoch"] > 1  # epoch accounting still works
