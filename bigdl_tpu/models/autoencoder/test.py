@@ -1,0 +1,42 @@
+"""Autoencoder MNIST evaluation — reconstruction MSE over the test split
+(completes the zoo's train/test surface; the reference ships only
+models/autoencoder/Train.scala, so this mirrors its objective at eval
+time: MSECriterion against the input image).
+
+    python -m bigdl_tpu.models.autoencoder.test -f /path/to/mnist --model s
+    python -m bigdl_tpu.models.autoencoder.test --synthetic 64
+"""
+from __future__ import annotations
+
+
+def main(argv=None):
+    from bigdl_tpu.models._cli import (base_parser, load_model_or,
+                                       mnist_arrays)
+
+    args = base_parser("Test the MNIST autoencoder").parse_args(argv)
+
+    import numpy as np
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+    from bigdl_tpu.models.autoencoder import Autoencoder
+    from bigdl_tpu.optim import Evaluator, Loss
+
+    bs = args.batchSize or 150
+    imgs, _ = mnist_arrays(args.folder, False, args.synthetic)
+    flat = imgs.reshape(len(imgs), -1).astype(np.float32)
+    samples = [Sample(flat[i], flat[i]) for i in range(len(flat))]
+    ds = DataSet.array(samples).transform(SampleToMiniBatch(bs))
+
+    model = load_model_or(args, lambda: Autoencoder(class_num=32)).evaluate()
+    if args.quantize:
+        model = model.quantize()
+    results = Evaluator(model).test(
+        ds, [Loss(nn.MSECriterion())], batch_size=bs)
+    for name, r in results.items():
+        print(f"{name}: {r}")
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,46 @@
+"""Inception-v1 ImageNet evaluation (models/inception/Test.scala:38-64 —
+center-crop 224, Top1/Top5 over the val folder).
+
+    python -m bigdl_tpu.models.inception.test -f /imagenet/val --model snap
+    python -m bigdl_tpu.models.inception.test --synthetic 16 --classNum 10
+"""
+from __future__ import annotations
+
+
+def main(argv=None):
+    from bigdl_tpu.models._cli import base_parser, load_model_or
+
+    ap = base_parser("Test Inception-v1 on ImageNet")
+    ap.add_argument("--classNum", type=int, default=1000)
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    from bigdl_tpu.models.inception import Inception_v1_NoAuxClassifier
+    from bigdl_tpu.optim import Evaluator, Top1Accuracy, Top5Accuracy
+
+    build = lambda: Inception_v1_NoAuxClassifier(args.classNum)
+    bs = args.batchSize or 32
+
+    if args.synthetic:
+        from bigdl_tpu.models._cli import evaluate_cli
+        rng = np.random.RandomState(1)
+        imgs = rng.rand(args.synthetic, 3, 224, 224).astype(np.float32)
+        lbls = rng.randint(1, args.classNum + 1,
+                           args.synthetic).astype(np.float32)
+        return evaluate_cli(args, build, (imgs, lbls), default_batch=32)
+
+    from bigdl_tpu.dataset import ImageFolderDataSet
+    model = load_model_or(args, build).evaluate()
+    if args.quantize:
+        model = model.quantize()
+    ds = ImageFolderDataSet(args.folder, batch_size=bs, crop=224, scale=256)
+    results = Evaluator(model).test(
+        ds, [Top1Accuracy(), Top5Accuracy()], batch_size=bs)
+    for name, r in results.items():
+        print(f"{name}: {r}")
+    return results
+
+
+if __name__ == "__main__":
+    main()

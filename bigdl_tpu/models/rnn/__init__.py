@@ -14,6 +14,17 @@ def SimpleRNN(input_size: int, hidden_size: int, output_size: int
     return m
 
 
+def WordRNN(vocab_size: int, hidden_size: int) -> nn.Sequential:
+    """The train/test recipes' model (Train.scala:104-110): embedding
+    front + the SimpleRNN body, shared so both mains build the exact
+    same architecture."""
+    m = nn.Sequential()
+    m.add(nn.LookupTable(vocab_size, hidden_size))
+    m.add(nn.Recurrent(nn.RnnCell(hidden_size, hidden_size, nn.Tanh())))
+    m.add(nn.TimeDistributed(nn.Linear(hidden_size, vocab_size)))
+    return m
+
+
 def PTBModel(input_size: int, hidden_size: int, output_size: int,
              num_layers: int = 2, keep_prob: float = 2.0) -> nn.Sequential:
     """PTBModel.scala:23-45: embedding -> (dropout) -> stacked LSTM ->

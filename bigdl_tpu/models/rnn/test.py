@@ -1,0 +1,99 @@
+"""RNN LM evaluation + generation (models/rnn/Test.scala:46-137).
+
+Evaluate mode scores ``test.txt`` (or a synthetic id stream) with
+Loss(TimeDistributedCriterion(CrossEntropy)) — the reference's evaluate
+branch (Test.scala:55-90) — and prints perplexity. With ``--numOfWords``
+it instead completes sentences by iteratively feeding back the argmax
+prediction (Test.scala:91-137). The training vocabulary saved by the
+train main (``dictionary.json``) is reloaded so words map to the same
+indices the snapshot was trained with (Test.scala:52 ``Dictionary(
+param.folder)``).
+
+    python -m bigdl_tpu.models.rnn.test -f dir_with_test.txt --model snap
+    python -m bigdl_tpu.models.rnn.test --synthetic 800 --numOfWords 5
+"""
+from __future__ import annotations
+
+import os
+
+
+def _test_stream(args):
+    """Token-id stream + vocab size for the eval corpus. Prefers the
+    dictionary persisted at training time over rebuilding one from the
+    test file (which would scramble the word->index map)."""
+    import numpy as np
+
+    from bigdl_tpu.dataset import Dictionary, load_ptb, read_words
+
+    if args.synthetic:
+        rng = np.random.RandomState(1)
+        return rng.randint(1, args.vocabSize + 1,
+                           args.synthetic).astype(np.float32), args.vocabSize
+
+    test_txt = args.folder if os.path.isfile(args.folder) else \
+        os.path.join(args.folder, "test.txt")
+    dict_path = args.dictionary or os.path.join(
+        os.path.dirname(test_txt), "dictionary.json")
+    if os.path.exists(dict_path):
+        d = Dictionary.load(dict_path)
+        stream = np.asarray([d.get_index(w) for w in read_words(test_txt)],
+                            np.float32)
+        return stream, d.vocab_size()
+    splits, d = load_ptb(test_txt, vocab_size=args.vocabSize)
+    return splits["train"], d.vocab_size()
+
+
+def main(argv=None):
+    from bigdl_tpu.models._cli import (arrays_to_dataset, base_parser,
+                                       load_model_or)
+
+    ap = base_parser("Test the RNN language model")
+    ap.add_argument("--vocabSize", type=int, default=4000)
+    ap.add_argument("--hiddenSize", type=int, default=40)
+    ap.add_argument("--numSteps", type=int, default=20)
+    ap.add_argument("--dictionary", default=None,
+                    help="dictionary.json saved by the train main")
+    ap.add_argument("--numOfWords", type=int, default=None,
+                    help="generate this many words per seed sentence "
+                         "instead of evaluating loss")
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import ptb_arrays
+    from bigdl_tpu.models.rnn import WordRNN
+    from bigdl_tpu.optim import Evaluator, Loss
+
+    bs = args.batchSize or 8
+    stream, vocab = _test_stream(args)
+    x, y = ptb_arrays(stream, bs, args.numSteps)
+
+    model = load_model_or(
+        args, lambda: WordRNN(vocab, args.hiddenSize)).evaluate()
+    if args.quantize:
+        model = model.quantize()
+
+    if args.numOfWords:
+        # generation branch: feed back the last-step argmax N times
+        cur = x[:bs].astype(np.float32)
+        for _ in range(args.numOfWords):
+            out = np.asarray(model.forward(cur))
+            nxt = out[:, -1].argmax(-1).astype(np.float32) + 1.0
+            cur = np.concatenate([cur, nxt[:, None]], axis=1)
+        for row in cur[:4]:
+            print(" ".join(str(int(t)) for t in row))
+        return cur
+
+    ds = arrays_to_dataset(x, y, bs)
+    crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion())
+    results = Evaluator(model).test(ds, [Loss(crit)], batch_size=bs)
+    for name, r in results.items():
+        print(f"{name}: {r}")
+    loss = results["Loss"].result()[0]
+    print(f"perplexity: {np.exp(loss):.2f}")
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -28,8 +28,8 @@ def main(argv=None):
     import numpy as np
 
     import bigdl_tpu.nn as nn
-    from bigdl_tpu.dataset import Dictionary, load_ptb, ptb_arrays
-    from bigdl_tpu.models.rnn import PTBModel, SimpleRNN
+    from bigdl_tpu.dataset import load_ptb, ptb_arrays
+    from bigdl_tpu.models.rnn import PTBModel, WordRNN
     from bigdl_tpu.optim import LocalOptimizer, SGD
 
     bs = args.batchSize or 32
@@ -43,17 +43,18 @@ def main(argv=None):
             os.path.join(args.folder, "train.txt")
         splits, d = load_ptb(train_txt, vocab_size=args.vocabSize)
         stream, vocab = splits["train"], d.vocab_size()
+        if args.checkpoint:
+            # persist the training vocabulary so the test main scores
+            # with the same word->index map (Train.scala:90 vocab.save)
+            os.makedirs(args.checkpoint, exist_ok=True)
+            d.save(os.path.join(args.checkpoint, "dictionary.json"))
     x, y = ptb_arrays(stream, bs, args.numSteps)
     ds = arrays_to_dataset(x, y, bs)
 
     if args.ptb:
         build = lambda: PTBModel(vocab, args.hiddenSize, vocab)
     else:
-        build = lambda: nn.Sequential() \
-            .add(nn.LookupTable(vocab, args.hiddenSize)) \
-            .add(nn.Recurrent(nn.RnnCell(args.hiddenSize, args.hiddenSize,
-                                         nn.Tanh()))) \
-            .add(nn.TimeDistributed(nn.Linear(args.hiddenSize, vocab)))
+        build = lambda: WordRNN(vocab, args.hiddenSize)
     model = load_model_or(args, build)
     optim = SGD(learning_rate=args.learningRate or 0.1,
                 learning_rate_decay=args.learningRateDecay or 0.0,

@@ -1,0 +1,100 @@
+"""Image-classification predict example — load a trained model and label a
+folder of images (example/imageclassification/ImagePredictor.scala:32-76:
+load model → decode/crop/normalize images → DLClassifierModel transform →
+print imageName, predict).
+
+    python examples/image_classification.py -f /imagenet/val --model snap
+    python examples/image_classification.py --synthetic 8   # no data needed
+"""
+from __future__ import annotations
+
+import argparse
+import os
+
+
+IMAGE_SIZE = 224
+_IMG_EXTS = (".jpg", ".jpeg", ".png")
+
+
+def scan_images(folder: str):
+    """Recursive, case-insensitive image scan (LocalImageFiles.readPaths
+    — ImageNet val names are uppercase .JPEG on disk)."""
+    paths = []
+    for root, _, files in os.walk(folder):
+        for fn in files:
+            if fn.lower().endswith(_IMG_EXTS):
+                paths.append(os.path.join(root, fn))
+    return sorted(paths)
+
+
+def decode_batch(paths):
+    """Decode + center-crop 224 + ImageNet-normalize one batch of paths
+    (MlUtils.scala imagesLoad + the transformer chain BytesToBGRImg ->
+    BGRImgCropper -> BGRImgNormalizer). Batched so an ImageNet-sized
+    folder never materializes in host memory at once."""
+    import numpy as np
+
+    from bigdl_tpu.dataset import decode_image
+    from bigdl_tpu.dataset.imagenet import IMAGENET_MEAN, IMAGENET_STD
+
+    mean = np.asarray(IMAGENET_MEAN, np.float32).reshape(3, 1, 1)
+    std = np.asarray(IMAGENET_STD, np.float32).reshape(3, 1, 1)
+    imgs = []
+    for p in paths:
+        img = decode_image(p, scale=256)
+        h, w = img.shape[:2]
+        oy, ox = (h - IMAGE_SIZE) // 2, (w - IMAGE_SIZE) // 2
+        chw = img[oy:oy + IMAGE_SIZE, ox:ox + IMAGE_SIZE] \
+            .transpose(2, 0, 1).astype(np.float32)
+        imgs.append((chw - mean) / std)
+    return np.stack(imgs)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Predict image classes with a trained model")
+    ap.add_argument("-f", "--folder", default="./",
+                    help="folder of images to label")
+    ap.add_argument("--model", default=None, help="model snapshot")
+    ap.add_argument("-b", "--batchSize", type=int, default=32)
+    ap.add_argument("--classNum", type=int, default=1000)
+    ap.add_argument("--showNum", type=int, default=100,
+                    help="print at most this many predictions")
+    ap.add_argument("--synthetic", type=int, default=0, metavar="N",
+                    help="predict N random images instead of -f data")
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    from bigdl_tpu.ml import DLClassifierModel
+
+    if args.model:
+        from bigdl_tpu.utils.serialization import load_module
+        model = load_module(args.model)
+    else:
+        from bigdl_tpu.models.inception import Inception_v1_NoAuxClassifier
+        model = Inception_v1_NoAuxClassifier(args.classNum)
+
+    clf = DLClassifierModel(model, batch_size=args.batchSize)
+    if args.synthetic:
+        rng = np.random.RandomState(0)
+        names = [f"synthetic_{i}.jpg" for i in range(args.synthetic)]
+        imgs = rng.rand(args.synthetic, 3, IMAGE_SIZE,
+                        IMAGE_SIZE).astype(np.float32)
+        out = list(zip(names, clf.predict(imgs).tolist()))
+    else:
+        names = scan_images(args.folder)
+        if not names:
+            raise SystemExit(f"no images found under {args.folder}")
+        out = []
+        for i in range(0, len(names), args.batchSize):
+            chunk = names[i:i + args.batchSize]
+            out.extend(zip(chunk, clf.predict(decode_batch(chunk)).tolist()))
+
+    for name, pred in out[:args.showNum]:
+        print(f"{os.path.basename(str(name))}: {pred}")
+    return out
+
+
+if __name__ == "__main__":
+    main()

@@ -40,3 +40,26 @@ def test_tree_lstm_sentiment_example():
     from examples.tree_lstm_sentiment import main
     acc = main(["--trees", "120"])
     assert acc > 0.8  # majority-polarity sentiment is learnable
+
+
+def test_image_classification_example(capsys):
+    """example/imageclassification ImagePredictor.scala — load model,
+    predict a folder (synthetic stand-in), print name -> class."""
+    from examples.image_classification import main
+    out = main(["--synthetic", "6", "--classNum", "10", "-b", "4"])
+    assert len(out) == 6
+    assert all(1 <= p <= 10 for _, p in out)
+    assert "synthetic_0.jpg:" in capsys.readouterr().out
+
+
+def test_image_classification_example_real_images(tmp_path):
+    """Folder scan + decode + center-crop path with real (tiny) JPEGs."""
+    import numpy as np
+    from PIL import Image
+    for i in range(3):
+        arr = np.random.RandomState(i).randint(
+            0, 255, (300, 260, 3), np.uint8)
+        Image.fromarray(arr).save(tmp_path / f"img_{i}.jpg")
+    from examples.image_classification import main
+    out = main(["-f", str(tmp_path), "--classNum", "10", "-b", "2"])
+    assert len(out) == 3

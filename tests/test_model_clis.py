@@ -92,3 +92,73 @@ def test_lenet_test_cli_quantized(capsys):
     results = main(["--synthetic", "32", "-b", "16", "--quantize"])
     out = capsys.readouterr().out
     assert "Top1Accuracy" in out and results
+
+
+def test_rnn_test_cli_evaluate(capsys):
+    """Evaluate branch of models/rnn/Test.scala:55-90 — Loss over a
+    TimeDistributed CrossEntropy, perplexity printed."""
+    from bigdl_tpu.models.rnn.test import main
+    results = main(["--synthetic", "400", "-b", "4", "--vocabSize", "30",
+                    "--numSteps", "5"])
+    out = capsys.readouterr().out
+    assert "Loss" in out and "perplexity" in out and results
+
+
+def test_rnn_test_cli_generate():
+    """Generation branch (Test.scala:91-137) — each step appends one
+    predicted token."""
+    from bigdl_tpu.models.rnn.test import main
+    gen = main(["--synthetic", "200", "-b", "4", "--vocabSize", "30",
+                "--numSteps", "5", "--numOfWords", "3"])
+    assert gen.shape[1] == 5 + 3
+
+
+def test_rnn_test_cli_from_snapshot(tmp_path):
+    """Trained snapshot round-trips into the test main (the reference's
+    Module.load path, Test.scala:52)."""
+    from bigdl_tpu.models.rnn.test import main as test_main
+    from bigdl_tpu.models.rnn.train import main as train_main
+    from bigdl_tpu.utils.serialization import save_module
+
+    model = train_main(["--synthetic", "400", "-b", "4", "--vocabSize",
+                        "30", "--numSteps", "5", "--maxIterations", "2"])
+    snap = str(tmp_path / "rnn_snap")
+    save_module(snap, model)
+    results = test_main(["--synthetic", "200", "-b", "4", "--vocabSize",
+                         "30", "--numSteps", "5", "--model", snap])
+    assert "Loss" in results
+
+
+def test_inception_test_cli(capsys):
+    from bigdl_tpu.models.inception.test import main
+    results = main(["--synthetic", "8", "-b", "4", "--classNum", "10"])
+    out = capsys.readouterr().out
+    assert "Top1Accuracy" in out and "Top5Accuracy" in out and results
+
+
+def test_autoencoder_test_cli(capsys):
+    from bigdl_tpu.models.autoencoder.test import main
+    results = main(["--synthetic", "32", "-b", "16"])
+    out = capsys.readouterr().out
+    assert "Loss" in out and results
+
+
+def test_rnn_dictionary_roundtrip(tmp_path):
+    """Train saves the vocabulary; test reloads it so words keep their
+    training-time indices (Train.scala:90 vocab.save / Test.scala:52
+    Dictionary(folder))."""
+    import os
+    from bigdl_tpu.models.rnn.test import main as test_main
+    from bigdl_tpu.models.rnn.train import main as train_main
+
+    txt = tmp_path / "train.txt"
+    txt.write_text("the cat sat on the mat\n" * 30)
+    ck = tmp_path / "ck"
+    train_main(["-f", str(txt), "--vocabSize", "20", "-b", "4",
+                "--numSteps", "4", "--maxIterations", "2",
+                "--checkpoint", str(ck)])
+    dict_path = ck / "dictionary.json"
+    assert dict_path.exists()
+    results = test_main(["-f", str(txt), "-b", "4", "--numSteps", "4",
+                         "--dictionary", str(dict_path)])
+    assert "Loss" in results
