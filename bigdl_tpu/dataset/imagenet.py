@@ -176,13 +176,42 @@ def write_image_record_shards(folder: str, out_dir: str, *,
 
 class _Augmenter:
     """Per-sample decode + augment: resize-shorter-side, crop, flip,
-    normalize -> CHW float32 (BGRImgCropper + HFlip + BGRImgNormalizer)."""
+    optional color jitter + PCA lighting, normalize -> CHW float32
+    (BGRImgCropper + HFlip + ColorJitter.scala + Lighting.scala +
+    BGRImgNormalizer)."""
+
+    # AlexNet PCA statistics (Lighting.scala:40-43), stated on 0-1 pixels;
+    # the shift is scaled to this pipeline's 0-255 space at apply time
+    _EIGVAL = np.array([0.2175, 0.0188, 0.0045], np.float32)
+    _EIGVEC = np.array([[-0.5675, 0.7192, 0.4009],
+                        [-0.5808, -0.0045, -0.8140],
+                        [-0.5836, -0.6948, 0.4203]], np.float32)
+    _LUMA = np.array([0.299, 0.587, 0.114], np.float32).reshape(3, 1, 1)
 
     def __init__(self, crop: int, scale: int, train: bool,
-                 mean: Sequence[float], std: Sequence[float]):
+                 mean: Sequence[float], std: Sequence[float],
+                 color_jitter: bool = False, lighting: bool = False):
         self.crop, self.scale, self.train = crop, scale, train
         self.mean = np.asarray(mean, np.float32).reshape(3, 1, 1)
         self.std = np.asarray(std, np.float32).reshape(3, 1, 1)
+        self.color_jitter = color_jitter
+        self.lighting = lighting
+
+    def _jitter(self, chw: np.ndarray, rng) -> np.ndarray:
+        """Brightness/contrast/saturation, random order, each blending
+        toward black / gray mean / per-pixel luma (ColorJitter.scala:52-
+        83; variance 0.4 as in its bcsParameters)."""
+        for kind in rng.permutation(3):
+            alpha = 1.0 + rng.uniform(-0.4, 0.4)
+            if kind == 0:    # brightness: blend with black
+                chw = chw * alpha
+            elif kind == 1:  # contrast: blend with mean gray
+                gray = (chw * self._LUMA).sum(0).mean()
+                chw = chw * alpha + gray * (1 - alpha)
+            else:            # saturation: blend with per-pixel gray
+                gs = (chw * self._LUMA).sum(0, keepdims=True)
+                chw = chw * alpha + gs * (1 - alpha)
+        return chw
 
     def __call__(self, raw, rng: np.random.RandomState) -> np.ndarray:
         img = decode_image(raw, scale=self.scale)
@@ -197,6 +226,12 @@ class _Augmenter:
         if self.train and rng.rand() < 0.5:
             img = img[:, ::-1]
         chw = img.transpose(2, 0, 1).astype(np.float32)
+        if self.train and self.color_jitter:
+            chw = self._jitter(chw, rng)
+        if self.train and self.lighting:
+            alpha = rng.normal(0, 0.1, 3).astype(np.float32)
+            shift = (self._EIGVEC * alpha * self._EIGVAL).sum(1) * 255.0
+            chw = chw + shift.reshape(3, 1, 1)
         return (chw - self.mean) / self.std
 
 
@@ -222,7 +257,8 @@ class ImageFolderDataSet(AbstractDataSet):
                  std: Sequence[float] = IMAGENET_STD,
                  num_threads: int = 8, prefetch: int = 8,
                  process_index: int = 0, process_count: int = 1,
-                 seed: int = 0):
+                 seed: int = 0, color_jitter: bool = False,
+                 lighting: bool = False):
         if (folder is None) == (record_shards is None):
             raise ValueError("pass exactly one of folder / record_shards")
         if folder is not None:
@@ -244,6 +280,7 @@ class ImageFolderDataSet(AbstractDataSet):
         self.seed = seed
         self._mean, self._std = mean, std
         self._crop, self._scale = crop, scale
+        self._color_jitter, self._lighting = color_jitter, lighting
         self._train_pool: Optional[_BatchPool] = None
 
     def size(self) -> int:
@@ -263,7 +300,9 @@ class ImageFolderDataSet(AbstractDataSet):
                 self._train_pool = _BatchPool(
                     self._items, self.batch_size,
                     _Augmenter(self._crop, self._scale, True,
-                               self._mean, self._std),
+                               self._mean, self._std,
+                               color_jitter=self._color_jitter,
+                               lighting=self._lighting),
                     num_threads=self.num_threads, prefetch=self.prefetch,
                     seed=self.seed)
             pool = self._train_pool
@@ -275,15 +314,28 @@ class ImageFolderDataSet(AbstractDataSet):
 
         aug = _Augmenter(self._crop, self._scale, False,
                          self._mean, self._std)
-        rng = np.random.RandomState(0)
+        items, bs = self._items, self.batch_size
+
+        def make_batch(start):
+            chunk = items[start:start + bs]
+            rng = np.random.RandomState(0)  # unused: eval is deterministic
+            imgs = np.stack([aug(raw, rng) for raw, _ in chunk])
+            lbls = np.asarray([lbl for _, lbl in chunk], np.float32)
+            return MiniBatch(imgs, lbls)
 
         def eval_it():
-            n = len(self._items)
-            for start in range(0, n, self.batch_size):
-                chunk = self._items[start:start + self.batch_size]
-                imgs = np.stack([aug(raw, rng) for raw, _ in chunk])
-                lbls = np.asarray([lbl for _, lbl in chunk], np.float32)
-                yield MiniBatch(imgs, lbls)
+            # threaded ordered prefetch: the reference runs val through
+            # the same MT batcher as train (MTLabeledBGRImgToBatch.scala)
+            from collections import deque
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(max_workers=self.num_threads) as ex:
+                window: deque = deque()
+                for start in range(0, len(items), bs):
+                    window.append(ex.submit(make_batch, start))
+                    if len(window) > max(2, self.prefetch):
+                        yield window.popleft().result()
+                while window:
+                    yield window.popleft().result()
         return eval_it()
 
     def close(self):

@@ -14,9 +14,18 @@ def main(argv=None):
     from bigdl_tpu.models._cli import (arrays_to_dataset, base_parser,
                                        load_model_or, wire_optimizer)
 
+    import argparse
+
     ap = base_parser("Train Inception-v1 on ImageNet")
     ap.add_argument("--weightDecay", type=float, default=1e-4)
     ap.add_argument("--classNum", type=int, default=1000)
+    ap.add_argument("--colorJitter", action=argparse.BooleanOptionalAction,
+                    default=False,
+                    help="random brightness/contrast/saturation "
+                         "(ColorJitter.scala)")
+    ap.add_argument("--lighting", action=argparse.BooleanOptionalAction,
+                    default=False,
+                    help="AlexNet PCA lighting noise (Lighting.scala)")
     args = ap.parse_args(argv)
 
     import numpy as np
@@ -39,7 +48,8 @@ def main(argv=None):
     else:
         from bigdl_tpu.dataset import ImageFolderDataSet
         ds = ImageFolderDataSet(args.folder, batch_size=bs, crop=224,
-                                scale=256)
+                                scale=256, color_jitter=args.colorJitter,
+                                lighting=args.lighting)
         n_train = ds.size()
         val_ds = None
 

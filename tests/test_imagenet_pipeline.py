@@ -179,3 +179,59 @@ def test_distri_optimizer_trains_from_image_folder(tmp_path):
         assert opt.driver_state["Loss"] < 0.2
     finally:
         ds.close()
+
+
+def test_augmenter_color_jitter_lighting(tmp_path):
+    """ColorJitter.scala / Lighting.scala analogues: train-time flags
+    perturb pixels; eval output stays deterministic and untouched."""
+    from bigdl_tpu.dataset.imagenet import _Augmenter
+
+    _make_folder(str(tmp_path), classes=("a",), per_class=1)
+    paths, _, _ = list_image_folder(str(tmp_path))
+    plain = _Augmenter(24, 32, True, (0, 0, 0), (1, 1, 1))
+    jit = _Augmenter(24, 32, True, (0, 0, 0), (1, 1, 1),
+                     color_jitter=True, lighting=True)
+    a = plain(paths[0], np.random.RandomState(7))
+    b = jit(paths[0], np.random.RandomState(7))  # same crop/flip draws
+    assert a.shape == b.shape == (3, 24, 24)
+    assert not np.allclose(a, b)          # photometric noise applied
+    assert np.abs(a - b).mean() < 128.0   # ... but bounded
+    # eval ignores the flags entirely
+    ev = _Augmenter(24, 32, False, (0, 0, 0), (1, 1, 1),
+                    color_jitter=True, lighting=True)
+    ev_plain = _Augmenter(24, 32, False, (0, 0, 0), (1, 1, 1))
+    np.testing.assert_array_equal(ev(paths[0], np.random.RandomState(0)),
+                                  ev_plain(paths[0],
+                                           np.random.RandomState(1)))
+
+
+def test_threaded_eval_order_matches_items(tmp_path):
+    """Eval decode runs on a thread pool but must keep the sorted file
+    order and exact per-epoch coverage (MTLabeledBGRImgToBatch is used
+    for val too in the reference)."""
+    _make_folder(str(tmp_path), classes=("a", "b", "c"), per_class=5)
+    ds = ImageFolderDataSet(str(tmp_path), batch_size=4, crop=24, scale=32,
+                            num_threads=4, prefetch=2)
+    try:
+        batches = list(ds.data(train=False))
+        lbls = np.concatenate([np.asarray(b.target) for b in batches])
+        # sorted class order -> labels are non-decreasing 1,1,..2,..3
+        np.testing.assert_array_equal(lbls, np.sort(lbls))
+        assert len(lbls) == 15
+        again = list(ds.data(train=False))
+        for b1, b2 in zip(batches, again):
+            np.testing.assert_array_equal(b1.input, b2.input)
+    finally:
+        ds.close()
+
+
+def test_image_folder_dataset_jitter_flags_train(tmp_path):
+    _make_folder(str(tmp_path))
+    ds = ImageFolderDataSet(str(tmp_path), batch_size=4, crop=24, scale=32,
+                            num_threads=2, color_jitter=True, lighting=True)
+    try:
+        b = next(ds.data(train=True))
+        assert b.input.shape == (4, 3, 24, 24)
+        assert np.isfinite(np.asarray(b.input)).all()
+    finally:
+        ds.close()

@@ -162,3 +162,16 @@ def test_rnn_dictionary_roundtrip(tmp_path):
     results = test_main(["-f", str(txt), "-b", "4", "--numSteps", "4",
                          "--dictionary", str(dict_path)])
     assert "Loss" in results
+
+
+def test_resnet_imagenet_train_cli():
+    """ImageNet branch: ResNet-18 recipe with the fb.resnet step
+    schedule; jitter/lighting flags are parsed (folder path wires them
+    into ImageFolderDataSet)."""
+    from bigdl_tpu.models.resnet.train import imagenet_decay, main
+    assert imagenet_decay(29) == 0.0
+    assert imagenet_decay(30) == 1.0
+    assert imagenet_decay(60) == 2.0
+    assert main(["--synthetic", "8", "-b", "4", "--dataset", "imagenet",
+                 "--depth", "18", "--classNum", "10",
+                 "--maxIterations", "2"]) is not None
