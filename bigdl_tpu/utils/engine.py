@@ -59,6 +59,7 @@ class Engine:
     def init_distributed(cls, coordinator_address: Optional[str] = None,
                          num_processes: Optional[int] = None,
                          process_id: Optional[int] = None,
+                         initialization_timeout: Optional[int] = None,
                          **init_kwargs) -> "Engine":
         """Multi-host bring-up: ``jax.distributed.initialize`` then
         ``init()`` — the role the reference's Engine.init played on Spark
@@ -69,9 +70,12 @@ class Engine:
         if not cls._distributed_started:
             # jax.distributed.initialize is once-per-process and cannot
             # be undone by Engine.reset()
+            kw = {}
+            if initialization_timeout is not None:
+                kw["initialization_timeout"] = initialization_timeout
             jax.distributed.initialize(
                 coordinator_address=coordinator_address,
-                num_processes=num_processes, process_id=process_id)
+                num_processes=num_processes, process_id=process_id, **kw)
             cls._distributed_started = True
         return cls.init(**init_kwargs)
 

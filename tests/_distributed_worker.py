@@ -1,0 +1,86 @@
+"""Worker process for the two-process jax.distributed smoke test
+(spawned by test_distributed_smoke.py; not itself a pytest file).
+
+Brings up Engine.init_distributed (Engine.scala:100-103's executor
+bring-up role), then exercises one cross-process psum and one tiny
+data-parallel SGD step whose result must match the sequential update.
+Prints one JSON line: {"ok": true, ...} on success, {"skip": reason}
+when the runtime lacks cross-process CPU collectives.
+"""
+import json
+import os
+import sys
+
+
+def main():
+    port, pid = sys.argv[1], int(sys.argv[2])
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+
+    import numpy as np
+
+    try:
+        import jax
+
+        # the container's sitecustomize initializes backends at
+        # interpreter startup; drop them so the distributed client is
+        # wired into the fresh CPU client (same trick as conftest.py)
+        jax.config.update("jax_platforms", "cpu")
+        try:
+            jax.extend.backend.clear_backends()
+        except Exception:
+            pass
+
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from bigdl_tpu.utils.engine import Engine
+
+        Engine.init_distributed(coordinator_address=f"127.0.0.1:{port}",
+                                num_processes=2, process_id=pid,
+                                initialization_timeout=60)
+        assert jax.process_count() == 2, jax.process_count()
+        assert Engine.node_number() == 2
+        mesh = Engine.mesh()
+        assert mesh.devices.size == 2
+
+        def replicated_value(arr):
+            return np.asarray(
+                jax.device_get(arr.addressable_shards[0].data))
+
+        # 1. one psum: global sum of per-process contributions
+        shard = NamedSharding(mesh, P("data"))
+        repl = NamedSharding(mesh, P())
+        local = np.array([float(pid + 1)], np.float32)
+        garr = jax.make_array_from_process_local_data(shard, local, (2,))
+        total = jax.jit(jnp.sum, out_shardings=repl)(garr)
+        tval = float(replicated_value(total))
+        assert tval == 3.0, tval
+
+        # 2. one DP step on a global batch sharded across the processes
+        xs = np.arange(1, 9, dtype=np.float32).reshape(8, 1)
+        ys = 2.0 * xs
+        gx = jax.make_array_from_process_local_data(
+            shard, xs[pid * 4:(pid + 1) * 4], (8, 1))
+        gy = jax.make_array_from_process_local_data(
+            shard, ys[pid * 4:(pid + 1) * 4], (8, 1))
+        w0 = jnp.zeros((1, 1), jnp.float32)
+
+        @lambda f: jax.jit(f, out_shardings=repl)
+        def step(w, x, y):
+            g = jax.grad(lambda w: jnp.mean((x @ w - y) ** 2))(w)
+            return w - 0.01 * g
+
+        w1 = float(replicated_value(step(w0, gx, gy)))
+        w_ref = float(-0.01 * (2.0 * (0.0 * xs - ys) * xs).mean())
+        assert abs(w1 - w_ref) < 1e-6, (w1, w_ref)
+
+        print(json.dumps({"ok": True, "psum": tval, "w1": w1}))
+    except (AssertionError,):
+        raise
+    except Exception as e:  # runtime without cross-process CPU support
+        print(json.dumps({"skip": f"{type(e).__name__}: {e}"}))
+
+
+if __name__ == "__main__":
+    main()
