@@ -1,0 +1,354 @@
+"""In-graph TF input pipelines executed on the host (reference:
+nn/ops/ParseExample.scala, nn/ops/DecodeImage.scala, and the
+queue-runner input graphs BigDLSessionImpl trains from,
+utils/tf/Session.scala:104-110).
+
+The reference runs readers/queues/ParseExample as graph ops on Spark
+partitions. The TPU build splits the graph instead: everything from
+reader nodes down to the last string-typed op runs HERE on host numpy
+(JAX cannot trace ragged string tensors), and the dense boundary
+tensors feed the jitted device graph — the same host/device split the
+driver's data feed uses everywhere else. Queues are stateful Python
+objects whose elements are pulled lazily from their enqueue subgraphs,
+so ``string_input_producer -> TFRecordReader -> batch -> ParseExample``
+executes with the reference's semantics (cycling filename epochs,
+streaming reads) without a queue-runner thread.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+# ops that force host execution (everything upstream of their outputs
+# runs on host; the refs their consumers read become device feeds)
+HOST_OPS = frozenset({
+    "TFRecordReaderV2", "TFRecordReader", "WholeFileReaderV2",
+    "IdentityReaderV2", "ReaderReadV2", "ReaderRead", "ReaderReadUpToV2",
+    "FIFOQueueV2", "FIFOQueue", "PaddingFIFOQueueV2",
+    "RandomShuffleQueueV2", "RandomShuffleQueue",
+    "QueueDequeueV2", "QueueDequeue", "QueueDequeueManyV2",
+    "QueueDequeueMany", "QueueDequeueUpToV2",
+    "QueueEnqueueV2", "QueueEnqueue", "QueueEnqueueManyV2",
+    "QueueEnqueueMany", "QueueCloseV2", "QueueSizeV2",
+    "ParseExample", "ParseExampleV2", "ParseSingleExample",
+    "DecodeJpeg", "DecodePng", "DecodeImage", "DecodeBmp", "DecodeRaw",
+})
+
+
+def _base(ref: str) -> str:
+    return ref.split(":")[0].lstrip("^")
+
+
+def _out_idx(ref: str) -> int:
+    return int(ref.split(":")[1]) if ":" in ref else 0
+
+
+def find_boundary_refs(nodes, by_name, outputs: Sequence[str]
+                       ) -> List[str]:
+    """Walk the requested outputs' ancestry; stop at host nodes and
+    collect the tensor refs where host data crosses into the device
+    graph. Deterministic order (sorted)."""
+    boundary = set()
+    seen = set()
+    stack = [_base(o) for o in outputs]
+    while stack:
+        name = stack.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        node = by_name.get(name)
+        if node is None:
+            continue
+        for ref in node.inputs:
+            if ref.startswith("^"):
+                continue
+            src = by_name.get(_base(ref))
+            if src is not None and src.op in HOST_OPS:
+                boundary.add(ref)
+            else:
+                stack.append(_base(ref))
+    return sorted(boundary)
+
+
+def has_input_pipeline(nodes) -> bool:
+    return any(n.op in HOST_OPS for n in nodes)
+
+
+class _Queue:
+    """FIFO/shuffle queue whose elements are pulled lazily from its
+    QueueEnqueue(Many) subgraphs (replaces the queue-runner thread)."""
+
+    def __init__(self, host: "HostInputGraph", qnode):
+        self.host = host
+        self.name = qnode.name
+        self.shuffle = "Shuffle" in qnode.op
+        self.enqs = [n for n in host.nodes
+                     if n.op.startswith("QueueEnqueue")
+                     and _base(n.inputs[0]) == qnode.name]
+        if not self.enqs:
+            raise ValueError(
+                f"queue {qnode.name} has no enqueue ops in the graph")
+        self.buf: deque = deque()
+
+    def dequeue(self):
+        if not self.buf:
+            self._fill()
+        if self.shuffle and len(self.buf) > 1:
+            i = int(self.host.rng.randint(0, len(self.buf)))
+            self.buf.rotate(-i)
+            out = self.buf.popleft()
+            self.buf.rotate(i)
+            return out
+        return self.buf.popleft()
+
+    def _fill(self):
+        for enq in self.enqs:
+            cache: Dict[str, Any] = {}  # fresh: reader state advances
+            comps = [self.host.eval_ref(r, cache)
+                     for r in enq.inputs[1:] if not r.startswith("^")]
+            if enq.op.startswith("QueueEnqueueMany"):
+                for i in range(len(comps[0])):
+                    self.buf.append(tuple(c[i] for c in comps))
+            else:
+                self.buf.append(tuple(comps))
+        if not self.buf:
+            raise RuntimeError(
+                f"queue {self.name}: enqueue sources produced no "
+                "elements")
+
+
+class _Reader:
+    """TFRecord/whole-file reader state: current file iterator plus the
+    filename queue it pulls from (ReaderReadV2 semantics)."""
+
+    def __init__(self, host: "HostInputGraph", kind: str):
+        self.host = host
+        self.kind = kind
+        self._it = None
+        self._fname = None
+        self._rec = 0
+        self._override_pos = 0
+
+    def _next_file(self, queue: Optional[_Queue]) -> str:
+        if self.host.record_files is not None:
+            files = self.host.record_files
+            f = files[self._override_pos % len(files)]
+            self._override_pos += 1
+            return f
+        if queue is None:
+            raise ValueError("reader has no filename queue")
+        el = queue.dequeue()
+        f = el[0] if isinstance(el, tuple) else el
+        if isinstance(f, np.ndarray):
+            f = f.item()
+        return f.decode() if isinstance(f, bytes) else str(f)
+
+    def read(self, queue: Optional[_Queue]):
+        from bigdl_tpu.utils.tfrecord import read_tfrecord
+        while True:
+            if self._it is None:
+                self._fname = self._next_file(queue)
+                self._rec = 0
+                if self.kind == "whole":
+                    def whole():
+                        with open(self._fname, "rb") as fh:
+                            yield fh.read()
+                    self._it = whole()
+                else:
+                    self._it = read_tfrecord(self._fname)
+            try:
+                value = next(self._it)
+                key = f"{self._fname}:{self._rec}".encode()
+                self._rec += 1
+                return (key, value)
+            except StopIteration:
+                self._it = None
+
+
+class HostInputGraph:
+    """Evaluates the host-side input region of an imported GraphDef.
+
+    ``batch(boundary_refs)`` yields, per training iteration, the numpy
+    values of the boundary tensors (one shared evaluation, so a
+    ParseExample producing features AND labels parses each record
+    once). ``record_files`` substitutes the .tfrecord paths baked into
+    the exporting machine's graph.
+    """
+
+    def __init__(self, nodes, *, record_files: Optional[Sequence[str]]
+                 = None, seed: int = 0):
+        self.nodes = list(nodes)
+        self.by_name = {n.name: n for n in self.nodes}
+        self.record_files = (list(record_files)
+                             if record_files is not None else None)
+        self.rng = np.random.RandomState(seed)
+        self._queues: Dict[str, _Queue] = {}
+        self._readers: Dict[str, _Reader] = {}
+
+    # ------------------------------------------------------- evaluation
+    def eval_ref(self, ref: str, cache: Dict[str, Any]):
+        name = _base(ref)
+        if name not in cache:
+            node = self.by_name[name]
+            cache[name] = self._eval_node(node, cache)
+        v = cache[name]
+        idx = _out_idx(ref)
+        return v[idx] if isinstance(v, tuple) else v
+
+    def _inputs(self, node) -> List[str]:
+        return [r for r in node.inputs if not r.startswith("^")]
+
+    def _eval_node(self, node, cache):
+        op = node.op
+        ins = self._inputs(node)
+        if op == "Const":
+            return np.asarray(node.attrs.get("value"))
+        if op in ("Identity", "StopGradient", "PreventGradient"):
+            return self.eval_ref(ins[0], cache)
+        if op == "RandomShuffle":
+            arr = np.asarray(self.eval_ref(ins[0], cache))
+            return self.rng.permutation(arr)
+        if op in ("TFRecordReaderV2", "TFRecordReader"):
+            return self._readers.setdefault(
+                node.name, _Reader(self, "tfrecord"))
+        if op in ("WholeFileReaderV2", "IdentityReaderV2"):
+            return self._readers.setdefault(
+                node.name, _Reader(self, "whole"))
+        if op.startswith("FIFOQueue") or op.startswith(
+                "RandomShuffleQueue") or op.startswith("PaddingFIFOQueue"):
+            return self._queues.setdefault(node.name, _Queue(self, node))
+        if op in ("ReaderReadV2", "ReaderRead"):
+            reader = self.eval_ref(ins[0], cache)
+            queue = self.eval_ref(ins[1], cache) if len(ins) > 1 else None
+            return reader.read(queue)
+        if op.startswith("QueueDequeueMany") or \
+                op.startswith("QueueDequeueUpTo"):
+            q = self.eval_ref(ins[0], cache)
+            n = int(np.asarray(self.eval_ref(ins[1], cache)))
+            els = [q.dequeue() for _ in range(n)]
+            return self._stack_elements(els)
+        if op.startswith("QueueDequeue"):
+            q = self.eval_ref(ins[0], cache)
+            el = q.dequeue()
+            return el if len(el) > 1 else el[0]
+        if op in ("ParseExample", "ParseExampleV2"):
+            return self._parse_example(node, cache)
+        if op in ("DecodeJpeg", "DecodePng", "DecodeImage", "DecodeBmp"):
+            from bigdl_tpu.dataset.imagenet import decode_image
+            data = self.eval_ref(ins[0], cache)
+            return decode_image(bytes(np.asarray(data).item()))
+        if op == "DecodeRaw":
+            out_t = node.attrs.get("out_type", np.float32)
+            data = np.asarray(self.eval_ref(ins[0], cache))
+            if data.ndim == 0:
+                return np.frombuffer(data.item(), dtype=out_t)
+            return np.stack([np.frombuffer(d, dtype=out_t)
+                             for d in data.ravel()]).reshape(
+                                 data.shape + (-1,))
+        if op == "Cast":
+            dst = node.attrs.get("DstT", np.float32)
+            return np.asarray(self.eval_ref(ins[0], cache)).astype(dst)
+        if op == "Reshape":
+            x = np.asarray(self.eval_ref(ins[0], cache))
+            shp = np.asarray(self.eval_ref(ins[1], cache)).astype(int)
+            return x.reshape(tuple(shp))
+        if op == "ExpandDims":
+            x = np.asarray(self.eval_ref(ins[0], cache))
+            ax = int(np.asarray(self.eval_ref(ins[1], cache)))
+            return np.expand_dims(x, ax)
+        if op == "Squeeze":
+            x = np.asarray(self.eval_ref(ins[0], cache))
+            dims = node.attrs.get("squeeze_dims") or None
+            return np.squeeze(x, tuple(dims) if dims else None)
+        raise ValueError(
+            f"unsupported host input op {op} (node {node.name}); "
+            "supported: readers, queues, ParseExample, DecodeJpeg/Png/"
+            "Raw and numpy glue (Cast/Reshape/ExpandDims/Squeeze)")
+
+    @staticmethod
+    def _stack_elements(els):
+        comps = []
+        for i in range(len(els[0])):
+            col = [e[i] for e in els]
+            if isinstance(col[0], (bytes, bytearray, str)) or (
+                    isinstance(col[0], np.ndarray)
+                    and col[0].dtype == object) or (
+                    isinstance(col[0], np.generic)
+                    and col[0].dtype == object):
+                arr = np.empty(len(col), object)
+                arr[:] = [c.item() if isinstance(c, np.ndarray) else c
+                          for c in col]
+                comps.append(arr)
+            else:
+                comps.append(np.stack([np.asarray(c) for c in col]))
+        return tuple(comps) if len(comps) > 1 else comps[0]
+
+    # ---------------------------------------------------- ParseExample
+    def _parse_example(self, node, cache):
+        """Dense-feature tf.Example batch parse (ParseExample.scala:1;
+        v1 layout Nsparse/Ndense attrs + per-key Const inputs, v2 layout
+        vector-Const keys). Sparse outputs are not supported."""
+        from bigdl_tpu.utils.tfrecord import parse_example
+
+        ins = self._inputs(node)
+        if node.op == "ParseExampleV2":
+            serialized = self.eval_ref(ins[0], cache)
+            sparse_keys = [self._to_str(k) for k in
+                           np.asarray(self.eval_ref(ins[2], cache)).ravel()]
+            dense_keys = [self._to_str(k) for k in
+                          np.asarray(self.eval_ref(ins[3], cache)).ravel()]
+            defaults = [np.asarray(self.eval_ref(r, cache))
+                        for r in ins[5:5 + len(dense_keys)]]
+        else:
+            n_sparse = int(node.attrs.get("Nsparse", 0))
+            n_dense = int(node.attrs.get("Ndense", 0))
+            serialized = self.eval_ref(ins[0], cache)
+            sparse_keys = [self._to_str(np.asarray(
+                self.eval_ref(r, cache)).item())
+                for r in ins[2:2 + n_sparse]]
+            dense_keys = [self._to_str(np.asarray(
+                self.eval_ref(r, cache)).item())
+                for r in ins[2 + n_sparse:2 + n_sparse + n_dense]]
+            defaults = [np.asarray(self.eval_ref(r, cache))
+                        for r in ins[2 + n_sparse + n_dense:
+                                     2 + n_sparse + n_dense + n_dense]]
+        if sparse_keys:
+            raise ValueError(
+                "ParseExample with sparse features is not supported; "
+                "use dense FixedLenFeatures")
+        dtypes = node.attrs.get("Tdense") or [np.float32] * len(dense_keys)
+        shapes = node.attrs.get("dense_shapes") or [[]] * len(dense_keys)
+
+        serialized = np.asarray(serialized)
+        scalar_in = serialized.ndim == 0
+        rows = [parse_example(bytes(s))
+                for s in np.atleast_1d(serialized.ravel())]
+        outs = []
+        for k, dt, shp, dflt in zip(dense_keys, dtypes, shapes, defaults):
+            col = []
+            for row in rows:
+                v = row.get(k)
+                if v is None:
+                    if dflt.size == 0:
+                        raise ValueError(
+                            f"record missing required feature '{k}'")
+                    v = dflt
+                col.append(np.asarray(v, dt).reshape(tuple(shp)))
+            stacked = np.stack(col)
+            outs.append(stacked[0] if scalar_in else stacked)
+        return tuple(outs) if len(outs) > 1 else outs[0]
+
+    @staticmethod
+    def _to_str(k) -> str:
+        return k.decode() if isinstance(k, bytes) else str(k)
+
+    # ------------------------------------------------------- iteration
+    def batches(self, boundary_refs: Sequence[str]):
+        """Infinite generator of per-iteration boundary values (the
+        Session's feed source, Session.scala:104)."""
+        while True:
+            cache: Dict[str, Any] = {}
+            yield [np.asarray(self.eval_ref(r, cache))
+                   for r in boundary_refs]

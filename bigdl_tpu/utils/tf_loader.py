@@ -24,8 +24,8 @@ from bigdl_tpu.utils import proto
 import ml_dtypes as _ml_dtypes
 
 _DTYPES = {1: np.float32, 2: np.float64, 3: np.int32, 4: np.uint8,
-           5: np.int16, 6: np.int8, 9: np.int64, 10: np.bool_,
-           14: _ml_dtypes.bfloat16, 19: np.float16}
+           5: np.int16, 6: np.int8, 7: np.object_, 9: np.int64,
+           10: np.bool_, 14: _ml_dtypes.bfloat16, 19: np.float16}
 
 
 def _parse_shape(buf: bytes) -> List[int]:
@@ -39,10 +39,19 @@ def _parse_shape(buf: bytes) -> List[int]:
 
 def _parse_tensor(buf: bytes) -> np.ndarray:
     """TensorProto: dtype=1, tensor_shape=2, tensor_content=4,
-    float_val=5, double_val=6, int_val=7, int64_val=10, bool_val=11."""
+    float_val=5, double_val=6, int_val=7, string_val=8, int64_val=10,
+    bool_val=11."""
     f = proto.parse_message(buf)
-    dtype = _DTYPES.get(f.get(1, [1])[0], np.float32)
+    dtype_enum = f.get(1, [1])[0]
+    dtype = _DTYPES.get(dtype_enum, np.float32)
     shape = _parse_shape(f[2][0]) if 2 in f else []
+    if dtype_enum == 7:  # DT_STRING: object array of bytes
+        vals = [bytes(v) for v in f.get(8, [])]
+        arr = np.empty(len(vals), object)
+        arr[:] = vals
+        if shape:
+            return arr.reshape(shape)
+        return arr.reshape(()) if arr.size == 1 else arr
     if 4 in f and f[4][0]:
         arr = np.frombuffer(f[4][0], dtype=dtype)
     else:
@@ -103,6 +112,19 @@ def _parse_attr(buf: bytes) -> Any:
         return _parse_tensor(f[8][0])
     if 1 in f:
         lf = proto.parse_message(f[1][0])
+        if 2 in lf:   # list(string)
+            return [proto.as_string(b) for b in lf[2]]
+        if 6 in lf:   # list(type)
+            types = []
+            for raw in lf[6]:
+                if isinstance(raw, bytes):
+                    types.extend(_DTYPES.get(v, np.float32)
+                                 for v in proto.unpack_packed_varints(raw))
+                else:
+                    types.append(_DTYPES.get(raw, np.float32))
+            return types
+        if 7 in lf:   # list(shape)
+            return [_parse_shape(b) for b in lf[7]]
         out = []
         for raw in lf.get(3, []):  # ints (packed or not)
             if isinstance(raw, bytes):
@@ -478,6 +500,23 @@ class TFModule(Module):
         else:
             feed = {self.input_names[0]: input}
         values: Dict[str, Any] = {}
+        # inputs may be tensor REFS ("parse:1") when a host input
+        # pipeline feeds mid-graph boundary tensors (Session.scala:104's
+        # queue-runner handoff); seed multi-output nodes as tuples
+        ref_feed: Dict[str, Dict[int, Any]] = {}
+        for key, x in list(feed.items()):
+            if ":" in key:
+                nm, idx = key.split(":")[0], int(key.split(":")[1])
+                ref_feed.setdefault(nm, {})[idx] = x
+                del feed[key]
+        for nm, d in ref_feed.items():
+            if nm in feed:
+                d.setdefault(0, feed.pop(nm))
+            if set(d) == {0}:
+                values[nm] = d[0]
+            else:
+                values[nm] = tuple(d.get(i)
+                                   for i in range(max(d) + 1))
 
         def resolve(ref: str):
             name = ref.split(":")[0].lstrip("^")
@@ -636,21 +675,50 @@ class Session:
 
     ``inputs`` are the feature/label placeholder names in MiniBatch order
     (features first, then targets); ``loss`` is the scalar loss node.
+
+    When the graph carries its own input pipeline (queue runners +
+    ParseExample / Decode* nodes, Session.scala:104-110), ``inputs`` may
+    be omitted: the host region is split off and executed on numpy (see
+    utils/tf_input.py), and ``train`` pulls batches straight from the
+    graph's own .tfrecord readers — pass ``record_files`` to point the
+    baked-in reader paths at local files.
     """
 
-    def __init__(self, nodes_or_bytes, inputs: Sequence[str], loss: str):
-        self.module = TFModule(nodes_or_bytes, inputs=inputs,
-                               outputs=[loss])
+    def __init__(self, nodes_or_bytes, inputs: Optional[Sequence[str]]
+                 = None, loss: str = "loss", *,
+                 record_files: Optional[Sequence[str]] = None,
+                 seed: int = 0):
+        from bigdl_tpu.utils import tf_input as _ti
+
+        nodes = (parse_graphdef(bytes(nodes_or_bytes))
+                 if isinstance(nodes_or_bytes, (bytes, bytearray))
+                 else list(nodes_or_bytes))
+        by_name = {n.name: n for n in nodes}
+        self.pipeline = None
+        if inputs is None:
+            if not _ti.has_input_pipeline(nodes):
+                raise ValueError(
+                    "inputs not given and the graph has no in-graph "
+                    "input pipeline (readers/queues/ParseExample)")
+            inputs = _ti.find_boundary_refs(nodes, by_name, [loss])
+            if not inputs:
+                raise ValueError(
+                    "input-pipeline graph: no host->device boundary "
+                    f"tensors found on the ancestry of '{loss}'")
+            self.pipeline = _ti.HostInputGraph(
+                nodes, record_files=record_files, seed=seed)
+        self.module = TFModule(nodes, inputs=inputs, outputs=[loss])
         if not self.module.variable_init:
             raise ValueError(
                 "graph has no Variables to train (frozen graph?)")
         self.loss_name = loss
 
-    def train(self, batches, optim_method, *, end_trigger=None,
+    def train(self, batches=None, optim_method=None, *, end_trigger=None,
               max_iterations: Optional[int] = None,
               epoch_size: Optional[int] = None):
-        """batches: iterable of MiniBatch (or (x, y) tuples). Returns the
-        trained TFModule (params updated in place).
+        """batches: iterable of MiniBatch (or (x, y) tuples); omit it
+        for input-pipeline graphs, which feed themselves from their own
+        readers. Returns the trained TFModule (params updated in place).
 
         ``epoch_size`` (iterations per epoch) makes epoch-based triggers
         (max_epoch/every_epoch) meaningful on infinite batch iterables —
@@ -660,6 +728,15 @@ class Session:
 
         from bigdl_tpu.dataset.sample import MiniBatch
         from bigdl_tpu.optim.trigger import max_iteration as _max_iter
+
+        if optim_method is None:
+            raise ValueError("optim_method is required")
+        if batches is None:
+            if self.pipeline is None:
+                raise ValueError(
+                    "batches is required: this graph has no in-graph "
+                    "input pipeline to feed itself from")
+            batches = self.pipeline.batches(self.module.input_names)
 
         module = self.module
         module.ensure_initialized()

@@ -1,0 +1,203 @@
+"""In-graph TF input pipelines: queue runners + ParseExample executed on
+host, device graph trained from the boundary tensors (reference:
+nn/ops/ParseExample.scala, nn/ops/DecodeImage.scala,
+utils/tf/Session.scala:104-110 — BigDLSessionImpl trains straight off
+queue-runner input graphs)."""
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from bigdl_tpu.utils.tf_loader import Session, TFNode, parse_graphdef
+from bigdl_tpu.utils.tf_input import (HostInputGraph, find_boundary_refs,
+                                      has_input_pipeline)
+
+
+def _write_tfrecord(path, n=64, seed=0):
+    """Linear data y = x.w + 1, serialized by REAL TF (adversarial oracle
+    for the host-side parse)."""
+    rng = np.random.RandomState(seed)
+    w = np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+    xs = rng.randn(n, 4).astype(np.float32)
+    ys = xs @ w + 1.0
+    with tf.io.TFRecordWriter(str(path)) as wr:
+        for i in range(n):
+            ex = tf.train.Example(features=tf.train.Features(feature={
+                "x": tf.train.Feature(float_list=tf.train.FloatList(
+                    value=xs[i])),
+                "y": tf.train.Feature(float_list=tf.train.FloatList(
+                    value=ys[i])),
+            }))
+            wr.write(ex.SerializeToString())
+    return xs, ys
+
+
+def _queue_runner_graph(record_path, batch_size=8):
+    """string_input_producer -> TFRecordReader -> tf.train.batch ->
+    parse_example -> linear model -> MSE loss (the classic v1 export)."""
+    tfv1 = tf.compat.v1
+    g = tfv1.Graph()
+    with g.as_default():
+        tfv1.set_random_seed(7)
+        fq = tfv1.train.string_input_producer([str(record_path)])
+        reader = tfv1.TFRecordReader()
+        _, serialized = reader.read(fq)
+        batch = tfv1.train.batch([serialized], batch_size=batch_size)
+        feats = tfv1.parse_example(batch, {
+            "x": tfv1.FixedLenFeature([4], tf.float32),
+            "y": tfv1.FixedLenFeature([1], tf.float32)})
+        w = tfv1.Variable(tfv1.random.truncated_normal([4, 1],
+                                                       stddev=0.1),
+                          name="w")
+        b = tfv1.Variable(tfv1.zeros([1]), name="b")
+        pred = tfv1.matmul(feats["x"], w) + b
+        tfv1.reduce_mean(tfv1.square(pred - feats["y"]), name="loss")
+    return g.as_graph_def().SerializeToString()
+
+
+def test_session_trains_queue_runner_graph(tmp_path):
+    """The verdict's done-bar: import a TF-exported graph containing
+    ParseExample and train it to lower loss from a .tfrecord."""
+    from bigdl_tpu.optim import SGD
+
+    rec = tmp_path / "train.tfrecord"
+    _write_tfrecord(rec)
+    graph_bytes = _queue_runner_graph(rec)
+
+    sess = Session(graph_bytes, loss="loss")
+    assert sess.pipeline is not None
+    first = Session(graph_bytes, loss="loss")
+    # sanity: pipeline auto-feeds; 40 SGD steps on a linear problem
+    m = sess.train(optim_method=SGD(learning_rate=0.05),
+                   max_iterations=40)
+    assert sess.last_loss is not None
+
+    # loss after training is far below the first-step loss
+    m0 = first.train(optim_method=SGD(learning_rate=0.05),
+                     max_iterations=1)
+    assert sess.last_loss < 0.25 * first.last_loss
+    # learned weights approach the generating w=[1,-2,.5,3], b=1
+    w = np.asarray(m.get_parameters()["w"]).ravel()
+    np.testing.assert_allclose(w, [1.0, -2.0, 0.5, 3.0], atol=0.35)
+    del m0
+
+
+def test_session_record_files_override(tmp_path):
+    """The graph bakes in the exporting machine's path; record_files
+    substitutes a local one (reader nodes resolve to a host iterator)."""
+    from bigdl_tpu.optim import SGD
+
+    rec = tmp_path / "local.tfrecord"
+    _write_tfrecord(rec)
+    graph_bytes = _queue_runner_graph("/nonexistent/exported.tfrecord")
+
+    sess = Session(graph_bytes, loss="loss",
+                   record_files=[str(rec)])
+    sess.train(optim_method=SGD(learning_rate=0.05), max_iterations=5)
+    assert np.isfinite(sess.last_loss)
+
+
+def test_boundary_detection(tmp_path):
+    rec = tmp_path / "b.tfrecord"
+    _write_tfrecord(rec, n=8)
+    nodes = parse_graphdef(_queue_runner_graph(rec))
+    by_name = {n.name: n for n in nodes}
+    assert has_input_pipeline(nodes)
+    refs = find_boundary_refs(nodes, by_name, ["loss"])
+    # exactly the two ParseExample dense outputs cross the boundary
+    assert [r.split(":")[0] for r in refs] == \
+        ["ParseExample/ParseExampleV2"] * 2
+
+
+def test_host_graph_epochs_cycle_over_file(tmp_path):
+    """The filename queue cycles: more batches than one file pass."""
+    rec = tmp_path / "c.tfrecord"
+    _write_tfrecord(rec, n=16)  # 2 batches of 8 per pass
+    nodes = parse_graphdef(_queue_runner_graph(rec))
+    by_name = {n.name: n for n in nodes}
+    refs = find_boundary_refs(nodes, by_name, ["loss"])
+    host = HostInputGraph(nodes)
+    it = host.batches(refs)
+    seen = [next(it) for _ in range(5)]  # 40 records from a 16-row file
+    for xs in seen:
+        assert xs[0].shape == (8, 4) and xs[1].shape == (8, 1)
+
+
+def test_parse_example_v1_layout():
+    """The pre-V2 op layout: Nsparse/Ndense attrs with per-key Const
+    inputs (nn/ops/ParseExample.scala:1 handles this form)."""
+    from bigdl_tpu.utils.tfrecord import encode_example
+
+    recs = [encode_example({"a": np.array([1.0, 2.0], np.float32),
+                            "b": np.array([7.0], np.float32)})
+            for _ in range(3)]
+    serialized = np.empty(3, object)
+    serialized[:] = recs
+
+    def const(name, val):
+        return TFNode(name, "Const", [], {"value": val})
+
+    key_a = np.empty((), object)
+    key_a[()] = b"a"
+    key_b = np.empty((), object)
+    key_b[()] = b"b"
+    nodes = [
+        const("keys/a", key_a), const("keys/b", key_b),
+        const("names", np.empty(0, object)),
+        const("default/a", np.zeros(0, np.float32)),
+        const("default/b", np.zeros(0, np.float32)),
+        TFNode("parse", "ParseExample",
+               ["serialized", "names", "keys/a", "keys/b",
+                "default/a", "default/b"],
+               {"Nsparse": 0, "Ndense": 2,
+                "Tdense": [np.float32, np.float32],
+                "dense_shapes": [[2], [1]]}),
+        TFNode("serialized", "Placeholder", [], {}),
+    ]
+    host = HostInputGraph(nodes)
+    cache = {"serialized": serialized}
+    a = host.eval_ref("parse:0", cache)
+    b = host.eval_ref("parse:1", cache)
+    np.testing.assert_allclose(a, [[1, 2]] * 3)
+    np.testing.assert_allclose(b, [[7.0]] * 3)
+
+
+def test_decode_raw_in_pipeline(tmp_path):
+    """String features + DecodeRaw: raw float32 bytes parsed on host
+    (nn/ops/DecodeImage.scala's DecodeRaw sibling)."""
+    from bigdl_tpu.optim import SGD
+
+    rng = np.random.RandomState(3)
+    xs = rng.randn(32, 4).astype(np.float32)
+    ys = (xs @ np.array([[2.0], [0.0], [-1.0], [1.0]],
+                        np.float32)).astype(np.float32)
+    rec = tmp_path / "raw.tfrecord"
+    with tf.io.TFRecordWriter(str(rec)) as wr:
+        for i in range(len(xs)):
+            ex = tf.train.Example(features=tf.train.Features(feature={
+                "x_raw": tf.train.Feature(bytes_list=tf.train.BytesList(
+                    value=[xs[i].tobytes()])),
+                "y": tf.train.Feature(float_list=tf.train.FloatList(
+                    value=ys[i]))}))
+            wr.write(ex.SerializeToString())
+
+    tfv1 = tf.compat.v1
+    g = tfv1.Graph()
+    with g.as_default():
+        fq = tfv1.train.string_input_producer([str(rec)])
+        reader = tfv1.TFRecordReader()
+        _, serialized = reader.read(fq)
+        batch = tfv1.train.batch([serialized], batch_size=8)
+        feats = tfv1.parse_example(batch, {
+            "x_raw": tfv1.FixedLenFeature([], tf.string),
+            "y": tfv1.FixedLenFeature([1], tf.float32)})
+        x = tfv1.reshape(tfv1.decode_raw(feats["x_raw"], tf.float32),
+                         [8, 4])
+        w = tfv1.Variable(tfv1.zeros([4, 1]), name="w")
+        pred = tfv1.matmul(x, w)
+        tfv1.reduce_mean(tfv1.square(pred - feats["y"]), name="loss")
+
+    sess = Session(g.as_graph_def().SerializeToString(), loss="loss")
+    sess.train(optim_method=SGD(learning_rate=0.05), max_iterations=30)
+    w_l = np.asarray(sess.module.get_parameters()["w"]).ravel()
+    np.testing.assert_allclose(w_l, [2.0, 0.0, -1.0, 1.0], atol=0.3)
