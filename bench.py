@@ -25,7 +25,7 @@ import time
 REFERENCE_BASELINE_IMGS_PER_SEC = 56.0
 
 
-def _build_decoded_pool():
+def _build_decoded_pool(default_n: int = 256):
     """Synthesize ImageNet-shaped JPEGs (375x500 q90), decode + scale
     shorter side to 256 + center-crop — the decode-once cost real
     training pays on its first epoch. Returns (pool u8 [N,3,256,256],
@@ -37,7 +37,7 @@ def _build_decoded_pool():
 
     from bigdl_tpu.dataset.imagenet import decode_image
 
-    pool_n = int(os.environ.get("BENCH_FED_POOL", 256))
+    pool_n = int(os.environ.get("BENCH_FED_POOL", default_n))
     rng = np.random.RandomState(0)
     t0 = time.time()
     pool = np.empty((pool_n, 3, 256, 256), np.uint8)
@@ -195,6 +195,90 @@ def main():
             "unit": "images/sec",
             "vs_baseline": round(
                 imgs_per_sec / REFERENCE_BASELINE_IMGS_PER_SEC, 3),
+            "first_epoch_decode_imgs_per_sec_per_core":
+                round(decode_rate, 1),
+        }))
+        return
+
+    if mode == "rotate":
+        # Shard-rotation variant: the decoded pool is >2x an artificial
+        # HBM budget of two shard slots; training runs on the resident
+        # shard while the next one streams host->device in cliff-safe
+        # pieces between scan-chunks (the composition that makes real
+        # ImageNet — ~250 GB decoded vs 128 GB pod HBM — train at
+        # near-cached rates; DataSet.scala:470-552's cluster-rate IO).
+        from bigdl_tpu.dataset.device_dataset import ShardRotator
+        from bigdl_tpu.dataset.imagenet import IMAGENET_MEAN, IMAGENET_STD
+
+        pool, labels, decode_rate = _build_decoded_pool(1024)
+        n_shards = int(os.environ.get("BENCH_ROTATE_SHARDS", 4))
+        shard = len(pool) // n_shards
+
+        def provider(i):
+            return (pool[i * shard:(i + 1) * shard],
+                    labels[i * shard:(i + 1) * shard])
+
+        rot = ShardRotator(provider, n_shards, batch, crop=(224, 224),
+                           flip=True, mean=IMAGENET_MEAN,
+                           std=IMAGENET_STD)
+        tmpl = rot.template
+
+        def scan_body_rot(carry, key_it, images, lbls):
+            params, opt_state, mstate, ep, pos = carry
+            kb, kr = jax.random.split(key_it)
+            x, y = tmpl.batch_fn_on(images, lbls, kb, epoch=ep, pos=pos)
+            params, opt_state, mstate, loss = step(
+                params, opt_state, mstate, kr, 0.1, x, y)
+            pos = pos + batch
+            ep = ep + pos // tmpl.n
+            pos = pos % tmpl.n
+            return (params, opt_state, mstate, ep, pos), loss
+
+        @jax.jit
+        def run_chunk_rot(carry, keys, images, lbls):
+            return lax.scan(
+                lambda c, k: scan_body_rot(c, k, images, lbls),
+                carry, keys)
+
+        # chunks per shard ~= one shard-epoch (>=1)
+        per_shard = max(1, shard // (batch * scan))
+        root = jax.random.PRNGKey(0)
+        carry = (params, opt_state, mstate, jnp.int32(0), jnp.int32(0))
+        for i in range(max(warmup, 1)):
+            keys = jax.random.split(jax.random.fold_in(root, i), scan)
+            carry, losses = run_chunk_rot(carry, keys, rot.images,
+                                          rot.labels)
+        float(losses.sum())
+        t0 = time.time()
+        done = 0
+        i = 0
+        while done < iters * scan:
+            for _ in range(per_shard):
+                keys = jax.random.split(
+                    jax.random.fold_in(root, 1000 + i), scan)
+                carry, losses = run_chunk_rot(carry, keys, rot.images,
+                                              rot.labels)
+                float(losses.sum())   # complete compute, THEN transfer
+                rot.pump()            # (alternation rule on the tunnel)
+                done += scan
+                i += 1
+                if done >= iters * scan:
+                    break
+            while not rot.staged:
+                rot.pump()
+            rot.rotate()
+        dt = time.time() - t0
+        imgs_per_sec = batch * done / dt
+        print(json.dumps({
+            "metric":
+                "resnet50_imagenet_train_shardrotate_imgs_per_sec_per_chip",
+            "value": round(imgs_per_sec, 2),
+            "unit": "images/sec",
+            "vs_baseline": round(
+                imgs_per_sec / REFERENCE_BASELINE_IMGS_PER_SEC, 3),
+            "pool_images": len(pool),
+            "hbm_budget_images": 2 * shard,
+            "chunk_bytes": rot.chunk_bytes,
             "first_epoch_decode_imgs_per_sec_per_core":
                 round(decode_rate, 1),
         }))

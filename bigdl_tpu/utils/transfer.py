@@ -1,0 +1,60 @@
+"""Host->device transfer sizing (the measured device_put "cliff").
+
+On tunneled/NIC-limited hosts a single large ``jax.device_put`` falls off
+a throughput cliff above a few hundred MB (BASELINE.md: a 1.23 GB put
+took 14-37 s while the same bytes as 38 MB pieces moved at ~1.1 GB/s).
+``probe_device_put_chunk`` measures ascending sizes once per process and
+returns the largest piece size that stays near peak throughput — the
+auto-tuned chunk every piecewise staging path (fed bench, shard
+rotation) should use. The reference's counterpart decision is caching
+decoded images to dodge its IO wall (dataset/DataSet.scala:240); here
+the wall is the link, so we size around it instead.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+import numpy as np
+
+_cached_chunk: Optional[int] = None
+
+
+def probe_device_put_chunk(max_mb: int = 96, *, drop_ratio: float = 0.5,
+                           device=None) -> int:
+    """Measure device_put throughput at 4,8,...,max_mb MB and return the
+    largest size (bytes) whose throughput holds >= ``drop_ratio`` x the
+    best seen. Ascending order stops at the first cliff, so at most one
+    slow transfer is ever issued. Result is cached per process; the
+    BENCH_CHUNK_MB env var short-circuits the probe."""
+    global _cached_chunk
+    if _cached_chunk is not None:
+        return _cached_chunk
+    env = os.environ.get("BENCH_CHUNK_MB")
+    if env:
+        _cached_chunk = int(float(env) * (1 << 20))
+        return _cached_chunk
+
+    import jax
+
+    dev = device or jax.devices()[0]
+    best_bps = 0.0
+    chosen = 4 << 20
+    mb = 4
+    while mb <= max_mb:
+        arr = np.empty(mb << 20, np.uint8)
+        t0 = time.time()
+        jax.device_put(arr, dev).block_until_ready()
+        dt = max(time.time() - t0, 1e-9)
+        bps = arr.nbytes / dt
+        if bps >= best_bps:
+            best_bps = bps
+            chosen = arr.nbytes
+        elif bps < drop_ratio * best_bps:
+            break  # over the cliff: stop probing larger sizes
+        else:
+            chosen = arr.nbytes  # slower but acceptable; keep growing
+        mb *= 2
+    _cached_chunk = chosen
+    return chosen
