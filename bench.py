@@ -17,6 +17,7 @@ Baseline: the reference publishes no absolute numbers (BASELINE.md); the
 working Xeon baseline recorded there is 56 img/s/node (BigDL-paper-era
 dual-socket Xeon ResNet-50 estimate) until a measured value replaces it.
 """
+import functools
 import json
 import os
 import time
@@ -77,7 +78,8 @@ def _fed_minibatch_chunks(batch, scan):
 
     loader = NativeBatchLoaderU8(
         pool, labels, batch, crop=(224, 224), pad=0, flip=True,
-        num_threads=int(os.environ.get("BENCH_FED_THREADS", 2)),
+        num_threads=int(os.environ.get("BENCH_FED_THREADS",
+                                       os.cpu_count() or 2)),
         prefetch=4)
 
     # Strictly serial, PIECEWISE staging. Two tunnel pathologies shape
@@ -169,7 +171,7 @@ def main():
             pos = pos % ds.n
             return (params, opt_state, mstate, ep, pos), loss
 
-        @jax.jit
+        @functools.partial(jax.jit, donate_argnums=(0,))
         def run_chunk_cached(carry, keys):
             return lax.scan(scan_body_cached, carry, keys)
 
@@ -234,7 +236,7 @@ def main():
             pos = pos % tmpl.n
             return (params, opt_state, mstate, ep, pos), loss
 
-        @jax.jit
+        @functools.partial(jax.jit, donate_argnums=(0,))
         def run_chunk_rot(carry, keys, images, lbls):
             return lax.scan(
                 lambda c, k: scan_body_rot(c, k, images, lbls),
@@ -250,6 +252,7 @@ def main():
                                           rot.labels)
         float(losses.sum())
         t0 = time.time()
+        t_end = t0
         done = 0
         i = 0
         while done < iters * scan:
@@ -259,15 +262,18 @@ def main():
                 carry, losses = run_chunk_rot(carry, keys, rot.images,
                                               rot.labels)
                 float(losses.sum())   # complete compute, THEN transfer
+                t_end = time.time()   # clock stops at counted work only
                 rot.pump()            # (alternation rule on the tunnel)
                 done += scan
                 i += 1
                 if done >= iters * scan:
                     break
+            if done >= iters * scan:
+                break  # don't time staging a shard that never trains
             while not rot.staged:
                 rot.pump()
             rot.rotate()
-        dt = time.time() - t0
+        dt = t_end - t0
         imgs_per_sec = batch * done / dt
         print(json.dumps({
             "metric":
@@ -301,7 +307,7 @@ def main():
                 params, opt_state, mstate, kr, 0.1, x, y)
             return (params, opt_state, mstate), loss
 
-        @jax.jit
+        @functools.partial(jax.jit, donate_argnums=(0,))
         def run_chunk_fed(carry, xs, ys):
             # xs/ys arrive as lists of per-batch device arrays (see
             # _fed_minibatch_chunks) — stack on device, then scan
@@ -345,7 +351,7 @@ def main():
                                                kr, 0.1, x, y)
         return (params, opt_state, mstate), loss
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0,))
     def run_chunk(carry, keys):
         return lax.scan(scan_body, carry, keys)
 

@@ -16,6 +16,8 @@ the flip) so XLA fuses it into the step.
 """
 from __future__ import annotations
 
+import functools
+
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -225,6 +227,19 @@ class DeviceCachedArrayDataSet:
         return x, y
 
 
+def _write_rows(dest, piece, off):
+    """Donated in-place row write: dest[off:off+len(piece)] = piece.
+    Pieces differ only in their (static) row count, so at most two
+    compiled variants exist (full chunk + final remainder)."""
+    return _write_rows_jit(dest, piece, off)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _write_rows_jit(dest, piece, off):
+    start = (off,) + (0,) * (dest.ndim - 1)
+    return jax.lax.dynamic_update_slice(dest, piece, start)
+
+
 class ShardRotator:
     """Double-buffered HBM shard cache: train on the resident shard while
     the NEXT shard streams host->device in cliff-safe pieces between
@@ -309,8 +324,14 @@ class ShardRotator:
             imgs = np.pad(imgs, ((0, 0), (0, 0),
                                  (self.pad, self.pad),
                                  (self.pad, self.pad)))
+        # the destination slot is preallocated ONCE and pieces are written
+        # into it with a donated dynamic_update_slice, so staging peaks at
+        # one slot + one chunk — never pieces + a concatenated copy (the
+        # documented two-slot HBM budget holds even for tightly sized
+        # shards)
+        dest = jnp.zeros(imgs.shape, jnp.uint8)
         self._staging = [imgs, np.ascontiguousarray(lbls, np.float32),
-                         [], 0]
+                         dest, 0]
 
     @property
     def staged(self) -> bool:
@@ -321,15 +342,12 @@ class ShardRotator:
         """Transfer at most ``chunk_bytes`` of the staged shard. Call
         between completed compute chunks (transfers stall compute on
         tunneled links — alternate, don't overlap). Returns ``staged``."""
-        import jax
-
         if self.staged:
             return True
-        imgs, lbls, pieces, off = self._staging
+        imgs, lbls, dest, off = self._staging
         rows = max(1, self.chunk_bytes // imgs[0].nbytes)
         piece = jax.device_put(imgs[off:off + rows])
-        piece.block_until_ready()
-        pieces.append(piece)
+        self._staging[2] = _write_rows(dest, piece, jnp.int32(off))
         self._staging[3] = off + len(imgs[off:off + rows])
         return self.staged
 
@@ -340,13 +358,9 @@ class ShardRotator:
         if not self.staged:
             raise RuntimeError(
                 "rotate() before staging finished — pump() until staged")
-        imgs_host, lbls, pieces, _ = self._staging
-        import jax
-        import jax.numpy as _jnp
-        new_imgs = pieces[0] if len(pieces) == 1 else \
-            _jnp.concatenate(pieces, axis=0)
+        _, lbls, dest, _ = self._staging
         new_lbls = jax.device_put(lbls)
-        self.template = self.template._from_device(new_imgs, new_lbls)
+        self.template = self.template._from_device(dest, new_lbls)
         # fixed cyclic order after the initial shuffle: the staged-ahead
         # shard is always the one the bookkeeping expects, so one cycle
         # == one exact pass over every shard (in-shard ordering still

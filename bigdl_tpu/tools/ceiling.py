@@ -1,0 +1,248 @@
+"""On-chip ceiling ablation: framework ResNet-50 step vs a hand-rolled
+raw-JAX step of identical semantics (the evidence behind BASELINE.md's
+platform-ceiling table; the reference's counterpart is
+models/utils/DistriOptimizerPerf.scala:38 leaving nothing on the table).
+
+Modes:
+  fw                framework step as shipped pre-r3 (conv biases, no donation)
+  fw_donate         + donated scan carry
+  fw_nobias         + pre-BN conv biases dropped (models/resnet default now)
+  fw_nobias_donate  + both (= bench.py configuration)
+  hand              hand-rolled full-semantics step (raw lax convs, one-pass
+                    BN with running stats, CE loss, SGD momentum+wd+nesterov)
+  hand_fwd          hand-rolled forward only
+
+Usage: python -m bigdl_tpu.tools.ceiling <mode> [iters]
+"""
+import functools
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+BATCH = int(os.environ.get("BENCH_BATCH", 256))
+SCAN = int(os.environ.get("BENCH_SCAN", 8))
+WARMUP = 1
+
+
+def timed(run_chunk, carry, iters):
+    root = jax.random.PRNGKey(0)
+    for i in range(WARMUP):
+        keys = jax.random.split(jax.random.fold_in(root, i), SCAN)
+        carry, losses = run_chunk(carry, keys)
+    float(losses.sum())
+    t0 = time.time()
+    for i in range(iters):
+        keys = jax.random.split(jax.random.fold_in(root, 1000 + i), SCAN)
+        carry, losses = run_chunk(carry, keys)
+    float(losses.sum())
+    dt = time.time() - t0
+    return BATCH * SCAN * iters / dt
+
+
+def framework(mode, iters):
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.models import resnet as R
+    from bigdl_tpu.optim import SGD
+    from bigdl_tpu.optim.optimizer import build_train_step
+    from bigdl_tpu.utils.engine import Engine
+    from bigdl_tpu.utils.random import RandomGenerator
+
+    Engine.set_compute_dtype(jnp.bfloat16)
+    RandomGenerator.set_seed(1)
+    # fw/fw_donate reproduce the r2 form (reference parameter set with
+    # conv biases); the nobias modes are models/resnet's r3 default
+    model = R.ResNet(1000, depth=50, dataset="ImageNet",
+                     conv_bias="nobias" not in mode).training()
+    model.ensure_initialized()
+    criterion = nn.CrossEntropyCriterion()
+    optim = SGD(learning_rate=0.1, momentum=0.9, weight_decay=1e-4,
+                nesterov=True, dampening=0.0)
+    params = model.get_parameters()
+    mstate = model.get_state()
+    opt_state = optim.init_state(params)
+    step = build_train_step(model, criterion, optim)
+
+    def scan_body(carry, key):
+        params, opt_state, mstate = carry
+        kx, ky, kr = jax.random.split(key, 3)
+        x = jax.random.uniform(kx, (BATCH, 3, 224, 224), jnp.float32)
+        y = jax.random.randint(ky, (BATCH,), 1, 1001).astype(jnp.float32)
+        params, opt_state, mstate, loss = step(params, opt_state, mstate,
+                                               kr, 0.1, x, y)
+        return (params, opt_state, mstate), loss
+
+    kw = {"donate_argnums": (0,)} if "donate" in mode else {}
+
+    @functools.partial(jax.jit, **kw)
+    def run_chunk(carry, keys):
+        return lax.scan(scan_body, carry, keys)
+
+    return timed(run_chunk, (params, opt_state, mstate), iters)
+
+
+# ------------------------------------------------------- hand-rolled RN50
+
+CFG50 = [(64, 3, 1), (128, 4, 2), (256, 6, 2), (512, 3, 2)]
+
+
+def hand_init(key):
+    params, state = [], []
+
+    def conv_p(k, cin, cout, kh, kw_):
+        fan_in = cin * kh * kw_
+        w = jax.random.normal(k, (cout, cin, kh, kw_), jnp.float32) \
+            * np.sqrt(2.0 / fan_in)
+        return w
+
+    def bn_p(c):
+        return {"g": jnp.ones((c,), jnp.float32),
+                "b": jnp.zeros((c,), jnp.float32)}
+
+    def bn_s(c):
+        return {"m": jnp.zeros((c,), jnp.float32),
+                "v": jnp.ones((c,), jnp.float32)}
+
+    ks = iter(jax.random.split(key, 256))
+    params.append(conv_p(next(ks), 3, 64, 7, 7))     # stem
+    params.append(bn_p(64))
+    state.append(bn_s(64))
+    cin = 64
+    for feats, count, stride in CFG50:
+        for i in range(count):
+            s = stride if i == 0 else 1
+            blk = {"c1": conv_p(next(ks), cin, feats, 1, 1),
+                   "bn1": bn_p(feats),
+                   "c2": conv_p(next(ks), feats, feats, 3, 3),
+                   "bn2": bn_p(feats),
+                   "c3": conv_p(next(ks), feats, feats * 4, 1, 1),
+                   "bn3": bn_p(feats * 4)}
+            st = {"bn1": bn_s(feats), "bn2": bn_s(feats),
+                  "bn3": bn_s(feats * 4)}
+            if i == 0:
+                blk["cs"] = conv_p(next(ks), cin, feats * 4, 1, 1)
+                blk["bns"] = bn_p(feats * 4)
+                st["bns"] = bn_s(feats * 4)
+            params.append(blk)
+            state.append(st)
+            cin = feats * 4
+    wfc = jax.random.normal(next(ks), (2048, 1000), jnp.float32) * 0.01
+    params.append({"w": wfc, "b": jnp.zeros((1000,), jnp.float32)})
+    return params, state
+
+
+def conv(x, w, stride=1, pad=0):
+    return lax.conv_general_dilated(
+        x, w.astype(x.dtype), (stride, stride),
+        ((pad, pad), (pad, pad)),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+
+def bn(x, p, s, mom=0.1):
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=(0, 2, 3))
+    ex2 = jnp.mean(jnp.square(x32), axis=(0, 2, 3))
+    var = jnp.maximum(ex2 - jnp.square(mean), 0.0)
+    n = x.size // x.shape[1]
+    new_s = {"m": (1 - mom) * s["m"] + mom * mean,
+             "v": (1 - mom) * s["v"] + mom * var * n / (n - 1)}
+    inv = lax.rsqrt(var + 1e-5).astype(x.dtype)
+    mean = mean.astype(x.dtype)
+    y = (x - mean[None, :, None, None]) * inv[None, :, None, None]
+    y = y * p["g"].astype(x.dtype)[None, :, None, None] \
+        + p["b"].astype(x.dtype)[None, :, None, None]
+    return y, new_s
+
+
+def hand_forward(params, state, x):
+    new_state = []
+    x = conv(lax.stop_gradient(x), params[0], 2, 3)
+    x, s = bn(x, params[1], state[0])
+    new_state.append(s)
+    x = jax.nn.relu(x)
+    x = lax.reduce_window(x, -jnp.inf, lax.max, (1, 1, 3, 3),
+                          (1, 1, 2, 2), ((0, 0), (0, 0), (1, 1), (1, 1)))
+    i = 2
+    si = 1
+    for feats, count, stride in CFG50:
+        for j in range(count):
+            blk, st = params[i], state[si]
+            s0 = stride if j == 0 else 1
+            ns = {}
+            h = conv(x, blk["c1"])
+            h, ns["bn1"] = bn(h, blk["bn1"], st["bn1"])
+            h = jax.nn.relu(h)
+            h = conv(h, blk["c2"], s0, 1)
+            h, ns["bn2"] = bn(h, blk["bn2"], st["bn2"])
+            h = jax.nn.relu(h)
+            h = conv(h, blk["c3"])
+            h, ns["bn3"] = bn(h, blk["bn3"], st["bn3"])
+            if "cs" in blk:
+                sc = conv(x, blk["cs"], s0)
+                sc, ns["bns"] = bn(sc, blk["bns"], st["bns"])
+            else:
+                sc = x
+            x = jax.nn.relu(h + sc)
+            new_state.append(ns)
+            i += 1
+            si += 1
+    x = jnp.mean(x, axis=(2, 3))
+    fc = params[i]
+    logits = x @ fc["w"].astype(x.dtype) + fc["b"].astype(x.dtype)
+    return logits.astype(jnp.float32), new_state
+
+
+def hand(mode, iters):
+    key = jax.random.PRNGKey(1)
+    params, state = hand_init(key)
+    mom_buf = jax.tree.map(jnp.zeros_like, params)
+
+    def loss_fn(p, s, x, y):
+        p16 = jax.tree.map(lambda a: a.astype(jnp.bfloat16), p)
+        logits, ns = hand_forward(p16, s, x.astype(jnp.bfloat16))
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        ll = jnp.take_along_axis(logits, y[:, None], axis=-1)[:, 0]
+        return jnp.mean(lse - ll), ns
+
+    fwd_only = mode == "hand_fwd"
+
+    def scan_body(carry, key):
+        params, mom, state = carry
+        kx, ky = jax.random.split(key)
+        x = jax.random.uniform(kx, (BATCH, 3, 224, 224), jnp.float32)
+        y = jax.random.randint(ky, (BATCH,), 0, 1000)
+        if fwd_only:
+            loss, ns = loss_fn(params, state, x, y)
+            return (params, mom, ns), loss
+        (loss, ns), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, state, x, y)
+        grads = jax.tree.map(
+            lambda g, p: g.astype(jnp.float32) + 1e-4 * p, grads, params)
+        mom = jax.tree.map(lambda m, g: 0.9 * m + g, mom_buf if mom is None
+                           else mom, grads)
+        upd = jax.tree.map(lambda g, m: g + 0.9 * m, grads, mom)  # nesterov
+        params = jax.tree.map(lambda p, u: p - 0.1 * u, params, upd)
+        return (params, mom, ns), loss
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def run_chunk(carry, keys):
+        return lax.scan(scan_body, carry, keys)
+
+    return timed(run_chunk, (params, mom_buf, state), iters)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) < 2:
+        raise SystemExit(__doc__)
+    mode = sys.argv[1]
+    iters = int(sys.argv[2]) if len(sys.argv) > 2 else 6
+    if mode.startswith("hand"):
+        r = hand(mode, iters)
+    else:
+        r = framework(mode, iters)
+    print(json.dumps({"mode": mode, "imgs_per_sec": round(r, 1)}))

@@ -43,9 +43,16 @@ def probe_device_put_chunk(max_mb: int = 96, *, drop_ratio: float = 0.5,
     chosen = 4 << 20
     mb = 4
     while mb <= max_mb:
-        arr = np.empty(mb << 20, np.uint8)
+        arr = np.random.randint(0, 256, mb << 20, dtype=np.uint8)
         t0 = time.time()
-        jax.device_put(arr, dev).block_until_ready()
+        out = jax.device_put(arr, dev)
+        out.block_until_ready()
+        # fetch a slice: on tunneled backends block_until_ready can
+        # return before the bytes actually crossed (measured: "fast"
+        # puts that were pure dispatch) — a readback is the only
+        # honest completion signal. Random payload defeats relay-side
+        # dedup of repeated buffers.
+        np.asarray(out[:64])
         dt = max(time.time() - t0, 1e-9)
         bps = arr.nbytes / dt
         if bps >= best_bps:
