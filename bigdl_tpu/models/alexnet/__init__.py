@@ -1,0 +1,83 @@
+"""AlexNet (reference: example/loadmodel/AlexNet.scala — the Caffe-era
+model the load-model example and DistriOptimizerPerf benchmark use).
+
+``AlexNet`` is the original ILSVRC-2012 form (LRN + grouped convs);
+``AlexNet_OWT`` is the one-weird-trick variant (no LRN, no groups)."""
+from __future__ import annotations
+
+import bigdl_tpu.nn as nn
+
+
+def AlexNet_OWT(class_num: int = 1000, has_dropout: bool = True,
+                first_layer_propagate_back: bool = False) -> nn.Sequential:
+    """AlexNet.scala:23-50 (AlexNet_OWT)."""
+    m = nn.Sequential()
+    m.add(nn.SpatialConvolution(
+        3, 64, 11, 11, 4, 4, 2, 2, 1,
+        propagate_back=first_layer_propagate_back).set_name("conv1"))
+    m.add(nn.ReLU(True).set_name("relu1"))
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2).set_name("pool1"))
+    m.add(nn.SpatialConvolution(64, 192, 5, 5, 1, 1, 2, 2).set_name("conv2"))
+    m.add(nn.ReLU(True))
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2).set_name("pool2"))
+    m.add(nn.SpatialConvolution(192, 384, 3, 3, 1, 1, 1, 1)
+          .set_name("conv3"))
+    m.add(nn.ReLU(True))
+    m.add(nn.SpatialConvolution(384, 256, 3, 3, 1, 1, 1, 1)
+          .set_name("conv4"))
+    m.add(nn.ReLU(True))
+    m.add(nn.SpatialConvolution(256, 256, 3, 3, 1, 1, 1, 1)
+          .set_name("conv5"))
+    m.add(nn.ReLU(True))
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2).set_name("pool5"))
+    m.add(nn.View(256 * 6 * 6).set_num_input_dims(3))
+    m.add(nn.Linear(256 * 6 * 6, 4096).set_name("fc6"))
+    m.add(nn.ReLU(True))
+    if has_dropout:
+        m.add(nn.Dropout(0.5))
+    m.add(nn.Linear(4096, 4096).set_name("fc7"))
+    m.add(nn.ReLU(True))
+    if has_dropout:
+        m.add(nn.Dropout(0.5))
+    m.add(nn.Linear(4096, class_num).set_name("fc8"))
+    m.add(nn.LogSoftMax())
+    return m
+
+
+def AlexNet(class_num: int = 1000, has_dropout: bool = True
+            ) -> nn.Sequential:
+    """AlexNet.scala:84-112: the original form with cross-map LRN and
+    2-group convs (the dual-GPU split baked into the weights)."""
+    m = nn.Sequential()
+    m.add(nn.SpatialConvolution(3, 96, 11, 11, 4, 4, 0, 0, 1,
+                                propagate_back=False).set_name("conv1"))
+    m.add(nn.ReLU(True))
+    m.add(nn.SpatialCrossMapLRN(5, 0.0001, 0.75).set_name("norm1"))
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2).set_name("pool1"))
+    m.add(nn.SpatialConvolution(96, 256, 5, 5, 1, 1, 2, 2, 2)
+          .set_name("conv2"))
+    m.add(nn.ReLU(True))
+    m.add(nn.SpatialCrossMapLRN(5, 0.0001, 0.75).set_name("norm2"))
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2).set_name("pool2"))
+    m.add(nn.SpatialConvolution(256, 384, 3, 3, 1, 1, 1, 1)
+          .set_name("conv3"))
+    m.add(nn.ReLU(True))
+    m.add(nn.SpatialConvolution(384, 384, 3, 3, 1, 1, 1, 1, 2)
+          .set_name("conv4"))
+    m.add(nn.ReLU(True))
+    m.add(nn.SpatialConvolution(384, 256, 3, 3, 1, 1, 1, 1, 2)
+          .set_name("conv5"))
+    m.add(nn.ReLU(True))
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2).set_name("pool5"))
+    m.add(nn.View(256 * 6 * 6).set_num_input_dims(3))
+    m.add(nn.Linear(256 * 6 * 6, 4096).set_name("fc6"))
+    m.add(nn.ReLU(True))
+    if has_dropout:
+        m.add(nn.Dropout(0.5))
+    m.add(nn.Linear(4096, 4096).set_name("fc7"))
+    m.add(nn.ReLU(True))
+    if has_dropout:
+        m.add(nn.Dropout(0.5))
+    m.add(nn.Linear(4096, class_num).set_name("fc8"))
+    m.add(nn.LogSoftMax())
+    return m

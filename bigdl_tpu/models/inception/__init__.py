@@ -182,3 +182,186 @@ def Inception_v1(class_num: int = 1000, has_dropout: bool = True
     model.add(feature1)
     model.add(split1)
     return model
+
+
+# ---------------------------------------------------------- Inception v2
+
+def _conv_bn(seq, cin, cout, kw, kh, sw=1, sh=1, pw=0, ph=0, name=""):
+    """conv -> BN(1e-3) -> ReLU triple, the v2 building unit
+    (Inception_v2.scala:31-40). Convs feeding BN are bias-free: BN's
+    mean subtraction cancels the bias exactly (see models/resnet._conv)."""
+    seq.add(nn.SpatialConvolution(cin, cout, kw, kh, sw, sh, pw, ph,
+                                  init_weight=Xavier(), init_bias=Zeros(),
+                                  with_bias=False).set_name(name))
+    seq.add(nn.SpatialBatchNormalization(cout, 1e-3).set_name(name + "/bn"))
+    seq.add(nn.ReLU(True))
+    return seq
+
+
+def Inception_Layer_v2(input_size: int, config, name_prefix: str = ""
+                       ) -> nn.Concat:
+    """BN-Inception block (Inception_v2.scala:27-107): optional 1x1,
+    3x3, double-3x3 and pool branches; a ("max", 0) pool entry marks the
+    stride-2 grid-reduction form."""
+    reduce_grid = config[4][1] == "max" and config[4][2] == 0
+    concat = nn.Concat(2)
+    if config[1][1] != 0:
+        conv1 = nn.Sequential()
+        _conv_bn(conv1, input_size, config[1][1], 1, 1,
+                 name=name_prefix + "1x1")
+        concat.add(conv1)
+
+    conv3 = nn.Sequential()
+    _conv_bn(conv3, input_size, config[2][1], 1, 1,
+             name=name_prefix + "3x3_reduce")
+    s = 2 if reduce_grid else 1
+    _conv_bn(conv3, config[2][1], config[2][2], 3, 3, s, s, 1, 1,
+             name=name_prefix + "3x3")
+    concat.add(conv3)
+
+    conv3xx = nn.Sequential()
+    _conv_bn(conv3xx, input_size, config[3][1], 1, 1,
+             name=name_prefix + "double3x3_reduce")
+    _conv_bn(conv3xx, config[3][1], config[3][2], 3, 3, 1, 1, 1, 1,
+             name=name_prefix + "double3x3a")
+    _conv_bn(conv3xx, config[3][2], config[3][2], 3, 3, s, s, 1, 1,
+             name=name_prefix + "double3x3b")
+    concat.add(conv3xx)
+
+    pool = nn.Sequential()
+    if config[4][1] == "max":
+        if config[4][2] != 0:
+            pool.add(nn.SpatialMaxPooling(3, 3, 1, 1, 1, 1).ceil())
+        else:
+            pool.add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+    elif config[4][1] == "avg":
+        pool.add(nn.SpatialAveragePooling(3, 3, 1, 1, 1, 1,
+                                          ceil_mode=True))
+    else:
+        raise ValueError(f"unknown pool kind {config[4][1]}")
+    if config[4][2] != 0:
+        _conv_bn(pool, input_size, config[4][2], 1, 1,
+                 name=name_prefix + "pool_proj")
+    concat.add(pool)
+    return concat.set_name(name_prefix + "output")
+
+
+def _v2_stem(m: nn.Sequential) -> nn.Sequential:
+    """conv1..pool2 (Inception_v2.scala:187-197); stem conv has
+    propagate_back analogue via nGroup=1,false in the reference."""
+    m.add(nn.SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3,
+                                propagate_back=False,
+                                init_weight=Xavier(), init_bias=Zeros(),
+                                with_bias=False).set_name("conv1/7x7_s2"))
+    m.add(nn.SpatialBatchNormalization(64, 1e-3).set_name("conv1/7x7_s2/bn"))
+    m.add(nn.ReLU(True))
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+    _conv_bn(m, 64, 64, 1, 1, name="conv2/3x3_reduce")
+    _conv_bn(m, 64, 192, 3, 3, 1, 1, 1, 1, name="conv2/3x3")
+    m.add(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+    return m
+
+
+def Inception_v2_NoAuxClassifier(class_num: int = 1000) -> nn.Sequential:
+    """BN-GoogLeNet without aux heads (Inception_v2.scala:185-219)."""
+    m = _v2_stem(nn.Sequential())
+    m.add(Inception_Layer_v2(192, T(T(64), T(64, 64), T(64, 96),
+                                    T("avg", 32)), "inception_3a/"))
+    m.add(Inception_Layer_v2(256, T(T(64), T(64, 96), T(64, 96),
+                                    T("avg", 64)), "inception_3b/"))
+    m.add(Inception_Layer_v2(320, T(T(0), T(128, 160), T(64, 96),
+                                    T("max", 0)), "inception_3c/"))
+    m.add(Inception_Layer_v2(576, T(T(224), T(64, 96), T(96, 128),
+                                    T("avg", 128)), "inception_4a/"))
+    m.add(Inception_Layer_v2(576, T(T(192), T(96, 128), T(96, 128),
+                                    T("avg", 128)), "inception_4b/"))
+    m.add(Inception_Layer_v2(576, T(T(160), T(128, 160), T(128, 160),
+                                    T("avg", 96)), "inception_4c/"))
+    m.add(Inception_Layer_v2(576, T(T(96), T(128, 192), T(160, 192),
+                                    T("avg", 96)), "inception_4d/"))
+    m.add(Inception_Layer_v2(576, T(T(0), T(128, 192), T(192, 256),
+                                    T("max", 0)), "inception_4e/"))
+    m.add(Inception_Layer_v2(1024, T(T(352), T(192, 320), T(160, 224),
+                                     T("avg", 128)), "inception_5a/"))
+    m.add(Inception_Layer_v2(1024, T(T(352), T(192, 320), T(192, 224),
+                                     T("max", 128)), "inception_5b/"))
+    m.add(nn.SpatialAveragePooling(7, 7, 1, 1, ceil_mode=True))
+    m.add(nn.View(1024).set_num_input_dims(3))
+    m.add(nn.Linear(1024, class_num).set_name("loss3/classifier"))
+    m.add(nn.LogSoftMax())
+    return m
+
+
+def Inception_v2(class_num: int = 1000) -> nn.Sequential:
+    """Full BN-GoogLeNet with both aux classifier heads
+    (Inception_v2.scala:275-364); output channel-concats
+    [main, aux2, aux1] like Inception_v1."""
+    features1 = _v2_stem(nn.Sequential())
+    features1.add(Inception_Layer_v2(192, T(T(64), T(64, 64), T(64, 96),
+                                            T("avg", 32)), "inception_3a/"))
+    features1.add(Inception_Layer_v2(256, T(T(64), T(64, 96), T(64, 96),
+                                            T("avg", 64)), "inception_3b/"))
+    features1.add(Inception_Layer_v2(320, T(T(0), T(128, 160), T(64, 96),
+                                            T("max", 0)), "inception_3c/"))
+
+    output1 = nn.Sequential()
+    output1.add(nn.SpatialAveragePooling(5, 5, 3, 3, ceil_mode=True))
+    _conv_bn(output1, 576, 128, 1, 1, name="loss1/conv")
+    output1.add(nn.View(128 * 4 * 4).set_num_input_dims(3))
+    output1.add(nn.Linear(128 * 4 * 4, 1024).set_name("loss1/fc"))
+    output1.add(nn.ReLU(True))
+    output1.add(nn.Linear(1024, class_num).set_name("loss1/classifier"))
+    output1.add(nn.LogSoftMax())
+
+    features2 = nn.Sequential()
+    features2.add(Inception_Layer_v2(576, T(T(224), T(64, 96), T(96, 128),
+                                            T("avg", 128)), "inception_4a/"))
+    features2.add(Inception_Layer_v2(576, T(T(192), T(96, 128), T(96, 128),
+                                            T("avg", 128)), "inception_4b/"))
+    features2.add(Inception_Layer_v2(576, T(T(160), T(128, 160),
+                                            T(128, 160), T("avg", 96)),
+                                     "inception_4c/"))
+    features2.add(Inception_Layer_v2(576, T(T(96), T(128, 192),
+                                            T(160, 192), T("avg", 96)),
+                                     "inception_4d/"))
+    features2.add(Inception_Layer_v2(576, T(T(0), T(128, 192),
+                                            T(192, 256), T("max", 0)),
+                                     "inception_4e/"))
+
+    output2 = nn.Sequential()
+    output2.add(nn.SpatialAveragePooling(5, 5, 3, 3, ceil_mode=True))
+    _conv_bn(output2, 1024, 128, 1, 1, name="loss2/conv")
+    output2.add(nn.View(128 * 2 * 2).set_num_input_dims(3))
+    output2.add(nn.Linear(128 * 2 * 2, 1024).set_name("loss2/fc"))
+    output2.add(nn.ReLU(True))
+    output2.add(nn.Linear(1024, class_num).set_name("loss2/classifier"))
+    output2.add(nn.LogSoftMax())
+
+    output3 = nn.Sequential()
+    output3.add(Inception_Layer_v2(1024, T(T(352), T(192, 320),
+                                           T(160, 224), T("avg", 128)),
+                                   "inception_5a/"))
+    output3.add(Inception_Layer_v2(1024, T(T(352), T(192, 320),
+                                           T(192, 224), T("max", 128)),
+                                   "inception_5b/"))
+    output3.add(nn.SpatialAveragePooling(7, 7, 1, 1, ceil_mode=True))
+    output3.add(nn.View(1024).set_num_input_dims(3))
+    output3.add(nn.Linear(1024, class_num).set_name("loss3/classifier"))
+    output3.add(nn.LogSoftMax())
+
+    split2 = nn.Concat(2).set_name("split2")
+    split2.add(output3)
+    split2.add(output2)
+
+    main_branch = nn.Sequential()
+    main_branch.add(features2)
+    main_branch.add(split2)
+
+    split1 = nn.Concat(2).set_name("split1")
+    split1.add(main_branch)
+    split1.add(output1)
+
+    model = nn.Sequential()
+    model.add(features1)
+    model.add(split1)
+    return model

@@ -28,6 +28,14 @@ def build_model(name: str, class_num: int = 1000):
         depth = int(name[len("resnet"):] or 50)
         return (models.ResNet(class_num, depth=depth, dataset="ImageNet"),
                 (3, 224, 224), class_num)
+    if name in ("alexnet", "alexnetowt", "alexnet_owt"):
+        # DistriOptimizerPerf.scala:44 offers both forms
+        builder = models.AlexNet if name == "alexnet" else models.AlexNet_OWT
+        size = 227 if name == "alexnet" else 224
+        return builder(class_num), (3, size, size), class_num
+    if name in ("inception_v2", "inception-v2", "inceptionv2"):
+        return (models.Inception_v2_NoAuxClassifier(class_num),
+                (3, 224, 224), class_num)
     if name.startswith("inception"):
         return models.Inception_v1(class_num), (3, 224, 224), class_num
     if name.startswith("transformer"):

@@ -143,3 +143,69 @@ def test_resnet_conv_bias_dropped_and_cancelled_by_bn():
     m.set_parameters(params)
     y1 = np.asarray(m.forward(x))
     np.testing.assert_allclose(y0, y1, atol=2e-4)
+
+
+def test_inception_v2_noaux_forward():
+    from bigdl_tpu.models import Inception_v2_NoAuxClassifier
+    m = Inception_v2_NoAuxClassifier(1000).evaluate()
+    x = np.random.rand(1, 3, 224, 224).astype(np.float32)
+    out = np.asarray(m.forward(x))
+    assert out.shape == (1, 1000)
+    assert np.isfinite(out).all()
+
+
+def test_inception_v2_full_three_heads():
+    """Full BN-GoogLeNet concats [main, aux2, aux1] on the class dim
+    (Inception_v2.scala:275-364)."""
+    from bigdl_tpu.models import Inception_v2
+    m = Inception_v2(7).evaluate()
+    x = np.random.rand(1, 3, 224, 224).astype(np.float32)
+    out = np.asarray(m.forward(x))
+    assert out.shape == (1, 21)
+    # each head is a LogSoftMax distribution over 7 classes
+    for h in range(3):
+        np.testing.assert_allclose(
+            np.exp(out[0, h * 7:(h + 1) * 7]).sum(), 1.0, atol=1e-4)
+
+
+def test_alexnet_forward_shapes():
+    """AlexNet.scala:84 (original, LRN + 2-group convs) and :23 (OWT)."""
+    from bigdl_tpu.models import AlexNet, AlexNet_OWT
+    m = AlexNet(50, has_dropout=False).evaluate()
+    out = np.asarray(m.forward(
+        np.random.rand(2, 3, 227, 227).astype(np.float32)))
+    assert out.shape == (2, 50)
+    m2 = AlexNet_OWT(50, has_dropout=False).evaluate()
+    out2 = np.asarray(m2.forward(
+        np.random.rand(2, 3, 224, 224).astype(np.float32)))
+    assert out2.shape == (2, 50)
+    np.testing.assert_allclose(np.exp(out2).sum(-1), 1.0, atol=1e-4)
+
+
+def test_alexnet_owt_trains():
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+    from bigdl_tpu.models import AlexNet_OWT
+    from bigdl_tpu.optim import LocalOptimizer, SGD, max_iteration
+
+    rng = np.random.RandomState(0)
+    xs = rng.rand(16, 3, 224, 224).astype(np.float32)
+    ys = rng.randint(1, 6, 16).astype(np.float32)
+    ds = DataSet.array([Sample(xs[i], ys[i]) for i in range(16)]) \
+        .transform(SampleToMiniBatch(8))
+    m = AlexNet_OWT(5, has_dropout=False)
+    opt = LocalOptimizer(m, ds, nn.ClassNLLCriterion(), batch_size=8)
+    opt.set_optim_method(SGD(learning_rate=0.01))
+    opt.set_end_when(max_iteration(3))
+    opt.optimize()
+    assert np.isfinite(opt.driver_state["Loss"])
+
+
+def test_perf_tool_knows_new_models():
+    from bigdl_tpu.tools.perf import build_model
+    m, shape, classes = build_model("alexnetowt", 10)
+    assert shape == (3, 224, 224) and classes == 10
+    m, shape, _ = build_model("alexnet", 10)
+    assert shape == (3, 227, 227)
+    m, shape, _ = build_model("inception_v2", 10)
+    assert shape == (3, 224, 224)
