@@ -1,0 +1,38 @@
+"""ImageNet folder -> packed record shards
+(models/utils/ImageNetSeqFileGenerator.scala:1 — raw JPEG to Hadoop
+SequenceFiles; here to the crc-framed .btir shard format
+ImageFolderDataSet reads via record_shards=).
+
+    python -m bigdl_tpu.tools.imagenet_seqfile_generator \
+        -f /imagenet/train -o /data/shards -p 64
+"""
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Pack an ImageFolder into record shards")
+    ap.add_argument("-f", "--folder", required=True,
+                    help="class-subfolder image tree (train or val)")
+    ap.add_argument("-o", "--output", required=True,
+                    help="output directory for shards")
+    ap.add_argument("-p", "--parallel", type=int, default=8,
+                    help="number of shards (the reference's partition "
+                         "count)")
+    ap.add_argument("--prefix", default="imagenet")
+    args = ap.parse_args(argv)
+
+    from bigdl_tpu.dataset import write_image_record_shards
+
+    shards = write_image_record_shards(
+        args.folder, args.output, num_shards=args.parallel,
+        prefix=args.prefix)
+    for s in shards:
+        print(s)
+    return shards
+
+
+if __name__ == "__main__":
+    main()

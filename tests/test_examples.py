@@ -63,3 +63,10 @@ def test_image_classification_example_real_images(tmp_path):
     from examples.image_classification import main
     out = main(["-f", str(tmp_path), "--classNum", "10", "-b", "2"])
     assert len(out) == 3
+
+
+def test_ml_pipeline_example():
+    """example/MLPipeline DLClassifierLeNet — estimator-API training."""
+    from examples.ml_pipeline import main
+    acc = main(["--synthetic", "128", "-e", "6", "-b", "32"])
+    assert acc > 0.9

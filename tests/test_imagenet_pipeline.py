@@ -235,3 +235,22 @@ def test_image_folder_dataset_jitter_flags_train(tmp_path):
         assert np.isfinite(np.asarray(b.input)).all()
     finally:
         ds.close()
+
+
+def test_seqfile_generator_cli(tmp_path):
+    """ImageNetSeqFileGenerator.scala analogue: folder -> shards that
+    ImageFolderDataSet(record_shards=) reads back."""
+    from bigdl_tpu.tools.imagenet_seqfile_generator import main
+
+    _make_folder(str(tmp_path / "imgs"))
+    out = tmp_path / "shards"
+    shards = main(["-f", str(tmp_path / "imgs"), "-o", str(out), "-p", "3"])
+    assert len(shards) == 3
+    ds = ImageFolderDataSet(record_shards=shards, batch_size=4, crop=24,
+                            scale=32, num_threads=1)
+    try:
+        assert ds.size() == 12
+        b = next(ds.data(train=True))
+        assert b.input.shape == (4, 3, 24, 24)
+    finally:
+        ds.close()
