@@ -271,12 +271,16 @@ class ShardRotator:
                  mean: Sequence[float] = (0.0, 0.0, 0.0),
                  std: Sequence[float] = (1.0, 1.0, 1.0),
                  chunk_bytes: Optional[int] = None,
-                 shuffle_shards: bool = True, seed: int = 0):
+                 shuffle_shards: bool = True, seed: int = 0,
+                 sharding=None):
         if n_shards < 2:
             raise ValueError("rotation needs at least 2 shards")
         self.provider = provider
         self.n_shards = n_shards
         self.pad = pad
+        self.sharding = sharding  # e.g. NamedSharding(mesh, P("data")):
+        # slots shard over the batch dim so each chip holds 2/n_shards of
+        # the rotating pod-wide cache (the v5e-8 ImageNet layout)
         self._rng = np.random.RandomState(seed)
         self.order = (self._rng.permutation(n_shards)
                       if shuffle_shards else np.arange(n_shards))
@@ -284,7 +288,7 @@ class ShardRotator:
         imgs0, lbls0 = provider(int(self.order[0]))
         self.template = DeviceCachedArrayDataSet(
             imgs0, lbls0, batch_size, crop=crop, pad=pad, flip=flip,
-            mean=mean, std=std, shuffle_seed=seed)
+            mean=mean, std=std, shuffle_seed=seed, sharding=sharding)
         self.shard_size = self.template.n
         if chunk_bytes is None:
             from bigdl_tpu.utils.transfer import probe_device_put_chunk
@@ -329,7 +333,11 @@ class ShardRotator:
         # one slot + one chunk — never pieces + a concatenated copy (the
         # documented two-slot HBM budget holds even for tightly sized
         # shards)
-        dest = jnp.zeros(imgs.shape, jnp.uint8)
+        if self.sharding is not None:
+            dest = jax.jit(lambda: jnp.zeros(imgs.shape, jnp.uint8),
+                           out_shardings=self.sharding)()
+        else:
+            dest = jnp.zeros(imgs.shape, jnp.uint8)
         self._staging = [imgs, np.ascontiguousarray(lbls, np.float32),
                          dest, 0]
 
@@ -346,7 +354,15 @@ class ShardRotator:
             return True
         imgs, lbls, dest, off = self._staging
         rows = max(1, self.chunk_bytes // imgs[0].nbytes)
-        piece = jax.device_put(imgs[off:off + rows])
+        if self.sharding is not None:
+            # sharded slots: pieces must split evenly over the mesh axis
+            ndev = self.sharding.mesh.devices.size
+            rows = max(ndev, rows - rows % ndev)
+            if (len(imgs) - off) % ndev:
+                raise ValueError(
+                    "shard size must be a multiple of the mesh size")
+            rows = min(rows, len(imgs) - off)
+        piece = jax.device_put(imgs[off:off + rows], self.sharding)
         self._staging[2] = _write_rows(dest, piece, jnp.int32(off))
         self._staging[3] = off + len(imgs[off:off + rows])
         return self.staged
@@ -359,7 +375,7 @@ class ShardRotator:
             raise RuntimeError(
                 "rotate() before staging finished — pump() until staged")
         _, lbls, dest, _ = self._staging
-        new_lbls = jax.device_put(lbls)
+        new_lbls = jax.device_put(lbls, self.sharding)
         self.template = self.template._from_device(dest, new_lbls)
         # fixed cyclic order after the initial shuffle: the staged-ahead
         # shard is always the one the bookkeeping expects, so one cycle

@@ -226,3 +226,47 @@ class TestShardRotator:
                                       pos=jnp.int32(it * 4))
             idxs.extend(np.asarray(idx).tolist())
         assert sorted(idxs) == list(range(16))
+
+
+def test_shard_rotator_sharded_slots_on_mesh():
+    """Rotation with slots sharded over a data mesh (the v5e-8 ImageNet
+    layout: each chip holds 1/n of both slots); swapping stays an
+    argument rebind on the same compiled step."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from bigdl_tpu.dataset.device_dataset import ShardRotator
+
+    devs = jax.devices()[:8]
+    mesh = Mesh(np.array(devs), ("data",))
+    sh = NamedSharding(mesh, P("data"))
+    m = 16
+
+    def provider(i):
+        r = np.random.RandomState(10 + i)
+        return (r.randint(0, 255, (m, 3, 8, 8), np.uint8),
+                np.full(m, float(i + 1), np.float32))
+
+    rot = ShardRotator(provider, 3, 8, crop=(6, 6), shuffle_shards=False,
+                       chunk_bytes=3 * 3 * 8 * 8, sharding=sh)
+    assert rot.images.sharding.spec == P("data")
+    tmpl = rot.template
+
+    @jax.jit
+    def draw(images, labels, key):
+        return tmpl.batch_fn_on(images, labels, key,
+                                epoch=jnp.int32(0), pos=jnp.int32(0))
+
+    _, y0 = draw(rot.images, rot.labels, jax.random.PRNGKey(0))
+    assert set(np.asarray(y0).tolist()) == {1.0}
+    while not rot.pump():
+        pass
+    rot.rotate()
+    assert rot.images.sharding.spec == P("data")
+    _, y1 = draw(rot.images, rot.labels, jax.random.PRNGKey(1))
+    assert set(np.asarray(y1).tolist()) == {2.0}
+    assert draw._cache_size() == 1
+    # staged content identical to the provider's shard
+    imgs1, _ = provider(1)
+    np.testing.assert_array_equal(np.asarray(rot.images), imgs1)
