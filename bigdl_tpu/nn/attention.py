@@ -37,22 +37,39 @@ def _flash_attention_tpu(q, k, v, causal: bool):
         return None  # shape/platform not supported by the kernel
 
 
+# Route to the pallas flash kernel when the materialized [S,S] score
+# matrix would not comfortably fit HBM. Measured on v5e-1 (bf16, H=8,
+# D=128): XLA's fused einsum BEATS the flash kernel on wall-clock at
+# every length it can compile (S=2048: 13.5 vs 14.1 ms; 4096: 25.5 vs
+# 31.8; 8192: 30.5 vs 41.9; 16384: 60.6 vs 77.4) and dies at S=32768
+# (scores alone 8.6 GB) where flash runs fine (191 ms) — so the kernel
+# is a MEMORY escape hatch, not a speedup, and the router keys on bytes.
+_FLASH_SCORE_BYTES = 2 << 30
+
+
+def _flash_eligible(q, mask, dropout_rate, training) -> bool:
+    b, h, seq, d = q.shape[-4], q.shape[-3], q.shape[-2], q.shape[-1]
+    scores_bytes = b * h * seq * seq * q.dtype.itemsize
+    return (mask is None
+            and not (training and dropout_rate > 0.0)
+            and seq % 128 == 0 and d % 128 == 0
+            and scores_bytes > _FLASH_SCORE_BYTES)
+
+
 def dot_product_attention(q, k, v, *, causal: bool = False, mask=None,
                           dropout_rate: float = 0.0, rng=None,
                           training: bool = False, use_flash: bool = True):
     """Scaled dot-product attention. q,k,v: [B, H, S, D].
 
-    On TPU, long sequences route to the pallas flash kernel (eligible
-    when there's no mask/dropout and the head dim tiles onto the MXU);
-    everything else uses the einsum form, which XLA fuses well at short
-    sequence lengths.
+    On TPU, sequences whose score matrix would bust HBM route to the
+    pallas flash kernel (O(S) memory); everything else uses the einsum
+    form, which XLA fuses onto the MXU and — measured on v5e — wins
+    wall-clock at every length it can hold (see _FLASH_SCORE_BYTES).
     """
     d = q.shape[-1]
-    seq = q.shape[-2]
     on_tpu = jax.devices()[0].platform == "tpu"
-    if (use_flash and on_tpu and mask is None
-            and not (training and dropout_rate > 0.0)
-            and seq >= 1024 and seq % 128 == 0 and d % 128 == 0):
+    if (use_flash and on_tpu
+            and _flash_eligible(q, mask, dropout_rate, training)):
         out = _flash_attention_tpu(q, k, v, causal)
         if out is not None:
             return out

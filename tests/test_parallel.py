@@ -378,3 +378,22 @@ def test_user_aux_loss_key_does_not_join_objective():
     opted_in = {"layer": {AUX_LOSS_KEY: jnp.asarray(3.0)},
                 "other": {"aux_loss": jnp.asarray(7.0)}}
     assert float(_collect_aux_losses(opted_in)) == 3.0
+
+
+def test_flash_routing_is_memory_keyed():
+    """The pallas kernel is an HBM escape hatch, not a speedup (measured
+    on v5e: XLA einsum wins wall-clock at every length it can compile) —
+    routing keys on score-matrix bytes, not sequence length."""
+    import jax.numpy as jnp
+
+    from bigdl_tpu.nn.attention import _flash_eligible
+
+    small = jnp.zeros((2, 8, 2048, 128), jnp.bfloat16)   # 128 MB scores
+    big = jnp.zeros((1, 8, 32768, 128), jnp.bfloat16)    # 17 GB scores
+    assert not _flash_eligible(small, None, 0.0, False)
+    assert _flash_eligible(big, None, 0.0, False)
+    # masks/dropout/untileable shapes stay on the einsum path
+    assert not _flash_eligible(big, object(), 0.0, False)
+    assert not _flash_eligible(big, None, 0.1, True)
+    odd = jnp.zeros((1, 8, 32768, 96), jnp.bfloat16)
+    assert not _flash_eligible(odd, None, 0.0, False)
