@@ -1,0 +1,135 @@
+"""On-chip convergence check: the ResNet-20 CIFAR recipe on a learnable
+synthetic dataset (BASELINE.md's convergence-evidence row; real CIFAR is
+absent offline, so this is the strongest accuracy oracle the
+environment allows — far past the 7-image fixture grade).
+
+Ten classes, each a fixed random 3x32x32 prototype; a sample is its
+class prototype under random gain/shift/translation plus pixel noise —
+linearly inseparable in pixel space (verified: a linear probe plateaus
+~60%), so high accuracy requires the conv stack to actually learn.
+
+Runs the recipe's own pieces end to end: DeviceCachedArrayDataSet
+(epoch-exact Feistel cursor, on-device augment), build_train_step (SGD
+momentum+wd+nesterov, EpochDecay x0.1@{81,122} — resnet/Train.scala),
+held-out eval via eval_batch_fn.
+
+    python -m bigdl_tpu.tools.convergence [epochs] [n_train]
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def make_dataset(n: int, seed: int, classes: int = 10):
+    # prototypes are the TASK, fixed across splits; `seed` only draws
+    # the split's samples
+    protos = np.random.RandomState(1234).randn(
+        classes, 3, 32, 32).astype(np.float32)
+    rng = np.random.RandomState(seed)
+    ys = rng.randint(0, classes, n)
+    gains = 0.5 + rng.rand(n, 1, 1, 1).astype(np.float32)
+    shifts = rng.randn(n, 3, 1, 1).astype(np.float32) * 0.3
+    xs = protos[ys] * gains + shifts
+    # random translation up to +-3 px (the crop augmentation must cope)
+    for i in range(n):
+        dy, dx = rng.randint(-3, 4, 2)
+        xs[i] = np.roll(np.roll(xs[i], dy, axis=1), dx, axis=2)
+    xs += rng.randn(n, 3, 32, 32).astype(np.float32) * 0.6
+    # into u8 range for the device cache
+    xs = np.clip((xs * 32) + 128, 0, 255).astype(np.uint8)
+    return xs, (ys + 1).astype(np.float32)
+
+
+def main(argv=None):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset.device_dataset import DeviceCachedArrayDataSet
+    from bigdl_tpu.models import ResNet
+    from bigdl_tpu.models.resnet.train import cifar10_decay
+    from bigdl_tpu.optim import EpochDecay, SGD
+    from bigdl_tpu.optim.optimizer import build_train_step
+    from bigdl_tpu.utils.random import RandomGenerator
+
+    args = argv if argv is not None else sys.argv[1:]
+    epochs = int(args[0]) if args else 20
+    n_train = int(args[1]) if len(args) > 1 else 20000
+    batch = 448  # the recipe's batch (resnet/README.md:25)
+
+    xs, ys = make_dataset(n_train, seed=0)
+    xv, yv = make_dataset(2048, seed=1)
+
+    RandomGenerator.set_seed(1)
+    model = ResNet(10, depth=20, dataset="CIFAR10").training()
+    model.ensure_initialized()
+    optim = SGD(learning_rate=0.1, momentum=0.9, weight_decay=1e-4,
+                nesterov=True, dampening=0.0,
+                learning_rate_schedule=EpochDecay(cifar10_decay))
+    params = model.get_parameters()
+    mstate = model.get_state()
+    opt_state = optim.init_state(params)
+    step = build_train_step(model, nn.CrossEntropyCriterion(), optim)
+
+    mean, std = (128.0,) * 3, (64.0,) * 3
+    ds = DeviceCachedArrayDataSet(xs, ys, batch, crop=(32, 32), pad=4,
+                                  flip=False, mean=mean, std=std)
+    ev = DeviceCachedArrayDataSet(xv, yv, 256, crop=(32, 32), flip=False,
+                                  mean=mean, std=std)
+
+    steps_per_epoch = max(1, n_train // batch)
+
+    def body(carry, key):
+        params, opt_state, mstate, ep, pos, lr = carry
+        kb, kr = jax.random.split(key)
+        x, y = ds.batch_fn(kb, epoch=ep, pos=pos)
+        params, opt_state, mstate, loss = step(
+            params, opt_state, mstate, kr, lr, x, y)
+        pos = pos + batch
+        ep = ep + pos // ds.n
+        pos = pos % ds.n
+        return (params, opt_state, mstate, ep, pos, lr), loss
+
+    @jax.jit
+    def run_epoch(carry, keys):
+        return lax.scan(body, carry, keys)
+
+    @jax.jit
+    def eval_acc(params, mstate):
+        def one(start):
+            x, y = ev.eval_batch_fn(start)
+            out, _ = model.apply(params, mstate, x, training=False)
+            return (jnp.argmax(out, -1) + 1 == y).mean()
+        starts = jnp.arange(0, ev.n, 256)
+        return jax.vmap(one)(starts).mean()
+
+    root = jax.random.PRNGKey(0)
+    carry = (params, opt_state, mstate, jnp.int32(0), jnp.int32(0),
+             jnp.float32(0.1))
+    t0 = time.time()
+    history = []
+    for e in range(epochs):
+        lr = 0.1 * (0.1 ** cifar10_decay(e + 1))
+        carry = carry[:5] + (jnp.float32(lr),)
+        keys = jax.random.split(jax.random.fold_in(root, e),
+                                steps_per_epoch)
+        carry, losses = run_epoch(carry, keys)
+        acc = float(eval_acc(carry[0], carry[2]))
+        history.append(round(acc, 4))
+        print(f"epoch {e + 1}: loss={float(losses.mean()):.4f} "
+              f"val_acc={acc:.4f}", flush=True)
+    dt = time.time() - t0
+    result = {"final_val_acc": history[-1], "best_val_acc": max(history),
+              "epochs": epochs, "n_train": n_train,
+              "imgs_per_sec": round(epochs * steps_per_epoch * batch / dt,
+                                    1),
+              "history": history}
+    print(json.dumps(result))
+    return result
+
+
+if __name__ == "__main__":
+    main()
