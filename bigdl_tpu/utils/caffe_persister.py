@@ -202,6 +202,14 @@ def _convert_module(module, name: str, bottoms: List[str],
         return [_Spec(name, "Reshape", bottoms, name,
                       {"reshape_param":
                        {"shape": {"dim": list(module.size)}}})]
+    if isinstance(module, nn.View):
+        # View(n) before InnerProduct is a flatten; Caffe's Flatten
+        # collapses axes 1..end, the same function
+        if len(module.sizes) == 1:
+            return [_Spec(name, "Flatten", bottoms, name)]
+        return [_Spec(name, "Reshape", bottoms, name,
+                      {"reshape_param":
+                       {"shape": {"dim": [0] + list(module.sizes)}}})]
     simple = {"ReLU": "ReLU", "Sigmoid": "Sigmoid", "Tanh": "TanH",
               "SoftMax": "Softmax", "Abs": "AbsVal"}
     if t in simple:
@@ -251,17 +259,49 @@ class CaffePersister:
                 blob_of[id(n)] = out[-1].top
         elif isinstance(self.model, nn.Sequential):
             input_names.append("data")
-            prev = "data"
-            for i, m in enumerate(self.model.modules):
-                name = m.get_name() or f"{type(m).__name__.lower()}{i}"
-                out = _convert_module(m, name, [prev],
-                                      tree.get(str(i), {}),
-                                      stree.get(str(i), {}))
-                specs.extend(out)
-                prev = out[-1].top
+            self._walk_seq(self.model, tree, stree, "data", specs, "")
         else:
             raise ValueError("CaffePersister exports Graph or Sequential")
         return specs, input_names
+
+    @staticmethod
+    def _walk_seq(seq, tree, stree, prev: str, specs: List[_Spec],
+                  prefix: str) -> str:
+        """Flatten nested Sequential/Concat containers into the linear
+        Caffe layer list (CaffePersister.scala walks containers the same
+        way: branches fan out from one bottom, a Concat layer joins the
+        branch tops)."""
+        import bigdl_tpu.nn as nn
+
+        for i, m in enumerate(seq.modules):
+            name = m.get_name() or f"{prefix}{type(m).__name__.lower()}{i}"
+            p = (tree or {}).get(str(i), {})
+            s = (stree or {}).get(str(i), {})
+            if isinstance(m, nn.Sequential):
+                prev = CaffePersister._walk_seq(m, p, s, prev, specs,
+                                                f"{name}_")
+            elif isinstance(m, nn.Concat):
+                tops = []
+                for j, br in enumerate(m.modules):
+                    bp = (p or {}).get(str(j), {})
+                    bs = (s or {}).get(str(j), {})
+                    bname = br.get_name() or f"{name}_b{j}"
+                    if isinstance(br, nn.Sequential):
+                        tops.append(CaffePersister._walk_seq(
+                            br, bp, bs, prev, specs, f"{bname}_"))
+                    else:
+                        out = _convert_module(br, bname, [prev], bp, bs)
+                        specs.extend(out)
+                        tops.append(out[-1].top)
+                specs.append(_Spec(name, "Concat", tops, name,
+                                   {"concat_param":
+                                    {"axis": m.dimension - 1}}))
+                prev = name
+            else:
+                out = _convert_module(m, name, [prev], p, s)
+                specs.extend(out)
+                prev = out[-1].top
+        return prev
 
     def save(self, def_path: str, model_path: str):
         specs, input_names = self._specs()
