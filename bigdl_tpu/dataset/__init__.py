@@ -11,7 +11,8 @@ from bigdl_tpu.dataset.imagenet import (
     read_image_records, write_image_record_shards,
     IMAGENET_MEAN, IMAGENET_STD)
 from bigdl_tpu.dataset.prefetch import device_prefetch
-from bigdl_tpu.dataset.device_dataset import DeviceCachedArrayDataSet
+from bigdl_tpu.dataset.device_dataset import (
+    DeviceCachedArrayDataSet, RotatingDeviceDataSet, ShardRotator)
 from bigdl_tpu.dataset.text import (
     Dictionary, LabeledSentence, LabeledSentenceToSample, SentenceBiPadding,
     SentenceSplitter, SentenceTokenizer, TextToLabeledSentence, load_ptb,

@@ -383,3 +383,73 @@ class ShardRotator:
         # reshuffles every epoch via the Feistel permutation)
         self._cycle_pos = (self._cycle_pos + 1) % self.n_shards
         self._begin_stage()
+
+
+class RotatingDeviceDataSet:
+    """Optimizer-ready feed over a :class:`ShardRotator` — the composition
+    that trains datasets larger than HBM at device-cached rates
+    (BASELINE.md v5e-8 ImageNet mapping; the reference's counterpart is
+    SeqFileFolder's cluster-rate streaming, DataSet.scala:470-552).
+
+    The Optimizer recognizes ``rotating = True`` and (a) passes the
+    CURRENT slot arrays as arguments to its jitted fused step — a closure
+    would bake them in as compile-time constants, silently training on
+    the first shard forever — and (b) calls :meth:`after_step` between
+    iterations, which streams one cliff-safe piece of the next shard and
+    rotates at shard boundaries. ``size()`` spans the full dataset so
+    epoch triggers and schedules see true data epochs.
+
+    Shard size should be a multiple of the batch size: a batch that
+    straddles a shard boundary re-draws from the resident shard (the
+    reference's per-partition locality had the same wrinkle).
+    """
+
+    rotating = True
+    continuous_stream = True
+
+    def __init__(self, rotator: ShardRotator):
+        self.rot = rotator
+        self._consumed_shards = 0
+
+    # geometry delegates to the rotator's (stable) template
+    @property
+    def template(self) -> DeviceCachedArrayDataSet:
+        return self.rot.template
+
+    @property
+    def images(self):
+        return self.rot.images
+
+    @property
+    def labels(self):
+        return self.rot.labels
+
+    @property
+    def batch_size(self) -> int:
+        return self.rot.template.batch_size
+
+    def size(self) -> int:
+        return self.rot.shard_size * self.rot.n_shards
+
+    def shard_cursor(self, neval: int):
+        """(visit, pos-in-shard) for iteration ``neval`` (1-based, the
+        driver convention): ``visit`` seeds the in-shard permutation so
+        every shard visit reshuffles."""
+        gpos = (neval - 1) * self.batch_size
+        return divmod(gpos, self.rot.shard_size)
+
+    def after_step(self, neval: int):
+        """Call with the just-finished iteration's neval, AFTER its loss
+        has been fetched (transfers must alternate with compute on
+        tunneled links). Pumps one piece; rotates when the sample stream
+        crossed into the next shard."""
+        done_shards = (neval * self.batch_size) // self.rot.shard_size
+        while self._consumed_shards < done_shards:
+            while not self.rot.staged:
+                self.rot.pump()
+            self.rot.rotate()
+            self._consumed_shards += 1
+        self.rot.pump()
+
+    def shuffle(self):
+        pass

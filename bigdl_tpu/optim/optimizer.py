@@ -397,8 +397,26 @@ class Optimizer:
         # sampled + augmented INSIDE the jitted step — zero per-step
         # host->device traffic (the HBM form of the reference's decoded
         # executor cache, DataSet.scala CachedDistriDataSet:240).
-        device_feed = hasattr(self.dataset, "batch_fn")
-        if device_feed:
+        rotating = getattr(self.dataset, "rotating", False)
+        device_feed = rotating or hasattr(self.dataset, "batch_fn")
+        if rotating:
+            # rotating HBM shard cache (RotatingDeviceDataSet): the slot
+            # arrays MUST be step arguments — a closure would bake them
+            # in as compile-time constants and train on the first shard
+            # forever; as arguments, each rotation is a plain rebind of
+            # the one compiled step
+            ds = self.dataset
+            tmpl = ds.template
+
+            def _fused_rot(p, o, m, key, lr, ep, pos, images, labels):
+                kb, kr = jax.random.split(key)
+                x, y = tmpl.batch_fn_on(images, labels, kb,
+                                        epoch=ep, pos=pos)
+                return step(p, o, m, kr, lr, x, y)
+
+            fused_step = jax.jit(_fused_rot, donate_argnums=(0, 1, 2))
+            data_iter = None
+        elif device_feed:
             ds = self.dataset
             # epoch-exact feed: the global iteration index drives a
             # per-epoch permutation inside batch_fn (DataSet.scala:240
@@ -426,7 +444,13 @@ class Optimizer:
         wall_start = time.time()
         while not end_when(state):
             t0 = time.time()
-            if device_feed:
+            if rotating:
+                bsz = self.dataset.batch_size
+                visit, sp = self.dataset.shard_cursor(state["neval"])
+                step_args = (jnp.int32(visit), jnp.int32(sp),
+                             self.dataset.images, self.dataset.labels)
+                run_step = fused_step
+            elif device_feed:
                 bsz = self.dataset.batch_size
                 # neval starts at 1 (reference convention); the sample
                 # stream is 0-based so epoch boundaries line up with
@@ -454,6 +478,11 @@ class Optimizer:
                 params, opt_state, model_state, rng, lr, *step_args)
             loss_f = float(loss)
             t_compute = time.time() - t1
+            if rotating:
+                # loss fetch above completed the step; stream the next
+                # shard piece now (alternation rule) and rotate slots at
+                # shard boundaries
+                self.dataset.after_step(state["neval"])
 
             state["neval"] += 1
             self.optim_method.state["neval"] = state["neval"]
