@@ -1,0 +1,94 @@
+"""TensorFlow interop example — load a frozen GraphDef as a model, and
+save a model back as a GraphDef TF can read (example/tensorflow/
+{Load,Save}.scala + model.py: the reference froze a TF LeNet, loaded it
+with Module.loadTF, and exported a BigDL model with saveTF).
+
+    python examples/tensorflow_interop.py load  frozen_model.pb
+    python examples/tensorflow_interop.py save  out_model.pb
+    python examples/tensorflow_interop.py demo  # build+freeze with real
+                                                # TF, round-trip, compare
+"""
+from __future__ import annotations
+
+import argparse
+
+
+def cmd_load(path: str):
+    import numpy as np
+
+    from bigdl_tpu.utils.tf_loader import load_tf_graph
+
+    m = load_tf_graph(path).evaluate()
+    print("inputs:", m.input_names)
+    print("outputs:", m.output_names)
+    x = np.random.RandomState(0).rand(1, 28, 28, 1).astype(np.float32)
+    out = np.asarray(m.forward(x))
+    print("forward ok, output shape", out.shape)
+    return m
+
+
+def cmd_save(path: str):
+    import numpy as np
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.utils.tf_saver import save_tf_graph
+
+    m = (nn.Sequential().add(nn.Reshape((784,)))
+         .add(nn.Linear(784, 10)).add(nn.SoftMax()))
+    m.ensure_initialized()
+    names = save_tf_graph(path, m)
+    print("wrote", path, names)
+    return m
+
+
+def cmd_demo():
+    """Build a TF LeNet with REAL TensorFlow, freeze it in-process,
+    import it, and check the two frameworks agree numerically."""
+    import numpy as np
+    import tensorflow as tf
+    from tensorflow.python.framework import convert_to_constants
+
+    from bigdl_tpu.utils.tf_loader import TFModule
+
+    @tf.function
+    def lenet(x):
+        k1 = tf.constant(np.random.RandomState(0)
+                         .randn(5, 5, 1, 6).astype(np.float32) * 0.1)
+        k2 = tf.constant(np.random.RandomState(1)
+                         .randn(400, 10).astype(np.float32) * 0.1)
+        h = tf.nn.conv2d(x, k1, strides=1, padding="VALID")
+        h = tf.nn.relu(h)
+        h = tf.nn.max_pool2d(h, 2, 2, "VALID")
+        h = tf.reshape(h, [1, -1])
+        h = h[:, :400]
+        return tf.matmul(h, k2)
+
+    conc = lenet.get_concrete_function(
+        tf.TensorSpec([1, 28, 28, 1], tf.float32))
+    frozen = convert_to_constants.convert_variables_to_constants_v2(conc)
+    graph_bytes = frozen.graph.as_graph_def().SerializeToString()
+
+    x = np.random.RandomState(2).rand(1, 28, 28, 1).astype(np.float32)
+    want = frozen(tf.constant(x))[0].numpy()
+    m = TFModule(graph_bytes).evaluate()
+    got = np.asarray(m.forward(x))
+    err = float(np.abs(got - want).max())
+    print(f"TF vs bigdl_tpu max err: {err:.2e}")
+    assert err < 1e-4
+    return err
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description="TF interop example")
+    ap.add_argument("cmd", choices=["load", "save", "demo"])
+    ap.add_argument("path", nargs="?", default="model.pb")
+    args = ap.parse_args(argv)
+    if args.cmd == "load":
+        return cmd_load(args.path)
+    if args.cmd == "save":
+        return cmd_save(args.path)
+    return cmd_demo()
+
+
+if __name__ == "__main__":
+    main()

@@ -70,3 +70,25 @@ def test_ml_pipeline_example():
     from examples.ml_pipeline import main
     acc = main(["--synthetic", "128", "-e", "6", "-b", "32"])
     assert acc > 0.9
+
+
+def test_tensorflow_interop_example_demo():
+    """example/tensorflow Load.scala path: a graph frozen by REAL TF
+    imports and agrees numerically."""
+    import pytest
+    pytest.importorskip("tensorflow")
+    from examples.tensorflow_interop import cmd_demo
+    assert cmd_demo() < 1e-4
+
+
+def test_tensorflow_interop_example_save(tmp_path):
+    """Save.scala path: exported GraphDef parses back in real TF."""
+    import pytest
+    tf = pytest.importorskip("tensorflow")
+    from examples.tensorflow_interop import cmd_save
+    p = str(tmp_path / "m.pb")
+    cmd_save(p)
+    gd = tf.compat.v1.GraphDef()
+    with open(p, "rb") as f:
+        gd.ParseFromString(f.read())
+    assert any(n.name == "input" for n in gd.node)
