@@ -283,6 +283,29 @@ _OPS: Dict[str, Callable] = {
     "Softmax": lambda n, xs: jax.nn.softmax(xs[0], axis=-1),
     "LogSoftmax": lambda n, xs: jax.nn.log_softmax(xs[0], axis=-1),
     "Softplus": lambda n, xs: jax.nn.softplus(xs[0]),
+    # the loss heads exported training graphs carry (Session.scala
+    # trains against the graph's own loss; loaders/…CrossEntropy…):
+    # outputs are (per-example loss, backprop gradient)
+    "SoftmaxCrossEntropyWithLogits": lambda n, xs: (
+        -(jnp.asarray(xs[1])
+          * jax.nn.log_softmax(xs[0], axis=-1)).sum(-1),
+        jax.nn.softmax(xs[0], axis=-1) - jnp.asarray(xs[1])),
+    "SparseSoftmaxCrossEntropyWithLogits": lambda n, xs: (
+        -jnp.take_along_axis(
+            jax.nn.log_softmax(xs[0], axis=-1),
+            jnp.asarray(xs[1], jnp.int32)[:, None], axis=-1)[:, 0],
+        jax.nn.softmax(xs[0], axis=-1)
+        - jax.nn.one_hot(jnp.asarray(xs[1], jnp.int32),
+                         xs[0].shape[-1], dtype=xs[0].dtype)),
+    "Gather": lambda n, xs: jnp.take(
+        xs[0], jnp.asarray(xs[1], jnp.int32), axis=0),
+    "Split": lambda n, xs: tuple(jnp.split(
+        xs[1], int(n.attrs.get("num_split", 1)), axis=int(xs[0]))),
+    "SplitV": lambda n, xs: tuple(jnp.split(
+        xs[0], np.cumsum(np.asarray(xs[1]).astype(int))[:-1].tolist(),
+        axis=int(np.asarray(xs[2])))),
+    "TopKV2": lambda n, xs: tuple(jax.lax.top_k(
+        xs[0], int(np.asarray(xs[1])))),
     "Reshape": lambda n, xs: jnp.reshape(
         xs[0], [int(v) for v in np.asarray(xs[1]).ravel()]),
     "Squeeze": lambda n, xs: jnp.squeeze(
