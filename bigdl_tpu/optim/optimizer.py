@@ -72,6 +72,16 @@ def _collect_aux_losses(state_tree):
     return total
 
 
+def _to_scalar(x) -> float:
+    """float(loss) that also works on multi-host global arrays (a fully
+    replicated value is readable from any addressable shard)."""
+    try:
+        return float(x)
+    except Exception:
+        return float(np.asarray(
+            jax.device_get(x.addressable_shards[0].data)))
+
+
 def build_train_step(module: Module, criterion: Criterion,
                      optim_method: OptimMethod,
                      aux_loss_weight: float = 0.01):
@@ -226,11 +236,26 @@ class Optimizer:
         return self
 
     # -- sharding helpers --------------------------------------------------
+    def _multiprocess(self) -> bool:
+        """True when the mesh spans more than this process's devices —
+        the multi-host regime the reference reached through Spark
+        executors (Engine.scala:93-106); arrays must then be assembled
+        from per-process local data."""
+        return self.mesh is not None and jax.process_count() > 1
+
     def _put_batch(self, arr):
         x = jnp.asarray(arr)
         if self.mesh is not None:
             sh = jax.sharding.NamedSharding(
                 self.mesh, jax.sharding.PartitionSpec(self.data_axis))
+            if self._multiprocess():
+                # each process contributes ITS batch rows; the global
+                # batch is their concatenation in process order (the
+                # role Spark partition locality played)
+                a = np.asarray(arr)
+                gshape = (a.shape[0] * jax.process_count(),) + a.shape[1:]
+                return jax.make_array_from_process_local_data(sh, a,
+                                                              gshape)
             return jax.device_put(x, sh)
         return x
 
@@ -238,6 +263,15 @@ class Optimizer:
         if self.mesh is not None:
             sh = jax.sharding.NamedSharding(self.mesh,
                                             jax.sharding.PartitionSpec())
+            if self._multiprocess():
+                # device_put cannot target non-addressable devices;
+                # build each replicated leaf via callback (every process
+                # holds the full value — init is seed-identical)
+                def put(a):
+                    a = np.asarray(a)
+                    return jax.make_array_from_callback(
+                        a.shape, sh, lambda idx: a[idx])
+                return jax.tree.map(put, tree)
             return jax.device_put(tree, sh)
         return tree
 
@@ -476,7 +510,7 @@ class Optimizer:
             t1 = time.time()
             params, opt_state, model_state, loss = run_step(
                 params, opt_state, model_state, rng, lr, *step_args)
-            loss_f = float(loss)
+            loss_f = _to_scalar(loss)
             t_compute = time.time() - t1
             if rotating:
                 # loss fetch above completed the step; stream the next

@@ -45,9 +45,22 @@ def tree_shardings(tree, mesh: Mesh, rules: Rules):
     return jax.tree_util.tree_map_with_path(leaf_sharding, tree)
 
 
+def put_global(leaf, sharding):
+    """device_put that also works on meshes spanning other processes
+    (multi-host: device_put cannot target non-addressable devices, so
+    each process materializes its shards via callback from the full
+    host value it holds)."""
+    if jax.process_count() > 1:
+        a = np.asarray(leaf)
+        return jax.make_array_from_callback(a.shape, sharding,
+                                            lambda idx: a[idx])
+    return jax.device_put(leaf, sharding)
+
+
 def shard_params(params, mesh: Mesh, rules: Rules):
-    """device_put the param pytree according to the rules."""
-    return jax.device_put(params, tree_shardings(params, mesh, rules))
+    """Place the param pytree according to the rules (multi-host-safe)."""
+    return jax.tree.map(put_global, params,
+                        tree_shardings(params, mesh, rules))
 
 
 def validate_rules(params, mesh: Mesh, rules: Rules) -> List[str]:
@@ -81,6 +94,6 @@ def shard_opt_state_zero1(tree, mesh: Mesh, data_axis: str = "data"):
         if (hasattr(leaf, "ndim") and leaf.ndim >= 1
                 and leaf.shape[0] % ndev == 0):
             spec = P(data_axis, *([None] * (leaf.ndim - 1)))
-            return jax.device_put(leaf, NamedSharding(mesh, spec))
-        return jax.device_put(leaf, NamedSharding(mesh, P()))
+            return put_global(leaf, NamedSharding(mesh, spec))
+        return put_global(leaf, NamedSharding(mesh, P()))
     return jax.tree.map(put, tree)

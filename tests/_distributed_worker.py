@@ -12,10 +12,50 @@ import os
 import sys
 
 
+def _optimizer_mode(pid: int):
+    """DistriOptimizer over a mesh spanning BOTH processes (4 virtual
+    devices each -> 8 global): each process feeds its half of the global
+    batch; prints the loss sequence, which the parent compares against a
+    single-process 8-device run of the identical global batches
+    (RefDistriOptimizer's oracle, lifted to real multi-host)."""
+    import jax
+    import numpy as np
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+    from bigdl_tpu.optim import DistriOptimizer, SGD, max_iteration
+    from bigdl_tpu.utils.random import RandomGenerator
+
+    mesh = jax.sharding.Mesh(np.array(jax.devices()), ("data",))
+    rng = np.random.RandomState(7)
+    xs = rng.randn(64, 10).astype(np.float32)
+    ys = (rng.randint(0, 3, 64) + 1).astype(np.float32)
+    lo, hi = pid * 32, pid * 32 + 32
+    samples = [Sample(xs[i], ys[i]) for i in range(lo, hi)]
+    ds = DataSet.array(samples).transform(SampleToMiniBatch(8))
+
+    RandomGenerator.set_seed(42)
+    model = (nn.Sequential().add(nn.Linear(10, 16)).add(nn.Tanh())
+             .add(nn.Linear(16, 3)).add(nn.LogSoftMax()))
+    opt = DistriOptimizer(model, ds, nn.ClassNLLCriterion(),
+                          batch_size=8, mesh=mesh)
+    opt.set_optim_method(SGD(learning_rate=0.2))
+    opt.set_end_when(max_iteration(4))  # exactly one local epoch:
+    # stopping before the rollover keeps the data order deterministic
+    # for the parent's single-process comparison
+    opt.optimize()
+    print(json.dumps({"ok": True, "pid": pid,
+                      "last_loss": opt.driver_state["Loss"],
+                      "neval": opt.driver_state["neval"]}))
+
+
 def main():
     port, pid = sys.argv[1], int(sys.argv[2])
+    mode = sys.argv[3] if len(sys.argv) > 3 else "smoke"
     os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    os.environ["XLA_FLAGS"] = (
+        "--xla_force_host_platform_device_count="
+        + ("4" if mode == "optimizer" else "1"))
 
     import numpy as np
 
@@ -41,6 +81,9 @@ def main():
                                 initialization_timeout=60)
         assert jax.process_count() == 2, jax.process_count()
         assert Engine.node_number() == 2
+        if mode == "optimizer":
+            _optimizer_mode(pid)
+            return
         mesh = Engine.mesh()
         assert mesh.devices.size == 2
 
