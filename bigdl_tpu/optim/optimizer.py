@@ -82,6 +82,24 @@ def _to_scalar(x) -> float:
             jax.device_get(x.addressable_shards[0].data)))
 
 
+def _local_rows(x) -> np.ndarray:
+    """Materialize a (possibly multi-host, batch-sharded) array's rows
+    held by THIS process, in batch order; plain arrays pass through."""
+    try:
+        return np.asarray(x)
+    except Exception:
+        shards = sorted(x.addressable_shards,
+                        key=lambda s: (s.index[0].start or 0))
+        seen, parts = set(), []
+        for s in shards:  # dedupe replicated copies across local devices
+            key = tuple((sl.start, sl.stop) for sl in s.index)
+            if key in seen:
+                continue
+            seen.add(key)
+            parts.append(np.asarray(jax.device_get(s.data)))
+        return np.concatenate(parts)
+
+
 def build_train_step(module: Module, criterion: Criterion,
                      optim_method: OptimMethod,
                      aux_loss_weight: float = 0.01):
@@ -181,6 +199,7 @@ class Optimizer:
         self.train_summary = None
         self.validation_summary = None
         # failure retry (DistriOptimizer.scala:789-855)
+        self._mp_batch_rows = None  # multi-host fixed-batch guard
         self.retry_times = int(os.environ.get("BIGDL_FAILURE_RETRY_TIMES", 5))
         self.retry_interval_s = float(
             os.environ.get("BIGDL_FAILURE_RETRY_INTERVAL", 1.0))
@@ -244,34 +263,41 @@ class Optimizer:
         return self.mesh is not None and jax.process_count() > 1
 
     def _put_batch(self, arr):
-        x = jnp.asarray(arr)
         if self.mesh is not None:
             sh = jax.sharding.NamedSharding(
                 self.mesh, jax.sharding.PartitionSpec(self.data_axis))
             if self._multiprocess():
                 # each process contributes ITS batch rows; the global
                 # batch is their concatenation in process order (the
-                # role Spark partition locality played)
+                # role Spark partition locality played). Every process
+                # must feed the same row count every step — a ragged
+                # final batch would change the global shape mid-run (or
+                # desynchronize iteration counts and deadlock the
+                # collective), so fail fast instead.
                 a = np.asarray(arr)
+                if self._mp_batch_rows is None:
+                    self._mp_batch_rows = a.shape[0]
+                elif a.shape[0] != self._mp_batch_rows:
+                    raise ValueError(
+                        f"multi-host batch changed size "
+                        f"{self._mp_batch_rows} -> {a.shape[0]}: local "
+                        "datasets must yield equal fixed-size batches "
+                        "(drop the remainder or pad)")
                 gshape = (a.shape[0] * jax.process_count(),) + a.shape[1:]
                 return jax.make_array_from_process_local_data(sh, a,
                                                               gshape)
-            return jax.device_put(x, sh)
-        return x
+            return jax.device_put(jnp.asarray(arr), sh)
+        return jnp.asarray(arr)
 
     def _put_replicated(self, tree):
         if self.mesh is not None:
             sh = jax.sharding.NamedSharding(self.mesh,
                                             jax.sharding.PartitionSpec())
             if self._multiprocess():
-                # device_put cannot target non-addressable devices;
-                # build each replicated leaf via callback (every process
-                # holds the full value — init is seed-identical)
-                def put(a):
-                    a = np.asarray(a)
-                    return jax.make_array_from_callback(
-                        a.shape, sh, lambda idx: a[idx])
-                return jax.tree.map(put, tree)
+                # every process holds the full value (init is
+                # seed-identical); put_global assembles the global array
+                from bigdl_tpu.parallel.tp import put_global
+                return jax.tree.map(lambda a: put_global(a, sh), tree)
             return jax.device_put(tree, sh)
         return tree
 
@@ -364,7 +390,12 @@ class Optimizer:
         for b in batches:
             inp, tgt = self._prep_io(b)
             out = eval_step(params, model_state, inp)
-            batch_res = [m(np.asarray(out), np.asarray(tgt))
+            # multi-host: out/tgt span non-addressable devices; each
+            # process scores ITS rows (the reference aggregated
+            # per-executor ValidationResults the same way — here the
+            # local shard IS this process's data)
+            out_np, tgt_np = _local_rows(out), _local_rows(tgt)
+            batch_res = [m(out_np, tgt_np)
                          for m in self.validation_methods]
             if results is None:
                 results = batch_res

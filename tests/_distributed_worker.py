@@ -23,7 +23,8 @@ def _optimizer_mode(pid: int):
 
     import bigdl_tpu.nn as nn
     from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
-    from bigdl_tpu.optim import DistriOptimizer, SGD, max_iteration
+    from bigdl_tpu.optim import (DistriOptimizer, SGD, Top1Accuracy,
+                                 every_epoch, max_iteration)
     from bigdl_tpu.utils.random import RandomGenerator
 
     mesh = jax.sharding.Mesh(np.array(jax.devices()), ("data",))
@@ -40,12 +41,16 @@ def _optimizer_mode(pid: int):
     opt = DistriOptimizer(model, ds, nn.ClassNLLCriterion(),
                           batch_size=8, mesh=mesh)
     opt.set_optim_method(SGD(learning_rate=0.2))
+    # validation exercises the multi-host local-shard scoring path
+    val = DataSet.array(samples[:16]).transform(SampleToMiniBatch(8))
+    opt.set_validation(every_epoch(), val, [Top1Accuracy()])
     opt.set_end_when(max_iteration(4))  # exactly one local epoch:
     # stopping before the rollover keeps the data order deterministic
     # for the parent's single-process comparison
     opt.optimize()
     print(json.dumps({"ok": True, "pid": pid,
                       "last_loss": opt.driver_state["Loss"],
+                      "score": opt.driver_state.get("score"),
                       "neval": opt.driver_state["neval"]}))
 
 
@@ -82,8 +87,16 @@ def main():
         assert jax.process_count() == 2, jax.process_count()
         assert Engine.node_number() == 2
         if mode == "optimizer":
-            _optimizer_mode(pid)
-            return
+            # bring-up succeeded: failures past this point are REAL
+            # regressions and must crash the worker (SystemExit bypasses
+            # the skip-catch below), not print a skip
+            try:
+                _optimizer_mode(pid)
+                return
+            except Exception:
+                import traceback
+                traceback.print_exc()
+                sys.exit(3)
         mesh = Engine.mesh()
         assert mesh.devices.size == 2
 
