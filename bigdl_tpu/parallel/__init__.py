@@ -5,6 +5,8 @@ from bigdl_tpu.parallel.mesh import (
     make_mesh, data_parallel_mesh, replicated, batch_sharded)
 from bigdl_tpu.parallel.ring_attention import (
     ring_attention, ring_attention_sharded)
+from bigdl_tpu.parallel.ulysses import (
+    ulysses_attention, ulysses_attention_sharded)
 from bigdl_tpu.parallel.tp import (
     shard_params, shard_opt_state_zero1, spec_for, tree_shardings,
     validate_rules)

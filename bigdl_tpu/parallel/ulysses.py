@@ -1,0 +1,59 @@
+"""Ulysses-style all-to-all sequence parallelism (net-new vs the
+reference, which has no sequence parallelism — SURVEY.md §2.3/§5; the
+second of the two first-class long-context layouts next to ring
+attention).
+
+Where ring attention rotates K/V blocks around the mesh, Ulysses
+re-shards: an all-to-all swaps the sharded dim from SEQUENCE to HEADS,
+every device then computes FULL-sequence attention for its head group
+(any kernel — here the memory-routed dot_product_attention), and a
+second all-to-all swaps back. Two collectives per layer, no online
+softmax, requires heads % mesh == 0. On an ICI mesh the all-to-alls are
+bandwidth-cheap (each device exchanges 1/n of its activations).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = False):
+    """Exact attention with sequence sharded over ``axis_name``.
+
+    Per-shard q,k,v: [B, H, S_local, D] with H divisible by the axis
+    size. Returns [B, H, S_local, D].
+    """
+    n = jax.lax.psum(1, axis_name)
+
+    def seq_to_heads(x):
+        # [B, H, S/n, D] -> all-to-all -> [B, H/n, S, D]
+        return jax.lax.all_to_all(x, axis_name, split_axis=1,
+                                  concat_axis=2, tiled=True)
+
+    def heads_to_seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=2,
+                                  concat_axis=1, tiled=True)
+
+    if q.shape[1] % n:
+        raise ValueError(
+            f"ulysses needs heads ({q.shape[1]}) divisible by the "
+            f"'{axis_name}' mesh size ({n})")
+    from bigdl_tpu.nn.attention import dot_product_attention
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    oh = dot_product_attention(qh, kh, vh, causal=causal)
+    return heads_to_seq(oh)
+
+
+def ulysses_attention_sharded(q, k, v, mesh: Mesh, seq_axis: str = "seq",
+                              *, causal: bool = False):
+    """Full-array convenience wrapper: shards S over ``seq_axis`` and
+    runs Ulysses attention under shard_map. q,k,v: [B, H, S, D]."""
+    from jax.experimental.shard_map import shard_map
+    spec = P(None, None, seq_axis, None)
+    fn = functools.partial(ulysses_attention, axis_name=seq_axis,
+                           causal=causal)
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec)(q, k, v)

@@ -397,3 +397,40 @@ def test_flash_routing_is_memory_keyed():
     assert not _flash_eligible(big, None, 0.1, True)
     odd = jnp.zeros((1, 8, 32768, 96), jnp.bfloat16)
     assert not _flash_eligible(odd, None, 0.0, False)
+
+
+def test_ulysses_attention_matches_full():
+    """All-to-all sequence parallelism: seq-sharded qkv re-shard to
+    head-sharded, full attention per head group, shard back — exact
+    equality with single-device attention (the second long-context
+    layout next to ring attention)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from bigdl_tpu.nn.attention import dot_product_attention
+    from bigdl_tpu.parallel import ulysses_attention_sharded
+
+    devs = jax.devices()[:8]
+    mesh = Mesh(np.array(devs), ("seq",))
+    rs = np.random.RandomState(0)
+    q, k, v = [jnp.asarray(rs.randn(2, 8, 64, 16).astype(np.float32))
+               for _ in range(3)]
+    for causal in (False, True):
+        out = ulysses_attention_sharded(q, k, v, mesh, causal=causal)
+        ref = dot_product_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from bigdl_tpu.parallel import ulysses_attention_sharded
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("seq",))
+    q = jnp.zeros((1, 4, 64, 16))  # 4 heads on an 8-way axis
+    with np.testing.assert_raises(Exception):
+        np.asarray(ulysses_attention_sharded(q, q, q, mesh))
