@@ -82,6 +82,24 @@ def _to_scalar(x) -> float:
             jax.device_get(x.addressable_shards[0].data)))
 
 
+def _allreduce_result(r):
+    """Sum a ValidationResult across processes: gather (numerator,
+    count) and rebuild, so every host reports the GLOBAL score."""
+    from jax.experimental import multihost_utils
+
+    from bigdl_tpu.optim.validation import AccuracyResult, LossResult
+
+    value, count = r.result()
+    arr = multihost_utils.process_allgather(
+        np.array([value * count, count], np.float64))
+    num, cnt = np.asarray(arr).reshape(-1, 2).sum(0)
+    if isinstance(r, AccuracyResult):
+        return AccuracyResult(int(round(num)), int(cnt))
+    if isinstance(r, LossResult):
+        return LossResult(float(num), int(cnt))
+    return r  # unknown result type: keep the local value
+
+
 def _local_rows(x) -> np.ndarray:
     """Materialize a (possibly multi-host, batch-sharded) array's rows
     held by THIS process, in batch order; plain arrays pass through."""
@@ -401,6 +419,10 @@ class Optimizer:
                 results = batch_res
             else:
                 results = [r + br for r, br in zip(results, batch_res)]
+        if self._multiprocess():
+            # reduce ValidationResults across processes (the reference
+            # reduce(+)s per-executor results, DistriOptimizer.scala:607)
+            results = [_allreduce_result(r) for r in results]
         summary = {}
         for m, r in zip(self.validation_methods, results):
             value, _ = r.result()

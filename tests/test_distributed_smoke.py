@@ -124,5 +124,8 @@ def test_two_process_distri_optimizer_matches_single_process():
     for r in results:
         assert r["ok"] and r["neval"] == 5
         np.testing.assert_allclose(r["last_loss"], ref_loss, atol=1e-5)
-        # validation ran on the global mesh (local-shard scoring)
+        # validation ran on the global mesh (local-shard scoring,
+        # reduced across processes)
         assert r["score"] is not None and 0.0 <= r["score"] <= 1.0
+    # the cross-process reduce makes every host report the GLOBAL score
+    assert results[0]["score"] == results[1]["score"]
