@@ -56,6 +56,21 @@ def _rebuild(template, arrays, prefix=""):
     return out
 
 
+def _host_leaf(a) -> np.ndarray:
+    """Leaf -> host numpy, including multi-host global arrays that span
+    non-addressable devices (e.g. ZeRO-1 shards): reshard to replicated
+    on device (an all-gather over the mesh), then read — every host
+    checkpoints the same full value (DistriOptimizer saves the
+    assembled weights the same way, :433-463)."""
+    try:
+        return np.asarray(a)
+    except Exception:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+        repl = NamedSharding(a.sharding.mesh, PartitionSpec())
+        return np.asarray(jax.jit(lambda x: x, out_shardings=repl)(a))
+
+
 def _flatten_leaves(tree, prefix=""):
     from bigdl_tpu.utils.table import Table
     out = {}
@@ -66,7 +81,7 @@ def _flatten_leaves(tree, prefix=""):
         for k, v in sorted(tree.items()):
             out.update(_flatten_leaves(v, f"{prefix}{k}/"))
     else:
-        out[prefix.rstrip("/")] = np.asarray(tree)
+        out[prefix.rstrip("/")] = _host_leaf(tree)
     return out
 
 

@@ -48,6 +48,21 @@ def _optimizer_mode(pid: int):
     # stopping before the rollover keeps the data order deterministic
     # for the parent's single-process comparison
     opt.optimize()
+
+    # checkpointing a cross-process ZeRO-1-sharded tree must reassemble
+    # the full value on every host (serialization._host_leaf)
+    import tempfile
+
+    from bigdl_tpu.parallel import shard_opt_state_zero1
+    from bigdl_tpu.utils.serialization import load_tree, save_tree
+
+    w = np.arange(32, dtype=np.float32).reshape(8, 4)
+    sharded = shard_opt_state_zero1({"momentum": {"w": w}}, mesh, "data")
+    d = tempfile.mkdtemp()
+    save_tree(d + "/ck", sharded)
+    back = load_tree(d + "/ck")
+    np.testing.assert_array_equal(np.asarray(back["momentum"]["w"]), w)
+
     print(json.dumps({"ok": True, "pid": pid,
                       "last_loss": opt.driver_state["Loss"],
                       "score": opt.driver_state.get("score"),
