@@ -92,6 +92,50 @@ class HFlip(Transformer):
             yield Sample(img, s.labels[0] if s.labels else None)
 
 
+# photometric augmentation primitives, shared by the Transformer forms
+# below and the threaded ImageNet augmenter (imagenet._Augmenter)
+
+_LUMA = np.array([0.299, 0.587, 0.114], np.float32).reshape(3, 1, 1)
+
+LIGHTING_EIGVAL = np.array([0.2175, 0.0188, 0.0045], np.float32)
+LIGHTING_EIGVEC = np.array([[-0.5675, 0.7192, 0.4009],
+                            [-0.5808, -0.0045, -0.8140],
+                            [-0.5836, -0.6948, 0.4203]], np.float32)
+
+
+def color_jitter_chw(img: np.ndarray, rng, brightness: float = 0.4,
+                     contrast: float = 0.4, saturation: float = 0.4
+                     ) -> np.ndarray:
+    """Brightness/contrast/saturation in random order, each blending
+    toward black / mean gray / per-pixel luma (ColorJitter.scala:52-83,
+    including its 0.299/0.587/0.114 grayscale weights)."""
+    mags = (brightness, contrast, saturation)
+    for kind in rng.permutation(3):
+        mag = mags[kind]
+        if mag <= 0:
+            continue
+        alpha = 1.0 + rng.uniform(-mag, mag)
+        if kind == 0:    # brightness: blend with black
+            img = img * alpha
+        elif kind == 1:  # contrast: blend with mean gray
+            gray = (img * _LUMA).sum(0).mean()
+            img = img * alpha + gray * (1 - alpha)
+        else:            # saturation: blend with per-pixel gray
+            gs = (img * _LUMA).sum(0, keepdims=True)
+            img = img * alpha + gs * (1 - alpha)
+    return img
+
+
+def lighting_chw(img: np.ndarray, rng, alpha_std: float = 0.1,
+                 scale: float = 1.0) -> np.ndarray:
+    """AlexNet PCA lighting noise (Lighting.scala:40-60). The eigen
+    statistics are stated on 0-1 pixels; pass ``scale=255`` for 0-255
+    pipelines."""
+    alpha = rng.normal(0, alpha_std, 3).astype(np.float32)
+    shift = (LIGHTING_EIGVEC * alpha * LIGHTING_EIGVAL).sum(1) * scale
+    return img + shift.reshape(3, 1, 1)
+
+
 class ColorJitter(Transformer):
     """Random brightness/contrast/saturation in the reference's order-
     shuffled style (dataset/image/ColorJitter.scala)."""
@@ -103,28 +147,11 @@ class ColorJitter(Transformer):
         self.saturation = saturation
         self.rng = np.random.RandomState(seed)
 
-    def _adjust(self, img, kind, alpha):
-        if kind == "brightness":
-            return img * alpha
-        if kind == "contrast":
-            mean = img.mean()
-            return img * alpha + mean * (1 - alpha)
-        # saturation: blend with per-pixel gray
-        gray = img.mean(axis=0, keepdims=True)
-        return img * alpha + gray * (1 - alpha)
-
     def apply(self, it):
-        kinds = [("brightness", self.brightness),
-                 ("contrast", self.contrast),
-                 ("saturation", self.saturation)]
         for s in it:
-            img = np.asarray(s.features[0], np.float32)
-            order = self.rng.permutation(len(kinds))
-            for i in order:
-                kind, mag = kinds[i]
-                if mag > 0:
-                    alpha = 1.0 + self.rng.uniform(-mag, mag)
-                    img = self._adjust(img, kind, alpha)
+            img = color_jitter_chw(
+                np.asarray(s.features[0], np.float32), self.rng,
+                self.brightness, self.contrast, self.saturation)
             yield Sample(img, s.labels[0] if s.labels else None)
 
 
@@ -132,10 +159,8 @@ class Lighting(Transformer):
     """AlexNet-style PCA lighting noise (dataset/image/Lighting.scala);
     eigen vectors/values default to the ImageNet RGB statistics."""
 
-    _EIGVAL = np.array([0.2175, 0.0188, 0.0045], np.float32)
-    _EIGVEC = np.array([[-0.5675, 0.7192, 0.4009],
-                        [-0.5808, -0.0045, -0.8140],
-                        [-0.5836, -0.6948, 0.4203]], np.float32)
+    _EIGVAL = LIGHTING_EIGVAL
+    _EIGVEC = LIGHTING_EIGVEC
 
     def __init__(self, alpha_std: float = 0.1, seed: int = 0):
         self.alpha_std = alpha_std
@@ -143,10 +168,8 @@ class Lighting(Transformer):
 
     def apply(self, it):
         for s in it:
-            img = np.asarray(s.features[0], np.float32)
-            alpha = self.rng.normal(0, self.alpha_std, 3).astype(np.float32)
-            rgb_shift = (self._EIGVEC * alpha * self._EIGVAL).sum(axis=1)
-            img = img + rgb_shift.reshape(3, 1, 1)
+            img = lighting_chw(np.asarray(s.features[0], np.float32),
+                               self.rng, self.alpha_std)
             yield Sample(img, s.labels[0] if s.labels else None)
 
 

@@ -178,15 +178,8 @@ class _Augmenter:
     """Per-sample decode + augment: resize-shorter-side, crop, flip,
     optional color jitter + PCA lighting, normalize -> CHW float32
     (BGRImgCropper + HFlip + ColorJitter.scala + Lighting.scala +
-    BGRImgNormalizer)."""
-
-    # AlexNet PCA statistics (Lighting.scala:40-43), stated on 0-1 pixels;
-    # the shift is scaled to this pipeline's 0-255 space at apply time
-    _EIGVAL = np.array([0.2175, 0.0188, 0.0045], np.float32)
-    _EIGVEC = np.array([[-0.5675, 0.7192, 0.4009],
-                        [-0.5808, -0.0045, -0.8140],
-                        [-0.5836, -0.6948, 0.4203]], np.float32)
-    _LUMA = np.array([0.299, 0.587, 0.114], np.float32).reshape(3, 1, 1)
+    BGRImgNormalizer). Photometric ops come from the shared primitives
+    in dataset/image.py."""
 
     def __init__(self, crop: int, scale: int, train: bool,
                  mean: Sequence[float], std: Sequence[float],
@@ -197,23 +190,9 @@ class _Augmenter:
         self.color_jitter = color_jitter
         self.lighting = lighting
 
-    def _jitter(self, chw: np.ndarray, rng) -> np.ndarray:
-        """Brightness/contrast/saturation, random order, each blending
-        toward black / gray mean / per-pixel luma (ColorJitter.scala:52-
-        83; variance 0.4 as in its bcsParameters)."""
-        for kind in rng.permutation(3):
-            alpha = 1.0 + rng.uniform(-0.4, 0.4)
-            if kind == 0:    # brightness: blend with black
-                chw = chw * alpha
-            elif kind == 1:  # contrast: blend with mean gray
-                gray = (chw * self._LUMA).sum(0).mean()
-                chw = chw * alpha + gray * (1 - alpha)
-            else:            # saturation: blend with per-pixel gray
-                gs = (chw * self._LUMA).sum(0, keepdims=True)
-                chw = chw * alpha + gs * (1 - alpha)
-        return chw
-
     def __call__(self, raw, rng: np.random.RandomState) -> np.ndarray:
+        from bigdl_tpu.dataset.image import color_jitter_chw, lighting_chw
+
         img = decode_image(raw, scale=self.scale)
         h, w = img.shape[:2]
         c = self.crop
@@ -227,11 +206,10 @@ class _Augmenter:
             img = img[:, ::-1]
         chw = img.transpose(2, 0, 1).astype(np.float32)
         if self.train and self.color_jitter:
-            chw = self._jitter(chw, rng)
+            chw = color_jitter_chw(chw, rng)
         if self.train and self.lighting:
-            alpha = rng.normal(0, 0.1, 3).astype(np.float32)
-            shift = (self._EIGVEC * alpha * self._EIGVAL).sum(1) * 255.0
-            chw = chw + shift.reshape(3, 1, 1)
+            # this pipeline works on 0-255 pixels
+            chw = lighting_chw(chw, rng, scale=255.0)
         return (chw - self.mean) / self.std
 
 
