@@ -26,6 +26,9 @@ def main(argv=None):
     ap.add_argument("--lighting", action=argparse.BooleanOptionalAction,
                     default=False,
                     help="AlexNet PCA lighting noise (Lighting.scala)")
+    ap.add_argument("--valFolder", default=None,
+                    help="ImageNet val folder for per-epoch Top1/Top5 "
+                         "(Train.scala:100 valSet)")
     args = ap.parse_args(argv)
 
     import numpy as np
@@ -51,7 +54,9 @@ def main(argv=None):
                                 scale=256, color_jitter=args.colorJitter,
                                 lighting=args.lighting)
         n_train = ds.size()
-        val_ds = None
+        val_ds = ImageFolderDataSet(args.valFolder, batch_size=bs,
+                                    crop=224, scale=256) \
+            if args.valFolder else None
 
     model = load_model_or(
         args, lambda: Inception_v1_NoAuxClassifier(args.classNum))

@@ -29,10 +29,12 @@ def _train_imagenet(args, nn, ResNet):
     Lighting.scala), SGD momentum 0.9 nesterov, x0.1 every 30 epochs."""
     from bigdl_tpu.models._cli import (arrays_to_dataset, load_model_or,
                                        wire_optimizer)
-    from bigdl_tpu.optim import EpochDecay, LocalOptimizer, SGD
+    from bigdl_tpu.optim import (EpochDecay, LocalOptimizer, SGD,
+                                 Top1Accuracy, Top5Accuracy)
 
     bs = args.batchSize or 256
     depth = args.depth if args.depth >= 18 else 50
+    val_ds = None
     if args.synthetic:
         import numpy as np
         rng = np.random.RandomState(0)
@@ -45,6 +47,9 @@ def _train_imagenet(args, nn, ResNet):
         ds = ImageFolderDataSet(args.folder, batch_size=bs, crop=224,
                                 scale=256, color_jitter=args.colorJitter,
                                 lighting=args.lighting)
+        if args.valFolder:
+            val_ds = ImageFolderDataSet(args.valFolder, batch_size=bs,
+                                        crop=224, scale=256)
     model = load_model_or(
         args, lambda: ResNet(args.classNum, depth=depth,
                              dataset="ImageNet"))
@@ -54,7 +59,9 @@ def _train_imagenet(args, nn, ResNet):
                 learning_rate_schedule=EpochDecay(imagenet_decay))
     opt = LocalOptimizer(model, ds, nn.CrossEntropyCriterion(),
                          batch_size=bs)
-    wire_optimizer(opt, args, optim, default_epochs=90)
+    wire_optimizer(opt, args, optim, val_ds=val_ds,
+                   val_methods=[Top1Accuracy(), Top5Accuracy()],
+                   default_epochs=90)
     opt.optimize()
     print(f"final loss: {opt.driver_state['Loss']:.4f}")
     return model
@@ -81,6 +88,9 @@ def main(argv=None):
     ap.add_argument("--lighting", action=argparse.BooleanOptionalAction,
                     default=True,
                     help="ImageNet only: PCA noise (Lighting.scala)")
+    ap.add_argument("--valFolder", default=None,
+                    help="ImageNet only: val folder for per-epoch "
+                         "Top1/Top5")
     args = ap.parse_args(argv)
 
     import bigdl_tpu.nn as nn

@@ -175,3 +175,26 @@ def test_resnet_imagenet_train_cli():
     assert main(["--synthetic", "8", "-b", "4", "--dataset", "imagenet",
                  "--depth", "18", "--classNum", "10",
                  "--maxIterations", "2"]) is not None
+
+
+def test_resnet_imagenet_with_val_folder(tmp_path):
+    """ImageNet recipe wires a val ImageFolder for per-epoch Top1/Top5
+    (Train.scala:100 valSet); tiny real-JPEG folders end to end."""
+    import os
+    from PIL import Image
+
+    rng = np.random.RandomState(0)
+    for split, per in (("train", 3), ("val", 2)):
+        for cls in ("a", "b"):
+            d = tmp_path / split / cls
+            os.makedirs(d)
+            for i in range(per):
+                Image.fromarray(rng.randint(
+                    0, 255, (240, 260, 3), np.uint8)).save(d / f"{i}.jpg")
+
+    from bigdl_tpu.models.resnet.train import main
+    m = main(["-f", str(tmp_path / "train"), "--dataset", "imagenet",
+              "--depth", "18", "--classNum", "2", "-b", "2",
+              "--valFolder", str(tmp_path / "val"),
+              "--maxIterations", "3"])
+    assert m is not None
