@@ -1,0 +1,43 @@
+"""bench.py is the driver's scoreboard — a broken bench is a silent
+zero. Smoke-run it at tiny shapes on CPU and check the one-line JSON
+contract ({"metric", "value", "unit", "vs_baseline"})."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_bench(mode, extra=None):
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "BENCH_BATCH": "2",
+                "BENCH_SCAN": "1", "BENCH_ITERS": "1",
+                "BENCH_WARMUP": "1", "BENCH_MODE": mode,
+                "BENCH_FED_POOL": "8", "BENCH_CHUNK_MB": "1",
+                "PYTHONPATH": _ROOT + os.pathsep
+                + env.get("PYTHONPATH", "")})
+    env.update(extra or {})
+    r = subprocess.run([sys.executable, os.path.join(_ROOT, "bench.py")],
+                       capture_output=True, text=True, timeout=540,
+                       env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    line = [l for l in r.stdout.strip().splitlines()
+            if l.startswith("{")][-1]
+    return json.loads(line)
+
+
+@pytest.mark.slow
+def test_bench_synthetic_contract():
+    out = _run_bench("synthetic")
+    assert {"metric", "value", "unit", "vs_baseline"} <= set(out)
+    assert out["value"] > 0 and out["unit"] == "images/sec"
+
+
+@pytest.mark.slow
+def test_bench_rotate_contract():
+    out = _run_bench("rotate", {"BENCH_ROTATE_SHARDS": "4"})
+    assert out["value"] > 0
+    assert out["pool_images"] == 8 and out["hbm_budget_images"] == 4
