@@ -108,14 +108,21 @@ def main(argv=None):
         def run():
             return eval_step(params, mstate, x)
 
+    def sync(out):
+        # fetch a VALUE, not just block_until_ready: on tunneled
+        # backends readiness can signal before execution completes
+        # (BASELINE.md feed note) — dispatch-only timings read 100x fast
+        leaf = jax.tree_util.tree_leaves(out)[0]
+        return float(jnp.sum(jnp.asarray(leaf).astype(jnp.float32)))
+
     print(f"# {args.model} {args.mode} batch={args.batch_size} "
           f"dtype={args.dtype} backend={jax.default_backend()}")
     for i in range(args.warmup):
-        jax.block_until_ready(run())
+        sync(run())
     times = []
     for i in range(args.iterations):
         t0 = time.perf_counter()
-        jax.block_until_ready(run())
+        sync(run())
         dt = time.perf_counter() - t0
         times.append(dt)
         unit = "tok/s" if is_lm else "img/s"
