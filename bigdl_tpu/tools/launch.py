@@ -1,0 +1,98 @@
+"""Process launcher — the spark-submit/torchrun role for multi-process
+training (reference: Engine.scala:93-137 derived topology from the
+Spark conf that spark-submit provided; here a small launcher provides
+the same contract through JAX's standard env vars).
+
+Single host, N processes (testing / CPU pods):
+
+    python -m bigdl_tpu.tools.launch --nproc 2 train.py --epochs 5
+
+Multi-host (run once per host):
+
+    python -m bigdl_tpu.tools.launch --nproc 1 \
+        --coordinator host0:12345 --nnodes 4 --node-rank 2 train.py
+
+Each worker gets JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+JAX_PROCESS_ID, so ``Engine.init_distributed()`` (no arguments) brings
+the mesh up. The launcher streams worker output with a ``[rank]``
+prefix and exits non-zero if any worker fails.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+import threading
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _stream(prefix: str, pipe, out):
+    for line in iter(pipe.readline, ""):
+        out.write(f"[{prefix}] {line}")
+        out.flush()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Launch multi-process training workers")
+    ap.add_argument("--nproc", type=int, default=1,
+                    help="processes to spawn on THIS host")
+    ap.add_argument("--nnodes", type=int, default=1,
+                    help="total hosts participating")
+    ap.add_argument("--node-rank", type=int, default=0,
+                    help="this host's rank in [0, nnodes)")
+    ap.add_argument("--coordinator", default=None,
+                    help="host:port of process 0 (default: a free local "
+                         "port — single-host mode)")
+    ap.add_argument("--cpu-devices", type=int, default=0,
+                    help="force N virtual CPU devices per process "
+                         "(testing without accelerators)")
+    ap.add_argument("script")
+    ap.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+
+    coord = args.coordinator or f"127.0.0.1:{_free_port()}"
+    total = args.nproc * args.nnodes
+    procs = []
+    threads = []
+    for local in range(args.nproc):
+        rank = args.node_rank * args.nproc + local
+        env = dict(os.environ)
+        env["JAX_COORDINATOR_ADDRESS"] = coord
+        env["JAX_NUM_PROCESSES"] = str(total)
+        env["JAX_PROCESS_ID"] = str(rank)
+        if args.cpu_devices:
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = (
+                env.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count="
+                  f"{args.cpu_devices}").strip()
+        p = subprocess.Popen(
+            [sys.executable, args.script] + args.script_args,
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        procs.append(p)
+        t = threading.Thread(target=_stream, args=(str(rank), p.stdout,
+                                                   sys.stdout),
+                             daemon=True)
+        t.start()
+        threads.append(t)
+
+    rcs = [p.wait() for p in procs]
+    for t in threads:
+        t.join(timeout=5)
+    bad = [(i, rc) for i, rc in enumerate(rcs) if rc != 0]
+    if bad:
+        raise SystemExit(f"workers failed: {bad}")
+    return 0
+
+
+if __name__ == "__main__":
+    main()

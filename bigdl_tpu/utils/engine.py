@@ -68,6 +68,17 @@ class Engine:
         pod launcher only needs to set the environment.
         """
         if not cls._distributed_started:
+            # honor the documented env contract ourselves —
+            # jax.distributed.initialize only auto-detects managed
+            # clusters (Slurm etc.), not raw JAX_* variables (which is
+            # what tools/launch provides, the spark-submit role)
+            if coordinator_address is None:
+                coordinator_address = os.environ.get(
+                    "JAX_COORDINATOR_ADDRESS")
+            if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+                num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+            if process_id is None and "JAX_PROCESS_ID" in os.environ:
+                process_id = int(os.environ["JAX_PROCESS_ID"])
             # jax.distributed.initialize is once-per-process and cannot
             # be undone by Engine.reset()
             kw = {}

@@ -129,3 +129,34 @@ def test_two_process_distri_optimizer_matches_single_process():
         assert r["score"] is not None and 0.0 <= r["score"] <= 1.0
     # the cross-process reduce makes every host report the GLOBAL score
     assert results[0]["score"] == results[1]["score"]
+
+
+def test_launcher_spawns_rendezvoused_workers(tmp_path):
+    """tools/launch (the spark-submit role): two workers get the env
+    contract, rendezvous through Engine.init_distributed() with NO
+    arguments, and both report the global topology."""
+    worker = tmp_path / "worker.py"
+    worker.write_text(
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "try:\n"
+        "    jax.extend.backend.clear_backends()\n"
+        "except Exception:\n"
+        "    pass\n"
+        "from bigdl_tpu.utils.engine import Engine\n"
+        "Engine.init_distributed(initialization_timeout=60)\n"
+        "assert jax.process_count() == 2, jax.process_count()\n"
+        "assert len(jax.devices()) == 4\n"
+        "print('WORKER_OK', jax.process_index())\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))) + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, "-m", "bigdl_tpu.tools.launch", "--nproc", "2",
+         "--cpu-devices", "2", str(worker)],
+        capture_output=True, text=True, timeout=240, env=env)
+    if r.returncode != 0 and "UNAVAILABLE" in r.stdout:
+        pytest.skip("no cross-process rendezvous on this runtime")
+    assert r.returncode == 0, r.stdout[-2000:]
+    assert "[0] WORKER_OK 0" in r.stdout
+    assert "[1] WORKER_OK 1" in r.stdout
