@@ -233,7 +233,8 @@ class HostInputGraph:
             q = self.eval_ref(ins[0], cache)
             el = q.dequeue()
             return el if len(el) > 1 else el[0]
-        if op in ("ParseExample", "ParseExampleV2"):
+        if op in ("ParseExample", "ParseExampleV2",
+                  "ParseSingleExample"):
             return self._parse_example(node, cache)
         if op in ("DecodeJpeg", "DecodePng", "DecodeImage", "DecodeBmp"):
             from bigdl_tpu.dataset.imagenet import decode_image
@@ -293,7 +294,19 @@ class HostInputGraph:
         from bigdl_tpu.utils.tfrecord import parse_example
 
         ins = self._inputs(node)
-        if node.op == "ParseExampleV2":
+        if node.op == "ParseSingleExample":
+            # TF1 frozen-graph layout: keys live in ATTRS, the only
+            # tensor inputs are the scalar serialized proto + defaults
+            # (modern TF lowers parse_single_example to ParseExampleV2,
+            # which the branch below handles via its scalar-input path)
+            serialized = self.eval_ref(ins[0], cache)
+            sparse_keys = [self._to_str(k) for k in
+                           (node.attrs.get("sparse_keys") or [])]
+            dense_keys = [self._to_str(k) for k in
+                          (node.attrs.get("dense_keys") or [])]
+            defaults = [np.asarray(self.eval_ref(r, cache))
+                        for r in ins[1:1 + len(dense_keys)]]
+        elif node.op == "ParseExampleV2":
             serialized = self.eval_ref(ins[0], cache)
             sparse_keys = [self._to_str(k) for k in
                            np.asarray(self.eval_ref(ins[2], cache)).ravel()]

@@ -201,3 +201,42 @@ def test_decode_raw_in_pipeline(tmp_path):
     sess.train(optim_method=SGD(learning_rate=0.05), max_iterations=30)
     w_l = np.asarray(sess.module.get_parameters()["w"]).ravel()
     np.testing.assert_allclose(w_l, [2.0, 0.0, -1.0, 1.0], atol=0.3)
+
+
+def test_parse_single_example_v1_layout():
+    """TF1 frozen-graph ParseSingleExample: keys in attrs, scalar
+    serialized input, unbatched dense outputs."""
+    from bigdl_tpu.utils.tfrecord import encode_example
+
+    rec = encode_example({"x": np.array([1.5, 2.5], np.float32)})
+    ser = np.empty((), object)
+    ser[()] = rec
+    nodes = [
+        TFNode("ser", "Placeholder", [], {}),
+        TFNode("default/x", "Const",
+               [], {"value": np.zeros(0, np.float32)}),
+        TFNode("parse", "ParseSingleExample", ["ser", "default/x"],
+               {"sparse_keys": [], "dense_keys": ["x"],
+                "Tdense": [np.float32], "dense_shapes": [[2]],
+                "num_sparse": 0}),
+    ]
+    host = HostInputGraph(nodes)
+    cache = {"ser": ser}
+    out = host.eval_ref("parse", cache)
+    np.testing.assert_allclose(out, [1.5, 2.5])
+    assert out.shape == (2,)  # unbatched
+
+    # the modern lowering (ParseExampleV2 with scalar input) agrees
+    import tensorflow.compat.v1 as tfv1
+    g = tfv1.Graph()
+    with g.as_default():
+        s = tfv1.placeholder(tf.string, [], name="ser2")
+        tfv1.io.parse_single_example(
+            s, {"x": tfv1.FixedLenFeature([2], tf.float32)})
+    nodes2 = parse_graphdef(g.as_graph_def().SerializeToString())
+    host2 = HostInputGraph(nodes2)
+    cache2 = {"ser2": ser}
+    pe = [n.name for n in nodes2 if n.op == "ParseExampleV2"][0]
+    out2 = host2.eval_ref(pe, cache2)
+    np.testing.assert_allclose(out2, [1.5, 2.5])
+    assert np.asarray(out2).shape == (2,)
