@@ -1,0 +1,88 @@
+"""PTB word-level language model — the stacked-LSTM recipe
+(example/languagemodel/PTBWordLM.scala:40-120: PTBModel with dropout,
+Adagrad, TimeDistributed CrossEntropy, per-epoch validation
+perplexity).
+
+    python examples/language_model.py -f /data/ptb   # train/valid.txt
+    python examples/language_model.py --synthetic 4000
+"""
+from __future__ import annotations
+
+import argparse
+import os
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description="PTB word LM (PTBWordLM)")
+    ap.add_argument("-f", "--folder", default="./",
+                    help="directory with train.txt / valid.txt")
+    ap.add_argument("-b", "--batchSize", type=int, default=20)
+    ap.add_argument("-e", "--maxEpoch", type=int, default=2)
+    ap.add_argument("--vocabSize", type=int, default=10000)
+    ap.add_argument("--hiddenSize", type=int, default=200)
+    ap.add_argument("--numLayers", type=int, default=2)
+    ap.add_argument("--numSteps", type=int, default=20)
+    ap.add_argument("--keepProb", type=float, default=2.0,
+                    help="<1 enables dropout (PTBModel.scala keepProb)")
+    ap.add_argument("--learningRate", type=float, default=0.1)
+    ap.add_argument("--maxIterations", type=int, default=None)
+    ap.add_argument("--synthetic", type=int, default=0, metavar="N",
+                    help="train on an N-token synthetic stream")
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import (DataSet, Sample, SampleToMiniBatch,
+                                   load_ptb, ptb_arrays)
+    from bigdl_tpu.models import PTBModel
+    from bigdl_tpu.optim import (Adagrad, LocalOptimizer, Loss,
+                                 every_epoch, max_epoch, max_iteration)
+
+    if args.synthetic:
+        rng = np.random.RandomState(0)
+        vocab = min(args.vocabSize, 50)
+        # learnable synthetic stream: a noisy repeating n-gram pattern
+        base = np.tile(np.arange(1, vocab + 1), args.synthetic // vocab + 1)
+        noise = rng.randint(1, vocab + 1, len(base))
+        keep = rng.rand(len(base)) < 0.9
+        stream = np.where(keep, base, noise)[:args.synthetic] \
+            .astype(np.float32)
+        val_stream = stream[: max(args.numSteps * args.batchSize * 2,
+                                  200)]
+    else:
+        splits, d = load_ptb(
+            os.path.join(args.folder, "train.txt"),
+            vocab_size=args.vocabSize,
+            valid_path=os.path.join(args.folder, "valid.txt"))
+        stream, vocab = splits["train"], d.vocab_size()
+        val_stream = splits.get("valid", stream[:2000])
+
+    def to_ds(token_stream):
+        x, y = ptb_arrays(token_stream, args.batchSize, args.numSteps)
+        samples = [Sample(x[i], y[i]) for i in range(len(x))]
+        return DataSet.array(samples).transform(
+            SampleToMiniBatch(args.batchSize))
+
+    model = PTBModel(vocab, args.hiddenSize, vocab,
+                     num_layers=args.numLayers, keep_prob=args.keepProb)
+    crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion())
+    opt = LocalOptimizer(model, to_ds(stream), crit,
+                         batch_size=args.batchSize)
+    opt.set_optim_method(Adagrad(learning_rate=args.learningRate))
+    opt.set_validation(every_epoch(), to_ds(val_stream), [Loss(crit)])
+    if args.maxIterations:
+        opt.set_end_when(max_iteration(args.maxIterations))
+    else:
+        opt.set_end_when(max_epoch(args.maxEpoch))
+    opt.optimize()
+    loss = opt.driver_state["Loss"]
+    val = opt.driver_state.get("score")
+    print(f"train loss {loss:.4f} perplexity {np.exp(loss):.2f}")
+    if val is not None:
+        print(f"valid loss {val:.4f} perplexity {np.exp(val):.2f}")
+    return opt.driver_state
+
+
+if __name__ == "__main__":
+    main()

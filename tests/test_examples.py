@@ -92,3 +92,15 @@ def test_tensorflow_interop_example_save(tmp_path):
     with open(p, "rb") as f:
         gd.ParseFromString(f.read())
     assert any(n.name == "input" for n in gd.node)
+
+
+def test_language_model_example_beats_uniform():
+    """example/languagemodel PTBWordLM: stacked-LSTM LM with per-epoch
+    validation perplexity; on the noisy cyclic stream it must beat the
+    uniform baseline (vocab 50 -> perplexity 50) decisively."""
+    import numpy as np
+
+    from examples.language_model import main
+    state = main(["--synthetic", "3000", "-e", "15", "--hiddenSize",
+                  "64", "--numSteps", "8", "-b", "8"])
+    assert np.exp(state["score"]) < 30.0
