@@ -42,21 +42,25 @@ def main(argv=None):
     if args.synthetic:
         rng = np.random.RandomState(0)
         vocab = min(args.vocabSize, 50)
-        # learnable synthetic stream: a noisy repeating n-gram pattern
-        base = np.tile(np.arange(1, vocab + 1), args.synthetic // vocab + 1)
-        noise = rng.randint(1, vocab + 1, len(base))
-        keep = rng.rand(len(base)) < 0.9
-        stream = np.where(keep, base, noise)[:args.synthetic] \
-            .astype(np.float32)
-        val_stream = stream[: max(args.numSteps * args.batchSize * 2,
-                                  200)]
+        # learnable synthetic stream: a noisy repeating pattern; the val
+        # split is a FRESH continuation (same pattern, different noise
+        # realization) so validation measures generalization, not
+        # memorization
+        n = args.synthetic + 2000
+        base = np.tile(np.arange(1, vocab + 1), n // vocab + 1)[:n]
+        noise = rng.randint(1, vocab + 1, n)
+        keep = rng.rand(n) < 0.9
+        full = np.where(keep, base, noise).astype(np.float32)
+        stream, val_stream = full[:args.synthetic], full[args.synthetic:]
     else:
         splits, d = load_ptb(
             os.path.join(args.folder, "train.txt"),
             vocab_size=args.vocabSize,
             valid_path=os.path.join(args.folder, "valid.txt"))
         stream, vocab = splits["train"], d.vocab_size()
-        val_stream = splits.get("valid", stream[:2000])
+        val_stream = splits.get("valid")
+        if val_stream is None:
+            print("warning: no valid.txt found — skipping validation")
 
     def to_ds(token_stream):
         x, y = ptb_arrays(token_stream, args.batchSize, args.numSteps)
@@ -66,11 +70,17 @@ def main(argv=None):
 
     model = PTBModel(vocab, args.hiddenSize, vocab,
                      num_layers=args.numLayers, keep_prob=args.keepProb)
-    crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion())
+    # size_average=True -> the loss is per-TOKEN cross entropy, so
+    # exp(loss) below is true perplexity (the reference trains on the
+    # step-summed form, PTBWordLM.scala:91; the gradient direction is
+    # identical, only the scale folds into the learning rate)
+    crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion(),
+                                       size_average=True)
     opt = LocalOptimizer(model, to_ds(stream), crit,
                          batch_size=args.batchSize)
     opt.set_optim_method(Adagrad(learning_rate=args.learningRate))
-    opt.set_validation(every_epoch(), to_ds(val_stream), [Loss(crit)])
+    if val_stream is not None:
+        opt.set_validation(every_epoch(), to_ds(val_stream), [Loss(crit)])
     if args.maxIterations:
         opt.set_end_when(max_iteration(args.maxIterations))
     else:

@@ -96,11 +96,12 @@ def test_tensorflow_interop_example_save(tmp_path):
 
 def test_language_model_example_beats_uniform():
     """example/languagemodel PTBWordLM: stacked-LSTM LM with per-epoch
-    validation perplexity; on the noisy cyclic stream it must beat the
-    uniform baseline (vocab 50 -> perplexity 50) decisively."""
+    HELD-OUT validation (a fresh continuation of the stream). Per-token
+    perplexity must land far below uniform (50) and near the noise
+    floor (~2.0; measured 3.5)."""
     import numpy as np
 
     from examples.language_model import main
     state = main(["--synthetic", "3000", "-e", "15", "--hiddenSize",
                   "64", "--numSteps", "8", "-b", "8"])
-    assert np.exp(state["score"]) < 30.0
+    assert np.exp(state["score"]) < 10.0
