@@ -69,13 +69,45 @@ def _optimizer_mode(pid: int):
                       "neval": opt.driver_state["neval"]}))
 
 
+def _imagefolder_mode(pid: int, folder: str):
+    """Multi-host input parity: each process reads ITS shard of one
+    image folder (process_index/process_count — the role Spark
+    partitioning played for SeqFileFolder) and feeds the global
+    DistriOptimizer batch from it."""
+    import jax
+    import numpy as np
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import ImageFolderDataSet
+    from bigdl_tpu.optim import DistriOptimizer, SGD, max_iteration
+    from bigdl_tpu.utils.random import RandomGenerator
+
+    mesh = jax.sharding.Mesh(np.array(jax.devices()), ("data",))
+    ds = ImageFolderDataSet(folder, batch_size=4, crop=12, scale=16,
+                            num_threads=1, process_index=pid,
+                            process_count=2)
+    assert ds.size() == 16 and ds.local_size() == 8
+
+    RandomGenerator.set_seed(42)
+    model = (nn.Sequential().add(nn.Reshape((3 * 12 * 12,)))
+             .add(nn.Linear(3 * 12 * 12, 2)).add(nn.LogSoftMax()))
+    opt = DistriOptimizer(model, ds, nn.ClassNLLCriterion(),
+                          batch_size=4, mesh=mesh)
+    opt.set_optim_method(SGD(learning_rate=0.1))
+    opt.set_end_when(max_iteration(3))
+    opt.optimize()
+    ds.close()
+    print(json.dumps({"ok": True, "pid": pid,
+                      "last_loss": opt.driver_state["Loss"]}))
+
+
 def main():
     port, pid = sys.argv[1], int(sys.argv[2])
     mode = sys.argv[3] if len(sys.argv) > 3 else "smoke"
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = (
         "--xla_force_host_platform_device_count="
-        + ("4" if mode == "optimizer" else "1"))
+        + ("4" if mode in ("optimizer", "imagefolder") else "1"))
 
     import numpy as np
 
@@ -101,12 +133,15 @@ def main():
                                 initialization_timeout=60)
         assert jax.process_count() == 2, jax.process_count()
         assert Engine.node_number() == 2
-        if mode == "optimizer":
+        if mode in ("optimizer", "imagefolder"):
             # bring-up succeeded: failures past this point are REAL
             # regressions and must crash the worker (SystemExit bypasses
             # the skip-catch below), not print a skip
             try:
-                _optimizer_mode(pid)
+                if mode == "optimizer":
+                    _optimizer_mode(pid)
+                else:
+                    _imagefolder_mode(pid, sys.argv[4])
                 return
             except Exception:
                 import traceback
