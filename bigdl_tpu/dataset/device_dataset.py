@@ -57,8 +57,22 @@ class DeviceCachedArrayDataSet:
         self.batch_size = batch_size
         self._mean = jnp.asarray(mean, jnp.float32).reshape(1, -1, 1, 1)
         self._std = jnp.asarray(std, jnp.float32).reshape(1, -1, 1, 1)
-        put = (lambda a: jax.device_put(a, sharding)) if sharding \
-            else jax.device_put
+        # multi-host: a sharding spanning other processes means the
+        # caller passes process-LOCAL rows; the cache's n is GLOBAL and
+        # global arrays assemble from each process's contribution
+        pc = jax.process_count() if sharding is not None else 1
+        if pc > 1:
+            self.n = n = n * pc
+
+        def put(a):
+            if sharding is None:
+                return jax.device_put(a)
+            if pc > 1:
+                a = np.asarray(a)
+                gshape = (a.shape[0] * pc,) + a.shape[1:]
+                return jax.make_array_from_process_local_data(
+                    sharding, a, gshape)
+            return jax.device_put(a, sharding)
         # pad ONCE at cache-build time; crops then need no bounds logic
         if pad:
             images = np.pad(images,
@@ -294,6 +308,8 @@ class ShardRotator:
             from bigdl_tpu.utils.transfer import probe_device_put_chunk
             chunk_bytes = probe_device_put_chunk()
         self.chunk_bytes = int(chunk_bytes)
+        # spanning mesh: providers return process-LOCAL shard rows
+        self._pc = (jax.process_count() if sharding is not None else 1)
         self._staging = None   # (imgs_host, lbls_host, pieces, row_offset)
         self._begin_stage()
 
@@ -317,10 +333,12 @@ class ShardRotator:
 
     def _begin_stage(self):
         imgs, lbls = self.provider(self._next_shard_index())
-        if len(imgs) != self.shard_size:
+        local_expected = self.shard_size // self._pc
+        if len(imgs) != local_expected:
             raise ValueError(
-                f"shard size mismatch: {len(imgs)} vs {self.shard_size} "
-                "(all shards must be equal; pad or drop the remainder)")
+                f"shard size mismatch: {len(imgs)} vs {local_expected} "
+                "local rows (all shards must be equal; pad or drop the "
+                "remainder)")
         if imgs.dtype != np.uint8:
             imgs = ((imgs * 255) if imgs.max() <= 1.0 else imgs) \
                 .astype(np.uint8)
@@ -334,7 +352,8 @@ class ShardRotator:
         # documented two-slot HBM budget holds even for tightly sized
         # shards)
         if self.sharding is not None:
-            dest = jax.jit(lambda: jnp.zeros(imgs.shape, jnp.uint8),
+            gshape = (imgs.shape[0] * self._pc,) + imgs.shape[1:]
+            dest = jax.jit(lambda: jnp.zeros(gshape, jnp.uint8),
                            out_shardings=self.sharding)()
         else:
             dest = jnp.zeros(imgs.shape, jnp.uint8)
@@ -355,16 +374,30 @@ class ShardRotator:
         imgs, lbls, dest, off = self._staging
         rows = max(1, self.chunk_bytes // imgs[0].nbytes)
         if self.sharding is not None:
-            # sharded slots: pieces must split evenly over the mesh axis
-            ndev = self.sharding.mesh.devices.size
-            rows = max(ndev, rows - rows % ndev)
-            if (len(imgs) - off) % ndev:
+            # sharded slots: pieces must split evenly over the devices
+            # THIS process contributes to
+            ld = self.sharding.mesh.devices.size // self._pc
+            rows = max(ld, rows - rows % ld)
+            if (len(imgs) - off) % ld:
                 raise ValueError(
                     "shard size must be a multiple of the mesh size")
             rows = min(rows, len(imgs) - off)
-        piece = jax.device_put(imgs[off:off + rows], self.sharding)
-        self._staging[2] = _write_rows(dest, piece, jnp.int32(off))
-        self._staging[3] = off + len(imgs[off:off + rows])
+        local = imgs[off:off + rows]
+        if self._pc > 1:
+            # every process stages its local rows of this global piece;
+            # the global row block [off*pc, (off+rows)*pc) maps
+            # process-major onto local rows — a stable bijection, and
+            # sample ORDER within the pool is irrelevant (the in-shard
+            # Feistel permutation draws uniformly)
+            gshape = (rows * self._pc,) + local.shape[1:]
+            piece = jax.make_array_from_process_local_data(
+                self.sharding, np.ascontiguousarray(local), gshape)
+            goff = off * self._pc
+        else:
+            piece = jax.device_put(local, self.sharding)
+            goff = off
+        self._staging[2] = _write_rows(dest, piece, jnp.int32(goff))
+        self._staging[3] = off + len(local)
         return self.staged
 
     def rotate(self):
@@ -375,7 +408,11 @@ class ShardRotator:
             raise RuntimeError(
                 "rotate() before staging finished — pump() until staged")
         _, lbls, dest, _ = self._staging
-        new_lbls = jax.device_put(lbls, self.sharding)
+        if self._pc > 1:
+            new_lbls = jax.make_array_from_process_local_data(
+                self.sharding, lbls, (len(lbls) * self._pc,))
+        else:
+            new_lbls = jax.device_put(lbls, self.sharding)
         self.template = self.template._from_device(dest, new_lbls)
         # fixed cyclic order after the initial shuffle: the staged-ahead
         # shard is always the one the bookkeeping expects, so one cycle

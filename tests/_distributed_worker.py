@@ -101,13 +101,62 @@ def _imagefolder_mode(pid: int, folder: str):
                       "last_loss": opt.driver_state["Loss"]}))
 
 
+def _rotate_mode(pid: int):
+    """ShardRotator with slots sharded over a mesh SPANNING both
+    processes: each process's provider returns its local shard rows,
+    staging assembles global pieces, and a rotation is an argument
+    rebind on the one compiled draw."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from bigdl_tpu.dataset.device_dataset import ShardRotator
+
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    sh = NamedSharding(mesh, P("data"))
+    local_m = 8  # global shard = 16
+
+    def provider(i):
+        r = np.random.RandomState(1000 + 10 * i + pid)
+        return (r.randint(0, 255, (local_m, 3, 8, 8), np.uint8),
+                np.full(local_m, float(i + 1), np.float32))
+
+    rot = ShardRotator(provider, 3, 8, crop=(6, 6),
+                       shuffle_shards=False, sharding=sh,
+                       chunk_bytes=2 * 3 * 8 * 8)
+    assert rot.shard_size == 16, rot.shard_size
+    tmpl = rot.template
+
+    @jax.jit
+    def label_mean(labels):
+        return jnp.mean(labels)
+
+    @jax.jit
+    def draw(images, labels, key):
+        return tmpl.batch_fn_on(images, labels, key,
+                                epoch=jnp.int32(0), pos=jnp.int32(0))
+
+    means = []
+    for step in range(3):
+        _, y = draw(rot.images, rot.labels, jax.random.PRNGKey(step))
+        means.append(float(label_mean(rot.labels)))
+        while not rot.staged:
+            rot.pump()
+        rot.rotate()
+    assert draw._cache_size() == 1, "slot swap must not retrace"
+    # shard k has labels k+1 on every row of every process
+    assert means == [1.0, 2.0, 3.0], means
+    print(json.dumps({"ok": True, "pid": pid, "means": means}))
+
+
 def main():
     port, pid = sys.argv[1], int(sys.argv[2])
     mode = sys.argv[3] if len(sys.argv) > 3 else "smoke"
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = (
         "--xla_force_host_platform_device_count="
-        + ("4" if mode in ("optimizer", "imagefolder") else "1"))
+        + ("4" if mode != "smoke" else "1"))
 
     import numpy as np
 
@@ -133,13 +182,15 @@ def main():
                                 initialization_timeout=60)
         assert jax.process_count() == 2, jax.process_count()
         assert Engine.node_number() == 2
-        if mode in ("optimizer", "imagefolder"):
+        if mode in ("optimizer", "imagefolder", "rotate"):
             # bring-up succeeded: failures past this point are REAL
             # regressions and must crash the worker (SystemExit bypasses
             # the skip-catch below), not print a skip
             try:
                 if mode == "optimizer":
                     _optimizer_mode(pid)
+                elif mode == "rotate":
+                    _rotate_mode(pid)
                 else:
                     _imagefolder_mode(pid, sys.argv[4])
                 return
