@@ -652,9 +652,12 @@ class Optimizer:
 
         logger.info("training done in %.1fs; %s", time.time() - wall_start,
                     self.metrics.summary())
-        # write trained params back to the stateful module
-        model.set_parameters(jax.tree.map(np.asarray, params))
-        model.set_state(jax.tree.map(np.asarray, model_state))
+        # write trained params back to the stateful module (multi-host
+        # safe: ZeRO-1 can leave updated params data-sharded, and a
+        # spanning shard is not plain-readable — host_value reshards)
+        from bigdl_tpu.utils.serialization import host_value
+        model.set_parameters(jax.tree.map(host_value, params))
+        model.set_state(jax.tree.map(host_value, model_state))
         return model
 
 

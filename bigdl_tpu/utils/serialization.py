@@ -71,6 +71,9 @@ def _host_leaf(a) -> np.ndarray:
         return np.asarray(jax.jit(lambda x: x, out_shardings=repl)(a))
 
 
+host_value = _host_leaf  # public alias: leaf -> host numpy, multi-host safe
+
+
 def _flatten_leaves(tree, prefix=""):
     from bigdl_tpu.utils.table import Table
     out = {}

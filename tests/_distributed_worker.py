@@ -38,9 +38,13 @@ def _optimizer_mode(pid: int):
     RandomGenerator.set_seed(42)
     model = (nn.Sequential().add(nn.Linear(10, 16)).add(nn.Tanh())
              .add(nn.Linear(16, 3)).add(nn.LogSoftMax()))
-    opt = DistriOptimizer(model, ds, nn.ClassNLLCriterion(),
-                          batch_size=8, mesh=mesh)
-    opt.set_optim_method(SGD(learning_rate=0.2))
+    from bigdl_tpu.optim.optimizer import Optimizer
+    # ZeRO-1 across REAL processes: moment buffers shard dim 0 over the
+    # spanning data axis; the update must stay identical to replicated
+    # state (the single-process reference the parent compares against)
+    opt = Optimizer(model, ds, nn.ClassNLLCriterion(),
+                    batch_size=8, mesh=mesh, zero1=True)
+    opt.set_optim_method(SGD(learning_rate=0.2, momentum=0.9))
     # validation exercises the multi-host local-shard scoring path
     val = DataSet.array(samples[:16]).transform(SampleToMiniBatch(8))
     opt.set_validation(every_epoch(), val, [Top1Accuracy()])
