@@ -254,6 +254,16 @@ def _write_rows_jit(dest, piece, off):
     return jax.lax.dynamic_update_slice(dest, piece, start)
 
 
+@functools.lru_cache(maxsize=None)
+def _alloc_slot_fn(shape, dtype, sharding):
+    """Compiled slot allocator, cached per (shape, dtype, sharding) so
+    rotation does not retrace a fresh lambda every shard."""
+    f = lambda: jnp.zeros(shape, dtype)
+    if sharding is None:
+        return jax.jit(f)
+    return jax.jit(f, out_shardings=sharding)
+
+
 class ShardRotator:
     """Double-buffered HBM shard cache: train on the resident shard while
     the NEXT shard streams host->device in cliff-safe pieces between
@@ -310,7 +320,8 @@ class ShardRotator:
         self.chunk_bytes = int(chunk_bytes)
         # spanning mesh: providers return process-LOCAL shard rows
         self._pc = (jax.process_count() if sharding is not None else 1)
-        self._staging = None   # (imgs_host, lbls_host, pieces, row_offset)
+        self._staging = None  # [imgs_host, lbls_host, img_dest, lbl_dest,
+        #                        row_offset]
         self._begin_stage()
 
     # ------------------------------------------------------------ current
@@ -339,6 +350,10 @@ class ShardRotator:
                 f"shard size mismatch: {len(imgs)} vs {local_expected} "
                 "local rows (all shards must be equal; pad or drop the "
                 "remainder)")
+        if len(lbls) != len(imgs):
+            raise ValueError(
+                f"provider returned {len(lbls)} labels for {len(imgs)} "
+                "images — rows must pair 1:1")
         if imgs.dtype != np.uint8:
             imgs = ((imgs * 255) if imgs.max() <= 1.0 else imgs) \
                 .astype(np.uint8)
@@ -351,19 +366,17 @@ class ShardRotator:
         # one slot + one chunk — never pieces + a concatenated copy (the
         # documented two-slot HBM budget holds even for tightly sized
         # shards)
-        if self.sharding is not None:
-            gshape = (imgs.shape[0] * self._pc,) + imgs.shape[1:]
-            dest = jax.jit(lambda: jnp.zeros(gshape, jnp.uint8),
-                           out_shardings=self.sharding)()
-        else:
-            dest = jnp.zeros(imgs.shape, jnp.uint8)
-        self._staging = [imgs, np.ascontiguousarray(lbls, np.float32),
-                         dest, 0]
+        lbls = np.ascontiguousarray(lbls, np.float32)
+        gshape = (imgs.shape[0] * self._pc,) + imgs.shape[1:]
+        dest = _alloc_slot_fn(gshape, jnp.uint8, self.sharding)()
+        ldest = _alloc_slot_fn((len(lbls) * self._pc,), jnp.float32,
+                               self.sharding)()
+        self._staging = [imgs, lbls, dest, ldest, 0]
 
     @property
     def staged(self) -> bool:
         return self._staging is not None and \
-            self._staging[3] >= len(self._staging[0])
+            self._staging[4] >= len(self._staging[0])
 
     def pump(self) -> bool:
         """Transfer at most ``chunk_bytes`` of the staged shard. Call
@@ -371,7 +384,7 @@ class ShardRotator:
         tunneled links — alternate, don't overlap). Returns ``staged``."""
         if self.staged:
             return True
-        imgs, lbls, dest, off = self._staging
+        imgs, lbls, dest, ldest, off = self._staging
         rows = max(1, self.chunk_bytes // imgs[0].nbytes)
         if self.sharding is not None:
             # sharded slots: pieces must split evenly over the devices
@@ -383,21 +396,30 @@ class ShardRotator:
                     "shard size must be a multiple of the mesh size")
             rows = min(rows, len(imgs) - off)
         local = imgs[off:off + rows]
+        llocal = lbls[off:off + rows]
         if self._pc > 1:
             # every process stages its local rows of this global piece;
             # the global row block [off*pc, (off+rows)*pc) maps
             # process-major onto local rows — a stable bijection, and
             # sample ORDER within the pool is irrelevant (the in-shard
-            # Feistel permutation draws uniformly)
+            # Feistel permutation draws uniformly). Labels ride the SAME
+            # piecewise mapping so image row i and label row i are always
+            # the same sample — a whole-shard label transfer would lay
+            # rows out process-contiguously and silently mispair.
             gshape = (rows * self._pc,) + local.shape[1:]
             piece = jax.make_array_from_process_local_data(
                 self.sharding, np.ascontiguousarray(local), gshape)
+            lpiece = jax.make_array_from_process_local_data(
+                self.sharding, np.ascontiguousarray(llocal),
+                (rows * self._pc,))
             goff = off * self._pc
         else:
             piece = jax.device_put(local, self.sharding)
+            lpiece = jax.device_put(llocal, self.sharding)
             goff = off
         self._staging[2] = _write_rows(dest, piece, jnp.int32(goff))
-        self._staging[3] = off + len(local)
+        self._staging[3] = _write_rows(ldest, lpiece, jnp.int32(goff))
+        self._staging[4] = off + len(local)
         return self.staged
 
     def rotate(self):
@@ -407,13 +429,8 @@ class ShardRotator:
         if not self.staged:
             raise RuntimeError(
                 "rotate() before staging finished — pump() until staged")
-        _, lbls, dest, _ = self._staging
-        if self._pc > 1:
-            new_lbls = jax.make_array_from_process_local_data(
-                self.sharding, lbls, (len(lbls) * self._pc,))
-        else:
-            new_lbls = jax.device_put(lbls, self.sharding)
-        self.template = self.template._from_device(dest, new_lbls)
+        _, _, dest, ldest, _ = self._staging
+        self.template = self.template._from_device(dest, ldest)
         # fixed cyclic order after the initial shuffle: the staged-ahead
         # shard is always the one the bookkeeping expects, so one cycle
         # == one exact pass over every shard (in-shard ordering still

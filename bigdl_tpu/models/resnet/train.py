@@ -33,7 +33,9 @@ def _train_imagenet(args, nn, ResNet):
                                  Top1Accuracy, Top5Accuracy)
 
     bs = args.batchSize or 256
-    depth = args.depth if args.depth >= 18 else 50
+    # dataset-dependent default; an explicitly invalid depth still fails
+    # fast inside ResNet()
+    depth = args.depth if args.depth is not None else 50
     val_ds = None
     if args.synthetic:
         import numpy as np
@@ -75,7 +77,8 @@ def main(argv=None):
         wire_optimizer)
 
     ap = base_parser("Train ResNet on CIFAR-10 / ImageNet")
-    ap.add_argument("--depth", type=int, default=20)
+    ap.add_argument("--depth", type=int, default=None,
+                    help="default: 20 (cifar10) / 50 (imagenet)")
     ap.add_argument("--weightDecay", type=float, default=1e-4)
     ap.add_argument("--nesterov", action=argparse.BooleanOptionalAction,
                     default=True)
@@ -105,7 +108,8 @@ def main(argv=None):
     tr = cifar10_arrays(args.folder, True, args.synthetic)
     va = cifar10_arrays(args.folder, False, args.synthetic or 0)
     model = load_model_or(
-        args, lambda: ResNet(10, depth=args.depth, dataset="CIFAR10"))
+        args, lambda: ResNet(10, depth=args.depth or 20,
+                             dataset="CIFAR10"))
     optim = SGD(learning_rate=args.learningRate or 0.1,
                 learning_rate_decay=0.0, weight_decay=args.weightDecay,
                 momentum=0.9, dampening=0.0, nesterov=args.nesterov,

@@ -169,12 +169,16 @@ def build_train_step(module: Module, criterion: Criterion,
     return jax.jit(step, donate_argnums=(0, 1, 2))
 
 
-def build_eval_step(module: Module):
+def build_eval_step(module: Module, out_sharding=None):
+    """``out_sharding`` pins the output layout (batch-sharded over the
+    data axis on a mesh): GSPMD is otherwise free to replicate the
+    output, and multi-host scoring slices each process's LOCAL rows —
+    those must be the rows that process fed."""
     def eval_step(params, model_state, inputs):
         out, _ = module.apply(params, model_state, inputs, training=False)
         return out
 
-    return jax.jit(eval_step)
+    return jax.jit(eval_step, out_shardings=out_sharding)
 
 
 class Optimizer:
@@ -217,7 +221,10 @@ class Optimizer:
         self.train_summary = None
         self.validation_summary = None
         # failure retry (DistriOptimizer.scala:789-855)
-        self._mp_batch_rows = None  # multi-host fixed-batch guard
+        # multi-host fixed-batch guard, tracked PER STREAM: validation may
+        # legitimately use a different batch size than training
+        self._mp_batch_rows: Dict[str, int] = {}
+        self._stream = "train"
         self.retry_times = int(os.environ.get("BIGDL_FAILURE_RETRY_TIMES", 5))
         self.retry_interval_s = float(
             os.environ.get("BIGDL_FAILURE_RETRY_INTERVAL", 1.0))
@@ -293,14 +300,15 @@ class Optimizer:
                 # desynchronize iteration counts and deadlock the
                 # collective), so fail fast instead.
                 a = np.asarray(arr)
-                if self._mp_batch_rows is None:
-                    self._mp_batch_rows = a.shape[0]
-                elif a.shape[0] != self._mp_batch_rows:
+                expect = self._mp_batch_rows.get(self._stream)
+                if expect is None:
+                    self._mp_batch_rows[self._stream] = a.shape[0]
+                elif a.shape[0] != expect:
                     raise ValueError(
-                        f"multi-host batch changed size "
-                        f"{self._mp_batch_rows} -> {a.shape[0]}: local "
-                        "datasets must yield equal fixed-size batches "
-                        "(drop the remainder or pad)")
+                        f"multi-host {self._stream} batch changed size "
+                        f"{expect} -> {a.shape[0]}: local datasets must "
+                        "yield equal fixed-size batches per stream (drop "
+                        "the remainder or pad the final batch)")
                 gshape = (a.shape[0] * jax.process_count(),) + a.shape[1:]
                 return jax.make_array_from_process_local_data(sh, a,
                                                               gshape)
@@ -387,6 +395,13 @@ class Optimizer:
 
     # -- validation (DistriOptimizer.scala:607-686) ------------------------
     def _validate(self, params, model_state, eval_step):
+        self._stream = "validate"
+        try:
+            return self._validate_impl(params, model_state, eval_step)
+        finally:
+            self._stream = "train"
+
+    def _validate_impl(self, params, model_state, eval_step):
         from bigdl_tpu.dataset.transformer import SampleToMiniBatch
         ds = self.validation_dataset
         it = ds.data(train=False)
@@ -476,7 +491,11 @@ class Optimizer:
         model_state = self._put_replicated(model_state)
 
         step = build_train_step(model, self.criterion, self.optim_method)
-        eval_step = build_eval_step(model)
+        ev_sh = None
+        if self.mesh is not None:
+            ev_sh = jax.sharding.NamedSharding(
+                self.mesh, jax.sharding.PartitionSpec(self.data_axis))
+        eval_step = build_eval_step(model, ev_sh)
 
         ds_size = self.dataset.size()
         state = self.driver_state

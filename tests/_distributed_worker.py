@@ -45,9 +45,12 @@ def _optimizer_mode(pid: int):
     opt = Optimizer(model, ds, nn.ClassNLLCriterion(),
                     batch_size=8, mesh=mesh, zero1=True)
     opt.set_optim_method(SGD(learning_rate=0.2, momentum=0.9))
-    # validation exercises the multi-host local-shard scoring path
-    val = DataSet.array(samples[:16]).transform(SampleToMiniBatch(8))
-    opt.set_validation(every_epoch(), val, [Top1Accuracy()])
+    # validation exercises the multi-host local-shard scoring path; a
+    # DIFFERENT batch size than training proves the fixed-batch guard is
+    # tracked per stream, not shared (it used to abort here)
+    val = DataSet.array(samples[:16]).transform(SampleToMiniBatch(4))
+    opt.set_validation(every_epoch(), val, [Top1Accuracy()],
+                       batch_size=4)
     opt.set_end_when(max_iteration(4))  # exactly one local epoch:
     # stopping before the rollover keeps the data order deterministic
     # for the parent's single-process comparison
@@ -122,9 +125,15 @@ def _rotate_mode(pid: int):
     local_m = 8  # global shard = 16
 
     def provider(i):
-        r = np.random.RandomState(1000 + 10 * i + pid)
-        return (r.randint(0, 255, (local_m, 3, 8, 8), np.uint8),
-                np.full(local_m, float(i + 1), np.float32))
+        # every sample carries a unique id in ALL pixels of channel 0
+        # AND as its label, so any image/label row mispairing (e.g.
+        # piecewise image staging vs whole-shard label layout) is
+        # caught sample-exactly, not just on a per-shard aggregate
+        ids = 100.0 * i + 10.0 * pid + np.arange(local_m)
+        imgs = np.random.RandomState(1000 + 10 * i + pid) \
+            .randint(0, 255, (local_m, 3, 8, 8), np.uint8)
+        imgs[:, 0, :, :] = ids[:, None, None].astype(np.uint8)
+        return imgs, ids.astype(np.float32)
 
     rot = ShardRotator(provider, 3, 8, crop=(6, 6),
                        shuffle_shards=False, sharding=sh,
@@ -138,19 +147,22 @@ def _rotate_mode(pid: int):
 
     @jax.jit
     def draw(images, labels, key):
-        return tmpl.batch_fn_on(images, labels, key,
+        x, y = tmpl.batch_fn_on(images, labels, key,
                                 epoch=jnp.int32(0), pos=jnp.int32(0))
+        # channel-0 pixel == sample id == label, crop/flip-invariant
+        return jnp.max(jnp.abs(x[:, 0, 0, 0] - y)), y
 
     means = []
     for step in range(3):
-        _, y = draw(rot.images, rot.labels, jax.random.PRNGKey(step))
+        err, _ = draw(rot.images, rot.labels, jax.random.PRNGKey(step))
+        assert float(err) == 0.0, f"image/label mispairing, err={err}"
         means.append(float(label_mean(rot.labels)))
         while not rot.staged:
             rot.pump()
         rot.rotate()
     assert draw._cache_size() == 1, "slot swap must not retrace"
-    # shard k has labels k+1 on every row of every process
-    assert means == [1.0, 2.0, 3.0], means
+    # shard k labels: {100k + 10p + r} -> global mean 100k + 8.5
+    assert means == [8.5, 108.5, 208.5], means
     print(json.dumps({"ok": True, "pid": pid, "means": means}))
 
 

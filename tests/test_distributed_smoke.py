@@ -240,4 +240,4 @@ def test_two_process_shard_rotation_on_spanning_mesh():
     if any("skip" in r for r in results):
         pytest.skip(f"no cross-process CPU collectives: {results}")
     for r in results:
-        assert r["ok"] and r["means"] == [1.0, 2.0, 3.0]
+        assert r["ok"] and r["means"] == [8.5, 108.5, 208.5]
