@@ -10,3 +10,4 @@ from bigdl_tpu.models.rnn import SimpleRNN, PTBModel
 from bigdl_tpu.models.autoencoder import Autoencoder
 from bigdl_tpu.models.transformer import (TransformerBlock, TransformerLM,
                                           FeedForward)
+from bigdl_tpu.models.transformer.pipelined import PipelinedTransformerLM

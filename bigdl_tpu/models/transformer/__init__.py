@@ -57,13 +57,14 @@ class TransformerBlock(Module):
 
     def __init__(self, hidden_size: int, num_heads: int, ffn_size: int,
                  dropout: float = 0.0, causal: bool = True,
-                 ring_axis: Optional[str] = None,
-                 moe_experts: int = 0, moe_top_k: int = 2):
+                 ring_axis: Optional[str] = None, sp_impl: str = "ring",
+                 mesh=None, moe_experts: int = 0, moe_top_k: int = 2):
         super().__init__()
         self.ln1 = LayerNorm(hidden_size)
         self.attn = MultiHeadAttention(hidden_size, num_heads,
                                        dropout=dropout, causal=causal,
-                                       ring_axis=ring_axis)
+                                       ring_axis=ring_axis,
+                                       sp_impl=sp_impl, mesh=mesh)
         self.ln2 = LayerNorm(hidden_size)
         if moe_experts > 0:
             self.mlp = MoE(hidden_size, ffn_size, moe_experts, moe_top_k)
@@ -100,6 +101,7 @@ class TransformerLM(Module):
                  num_layers: int = 6, num_heads: int = 8,
                  ffn_size: Optional[int] = None, max_len: int = 2048,
                  dropout: float = 0.0, ring_axis: Optional[str] = None,
+                 sp_impl: str = "ring", mesh=None,
                  moe_experts: int = 0, moe_every: int = 2,
                  tie_embeddings: bool = True):
         super().__init__()
@@ -116,7 +118,8 @@ class TransformerLM(Module):
         self.blocks = [
             TransformerBlock(
                 hidden_size, num_heads, self.ffn_size, dropout=dropout,
-                causal=True, ring_axis=ring_axis,
+                causal=True, ring_axis=ring_axis, sp_impl=sp_impl,
+                mesh=mesh,
                 moe_experts=(moe_experts if moe_experts
                              and (i % moe_every == moe_every - 1) else 0))
             for i in range(num_layers)]

@@ -1,0 +1,91 @@
+"""TransformerLM evaluation — true per-token perplexity over a held-out
+corpus (the transformer counterpart of models/rnn/Test.scala:55-90's
+evaluate branch; same dictionary-reload contract as the RNN test main).
+
+    python -m bigdl_tpu.models.transformer.test -f dir --model snap
+    python -m bigdl_tpu.models.transformer.test --synthetic 5000
+"""
+from __future__ import annotations
+
+import os
+
+
+def main(argv=None):
+    from bigdl_tpu.models._cli import base_parser
+
+    ap = base_parser("Evaluate the Transformer language model")
+    ap.add_argument("--vocabSize", type=int, default=4000)
+    ap.add_argument("--hiddenSize", type=int, default=128)
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--seqLen", type=int, default=128)
+    ap.add_argument("--dictionary", default=None,
+                    help="dictionary.json saved by the train main")
+    args = ap.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bigdl_tpu.dataset import (Dictionary, load_ptb, ptb_arrays,
+                                   read_words)
+    from bigdl_tpu.models import TransformerLM
+
+    if args.synthetic:
+        rng = np.random.RandomState(1)
+        stream = rng.randint(1, args.vocabSize + 1,
+                             args.synthetic).astype(np.float32)
+        vocab = args.vocabSize
+    else:
+        test_txt = args.folder if os.path.isfile(args.folder) else \
+            os.path.join(args.folder, "test.txt")
+        dict_path = args.dictionary or os.path.join(
+            os.path.dirname(test_txt), "dictionary.json")
+        if os.path.exists(dict_path):
+            d = Dictionary.load(dict_path)
+            stream = np.asarray(
+                [d.get_index(w) for w in read_words(test_txt)], np.float32)
+            vocab = d.vocab_size()
+        else:
+            splits, d = load_ptb(test_txt, vocab_size=args.vocabSize)
+            stream, vocab = splits["train"], d.vocab_size()
+
+    if args.model:
+        from bigdl_tpu.utils.serialization import load_module
+        model = load_module(args.model)
+    else:
+        model = TransformerLM(vocab, hidden_size=args.hiddenSize,
+                              num_layers=args.layers,
+                              num_heads=args.heads, max_len=args.seqLen)
+    model.evaluate()
+    model.ensure_initialized()
+
+    bs = args.batchSize or 8
+    x, y = ptb_arrays(stream, bs, args.seqLen)
+    x, y = (x - 1).astype(np.int32), (y - 1).astype(np.int32)
+    params, state = model.get_parameters(), model.get_state()
+
+    @jax.jit
+    def nll_sum(toks, tgts):
+        logits, _ = model.apply(params, state, toks)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(
+            logp, tgts[..., None].astype(jnp.int32), axis=-1,
+            mode="clip")[..., 0]
+        return jnp.sum(nll)
+
+    total, count = 0.0, 0
+    for i in range(0, len(x), bs):
+        xb, yb = x[i:i + bs], y[i:i + bs]
+        if len(xb) < bs:
+            break  # static shapes: drop the ragged tail
+        total += float(nll_sum(xb, yb))
+        count += xb.size
+    ppl = np.exp(total / max(count, 1))
+    print(f"tokens: {count} avg nll: {total / max(count, 1):.4f} "
+          f"perplexity: {ppl:.2f}")
+    return ppl
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,164 @@
+"""TransformerLM flagship training recipe — the one-call surface for
+every parallelism the framework has (the role DistriOptimizer.scala:728
+played for the reference: parallel training behind Optimizer.optimize()).
+
+Parallelism is CONFIG, not code:
+
+    # single chip
+    python -m bigdl_tpu.models.transformer.train --synthetic 20000 -e 1
+    # 2-way pipeline x 2-way tensor x data parallel on the rest
+    python -m bigdl_tpu.models.transformer.train --synthetic 20000 \
+        --pp 2 --tp 2
+    # ring-attention sequence parallelism for long context
+    python -m bigdl_tpu.models.transformer.train --synthetic 20000 \
+        --sp ring --spSize 4 --seqLen 2048
+    # Ulysses all-to-all SP instead of ring
+    python -m bigdl_tpu.models.transformer.train ... --sp ulysses
+
+Corpus input mirrors the RNN recipe (models/rnn/Train.scala:60-133):
+``-f dir`` reads ``train.txt`` through the PTB tokenizer/Dictionary.
+"""
+from __future__ import annotations
+
+import os
+
+
+def build_mesh_for(pp: int, tp: int, sp_size: int):
+    """Carve the available devices into (data[, pipe][, model][, seq]).
+
+    Data parallelism absorbs whatever is left: dp = n // (pp*tp*sp).
+    Returns (mesh, axes_present) — mesh is None on a single device with
+    no parallelism requested.
+    """
+    import jax
+
+    from bigdl_tpu.parallel import make_mesh
+
+    n = len(jax.devices())
+    need = pp * tp * sp_size
+    if n % need:
+        raise ValueError(
+            f"device count {n} not divisible by pp*tp*spSize={need}")
+    dp = n // need
+    sizes, names = [dp], ["data"]
+    if pp > 1:
+        sizes.append(pp)
+        names.append("pipe")
+    if tp > 1:
+        sizes.append(tp)
+        names.append("model")
+    if sp_size > 1:
+        sizes.append(sp_size)
+        names.append("seq")
+    if sizes == [1]:
+        return None, names
+    return make_mesh(sizes, names, jax.devices()[:n]), names
+
+
+def _corpus(args):
+    """(x, y) int32 0-based token windows [N, seqLen] + vocab size."""
+    import numpy as np
+
+    from bigdl_tpu.dataset import load_ptb, ptb_arrays
+
+    if args.synthetic:
+        rng = np.random.RandomState(0)
+        stream = rng.randint(1, args.vocabSize + 1,
+                             args.synthetic).astype(np.float32)
+        vocab = args.vocabSize
+    else:
+        train_txt = args.folder if os.path.isfile(args.folder) else \
+            os.path.join(args.folder, "train.txt")
+        splits, d = load_ptb(train_txt, vocab_size=args.vocabSize)
+        stream, vocab = splits["train"], d.vocab_size()
+        if args.checkpoint:
+            os.makedirs(args.checkpoint, exist_ok=True)
+            d.save(os.path.join(args.checkpoint, "dictionary.json"))
+    bs = args.batchSize or 8
+    x, y = ptb_arrays(stream, bs, args.seqLen)
+    # ptb_arrays is 1-based (the torch convention); LM criterion wants
+    # 0-based vocabulary ids
+    return (x - 1).astype(np.int32), (y - 1).astype(np.int32), vocab
+
+
+def main(argv=None):
+    from bigdl_tpu.models._cli import (arrays_to_dataset, base_parser,
+                                       load_model_or, wire_optimizer)
+
+    ap = base_parser("Train the Transformer language model")
+    ap.add_argument("--vocabSize", type=int, default=4000)
+    ap.add_argument("--hiddenSize", type=int, default=128)
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--seqLen", type=int, default=128)
+    ap.add_argument("--dropout", type=float, default=0.0)
+    ap.add_argument("--moeExperts", type=int, default=0)
+    ap.add_argument("--pp", type=int, default=1,
+                    help="pipeline stages (PipelinedTransformerLM)")
+    ap.add_argument("--microbatches", type=int, default=0,
+                    help="pipeline microbatches (default: 2*pp)")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="megatron tensor-parallel degree")
+    ap.add_argument("--sp", choices=("none", "ring", "ulysses"),
+                    default="none", help="sequence parallelism kernel")
+    ap.add_argument("--spSize", type=int, default=1,
+                    help="sequence-parallel degree (mesh 'seq' axis)")
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.models import PipelinedTransformerLM, TransformerLM
+    from bigdl_tpu.optim import SGD
+    from bigdl_tpu.optim.optimizer import Optimizer
+
+    if args.sp != "none" and args.spSize < 2:
+        args.spSize = 2
+    if args.pp > 1 and (args.sp != "none" or args.moeExperts
+                        or args.dropout):
+        raise ValueError(
+            "--pp composes with --tp (and data parallelism); sequence "
+            "parallelism / MoE / dropout ride the non-pipelined "
+            "TransformerLM")
+
+    x, y, vocab = _corpus(args)
+    bs = args.batchSize or 8
+    ds = arrays_to_dataset(x, y, bs)
+
+    mesh, _ = build_mesh_for(args.pp, args.tp,
+                             args.spSize if args.sp != "none" else 1)
+    rules = None
+    if args.pp > 1:
+        mb = args.microbatches or 2 * args.pp
+        build = lambda: PipelinedTransformerLM(
+            vocab, hidden_size=args.hiddenSize, num_layers=args.layers,
+            num_heads=args.heads, max_len=args.seqLen,
+            n_microbatches=mb, mesh=mesh)
+        model = load_model_or(args, build)
+        rules = model.sharding_rules(
+            model_axis="model" if args.tp > 1 else None)
+    else:
+        build = lambda: TransformerLM(
+            vocab, hidden_size=args.hiddenSize, num_layers=args.layers,
+            num_heads=args.heads, max_len=args.seqLen,
+            dropout=args.dropout,
+            ring_axis="seq" if args.sp != "none" else None,
+            sp_impl=args.sp if args.sp != "none" else "ring",
+            mesh=mesh, moe_experts=args.moeExperts)
+        model = load_model_or(args, build)
+        if args.tp > 1:
+            rules = model.sharding_rules(model_axis="model")
+
+    optim = SGD(learning_rate=args.learningRate or 0.1,
+                learning_rate_decay=args.learningRateDecay or 0.0)
+    opt = Optimizer(model, ds, nn.SequenceCrossEntropyCriterion(),
+                    batch_size=bs, mesh=mesh, sharding_rules=rules)
+    wire_optimizer(opt, args, optim, default_epochs=1)
+    opt.optimize()
+    loss = opt.driver_state["Loss"]
+    print(f"final loss: {loss:.4f} perplexity: {np.exp(loss):.2f}")
+    return model
+
+
+if __name__ == "__main__":
+    main()

@@ -48,6 +48,8 @@ _FLASH_SCORE_BYTES = 2 << 30
 
 
 def _flash_eligible(q, mask, dropout_rate, training) -> bool:
+    if q.ndim < 4:  # the kernel needs [B,H,S,D]; lower ranks use einsum
+        return False
     b, h, seq, d = q.shape[-4], q.shape[-3], q.shape[-2], q.shape[-1]
     scores_bytes = b * h * seq * seq * q.dtype.itemsize
     return (mask is None
@@ -90,16 +92,25 @@ def dot_product_attention(q, k, v, *, causal: bool = False, mask=None,
 class MultiHeadAttention(Module):
     """Multi-head attention over [B, S, E] input.
 
-    ``ring_axis`` names a mesh axis; when the module runs inside
-    ``shard_map`` with the sequence dim sharded over that axis, attention
-    runs as ring attention (parallel/ring_attention.py) — exact, memory-
-    linear in local sequence length, comms overlapped around the ICI ring.
+    ``ring_axis`` names a mesh axis carrying the sequence dimension.
+    When the module runs inside ``shard_map`` with that axis bound,
+    attention runs directly as the chosen sequence-parallel kernel;
+    when it runs under plain ``jit`` on a mesh that HAS the axis (the
+    Optimizer product path), the kernel is auto-wrapped in
+    ``jax.shard_map(axis_names={ring_axis})`` — the sequence dim goes
+    manual over that axis while batch/model dims stay GSPMD-auto, so
+    SP composes with DP/TP with no caller-side plumbing.
+
+    ``sp_impl`` picks the kernel: "ring" (K/V blocks rotate via
+    ppermute, parallel/ring_attention.py) or "ulysses" (all-to-all
+    head re-sharding, parallel/ulysses.py).
     """
 
     def __init__(self, hidden_size: int, num_heads: int,
                  dropout: float = 0.0, causal: bool = False,
                  with_bias: bool = True,
-                 ring_axis: Optional[str] = None):
+                 ring_axis: Optional[str] = None,
+                 sp_impl: str = "ring", mesh=None):
         super().__init__()
         assert hidden_size % num_heads == 0
         if ring_axis is not None and dropout > 0.0:
@@ -107,6 +118,8 @@ class MultiHeadAttention(Module):
                 "attention dropout is not supported on the ring-attention "
                 "path (it would change the objective vs the unsharded "
                 "model); use dropout=0.0 with ring_axis")
+        if sp_impl not in ("ring", "ulysses"):
+            raise ValueError(f"sp_impl must be ring|ulysses, got {sp_impl}")
         self.hidden_size = hidden_size
         self.num_heads = num_heads
         self.head_dim = hidden_size // num_heads
@@ -114,6 +127,8 @@ class MultiHeadAttention(Module):
         self.causal = causal
         self.with_bias = with_bias
         self.ring_axis = ring_axis
+        self.sp_impl = sp_impl
+        self.mesh = mesh
 
     def init(self, rng):
         dtype = Engine.default_dtype()
@@ -145,16 +160,53 @@ class MultiHeadAttention(Module):
         k = split(self._proj(params, x, "k"))
         v = split(self._proj(params, x, "v"))
 
-        if self.ring_axis is not None and _inside_axis(self.ring_axis):
-            from bigdl_tpu.parallel.ring_attention import ring_attention
-            out = ring_attention(q, k, v, axis_name=self.ring_axis,
-                                 causal=self.causal)
-        else:
+        out = None
+        if self.ring_axis is not None:
+            kern = self._sp_kernel()
+            if _inside_axis(self.ring_axis):
+                out = kern(q, k, v, axis_name=self.ring_axis,
+                           causal=self.causal)
+            else:
+                mesh = self._sp_mesh()
+                if mesh is not None:
+                    import functools
+                    from jax.sharding import PartitionSpec as P
+                    spec = P(None, None, self.ring_axis, None)
+                    fn = functools.partial(kern, axis_name=self.ring_axis,
+                                           causal=self.causal)
+                    sm = jax.shard_map(
+                        fn, mesh=mesh, in_specs=(spec, spec, spec),
+                        out_specs=spec,
+                        axis_names=frozenset({self.ring_axis}),
+                        check_vma=False)
+                    # jit is load-bearing: partial-manual shard_map
+                    # cannot run eagerly; inlines under an outer jit
+                    out = jax.jit(sm)(q, k, v)
+        if out is None:
             out = dot_product_attention(
                 q, k, v, causal=self.causal, dropout_rate=self.dropout,
                 rng=rng, training=training)
         out = out.transpose(0, 2, 1, 3).reshape(b, s, e)
         return self._proj(params, out, "o")
+
+
+    def _sp_kernel(self):
+        if self.sp_impl == "ulysses":
+            from bigdl_tpu.parallel.ulysses import ulysses_attention
+            return ulysses_attention
+        from bigdl_tpu.parallel.ring_attention import ring_attention
+        return ring_attention
+
+    def _sp_mesh(self):
+        """The configured (or Engine) mesh, when it actually carries the
+        sequence axis (>1 devices); otherwise None → local attention."""
+        mesh = self.mesh
+        if mesh is None and Engine.is_initialized():
+            mesh = Engine.mesh()
+        if (mesh is not None and self.ring_axis in mesh.shape
+                and mesh.shape[self.ring_axis] > 1):
+            return mesh
+        return None
 
 
 def _inside_axis(axis_name: str) -> bool:

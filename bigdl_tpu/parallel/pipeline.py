@@ -73,13 +73,18 @@ def spmd_pipeline(block_fn: Callable, stage_params, x, *,
 
 def pipeline_forward(block_fn: Callable, stacked_params, x, mesh: Mesh, *,
                      axis_name: str = "pipe", n_microbatches: int):
-    """Full-array convenience wrapper.
+    """Full-array convenience wrapper — composes with DP/TP.
 
     stacked_params: pytree with leading dim [n_layers] (n_layers divisible
     by the pipe axis size); x: [batch, ...] (batch divisible by
     n_microbatches). Returns [batch, ...].
+
+    Only ``axis_name`` is mapped manually; every OTHER mesh axis stays
+    an auto (GSPMD) axis, so a (data × pipe × model) mesh runs the
+    microbatch dim data-parallel and the within-block matmuls
+    tensor-parallel with XLA-inserted collectives, while activations
+    ride the pipe ring via ppermute — DP×TP×PP in one jitted step.
     """
-    from jax.experimental.shard_map import shard_map
     n_stages = mesh.shape[axis_name]
     b = x.shape[0]
     assert b % n_microbatches == 0, (b, n_microbatches)
@@ -89,8 +94,13 @@ def pipeline_forward(block_fn: Callable, stacked_params, x, mesh: Mesh, *,
     fn = functools.partial(spmd_pipeline, block_fn, axis_name=axis_name,
                            n_stages=n_stages)
     pspec = jax.tree.map(lambda _: P(axis_name), stacked_params)
-    out = shard_map(
+    sm = jax.shard_map(
         fn, mesh=mesh,
         in_specs=(pspec, P()),
-        out_specs=P(), check_rep=False)(stacked_params, xm)
+        out_specs=P(),
+        axis_names=frozenset({axis_name}),
+        check_vma=False)
+    # jit is load-bearing: partial-manual shard_map (auto data/model
+    # axes) cannot run eagerly — under an outer jit this one inlines
+    out = jax.jit(sm)(stacked_params, xm)
     return out.reshape((b,) + out.shape[2:])

@@ -112,8 +112,16 @@ def to_spec(module) -> Dict[str, Any]:
     if isinstance(module, Graph):
         return _graph_to_spec(module)
 
-    args = list(getattr(module, "_init_args", ()))
-    kwargs = dict(getattr(module, "_init_kwargs", {}))
+    from jax.sharding import Mesh
+
+    # a device mesh is runtime PLACEMENT, not model identity — snapshots
+    # must load on any topology (reattach via the ctor's mesh= after
+    # load); a Mesh also cannot round-trip through JSON
+    args = [None if isinstance(a, Mesh) else a
+            for a in getattr(module, "_init_args", ())]
+    kwargs = {k: v for k, v in
+              dict(getattr(module, "_init_kwargs", {})).items()
+              if not isinstance(v, Mesh)}
     spec: Dict[str, Any] = {
         "class": type(module).__name__,
         "args": [_encode_value(a) for a in args],

@@ -198,3 +198,48 @@ def test_resnet_imagenet_with_val_folder(tmp_path):
               "--valFolder", str(tmp_path / "val"),
               "--maxIterations", "3"])
     assert m is not None
+
+
+def test_transformer_train_cli():
+    # data parallelism absorbs all devices by default, so the batch must
+    # divide by the device count (8 on the virtual test mesh)
+    from bigdl_tpu.models.transformer.train import main
+    model = main(["--synthetic", "600", "-b", "8", "--vocabSize", "30",
+                  "--hiddenSize", "16", "--layers", "2", "--heads", "2",
+                  "--seqLen", "8", "--maxIterations", "3"])
+    assert model is not None
+
+
+def test_transformer_train_cli_pp_tp():
+    import jax
+    if len(jax.devices()) < 8:
+        import pytest
+        pytest.skip("needs 8 virtual devices")
+    from bigdl_tpu.models.transformer.train import main
+    model = main(["--synthetic", "600", "-b", "8", "--vocabSize", "32",
+                  "--hiddenSize", "16", "--layers", "4", "--heads", "2",
+                  "--seqLen", "8", "--pp", "2", "--tp", "2",
+                  "--maxIterations", "3"])
+    assert model is not None
+
+
+def test_transformer_train_cli_sp_ring():
+    import jax
+    if len(jax.devices()) < 8:
+        import pytest
+        pytest.skip("needs 8 virtual devices")
+    from bigdl_tpu.models.transformer.train import main
+    model = main(["--synthetic", "600", "-b", "4", "--vocabSize", "32",
+                  "--hiddenSize", "16", "--layers", "2", "--heads", "4",
+                  "--seqLen", "16", "--sp", "ring", "--spSize", "4",
+                  "--maxIterations", "3"])
+    assert model is not None
+
+
+def test_transformer_test_cli_perplexity(capsys):
+    from bigdl_tpu.models.transformer.test import main
+    ppl = main(["--synthetic", "600", "-b", "4", "--vocabSize", "30",
+                "--hiddenSize", "16", "--layers", "2", "--heads", "2",
+                "--seqLen", "8"])
+    out = capsys.readouterr().out
+    assert "perplexity" in out and ppl > 0
