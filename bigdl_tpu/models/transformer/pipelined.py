@@ -69,6 +69,10 @@ class PipelinedTransformerLM(Module):
         self.pipe_axis = pipe_axis
         self.mesh = mesh
         self.tie_embeddings = tie_embeddings
+        # stable bound-method identity: pipeline_forward's cache keys on
+        # the block callable, and `self._block` creates a fresh bound
+        # method on every attribute access
+        self._block_fn = self._block
 
     # ------------------------------------------------------------ params
     def init(self, rng):
@@ -129,24 +133,16 @@ class PipelinedTransformerLM(Module):
             + lp["b_down"]
         return h + ffn
 
-    def _pipe_mesh(self) -> Optional[jax.sharding.Mesh]:
-        mesh = self.mesh
-        if mesh is None and Engine.is_initialized():
-            mesh = Engine.mesh()
-        if (mesh is not None and self.pipe_axis in mesh.shape
-                and mesh.shape[self.pipe_axis] > 1):
-            return mesh
-        return None
-
     def forward_fn(self, params, input, *, training=False, rng=None):
+        from bigdl_tpu.parallel.mesh import resolve_axis_mesh
         tokens = input.astype(jnp.int32)
         b, s = tokens.shape
         x = params["embed"][tokens] + params["pos_embed"][:s][None]
-        mesh = self._pipe_mesh()
+        mesh = resolve_axis_mesh(self.mesh, self.pipe_axis)
         if mesh is not None:
             from bigdl_tpu.parallel.pipeline import pipeline_forward
-            x = pipeline_forward(self._block, params["blocks"], x, mesh,
-                                 axis_name=self.pipe_axis,
+            x = pipeline_forward(self._block_fn, params["blocks"], x,
+                                 mesh, axis_name=self.pipe_axis,
                                  n_microbatches=self.n_microbatches)
         else:
             def body(h, lp):

@@ -135,6 +135,10 @@ def main(argv=None):
             num_heads=args.heads, max_len=args.seqLen,
             n_microbatches=mb, mesh=mesh)
         model = load_model_or(args, build)
+        # snapshots strip the mesh (runtime placement, not identity) —
+        # reattach or a resumed run would silently fall back to the
+        # dense path while the CLI still promises --pp
+        model.mesh = mesh
         rules = model.sharding_rules(
             model_axis="model" if args.tp > 1 else None)
     else:
@@ -146,6 +150,10 @@ def main(argv=None):
             sp_impl=args.sp if args.sp != "none" else "ring",
             mesh=mesh, moe_experts=args.moeExperts)
         model = load_model_or(args, build)
+        # snapshots strip runtime placement; SP lives in the attention
+        # modules — reattach so a resumed run keeps its parallelism
+        for blk in model.blocks:
+            blk.attn.mesh = mesh
         if args.tp > 1:
             rules = model.sharding_rules(model_axis="model")
 

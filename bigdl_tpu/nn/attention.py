@@ -167,21 +167,12 @@ class MultiHeadAttention(Module):
                 out = kern(q, k, v, axis_name=self.ring_axis,
                            causal=self.causal)
             else:
-                mesh = self._sp_mesh()
+                from bigdl_tpu.parallel.mesh import (resolve_axis_mesh,
+                                                     seq_sharded_attention)
+                mesh = resolve_axis_mesh(self.mesh, self.ring_axis)
                 if mesh is not None:
-                    import functools
-                    from jax.sharding import PartitionSpec as P
-                    spec = P(None, None, self.ring_axis, None)
-                    fn = functools.partial(kern, axis_name=self.ring_axis,
-                                           causal=self.causal)
-                    sm = jax.shard_map(
-                        fn, mesh=mesh, in_specs=(spec, spec, spec),
-                        out_specs=spec,
-                        axis_names=frozenset({self.ring_axis}),
-                        check_vma=False)
-                    # jit is load-bearing: partial-manual shard_map
-                    # cannot run eagerly; inlines under an outer jit
-                    out = jax.jit(sm)(q, k, v)
+                    out = seq_sharded_attention(
+                        kern, mesh, self.ring_axis, self.causal)(q, k, v)
         if out is None:
             out = dot_product_attention(
                 q, k, v, causal=self.causal, dropout_rate=self.dropout,
@@ -196,17 +187,6 @@ class MultiHeadAttention(Module):
             return ulysses_attention
         from bigdl_tpu.parallel.ring_attention import ring_attention
         return ring_attention
-
-    def _sp_mesh(self):
-        """The configured (or Engine) mesh, when it actually carries the
-        sequence axis (>1 devices); otherwise None → local attention."""
-        mesh = self.mesh
-        if mesh is None and Engine.is_initialized():
-            mesh = Engine.mesh()
-        if (mesh is not None and self.ring_axis in mesh.shape
-                and mesh.shape[self.ring_axis] > 1):
-            return mesh
-        return None
 
 
 def _inside_axis(axis_name: str) -> bool:

@@ -529,12 +529,25 @@ class Optimizer:
             # shuffle semantics); datasets without sample_indices keep
             # the rng-only contract
             epoch_exact = hasattr(ds, "sample_indices")
+            # on a mesh spanning processes the cache arrays are global
+            # arrays with non-addressable shards — jit cannot close over
+            # those; pass them as arguments (batch_fn_on) when available
+            feed_by_arg = hasattr(ds, "batch_fn_on")
 
-            def _fused(p, o, m, key, lr, ep, pos):
-                kb, kr = jax.random.split(key)
-                x, y = ds.batch_fn(kb, epoch=ep, pos=pos) if epoch_exact \
-                    else ds.batch_fn(kb)
-                return step(p, o, m, kr, lr, x, y)
+            if feed_by_arg:
+                def _fused(p, o, m, key, lr, ep, pos, images, labels):
+                    kb, kr = jax.random.split(key)
+                    x, y = ds.batch_fn_on(images, labels, kb,
+                                          epoch=ep, pos=pos) \
+                        if epoch_exact else \
+                        ds.batch_fn_on(images, labels, kb)
+                    return step(p, o, m, kr, lr, x, y)
+            else:
+                def _fused(p, o, m, key, lr, ep, pos):
+                    kb, kr = jax.random.split(key)
+                    x, y = ds.batch_fn(kb, epoch=ep, pos=pos) \
+                        if epoch_exact else ds.batch_fn(kb)
+                    return step(p, o, m, kr, lr, x, y)
 
             # donate like build_train_step does — inner-jit donation is
             # ignored when traced inside an outer jit
@@ -565,6 +578,9 @@ class Optimizer:
                 # so no device-int overflow however long the run.
                 e0, p0 = divmod((state["neval"] - 1) * bsz, ds_size)
                 step_args = (jnp.int32(e0), jnp.int32(p0))
+                if feed_by_arg:
+                    step_args += (self.dataset.images,
+                                  self.dataset.labels)
                 run_step = fused_step
             else:
                 batch = next(data_iter)

@@ -8,6 +8,8 @@ inserts and schedules the collectives from sharding annotations.
 """
 from __future__ import annotations
 
+import functools
+
 from typing import Optional, Sequence
 
 import jax
@@ -38,3 +40,35 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 def batch_sharded(mesh: Mesh, axis: str = "data") -> NamedSharding:
     return NamedSharding(mesh, P(axis))
+
+
+def resolve_axis_mesh(mesh: Optional[Mesh], axis: str) -> Optional[Mesh]:
+    """The mesh a parallelism axis actually lives on: the configured
+    mesh, else the Engine's — and only when the axis is present with >1
+    devices. None means "run the local/dense path"."""
+    if mesh is None:
+        from bigdl_tpu.utils.engine import Engine
+        if Engine.is_initialized():
+            mesh = Engine.mesh()
+    if mesh is not None and axis in mesh.shape and mesh.shape[axis] > 1:
+        return mesh
+    return None
+
+
+@functools.lru_cache(maxsize=None)
+def seq_sharded_attention(kern, mesh: Mesh, seq_axis: str, causal: bool):
+    """Jitted partial-manual shard_map wrapper for a sequence-parallel
+    attention kernel (``ring_attention`` / ``ulysses_attention``):
+    [B,H,S,D] with S manual over ``seq_axis``, every other mesh axis
+    left auto so batch/model dims compose with DP/TP under GSPMD.
+
+    Cached per (kernel, mesh, axis, causal): callers may invoke it every
+    forward without rebuilding or retracing. jit is load-bearing —
+    partial-manual shard_map cannot run eagerly; under an outer jit it
+    inlines.
+    """
+    spec = P(None, None, seq_axis, None)
+    fn = functools.partial(kern, axis_name=seq_axis, causal=causal)
+    return jax.jit(jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        axis_names=frozenset({seq_axis}), check_vma=False))

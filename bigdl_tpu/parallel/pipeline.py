@@ -71,6 +71,27 @@ def spmd_pipeline(block_fn: Callable, stage_params, x, *,
     return jax.lax.psum(out, axis_name)
 
 
+@functools.lru_cache(maxsize=None)
+def _pipeline_callable(block_fn: Callable, mesh: Mesh, axis_name: str,
+                       n_stages: int):
+    """Cached jitted partial-manual pipeline over ``axis_name``.
+
+    in_specs uses pytree-PREFIX specs, so one cache entry serves any
+    stacked-params structure; cache key includes block_fn — pass a
+    STABLE callable (a stored bound method, not a fresh lambda) or every
+    call recompiles. jit is load-bearing: partial-manual shard_map
+    cannot run eagerly; under an outer jit it inlines.
+    """
+    fn = functools.partial(spmd_pipeline, block_fn, axis_name=axis_name,
+                           n_stages=n_stages)
+    return jax.jit(jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(P(axis_name), P()),
+        out_specs=P(),
+        axis_names=frozenset({axis_name}),
+        check_vma=False))
+
+
 def pipeline_forward(block_fn: Callable, stacked_params, x, mesh: Mesh, *,
                      axis_name: str = "pipe", n_microbatches: int):
     """Full-array convenience wrapper — composes with DP/TP.
@@ -90,17 +111,6 @@ def pipeline_forward(block_fn: Callable, stacked_params, x, mesh: Mesh, *,
     assert b % n_microbatches == 0, (b, n_microbatches)
     mb = b // n_microbatches
     xm = x.reshape((n_microbatches, mb) + x.shape[1:])
-
-    fn = functools.partial(spmd_pipeline, block_fn, axis_name=axis_name,
-                           n_stages=n_stages)
-    pspec = jax.tree.map(lambda _: P(axis_name), stacked_params)
-    sm = jax.shard_map(
-        fn, mesh=mesh,
-        in_specs=(pspec, P()),
-        out_specs=P(),
-        axis_names=frozenset({axis_name}),
-        check_vma=False)
-    # jit is load-bearing: partial-manual shard_map (auto data/model
-    # axes) cannot run eagerly — under an outer jit this one inlines
-    out = jax.jit(sm)(stacked_params, xm)
+    out = _pipeline_callable(block_fn, mesh, axis_name,
+                             n_stages)(stacked_params, xm)
     return out.reshape((b,) + out.shape[2:])

@@ -11,7 +11,6 @@ on full arrays and let it wrap the shard_map.
 """
 from __future__ import annotations
 
-import functools
 import math
 
 import jax
@@ -91,9 +90,9 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = False):
 def ring_attention_sharded(q, k, v, mesh: Mesh, seq_axis: str = "seq",
                            *, causal: bool = False):
     """Full-array convenience wrapper: shards S over ``seq_axis`` and runs
-    ring attention under shard_map. q,k,v: [B, H, S, D] (global)."""
-    from jax.experimental.shard_map import shard_map
-    spec = P(None, None, seq_axis, None)
-    fn = functools.partial(ring_attention, axis_name=seq_axis, causal=causal)
-    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec)(q, k, v)
+    ring attention under shard_map. q,k,v: [B, H, S, D] (global). Mesh
+    axes other than ``seq_axis`` stay GSPMD-auto (composes with DP/TP);
+    the wrapper is cached, so call it every forward."""
+    from bigdl_tpu.parallel.mesh import seq_sharded_attention
+    return seq_sharded_attention(ring_attention, mesh, seq_axis,
+                                 causal)(q, k, v)

@@ -13,7 +13,6 @@ bandwidth-cheap (each device exchanges 1/n of its activations).
 """
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp
@@ -50,10 +49,9 @@ def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = False):
 def ulysses_attention_sharded(q, k, v, mesh: Mesh, seq_axis: str = "seq",
                               *, causal: bool = False):
     """Full-array convenience wrapper: shards S over ``seq_axis`` and
-    runs Ulysses attention under shard_map. q,k,v: [B, H, S, D]."""
-    from jax.experimental.shard_map import shard_map
-    spec = P(None, None, seq_axis, None)
-    fn = functools.partial(ulysses_attention, axis_name=seq_axis,
-                           causal=causal)
-    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec)(q, k, v)
+    runs Ulysses attention under shard_map. q,k,v: [B, H, S, D]. Mesh
+    axes other than ``seq_axis`` stay GSPMD-auto (composes with DP/TP);
+    the wrapper is cached, so call it every forward."""
+    from bigdl_tpu.parallel.mesh import seq_sharded_attention
+    return seq_sharded_attention(ulysses_attention, mesh, seq_axis,
+                                 causal)(q, k, v)

@@ -16,6 +16,18 @@ Each worker gets JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
 JAX_PROCESS_ID, so ``Engine.init_distributed()`` (no arguments) brings
 the mesh up. The launcher streams worker output with a ``[rank]``
 prefix and exits non-zero if any worker fails.
+
+Fault tolerance (``--max-restarts N``): a dead worker poisons the
+whole gang — its peers hang or fail in the next collective, and a JAX
+distributed client cannot re-join a live job — so recovery is GANG
+restart (the torchrun/elastic model, and the multi-process form of the
+reference's retry-from-checkpoint loop, DistriOptimizer.scala:789-855):
+kill the survivors, pick a FRESH coordinator port (the dead
+coordinator's socket may linger), relaunch everyone, and let each
+worker's ``Optimizer`` resume from its latest checkpoint. Workers see
+``BIGDL_RESTART_ATTEMPT`` so tests can script failures on the first
+incarnation only (the reference's ExceptionTest pattern,
+test/.../utils/TestUtils.scala:103-131).
 """
 from __future__ import annotations
 
@@ -25,6 +37,7 @@ import socket
 import subprocess
 import sys
 import threading
+import time
 
 
 def _free_port() -> int:
@@ -37,6 +50,48 @@ def _stream(prefix: str, pipe, out):
     for line in iter(pipe.readline, ""):
         out.write(f"[{prefix}] {line}")
         out.flush()
+
+
+def _launch_gang(args, coord: str, attempt: int):
+    total = args.nproc * args.nnodes
+    procs, threads = [], []
+    for local in range(args.nproc):
+        rank = args.node_rank * args.nproc + local
+        env = dict(os.environ)
+        env["JAX_COORDINATOR_ADDRESS"] = coord
+        env["JAX_NUM_PROCESSES"] = str(total)
+        env["JAX_PROCESS_ID"] = str(rank)
+        env["BIGDL_RESTART_ATTEMPT"] = str(attempt)
+        if args.cpu_devices:
+            env["JAX_PLATFORMS"] = "cpu"
+            env["XLA_FLAGS"] = (
+                env.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count="
+                  f"{args.cpu_devices}").strip()
+        p = subprocess.Popen(
+            [sys.executable, args.script] + args.script_args,
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        procs.append(p)
+        t = threading.Thread(target=_stream, args=(str(rank), p.stdout,
+                                                   sys.stdout),
+                             daemon=True)
+        t.start()
+        threads.append(t)
+    return procs, threads
+
+
+def _kill_gang(procs):
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    deadline = time.time() + 10
+    for p in procs:
+        while p.poll() is None and time.time() < deadline:
+            time.sleep(0.1)
+        if p.poll() is None:
+            p.kill()
+            p.wait()
 
 
 def main(argv=None):
@@ -54,44 +109,51 @@ def main(argv=None):
     ap.add_argument("--cpu-devices", type=int, default=0,
                     help="force N virtual CPU devices per process "
                          "(testing without accelerators)")
+    ap.add_argument("--max-restarts", type=int, default=0,
+                    help="gang-restart the workers up to N times after "
+                         "a failure (workers resume from their latest "
+                         "checkpoint)")
     ap.add_argument("script")
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
 
-    coord = args.coordinator or f"127.0.0.1:{_free_port()}"
-    total = args.nproc * args.nnodes
-    procs = []
-    threads = []
-    for local in range(args.nproc):
-        rank = args.node_rank * args.nproc + local
-        env = dict(os.environ)
-        env["JAX_COORDINATOR_ADDRESS"] = coord
-        env["JAX_NUM_PROCESSES"] = str(total)
-        env["JAX_PROCESS_ID"] = str(rank)
-        if args.cpu_devices:
-            env["JAX_PLATFORMS"] = "cpu"
-            env["XLA_FLAGS"] = (
-                env.get("XLA_FLAGS", "")
-                + f" --xla_force_host_platform_device_count="
-                  f"{args.cpu_devices}").strip()
-        p = subprocess.Popen(
-            [sys.executable, args.script] + args.script_args,
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True)
-        procs.append(p)
-        t = threading.Thread(target=_stream, args=(str(rank), p.stdout,
-                                                   sys.stdout),
-                             daemon=True)
-        t.start()
-        threads.append(t)
-
-    rcs = [p.wait() for p in procs]
-    for t in threads:
-        t.join(timeout=5)
-    bad = [(i, rc) for i, rc in enumerate(rcs) if rc != 0]
-    if bad:
-        raise SystemExit(f"workers failed: {bad}")
-    return 0
+    attempt = 0
+    while True:
+        # fresh port per attempt: a relaunch must not rendezvous with a
+        # half-dead coordinator. User-pinned --coordinator (multi-host)
+        # is kept as-is — every host's launcher restarts its own gang.
+        coord = args.coordinator or f"127.0.0.1:{_free_port()}"
+        procs, threads = _launch_gang(args, coord, attempt)
+        failed = None
+        while failed is None and any(p.poll() is None for p in procs):
+            for i, p in enumerate(procs):
+                rc = p.poll()
+                if rc is not None and rc != 0:
+                    failed = (i, rc)
+                    break
+            else:
+                time.sleep(0.2)
+        if failed is None:
+            rcs = [p.wait() for p in procs]
+            bad = [(i, rc) for i, rc in enumerate(rcs) if rc != 0]
+            if not bad:
+                for t in threads:
+                    t.join(timeout=5)
+                return 0
+            failed = bad[0]
+        # one death poisons the gang's collectives: put the survivors
+        # down before relaunching
+        _kill_gang(procs)
+        for t in threads:
+            t.join(timeout=5)
+        if attempt >= args.max_restarts:
+            raise SystemExit(
+                f"worker {failed[0]} failed rc={failed[1]} and "
+                f"max-restarts={args.max_restarts} exhausted")
+        attempt += 1
+        print(f"[launcher] worker {failed[0]} died rc={failed[1]}; "
+              f"gang restart {attempt}/{args.max_restarts}",
+              flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,90 @@
+"""Worker for the kill-and-resume fault-tolerance test (spawned by
+``bigdl_tpu.tools.launch``; not itself a pytest file).
+
+Trains a small deterministic model over a 2-process spanning mesh with
+periodic checkpoints. When ``kill_at > 0``, process 1 SIGKILLs ITSELF
+right before that iteration — but only on the first incarnation
+(``BIGDL_RESTART_ATTEMPT == 0``), the scripted-failure pattern of the
+reference's ExceptionTest (test/.../utils/TestUtils.scala:103-131). The
+relaunched gang resumes from the latest checkpoint; because the feed is
+the epoch-exact device cache (a pure function of the iteration number),
+the augmentation is deterministic, and the checkpoint captures
+params + momentum + driver state, the final loss must equal an
+uninterrupted run's bit-for-bit.
+
+argv: ckpt_root kill_at
+"""
+import json
+import os
+import signal
+import sys
+
+
+def main():
+    ckpt_root, kill_at = sys.argv[1], int(sys.argv[2])
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.extend.backend.clear_backends()
+    except Exception:
+        pass
+
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from bigdl_tpu.utils.engine import Engine
+
+    Engine.init_distributed(initialization_timeout=60)
+    pid = jax.process_index()
+    attempt = int(os.environ.get("BIGDL_RESTART_ATTEMPT", "0"))
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset.device_dataset import DeviceCachedArrayDataSet
+    from bigdl_tpu.optim import SGD, max_iteration, several_iteration
+    from bigdl_tpu.optim.optimizer import Optimizer
+    from bigdl_tpu.utils.random import RandomGenerator
+
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    sh = NamedSharding(mesh, P("data"))
+    r = np.random.RandomState(100 + pid)
+    imgs = r.randint(0, 255, (16, 3, 8, 8), np.uint8)
+    lbls = (r.randint(0, 2, 16) + 1).astype(np.float32)
+    # full-size crop + no flip: augmentation is deterministic, and the
+    # epoch-exact Feistel walk makes every batch a pure function of the
+    # iteration number — resume-exact by construction
+    ds = DeviceCachedArrayDataSet(imgs, lbls, batch_size=8, flip=False,
+                                  mean=(127,) * 3, std=(64,) * 3,
+                                  sharding=sh, shuffle_seed=5)
+
+    class KillingSGD(SGD):
+        """SGD that scripts a worker death before iteration kill_at
+        (first incarnation of process 1 only)."""
+
+        def update_hyper_parameter(self):
+            self.state["_it"] = self.state.get("_it", 0) + 1
+            if (kill_at and pid == 1 and attempt == 0
+                    and self.state["_it"] == kill_at):
+                os.kill(os.getpid(), signal.SIGKILL)
+            return super().update_hyper_parameter()
+
+    RandomGenerator.set_seed(42)
+    model = (nn.Sequential().add(nn.Reshape((3 * 8 * 8,)))
+             .add(nn.Linear(3 * 8 * 8, 16)).add(nn.Tanh())
+             .add(nn.Linear(16, 2)).add(nn.LogSoftMax()))
+    opt = Optimizer(model, ds, nn.ClassNLLCriterion(), batch_size=8,
+                    mesh=mesh)
+    opt.set_optim_method(KillingSGD(learning_rate=0.2, momentum=0.9))
+    # per-process checkpoint dir: each rank restores its own latest
+    opt.set_checkpoint(os.path.join(ckpt_root, f"rank{pid}"),
+                       several_iteration(2))
+    opt.set_end_when(max_iteration(8))
+    opt.optimize()
+
+    print(json.dumps({"ok": True, "pid": pid, "attempt": attempt,
+                      "final_loss": opt.driver_state["Loss"],
+                      "neval": opt.driver_state["neval"]}))
+
+
+if __name__ == "__main__":
+    main()
