@@ -1,5 +1,6 @@
 from bigdl_tpu.dataset.sample import (
-    Sample, MiniBatch, PaddingParam, samples_to_minibatch)
+    HostBatchedCOO, Sample, SparseFeature, MiniBatch, PaddingParam,
+    samples_to_minibatch)
 from bigdl_tpu.dataset.transformer import (
     Transformer, ChainedTransformer, SampleToMiniBatch, Lambda)
 from bigdl_tpu.dataset.dataset import (

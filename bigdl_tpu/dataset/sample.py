@@ -10,12 +10,92 @@ from typing import List, Optional, Sequence, Union
 import numpy as np
 
 
+class SparseFeature:
+    """One sample's sparse feature in host COO form (the per-sample unit
+    of the reference's SparseTensor path, tensor/SparseTensor.scala;
+    batched by SampleToMiniBatch into the SparseMiniBatch analogue,
+    dataset/MiniBatch.scala:587).
+
+    ``indices``: [nnz, ndim] int32; ``values``: [nnz]; ``shape``: the
+    DENSE shape of this feature (without a batch dim).
+    """
+
+    def __init__(self, indices, values, shape):
+        self.values = np.asarray(values)
+        self.shape = tuple(int(s) for s in shape)
+        self.indices = np.asarray(indices, np.int32).reshape(
+            len(self.values), len(self.shape))
+
+    @classmethod
+    def from_dense(cls, arr) -> "SparseFeature":
+        arr = np.asarray(arr)
+        idx = np.argwhere(arr != 0)
+        return cls(idx, arr[tuple(idx.T)], arr.shape)
+
+    def to_dense(self) -> np.ndarray:
+        out = np.zeros(self.shape, self.values.dtype)
+        out[tuple(self.indices.T)] = self.values
+        return out
+
+    def __repr__(self):
+        return (f"SparseFeature(nnz={len(self.values)}, "
+                f"shape={self.shape})")
+
+
+class HostBatchedCOO:
+    """A stacked batch of :class:`SparseFeature`s with STATIC shapes —
+    nnz padded to the batch max (or a PaddingParam fixed length) with
+    zero values, which contribute nothing to any linear op. This is the
+    host-side SparseMiniBatch payload (MiniBatch.scala:587); the
+    Optimizer materializes it as a device ``BCOO`` (jit-compatible
+    pytree) with the batch dim sharded like any dense input.
+
+    ``indices``: [B, max_nnz, ndim]; ``values``: [B, max_nnz];
+    ``shape``: (B, *dense_shape). ``fixed_nnz`` records whether the nnz
+    dim came from a PaddingParam fixed length — required on multi-host
+    meshes, where every process must pad to the same static shape.
+    """
+
+    def __init__(self, indices, values, shape, fixed_nnz: bool = False):
+        self.indices = indices
+        self.values = values
+        self.shape = tuple(shape)
+        self.fixed_nnz = fixed_nnz
+
+    def __getitem__(self, sl) -> "HostBatchedCOO":
+        idx, vals = self.indices[sl], self.values[sl]
+        return HostBatchedCOO(idx, vals,
+                              (len(vals),) + self.shape[1:],
+                              self.fixed_nnz)
+
+    def to_bcoo(self, indices=None, values=None):
+        """Device BCOO view (n_batch=1). Pass pre-placed leaves to keep
+        a sharded layout (their batch dim may be the GLOBAL multi-host
+        batch — the dense shape follows the leaves)."""
+        from jax.experimental import sparse as jsparse
+        import jax.numpy as jnp
+        v = values if values is not None else jnp.asarray(self.values)
+        i = indices if indices is not None else jnp.asarray(self.indices)
+        return jsparse.BCOO((v, i), shape=(v.shape[0],) + self.shape[1:])
+
+    def to_dense(self) -> np.ndarray:
+        out = np.zeros(self.shape, self.values.dtype)
+        b = np.repeat(np.arange(self.shape[0]), self.indices.shape[1])
+        flat = self.indices.reshape(-1, self.indices.shape[-1])
+        # zero-padded entries all accumulate into index 0 with value 0
+        np.add.at(out, (b,) + tuple(flat.T), self.values.ravel())
+        return out
+
+
 class Sample:
     """A feature/label pair; features and labels may each be one array or a
-    list of arrays (multi-input models), like ArraySample in the reference."""
+    list of arrays (multi-input models), like ArraySample in the reference.
+    A feature may also be a :class:`SparseFeature` (TensorSample vs the
+    sparse ArraySample split in Sample.scala)."""
 
     def __init__(self, feature, label=None):
-        self.features = [np.asarray(f) for f in
+        self.features = [f if isinstance(f, SparseFeature)
+                         else np.asarray(f) for f in
                          (feature if isinstance(feature, (list, tuple))
                           else [feature])]
         if label is None:
@@ -80,7 +160,49 @@ class PaddingParam:
         self.fixed_length = fixed_length
 
 
+def _stack_sparse(feats: List[SparseFeature],
+                  padding: Optional[PaddingParam] = None) -> HostBatchedCOO:
+    """SparseFeatures -> one static-shape HostBatchedCOO (the batching
+    half of the reference's SparseMiniBatch.init, MiniBatch.scala:587):
+    nnz pads to the batch max (or PaddingParam.fixed_length) with
+    index-0/value-0 entries — harmless under any linear consumer."""
+    shape = feats[0].shape
+    if any(f.shape != shape for f in feats):
+        raise ValueError("sparse features in a batch must share a shape")
+    max_nnz = max((len(f.values) for f in feats), default=0)
+    fixed = padding is not None and padding.fixed_length is not None
+    if fixed:
+        if padding.fixed_length < max_nnz:
+            raise ValueError(
+                f"fixed nnz {padding.fixed_length} < batch max {max_nnz}")
+        max_nnz = padding.fixed_length
+    max_nnz = max(max_nnz, 1)  # zero-size dims break device layouts
+    b, nd = len(feats), len(shape)
+    idx = np.zeros((b, max_nnz, nd), np.int32)
+    vals = np.zeros((b, max_nnz), feats[0].values.dtype)
+    for i, f in enumerate(feats):
+        idx[i, :len(f.values)] = f.indices
+        vals[i, :len(f.values)] = f.values
+    return HostBatchedCOO(idx, vals, (b,) + shape, fixed_nnz=fixed)
+
+
+def minibatch_input_to_device(inp):
+    """MiniBatch input/target -> a jit-ready argument: HostBatchedCOO
+    becomes a device BCOO, multi-input lists become Tables of converted
+    entries, arrays pass through. The single conversion point every
+    local consumer (Evaluator, Predictor) shares; the Optimizer's
+    ``_prep_io`` is its mesh-aware sibling."""
+    if isinstance(inp, HostBatchedCOO):
+        return inp.to_bcoo()
+    if isinstance(inp, (list, tuple)):
+        from bigdl_tpu.utils.table import T
+        return T(*[minibatch_input_to_device(x) for x in inp])
+    return np.asarray(inp)
+
+
 def _stack(arrays: List[np.ndarray], padding: Optional[PaddingParam] = None):
+    if isinstance(arrays[0], SparseFeature):
+        return _stack_sparse(arrays, padding)
     shapes = {a.shape for a in arrays}
     if len(shapes) == 1 and padding is None:
         return np.stack(arrays)

@@ -45,8 +45,10 @@ class Evaluator:
         batches = full if isinstance(first[0], MiniBatch) \
             else SampleToMiniBatch(batch_size).apply(full)
         results = None
+        from bigdl_tpu.dataset.sample import minibatch_input_to_device
         for b in batches:
-            out = np.asarray(step(params, state, np.asarray(b.get_input())))
+            out = np.asarray(step(params, state,
+                                  minibatch_input_to_device(b.get_input())))
             tgt = np.asarray(b.get_target())
             batch_res = [m(out, tgt) for m in methods]
             results = batch_res if results is None \

@@ -288,6 +288,21 @@ class Optimizer:
         return self.mesh is not None and jax.process_count() > 1
 
     def _put_batch(self, arr):
+        from bigdl_tpu.dataset.sample import HostBatchedCOO
+        if isinstance(arr, HostBatchedCOO):
+            # SparseMiniBatch feed (MiniBatch.scala:587): transfer the
+            # static-shape COO leaves like any dense batch (batch-dim
+            # sharded) and rebuild the jit-compatible BCOO pytree
+            if self._multiprocess() and not arr.fixed_nnz:
+                raise ValueError(
+                    "multi-host sparse batches must pad nnz to a FIXED "
+                    "length (SampleToMiniBatch(feature_padding="
+                    "PaddingParam(fixed_length=...))): each process "
+                    "pads to its own batch max otherwise, and differing "
+                    "static shapes desynchronize the SPMD programs")
+            vals = self._put_batch(arr.values)
+            idx = self._put_batch(arr.indices)
+            return arr.to_bcoo(indices=idx, values=vals)
         if self.mesh is not None:
             sh = jax.sharding.NamedSharding(
                 self.mesh, jax.sharding.PartitionSpec(self.data_axis))

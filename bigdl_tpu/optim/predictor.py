@@ -46,8 +46,10 @@ class LocalPredictor:
         full = itertools.chain(first, it)
         batches = full if isinstance(first[0], MiniBatch) \
             else batcher.apply(full)
+        from bigdl_tpu.dataset.sample import minibatch_input_to_device
         for b in batches:
-            out = step(params, state, np.asarray(b.get_input()))
+            out = step(params, state,
+                       minibatch_input_to_device(b.get_input()))
             outs.extend(np.asarray(out))
         return outs
 

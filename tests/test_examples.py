@@ -105,3 +105,9 @@ def test_language_model_example_beats_uniform():
     state = main(["--synthetic", "3000", "-e", "15", "--hiddenSize",
                   "64", "--numSteps", "8", "-b", "8"])
     assert np.exp(state["score"]) < 10.0
+
+
+def test_wide_and_deep_example_sparse_feed():
+    from examples.wide_and_deep import main
+    acc = main(["-n", "512", "--wideDim", "100", "-e", "3", "-b", "32"])
+    assert acc > 0.8, acc

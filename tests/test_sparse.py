@@ -63,3 +63,150 @@ def test_wide_and_deep_style_model():
     logits = w_out + d_out
     assert logits.shape == (4, 4)
     assert np.isfinite(logits).all()
+
+
+def test_sparse_sample_to_minibatch_batches_coo():
+    """SampleToMiniBatch on SparseFeature samples produces the static-
+    shape SparseMiniBatch analogue (MiniBatch.scala:587): nnz padded to
+    the batch max with zero values, dense view == stacked dense."""
+    from bigdl_tpu.dataset import (DataSet, HostBatchedCOO, Sample,
+                                   SampleToMiniBatch, SparseFeature)
+
+    rng = np.random.RandomState(0)
+    dense = rng.rand(6, 12) * (rng.rand(6, 12) < 0.3)
+    samples = [Sample(SparseFeature.from_dense(dense[i]), float(i % 2 + 1))
+               for i in range(6)]
+    mbs = list(DataSet.array(samples)
+               .transform(SampleToMiniBatch(3)).data(train=False))
+    assert len(mbs) == 2
+    for j, mb in enumerate(mbs):
+        wide = mb.get_input()
+        assert isinstance(wide, HostBatchedCOO)
+        assert wide.values.shape == wide.indices.shape[:2]
+        np.testing.assert_allclose(wide.to_dense(),
+                                   dense[3 * j:3 * j + 3], atol=1e-6)
+        assert mb.size() == 3
+
+
+def test_sparse_feed_trains_through_optimizer():
+    """The last §2 gap closed: a dataset of sparse Samples feeds the
+    Optimizer end to end and SparseLinear learns (dataset path
+    Transformer.scala:309 -> MiniBatch.scala:587 -> SparseLinear)."""
+    from bigdl_tpu.dataset import (DataSet, Sample, SampleToMiniBatch,
+                                   SparseFeature)
+    from bigdl_tpu.optim import LocalOptimizer, SGD, max_iteration
+
+    rng = np.random.RandomState(3)
+    dim = 64
+    samples = []
+    for _ in range(128):
+        hot = rng.choice(dim, size=3, replace=False)
+        label = 1.0 if (hot < dim // 2).sum() >= 2 else 2.0
+        samples.append(Sample(
+            SparseFeature(hot[:, None], np.ones(3, np.float32), (dim,)),
+            label))
+    ds = DataSet.array(samples).transform(SampleToMiniBatch(32))
+    model = nn.Sequential().add(nn.SparseLinear(dim, 2)) \
+        .add(nn.LogSoftMax())
+    opt = LocalOptimizer(model, ds, nn.ClassNLLCriterion(), batch_size=32)
+    opt.set_optim_method(SGD(learning_rate=1.0))
+    opt.set_end_when(max_iteration(48))
+    opt.optimize()
+    # init loss is ln(2)=0.693; well below it proves the sparse feed
+    # carries gradient (margin-loss tail converges slowly by nature)
+    assert opt.driver_state["Loss"] < 0.3, opt.driver_state["Loss"]
+
+
+def test_sparse_feed_matches_dense_feed():
+    """Sparse COO feed computes the SAME training losses as the dense
+    feed on identical data + init (zero-padding must be a no-op)."""
+    from bigdl_tpu.dataset import (DataSet, Sample, SampleToMiniBatch,
+                                   SparseFeature)
+    from bigdl_tpu.optim import LocalOptimizer, SGD, max_iteration
+    from bigdl_tpu.utils.random import RandomGenerator
+
+    rng = np.random.RandomState(5)
+    dense = (rng.rand(64, 20) * (rng.rand(64, 20) < 0.2)) \
+        .astype(np.float32)
+    lbls = rng.randint(1, 3, 64).astype(np.float32)
+
+    losses = {}
+    for kind in ("sparse", "dense"):
+        if kind == "sparse":
+            ss = [Sample(SparseFeature.from_dense(dense[i]), lbls[i])
+                  for i in range(64)]
+        else:
+            ss = [Sample(dense[i], lbls[i]) for i in range(64)]
+        ds = DataSet.array(ss).transform(SampleToMiniBatch(16))
+        RandomGenerator.set_seed(7)
+        model = nn.Sequential().add(nn.SparseLinear(20, 2)) \
+            .add(nn.LogSoftMax())
+        opt = LocalOptimizer(model, ds, nn.ClassNLLCriterion(),
+                             batch_size=16)
+        opt.set_optim_method(SGD(learning_rate=0.5))
+        opt.set_end_when(max_iteration(6))
+        opt.optimize()
+        losses[kind] = opt.driver_state["Loss"]
+    np.testing.assert_allclose(losses["sparse"], losses["dense"],
+                               atol=1e-5)
+
+
+def test_sparse_feed_on_mesh():
+    """Sparse batches shard their leaves over the data axis like any
+    dense input (DistriOptimizer + SparseMiniBatch)."""
+    import jax
+
+    if len(jax.devices()) < 8:
+        import pytest
+        pytest.skip("needs 8 virtual devices")
+    from jax.sharding import Mesh
+
+    from bigdl_tpu.dataset import (DataSet, Sample, SampleToMiniBatch,
+                                   SparseFeature)
+    from bigdl_tpu.optim import SGD, max_iteration
+    from bigdl_tpu.optim.optimizer import Optimizer
+
+    rng = np.random.RandomState(9)
+    samples = []
+    for _ in range(64):
+        hot = rng.choice(32, size=2, replace=False)
+        samples.append(Sample(
+            SparseFeature(hot[:, None], np.ones(2, np.float32), (32,)),
+            float(hot[0] % 2 + 1)))
+    ds = DataSet.array(samples).transform(SampleToMiniBatch(16))
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    model = nn.Sequential().add(nn.SparseLinear(32, 2)) \
+        .add(nn.LogSoftMax())
+    opt = Optimizer(model, ds, nn.ClassNLLCriterion(), batch_size=16,
+                    mesh=mesh)
+    opt.set_optim_method(SGD(learning_rate=0.5))
+    opt.set_end_when(max_iteration(4))
+    opt.optimize()
+    assert np.isfinite(opt.driver_state["Loss"])
+
+
+def test_sparse_minibatch_slice_and_predictor():
+    """MiniBatch.slice works on sparse payloads, and the stock
+    Predictor/Evaluator consume sparse datasets directly."""
+    from bigdl_tpu.dataset import (DataSet, Sample, SampleToMiniBatch,
+                                   SparseFeature, samples_to_minibatch)
+    from bigdl_tpu.optim import Evaluator, Top1Accuracy
+    from bigdl_tpu.optim.predictor import LocalPredictor
+
+    rng = np.random.RandomState(11)
+    dense = (rng.rand(8, 10) * (rng.rand(8, 10) < 0.4)).astype(np.float32)
+    samples = [Sample(SparseFeature.from_dense(dense[i]),
+                      float(i % 2 + 1)) for i in range(8)]
+    mb = samples_to_minibatch(samples)
+    sub = mb.slice(3, 2)  # 1-based offset
+    np.testing.assert_allclose(sub.get_input().to_dense(), dense[2:4],
+                               atol=1e-6)
+
+    model = nn.Sequential().add(nn.SparseLinear(10, 2)) \
+        .add(nn.LogSoftMax())
+    ds = DataSet.array(samples).transform(SampleToMiniBatch(4))
+    preds = LocalPredictor(model).predict_class(ds, batch_size=4)
+    assert len(preds) == 8 and all(p in (1, 2) for p in preds)
+    res = Evaluator(model).test(ds, [Top1Accuracy()], batch_size=4)
+    acc, count = res["Top1Accuracy"].result()
+    assert count == 8 and 0.0 <= acc <= 1.0
