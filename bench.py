@@ -378,7 +378,32 @@ def main():
         "vs_baseline": round(imgs_per_sec / REFERENCE_BASELINE_IMGS_PER_SEC,
                              3),
     }
+    # second tracked metric: TransformerLM training tokens/s (the
+    # net-new flagship family; a regression here must be visible to the
+    # driver's scoreboard, not just ResNet-50). Skipped on CPU smoke
+    # runs unless forced — the compile alone would dominate CI.
+    lm_flag = os.environ.get("BENCH_LM", "")
+    if lm_flag != "0" and (platform != "cpu" or lm_flag == "1"):
+        result["transformerlm_tokens_per_sec_per_chip"] = round(
+            _bench_transformer_lm(), 1)
     print(json.dumps(result))
+
+
+def _bench_transformer_lm():
+    """TransformerLM 6L/512d/8H seq 512, batch 16: full train steps
+    (fwd+bwd+SGD) under one scanned dispatch; returns tokens/sec.
+
+    ONE implementation serves the scoreboard metric and the ceiling
+    ablation (tools/ceiling.framework_tlm) — they must measure the same
+    program, so this only parameterizes that harness."""
+    from bigdl_tpu.tools import ceiling as C
+
+    C.BATCH = int(os.environ.get("BENCH_LM_BATCH", 16))
+    C.SCAN = int(os.environ.get("BENCH_SCAN", 8))
+    C.TLM["seq"] = int(os.environ.get("BENCH_LM_SEQ", 512))
+    iters = int(os.environ.get("BENCH_ITERS", 6))
+    seqs_per_sec = C.framework_tlm(iters)
+    return seqs_per_sec * C.TLM["seq"]
 
 
 if __name__ == "__main__":

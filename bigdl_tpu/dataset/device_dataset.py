@@ -228,16 +228,24 @@ class DeviceCachedArrayDataSet:
     def eval_batch_fn(self, start: int):
         """Jittable: deterministic center-crop batch starting at ``start``
         (host passes the offset; shapes stay static)."""
+        return self.eval_batch_fn_on(self.images, self.labels, start)
+
+    def eval_batch_fn_on(self, images, labels, start):
+        """:meth:`eval_batch_fn` with the resident arrays passed
+        explicitly — required under jit on meshes spanning processes
+        (closing over a globally sharded array is illegal), and what
+        ``Optimizer.set_validation`` uses to run trigger-driven
+        validation at HBM rates with zero per-trigger host feed."""
         b = self.batch_size
         idx = (start + jnp.arange(b)) % self.n
-        imgs = jnp.take(self.images, idx, axis=0)
+        imgs = jnp.take(images, idx, axis=0)
         oy = (self.h + 2 * self.pad - self.crop_h) // 2
         ox = (self.w + 2 * self.pad - self.crop_w) // 2
         crops = jax.lax.dynamic_slice(
             imgs, (0, 0, oy, ox),
             (b, self.c, self.crop_h, self.crop_w))
         x = (crops.astype(jnp.float32) - self._mean) / self._std
-        y = jnp.take(self.labels, idx, axis=0)
+        y = jnp.take(labels, idx, axis=0)
         return x, y
 
 

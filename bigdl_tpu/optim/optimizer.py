@@ -229,6 +229,10 @@ class Optimizer:
         self.retry_interval_s = float(
             os.environ.get("BIGDL_FAILURE_RETRY_INTERVAL", 1.0))
         self.metrics = Metrics()
+        # single-slot (dataset, jitted fn) cache for device-cached
+        # validation — replacing the validation dataset must free the
+        # old split's HBM-resident arrays, not pin them forever
+        self._dc_eval: Optional[tuple] = None
         self.driver_state: Dict[str, Any] = {"epoch": 1, "neval": 1,
                                              "recordsProcessedThisEpoch": 0}
         self._drop_percentage = 0.0  # accepted, no-op on TPU
@@ -249,6 +253,7 @@ class Optimizer:
         self.validation_dataset = dataset
         self.validation_methods = list(methods)
         self._val_batch_size = batch_size or self.batch_size
+        self._dc_eval = None  # new dataset: drop the old compiled slot
         return self
 
     def set_checkpoint(self, path: str, trigger: Trigger) -> "Optimizer":
@@ -419,6 +424,8 @@ class Optimizer:
     def _validate_impl(self, params, model_state, eval_step):
         from bigdl_tpu.dataset.transformer import SampleToMiniBatch
         ds = self.validation_dataset
+        if hasattr(ds, "eval_batch_fn_on"):
+            return self._validate_device_cached(params, model_state, ds)
         it = ds.data(train=False)
         results = None
         # Accept datasets of Samples or of MiniBatches
@@ -453,6 +460,53 @@ class Optimizer:
             # reduce ValidationResults across processes (the reference
             # reduce(+)s per-executor results, DistriOptimizer.scala:607)
             results = [_allreduce_result(r) for r in results]
+        return self._score_summary(results)
+
+    def _validate_device_cached(self, params, model_state, ds):
+        """Trigger-driven validation straight off the HBM cache
+        (DeviceCachedArrayDataSet passed to set_validation): one jitted
+        sample+forward per batch, zero per-trigger host feed — the
+        device-resident form of validation riding the same cached
+        distributed dataset as training (DistriOptimizer.scala:607-686).
+        """
+        fn = self._dc_eval[1] if (self._dc_eval is not None
+                                  and self._dc_eval[0] is ds) else None
+        if fn is None:
+            ev_sh = None
+            if self.mesh is not None:
+                ev_sh = jax.sharding.NamedSharding(
+                    self.mesh, jax.sharding.PartitionSpec(self.data_axis))
+
+            def _ev(p, m, start, images, labels):
+                x, y = ds.eval_batch_fn_on(images, labels, start)
+                out, _ = self.model.apply(p, m, x, training=False)
+                return out, y
+
+            fn = jax.jit(_ev, out_shardings=(ev_sh, ev_sh))
+            self._dc_eval = (ds, fn)
+        n, b = ds.size(), ds.batch_size
+        if self._multiprocess() and n % b:
+            raise ValueError(
+                "device-cached multi-host validation needs batch_size to "
+                "divide the dataset (a wrapped final batch cannot be "
+                "trimmed consistently across processes)")
+        results = None
+        for start in range(0, n, b):
+            out, y = fn(params, model_state, jnp.int32(start),
+                        ds.images, ds.labels)
+            out_np, tgt_np = _local_rows(out), _local_rows(y)
+            valid = min(b, n - start)
+            if valid < b:  # eval_batch_fn wraps modulo n; trim the tail
+                out_np, tgt_np = out_np[:valid], tgt_np[:valid]
+            batch_res = [m(out_np, tgt_np)
+                         for m in self.validation_methods]
+            results = batch_res if results is None else \
+                [r + br for r, br in zip(results, batch_res)]
+        if self._multiprocess():
+            results = [_allreduce_result(r) for r in results]
+        return self._score_summary(results)
+
+    def _score_summary(self, results):
         summary = {}
         for m, r in zip(self.validation_methods, results):
             value, _ = r.result()

@@ -1,9 +1,9 @@
-"""On-chip ceiling ablation: framework ResNet-50 step vs a hand-rolled
-raw-JAX step of identical semantics (the evidence behind BASELINE.md's
+"""On-chip ceiling ablation: framework steps vs hand-rolled raw-JAX
+steps of identical semantics (the evidence behind BASELINE.md's
 platform-ceiling table; the reference's counterpart is
 models/utils/DistriOptimizerPerf.scala:38 leaving nothing on the table).
 
-Modes:
+ResNet-50 modes:
   fw                framework step as shipped pre-r3 (conv biases, no donation)
   fw_donate         + donated scan carry
   fw_nobias         + pre-BN conv biases dropped (models/resnet default now)
@@ -11,6 +11,15 @@ Modes:
   hand              hand-rolled full-semantics step (raw lax convs, one-pass
                     BN with running stats, CE loss, SGD momentum+wd+nesterov)
   hand_fwd          hand-rolled forward only
+
+Zoo-wide modes (same methodology — the framework must meet its own
+hand-rolled same-semantics ceiling on every flagship family):
+  fw_vgg16 / hand_vgg16   VGG-16 ImageNet (batch BENCH_BATCH, default 128)
+  fw_tlm / hand_tlm       TransformerLM 6L/512d/8H seq 512 (batch 16)
+
+Every mode also reports analytic-TF/s and MFU against the measured
+device envelope (BIGDL_DEVICE_TFS, default 30 TF/s per BASELINE.md's
+mid-size-op measurement) using XLA's own compiled cost analysis.
 
 Usage: python -m bigdl_tpu.tools.ceiling <mode> [iters]
 """
@@ -28,10 +37,27 @@ from jax import lax
 BATCH = int(os.environ.get("BENCH_BATCH", 256))
 SCAN = int(os.environ.get("BENCH_SCAN", 8))
 WARMUP = 1
+DEVICE_TFS = float(os.environ.get("BIGDL_DEVICE_TFS", 30.0))
+
+_FLOPS = {"per_chunk": None}
 
 
 def timed(run_chunk, carry, iters):
     root = jax.random.PRNGKey(0)
+    keys0 = jax.random.split(root, SCAN)
+    # ONE AOT compile serves both the cost analysis and the timed loop
+    # (lower().compile() does not populate the jit dispatch cache, so
+    # executing the compiled object avoids paying the compile twice)
+    _FLOPS["per_chunk"] = None
+    try:
+        compiled = run_chunk.lower(carry, keys0).compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        _FLOPS["per_chunk"] = float(cost["flops"])
+        run_chunk = compiled
+    except Exception:
+        pass  # backend without AOT cost analysis: plain jit path
     for i in range(WARMUP):
         keys = jax.random.split(jax.random.fold_in(root, i), SCAN)
         carry, losses = run_chunk(carry, keys)
@@ -43,6 +69,21 @@ def timed(run_chunk, carry, iters):
     float(losses.sum())
     dt = time.time() - t0
     return BATCH * SCAN * iters / dt
+
+
+def mfu_fields(rate_per_sec, per_item_flops=None):
+    """{achieved_tfs, mfu_vs_envelope} from the measured rate and the
+    compiled chunk's analytic flops (fallback: caller-supplied
+    per-item flops)."""
+    if _FLOPS["per_chunk"] is not None:
+        tfs = _FLOPS["per_chunk"] / (BATCH * SCAN) * rate_per_sec / 1e12
+    elif per_item_flops:
+        tfs = per_item_flops * rate_per_sec / 1e12
+    else:
+        return {}
+    return {"achieved_tfs": round(tfs, 2),
+            "mfu_vs_envelope": round(tfs / DEVICE_TFS, 3),
+            "envelope_tfs": DEVICE_TFS}
 
 
 def framework(mode, iters):
@@ -236,13 +277,270 @@ def hand(mode, iters):
     return timed(run_chunk, (params, mom_buf, state), iters)
 
 
+# ----------------------------------------------------------- VGG-16 pair
+
+def _sgd_momentum_tree(params, grads, mom, lr=0.01):
+    mom = jax.tree.map(lambda m, g: 0.9 * m + g.astype(jnp.float32),
+                       mom, grads)
+    params = jax.tree.map(lambda p, m: p - lr * m, params, mom)
+    return params, mom
+
+
+def framework_vgg16(iters):
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.models import Vgg_16
+    from bigdl_tpu.optim import SGD
+    from bigdl_tpu.optim.optimizer import build_train_step
+    from bigdl_tpu.utils.engine import Engine
+    from bigdl_tpu.utils.random import RandomGenerator
+
+    Engine.set_compute_dtype(jnp.bfloat16)
+    RandomGenerator.set_seed(1)
+    model = Vgg_16(1000).training()
+    model.ensure_initialized()
+    optim = SGD(learning_rate=0.01, momentum=0.9)
+    params = model.get_parameters()
+    mstate = model.get_state()
+    opt_state = optim.init_state(params)
+    step = build_train_step(model, nn.ClassNLLCriterion(), optim)
+
+    def scan_body(carry, key):
+        params, opt_state, mstate = carry
+        kx, ky, kr = jax.random.split(key, 3)
+        x = jax.random.uniform(kx, (BATCH, 3, 224, 224), jnp.float32)
+        y = jax.random.randint(ky, (BATCH,), 1, 1001).astype(jnp.float32)
+        params, opt_state, mstate, loss = step(params, opt_state, mstate,
+                                               kr, 0.01, x, y)
+        return (params, opt_state, mstate), loss
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def run_chunk(carry, keys):
+        return lax.scan(scan_body, carry, keys)
+
+    return timed(run_chunk, (params, opt_state, mstate), iters)
+
+
+VGG_CFG = [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+           512, 512, 512, "M", 512, 512, 512, "M"]
+
+
+def hand_vgg16(iters):
+    """Raw-JAX VGG-16 with the framework model's exact semantics: biased
+    3x3 convs + ReLU + maxpools, FC 25088-4096-4096-1000 with
+    Threshold(0,1e-6) and Dropout(0.5), LogSoftMax + NLL, SGD momentum,
+    bf16 compute / f32 master."""
+    key = jax.random.PRNGKey(1)
+    ks = iter(jax.random.split(key, 64))
+    params = []
+    cin = 3
+    for v in VGG_CFG:
+        if v == "M":
+            continue
+        fan = cin * 9
+        params.append({
+            "w": jax.random.normal(next(ks), (v, cin, 3, 3), jnp.float32)
+            * np.sqrt(2.0 / fan),
+            "b": jnp.zeros((v,), jnp.float32)})
+        cin = v
+    dims = [(512 * 7 * 7, 4096), (4096, 4096), (4096, 1000)]
+    for din, dout in dims:
+        params.append({
+            "w": jax.random.normal(next(ks), (din, dout), jnp.float32)
+            * np.sqrt(1.0 / din),
+            "b": jnp.zeros((dout,), jnp.float32)})
+    mom = jax.tree.map(jnp.zeros_like, params)
+
+    def fwd(p, x, key):
+        i = 0
+        for v in VGG_CFG:
+            if v == "M":
+                x = lax.reduce_window(x, -jnp.inf, lax.max, (1, 1, 2, 2),
+                                      (1, 1, 2, 2), "VALID")
+                continue
+            x = conv(x, p[i]["w"], 1, 1) \
+                + p[i]["b"].astype(x.dtype)[None, :, None, None]
+            x = jax.nn.relu(x)
+            i += 1
+        x = x.reshape(x.shape[0], -1)
+        for j, (din, dout) in enumerate(dims):
+            fc = p[i + j]
+            x = x @ fc["w"].astype(x.dtype) + fc["b"].astype(x.dtype)
+            if j < 2:
+                x = jnp.where(x > 0, x, jnp.asarray(1e-6, x.dtype))
+                keep = jax.random.bernoulli(
+                    jax.random.fold_in(key, j), 0.5, x.shape)
+                x = jnp.where(keep, x / 0.5, 0.0)
+        return jax.nn.log_softmax(x.astype(jnp.float32), axis=-1)
+
+    def loss_fn(p, x, y, key):
+        p16 = jax.tree.map(lambda a: a.astype(jnp.bfloat16), p)
+        logp = fwd(p16, x.astype(jnp.bfloat16), key)
+        return -jnp.take_along_axis(logp, y[:, None], axis=-1).mean()
+
+    def scan_body(carry, key):
+        params, mom = carry
+        kx, ky, kd = jax.random.split(key, 3)
+        x = jax.random.uniform(kx, (BATCH, 3, 224, 224), jnp.float32)
+        y = jax.random.randint(ky, (BATCH,), 0, 1000)
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y, kd)
+        params, mom = _sgd_momentum_tree(params, grads, mom)
+        return (params, mom), loss
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def run_chunk(carry, keys):
+        return lax.scan(scan_body, carry, keys)
+
+    return timed(run_chunk, (params, mom), iters)
+
+
+# ------------------------------------------------------ TransformerLM pair
+
+TLM = dict(vocab=32000, d=512, layers=6, heads=8, seq=512)
+
+
+def framework_tlm(iters):
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.models import TransformerLM
+    from bigdl_tpu.optim import SGD
+    from bigdl_tpu.optim.optimizer import build_train_step
+    from bigdl_tpu.utils.engine import Engine
+    from bigdl_tpu.utils.random import RandomGenerator
+
+    Engine.set_compute_dtype(jnp.bfloat16)
+    RandomGenerator.set_seed(1)
+    model = TransformerLM(TLM["vocab"], hidden_size=TLM["d"],
+                          num_layers=TLM["layers"], num_heads=TLM["heads"],
+                          max_len=TLM["seq"]).training()
+    model.ensure_initialized()
+    optim = SGD(learning_rate=0.1)
+    params = model.get_parameters()
+    mstate = model.get_state()
+    opt_state = optim.init_state(params)
+    step = build_train_step(model, nn.SequenceCrossEntropyCriterion(),
+                            optim)
+
+    def scan_body(carry, key):
+        params, opt_state, mstate = carry
+        kx, kr = jax.random.split(key)
+        x = jax.random.randint(kx, (BATCH, TLM["seq"]), 0, TLM["vocab"])
+        params, opt_state, mstate, loss = step(params, opt_state, mstate,
+                                               kr, 0.1, x, x)
+        return (params, opt_state, mstate), loss
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def run_chunk(carry, keys):
+        return lax.scan(scan_body, carry, keys)
+
+    return timed(run_chunk, (params, opt_state, mstate), iters)
+
+
+def hand_tlm(iters):
+    """Raw-JAX decoder LM with models/transformer's exact semantics:
+    learned pos embeddings, pre-norm blocks (uniform-init QKV/O and FFN
+    with biases, gelu), ln_f, tied head, sequence CE, plain SGD,
+    bf16 compute / f32 master."""
+    V, D, L, H, S = (TLM["vocab"], TLM["d"], TLM["layers"], TLM["heads"],
+                     TLM["seq"])
+    hd = D // H
+    key = jax.random.PRNGKey(1)
+    ks = iter(jax.random.split(key, 16 + 8 * L))
+    s = 1.0 / np.sqrt(D)
+
+    def u(shape, scale):
+        return jax.random.uniform(next(ks), shape, jnp.float32,
+                                  -scale, scale)
+
+    params = {"embed": jax.random.normal(next(ks), (V, D)) * s,
+              "pos": jax.random.normal(next(ks), (S, D)) * s,
+              "lnf": (jnp.ones((D,)), jnp.zeros((D,)))}
+    blocks = []
+    sf = 1.0 / np.sqrt(4 * D)
+    for _ in range(L):
+        blocks.append({
+            "ln1": (jnp.ones((D,)), jnp.zeros((D,))),
+            "qkvo": [(u((D, D), s), jnp.zeros((D,))) for _ in range(4)],
+            "ln2": (jnp.ones((D,)), jnp.zeros((D,))),
+            "up": (u((D, 4 * D), s), jnp.zeros((4 * D,))),
+            "down": (u((4 * D, D), sf), jnp.zeros((D,)))})
+    params["blocks"] = blocks
+
+    def ln(x, p):
+        g, b = p
+        mu = jnp.mean(x, -1, keepdims=True)
+        var = jnp.var(x, -1, keepdims=True)
+        return (x - mu) * lax.rsqrt(var + 1e-5) * g.astype(x.dtype) \
+            + b.astype(x.dtype)
+
+    def fwd(p, toks):
+        b = toks.shape[0]
+        x = p["embed"][toks] + p["pos"][None, :S]
+        for blk in p["blocks"]:
+            h = ln(x, blk["ln1"])
+            (qw, qb), (kw, kb), (vw, vb), (ow, ob) = blk["qkvo"]
+
+            def split(t):
+                return t.reshape(b, S, H, hd).transpose(0, 2, 1, 3)
+            q = split(h @ qw.astype(h.dtype) + qb.astype(h.dtype))
+            k = split(h @ kw.astype(h.dtype) + kb.astype(h.dtype))
+            v = split(h @ vw.astype(h.dtype) + vb.astype(h.dtype))
+            sc = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(hd)
+            cmask = jnp.tril(jnp.ones((S, S), bool))
+            sc = jnp.where(cmask, sc, jnp.finfo(sc.dtype).min)
+            att = jax.nn.softmax(sc, axis=-1)
+            out = jnp.einsum("bhqk,bhkd->bhqd", att, v)
+            out = out.transpose(0, 2, 1, 3).reshape(b, S, D)
+            x = x + out @ ow.astype(x.dtype) + ob.astype(x.dtype)
+            h = ln(x, blk["ln2"])
+            uw, ub = blk["up"]
+            dw, db = blk["down"]
+            h = jax.nn.gelu(h @ uw.astype(h.dtype) + ub.astype(h.dtype))
+            x = x + h @ dw.astype(h.dtype) + db.astype(h.dtype)
+        x = ln(x, p["lnf"])
+        return x @ p["embed"].T.astype(x.dtype)
+
+    def loss_fn(p, toks):
+        p16 = jax.tree.map(lambda a: a.astype(jnp.bfloat16), p)
+        logits = fwd(p16, toks).astype(jnp.float32).reshape(-1, V)
+        t = toks.reshape(-1)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.take_along_axis(logp, t[:, None], axis=1).mean()
+
+    def scan_body(carry, key):
+        params = carry
+        x = jax.random.randint(key, (BATCH, S), 0, V)
+        loss, grads = jax.value_and_grad(loss_fn)(params, x)
+        params = jax.tree.map(
+            lambda p, g: p - 0.1 * g.astype(jnp.float32), params, grads)
+        return params, loss
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def run_chunk(carry, keys):
+        return lax.scan(scan_body, carry, keys)
+
+    return timed(run_chunk, params, iters)
+
+
+MODES = {"fw_vgg16": framework_vgg16, "hand_vgg16": hand_vgg16,
+         "fw_tlm": framework_tlm, "hand_tlm": hand_tlm}
+
+
 if __name__ == "__main__":
     if len(sys.argv) < 2:
         raise SystemExit(__doc__)
     mode = sys.argv[1]
     iters = int(sys.argv[2]) if len(sys.argv) > 2 else 6
-    if mode.startswith("hand"):
+    if "tlm" in mode and "BENCH_BATCH" not in os.environ:
+        BATCH = 16
+    if "vgg" in mode and "BENCH_BATCH" not in os.environ:
+        BATCH = 128
+    if mode in MODES:
+        r = MODES[mode](iters)
+    elif mode.startswith("hand"):
         r = hand(mode, iters)
     else:
         r = framework(mode, iters)
-    print(json.dumps({"mode": mode, "imgs_per_sec": round(r, 1)}))
+    out = {"mode": mode, "items_per_sec": round(r, 1)}
+    if "tlm" in mode:
+        out["tokens_per_sec"] = round(r * TLM["seq"], 1)
+    out.update(mfu_fields(r))
+    print(json.dumps(out))

@@ -1,19 +1,29 @@
-"""On-chip convergence check: the ResNet-20 CIFAR recipe on a learnable
-synthetic dataset (BASELINE.md's convergence-evidence row; real CIFAR is
-absent offline, so this is the strongest accuracy oracle the
-environment allows — far past the 7-image fixture grade).
+"""On-chip convergence checks: zoo recipes on LEARNABLE synthetic tasks
+(BASELINE.md convergence-evidence rows; real corpora are absent offline,
+so these are the strongest accuracy oracles the environment allows —
+far past 7-image fixture grade).
 
-Ten classes, each a fixed random 3x32x32 prototype; a sample is its
-class prototype under random gain/shift/translation plus pixel noise —
-linearly inseparable in pixel space (verified: a linear probe plateaus
-~60%), so high accuracy requires the conv stack to actually learn.
+Image recipes (resnet / vgg / inception) — ten classes, each a fixed
+random prototype; a sample is its class prototype under random
+gain/shift/translation plus heavy pixel noise. Linearly inseparable in
+pixel space (a linear probe plateaus ~60%), so high held-out accuracy
+requires the conv stack to actually learn.
 
-Runs the recipe's own pieces end to end: DeviceCachedArrayDataSet
-(epoch-exact Feistel cursor, on-device augment), build_train_step (SGD
-momentum+wd+nesterov, EpochDecay x0.1@{81,122} — resnet/Train.scala),
-held-out eval via eval_batch_fn.
+LM recipes (lstm / transformer) — a corpus sampled from a fixed sparse
+first-order Markov chain (4 successors per state, Dirichlet weights).
+The chain's conditional entropy gives a COMPUTABLE perplexity floor:
+held-out per-token perplexity approaching exp(H) proves the model
+learned the transition structure, not just unigram frequencies.
 
-    python -m bigdl_tpu.tools.convergence [epochs] [n_train]
+Each recipe runs its zoo pieces end to end on device: device-resident
+data, build_train_step (the recipe's optimizer), jitted epoch scans,
+held-out eval.
+
+    python -m bigdl_tpu.tools.convergence resnet 20 20000
+    python -m bigdl_tpu.tools.convergence vgg 20 20000
+    python -m bigdl_tpu.tools.convergence inception 10 8192
+    python -m bigdl_tpu.tools.convergence lstm 20 1000000
+    python -m bigdl_tpu.tools.convergence transformer 20 1000000
 """
 import json
 import sys
@@ -22,63 +32,57 @@ import time
 import numpy as np
 
 
-def make_dataset(n: int, seed: int, classes: int = 10):
+# --------------------------------------------------------------- image task
+
+def make_dataset(n: int, seed: int, classes: int = 10, hw: int = 32):
     # prototypes are the TASK, fixed across splits; `seed` only draws
     # the split's samples
     protos = np.random.RandomState(1234).randn(
-        classes, 3, 32, 32).astype(np.float32)
+        classes, 3, hw, hw).astype(np.float32)
     rng = np.random.RandomState(seed)
     ys = rng.randint(0, classes, n)
     gains = 0.5 + rng.rand(n, 1, 1, 1).astype(np.float32)
     shifts = rng.randn(n, 3, 1, 1).astype(np.float32) * 0.3
     xs = protos[ys] * gains + shifts
-    # random translation up to +-3 px (the crop augmentation must cope)
+    # random translation up to +-hw/10 px (the crop augmentation must cope)
+    t = max(1, hw // 10)
     for i in range(n):
-        dy, dx = rng.randint(-3, 4, 2)
+        dy, dx = rng.randint(-t, t + 1, 2)
         xs[i] = np.roll(np.roll(xs[i], dy, axis=1), dx, axis=2)
-    xs += rng.randn(n, 3, 32, 32).astype(np.float32) * 0.6
+    xs += rng.randn(n, 3, hw, hw).astype(np.float32) * 0.6
     # into u8 range for the device cache
     xs = np.clip((xs * 32) + 128, 0, 255).astype(np.uint8)
     return xs, (ys + 1).astype(np.float32)
 
 
-def main(argv=None):
+def run_image(name: str, build_model, optim, lr_for_epoch, epochs: int,
+              n_train: int, batch: int, hw: int, pad: int,
+              eval_batch: int = 256):
     import jax
     import jax.numpy as jnp
     from jax import lax
 
     import bigdl_tpu.nn as nn
     from bigdl_tpu.dataset.device_dataset import DeviceCachedArrayDataSet
-    from bigdl_tpu.models import ResNet
-    from bigdl_tpu.models.resnet.train import cifar10_decay
-    from bigdl_tpu.optim import EpochDecay, SGD
     from bigdl_tpu.optim.optimizer import build_train_step
     from bigdl_tpu.utils.random import RandomGenerator
 
-    args = argv if argv is not None else sys.argv[1:]
-    epochs = int(args[0]) if args else 20
-    n_train = int(args[1]) if len(args) > 1 else 20000
-    batch = 448  # the recipe's batch (resnet/README.md:25)
-
-    xs, ys = make_dataset(n_train, seed=0)
-    xv, yv = make_dataset(2048, seed=1)
+    xs, ys = make_dataset(n_train, seed=0, hw=hw)
+    xv, yv = make_dataset(2048, seed=1, hw=hw)
 
     RandomGenerator.set_seed(1)
-    model = ResNet(10, depth=20, dataset="CIFAR10").training()
+    model = build_model().training()
     model.ensure_initialized()
-    optim = SGD(learning_rate=0.1, momentum=0.9, weight_decay=1e-4,
-                nesterov=True, dampening=0.0,
-                learning_rate_schedule=EpochDecay(cifar10_decay))
     params = model.get_parameters()
     mstate = model.get_state()
     opt_state = optim.init_state(params)
     step = build_train_step(model, nn.CrossEntropyCriterion(), optim)
 
     mean, std = (128.0,) * 3, (64.0,) * 3
-    ds = DeviceCachedArrayDataSet(xs, ys, batch, crop=(32, 32), pad=4,
+    ds = DeviceCachedArrayDataSet(xs, ys, batch, crop=(hw, hw), pad=pad,
                                   flip=False, mean=mean, std=std)
-    ev = DeviceCachedArrayDataSet(xv, yv, 256, crop=(32, 32), flip=False,
-                                  mean=mean, std=std)
+    ev = DeviceCachedArrayDataSet(xv, yv, eval_batch, crop=(hw, hw),
+                                  flip=False, mean=mean, std=std)
 
     steps_per_epoch = max(1, n_train // batch)
 
@@ -103,17 +107,16 @@ def main(argv=None):
             x, y = ev.eval_batch_fn(start)
             out, _ = model.apply(params, mstate, x, training=False)
             return (jnp.argmax(out, -1) + 1 == y).mean()
-        starts = jnp.arange(0, ev.n, 256)
+        starts = jnp.arange(0, ev.n, eval_batch)
         return jax.vmap(one)(starts).mean()
 
     root = jax.random.PRNGKey(0)
     carry = (params, opt_state, mstate, jnp.int32(0), jnp.int32(0),
-             jnp.float32(0.1))
+             jnp.float32(lr_for_epoch(1)))
     t0 = time.time()
     history = []
     for e in range(epochs):
-        lr = 0.1 * (0.1 ** cifar10_decay(e + 1))
-        carry = carry[:5] + (jnp.float32(lr),)
+        carry = carry[:5] + (jnp.float32(lr_for_epoch(e + 1)),)
         keys = jax.random.split(jax.random.fold_in(root, e),
                                 steps_per_epoch)
         carry, losses = run_epoch(carry, keys)
@@ -122,13 +125,203 @@ def main(argv=None):
         print(f"epoch {e + 1}: loss={float(losses.mean()):.4f} "
               f"val_acc={acc:.4f}", flush=True)
     dt = time.time() - t0
-    result = {"final_val_acc": history[-1], "best_val_acc": max(history),
-              "epochs": epochs, "n_train": n_train,
-              "imgs_per_sec": round(epochs * steps_per_epoch * batch / dt,
-                                    1),
+    result = {"recipe": name, "final_val_acc": history[-1],
+              "best_val_acc": max(history), "epochs": epochs,
+              "n_train": n_train,
+              "imgs_per_sec": round(
+                  epochs * steps_per_epoch * batch / dt, 1),
               "history": history}
     print(json.dumps(result))
     return result
+
+
+# ------------------------------------------------------------------ LM task
+
+def make_markov_corpus(n_tokens: int, seed: int, vocab: int = 256,
+                       branch: int = 4):
+    """Corpus from a fixed sparse Markov chain + its entropy floor.
+
+    Returns (tokens 0-based, exp(H)) where H is the chain's conditional
+    entropy under the empirical state distribution of THIS sample — the
+    perplexity a perfect model of the transitions would achieve.
+    """
+    truth = np.random.RandomState(1234)
+    succ = np.stack([truth.choice(vocab, branch, replace=False)
+                     for _ in range(vocab)])
+    probs = truth.dirichlet(np.ones(branch) * 0.7, size=vocab)
+    row_h = -np.sum(probs * np.log(probs), axis=1)
+
+    rng = np.random.RandomState(seed)
+    toks = np.empty(n_tokens, np.int64)
+    s = rng.randint(vocab)
+    # vectorized-ish generation: draw all uniforms up front
+    us = rng.rand(n_tokens)
+    cum = np.cumsum(probs, axis=1)
+    for i in range(n_tokens):
+        k = np.searchsorted(cum[s], us[i])
+        s = succ[s, min(k, branch - 1)]
+        toks[i] = s
+    visits = np.bincount(toks, minlength=vocab)
+    h = float((row_h * visits).sum() / max(1, visits.sum()))
+    return toks, float(np.exp(h))
+
+
+def run_lm(name: str, build_model, criterion, optim, lr: float,
+           epochs: int, n_tokens: int, seq: int = 32, batch: int = 256,
+           one_based: bool = False, vocab: int = 256):
+    """Shared LM convergence loop: device-resident token windows, jitted
+    epoch scans, held-out per-token perplexity vs the chain's floor."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from bigdl_tpu.optim.optimizer import build_train_step
+    from bigdl_tpu.utils.random import RandomGenerator
+
+    toks, floor = make_markov_corpus(n_tokens, seed=0, vocab=vocab)
+    vtoks, _ = make_markov_corpus(max(65536, seq * 2048), seed=1,
+                                  vocab=vocab)
+
+    def windows(stream):
+        n_win = (len(stream) - 1) // seq
+        x = stream[:n_win * seq].reshape(n_win, seq)
+        y = stream[1:n_win * seq + 1].reshape(n_win, seq)
+        off = 1 if one_based else 0
+        return (jnp.asarray(x + off, jnp.int32),
+                jnp.asarray(y + off, jnp.int32))
+
+    xw, yw = windows(toks)
+    xv, yv = windows(vtoks)
+    n_win = xw.shape[0]
+    nv = (xv.shape[0] // batch) * batch
+    xv, yv = xv[:nv], yv[:nv]
+
+    RandomGenerator.set_seed(1)
+    model = build_model().training()
+    model.ensure_initialized()
+    params = model.get_parameters()
+    mstate = model.get_state()
+    opt_state = optim.init_state(params)
+    step = build_train_step(model, criterion, optim)
+
+    steps_per_epoch = max(1, n_win // batch)
+
+    def body(carry, key):
+        params, opt_state, mstate = carry
+        kb, kr = jax.random.split(key)
+        idx = jax.random.randint(kb, (batch,), 0, n_win)
+        params, opt_state, mstate, loss = step(
+            params, opt_state, mstate, kr, lr,
+            jnp.take(xw, idx, 0), jnp.take(yw, idx, 0))
+        return (params, opt_state, mstate), loss
+
+    @jax.jit
+    def run_epoch(carry, keys):
+        return lax.scan(body, carry, keys)
+
+    @jax.jit
+    def eval_nll(params, mstate):
+        def one(i):
+            x = lax.dynamic_slice_in_dim(xv, i * batch, batch)
+            y = lax.dynamic_slice_in_dim(yv, i * batch, batch)
+            out, _ = model.apply(params, mstate, x, training=False)
+            logp = jax.nn.log_softmax(out, axis=-1)
+            tgt = (y - 1) if one_based else y
+            nll = -jnp.take_along_axis(
+                logp, tgt[..., None], axis=-1, mode="clip")[..., 0]
+            return nll.mean()
+        return jax.vmap(one)(jnp.arange(nv // batch)).mean()
+
+    root = jax.random.PRNGKey(0)
+    carry = (params, opt_state, mstate)
+    t0 = time.time()
+    history = []
+    for e in range(epochs):
+        keys = jax.random.split(jax.random.fold_in(root, e),
+                                steps_per_epoch)
+        carry, losses = run_epoch(carry, keys)
+        ppl = float(jnp.exp(eval_nll(carry[0], carry[2])))
+        history.append(round(ppl, 3))
+        print(f"epoch {e + 1}: loss={float(losses.mean()):.4f} "
+              f"val_ppl={ppl:.3f} (floor {floor:.3f})", flush=True)
+    dt = time.time() - t0
+    result = {"recipe": name, "final_val_ppl": history[-1],
+              "best_val_ppl": min(history), "ppl_floor": round(floor, 3),
+              "epochs": epochs, "n_tokens": n_tokens,
+              "tokens_per_sec": round(
+                  epochs * steps_per_epoch * batch * seq / dt, 1),
+              "history": history}
+    print(json.dumps(result))
+    return result
+
+
+# ---------------------------------------------------------------- recipes
+
+def run_recipe(recipe: str, epochs: int, n: int):
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.optim import Adam, EpochDecay, EpochStep, SGD
+
+    if recipe == "resnet":
+        from bigdl_tpu.models import ResNet
+        from bigdl_tpu.models.resnet.train import cifar10_decay
+        optim = SGD(learning_rate=0.1, momentum=0.9, weight_decay=1e-4,
+                    nesterov=True, dampening=0.0,
+                    learning_rate_schedule=EpochDecay(cifar10_decay))
+        return run_image(
+            recipe, lambda: ResNet(10, depth=20, dataset="CIFAR10"),
+            optim, lambda e: 0.1 * (0.1 ** cifar10_decay(e)),
+            epochs, n, batch=448, hw=32, pad=4)
+    if recipe == "vgg":
+        from bigdl_tpu.models import VggForCifar10
+        optim = SGD(learning_rate=0.01, momentum=0.9, weight_decay=5e-4,
+                    dampening=0.0,
+                    learning_rate_schedule=EpochStep(25, 0.5))
+        return run_image(
+            recipe, lambda: VggForCifar10(10), optim,
+            lambda e: 0.01 * (0.5 ** ((e - 1) // 25)),
+            epochs, n, batch=256, hw=32, pad=4)
+    if recipe == "inception":
+        from bigdl_tpu.models import Inception_v1_NoAuxClassifier
+        optim = SGD(learning_rate=0.01, momentum=0.9, weight_decay=2e-4,
+                    dampening=0.0)
+        return run_image(
+            recipe, lambda: Inception_v1_NoAuxClassifier(10), optim,
+            lambda e: 0.01, epochs, n, batch=64, hw=224, pad=8,
+            eval_batch=128)
+    if recipe == "lstm":
+        from bigdl_tpu.models import PTBModel
+        vocab = 256
+        optim = SGD(learning_rate=1.0)
+        crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion())
+        return run_lm(
+            recipe, lambda: PTBModel(vocab, 200, vocab, num_layers=2,
+                                     keep_prob=2.0),
+            crit, optim, 1.0, epochs, n, seq=32, batch=128,
+            one_based=True, vocab=vocab)
+    if recipe == "transformer":
+        from bigdl_tpu.models import TransformerLM
+        vocab = 256
+        optim = Adam(learning_rate=1e-3)
+        crit = nn.SequenceCrossEntropyCriterion()
+        return run_lm(
+            recipe, lambda: TransformerLM(vocab, hidden_size=128,
+                                          num_layers=4, num_heads=8,
+                                          max_len=32),
+            crit, optim, 1e-3, epochs, n, seq=32, batch=256,
+            one_based=False, vocab=vocab)
+    raise ValueError(f"unknown recipe {recipe}")
+
+
+def main(argv=None):
+    args = list(argv if argv is not None else sys.argv[1:])
+    # back-compat: a leading number means the original resnet run
+    recipe = "resnet"
+    if args and not args[0].isdigit():
+        recipe = args.pop(0)
+    epochs = int(args[0]) if args else 20
+    default_n = 1_000_000 if recipe in ("lstm", "transformer") else 20000
+    n = int(args[1]) if len(args) > 1 else default_n
+    return run_recipe(recipe, epochs, n)
 
 
 if __name__ == "__main__":

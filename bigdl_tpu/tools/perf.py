@@ -91,11 +91,21 @@ def main(argv=None):
     params = model.get_parameters()
     mstate = model.get_state()
 
+    # ONE AOT compile serves both the timed loop and the MFU cost
+    # analysis (a post-hoc step.lower().compile() would re-compile the
+    # whole program a second time just to read the flop count)
+    compiled_for_cost = None
     if args.mode == "train":
         optim = SGD(learning_rate=0.01, momentum=0.9)
         opt_state = optim.init_state(params)
         step = build_train_step(model, criterion, optim)
         key = jax.random.PRNGKey(0)
+        try:
+            step = step.lower(params, opt_state, mstate, key, 0.01,
+                              x, y).compile()
+            compiled_for_cost = step
+        except Exception as e:
+            print(f"# cost-analysis unavailable ({type(e).__name__})")
 
         def run():
             nonlocal params, opt_state, mstate
@@ -104,6 +114,11 @@ def main(argv=None):
             return loss
     else:
         eval_step = build_eval_step(model)
+        try:
+            eval_step = eval_step.lower(params, mstate, x).compile()
+            compiled_for_cost = eval_step
+        except Exception as e:
+            print(f"# cost-analysis unavailable ({type(e).__name__})")
 
         def run():
             return eval_step(params, mstate, x)
@@ -130,8 +145,24 @@ def main(argv=None):
         print(f"iter {i}: {dt*1000:.1f} ms  {rate:.1f} {unit}")
     med = float(np.median(times))
     rate = (args.batch_size * (in_shape[0] if is_lm else 1)) / med
-    print(f"median: {med*1000:.1f} ms  {rate:.1f} "
-          f"{'tok/s' if is_lm else 'img/s'}")
+    line = (f"median: {med*1000:.1f} ms  {rate:.1f} "
+            f"{'tok/s' if is_lm else 'img/s'}")
+    # analytic MFU vs the measured device envelope (BASELINE.md platform
+    # note; override with BIGDL_DEVICE_TFS) from the one compiled program
+    import os
+    if compiled_for_cost is not None:
+        try:
+            cost = compiled_for_cost.cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0]
+            tfs = float(cost["flops"]) / med / 1e12
+            env_tfs = float(os.environ.get("BIGDL_DEVICE_TFS", 30.0))
+            line += (f"  |  {tfs:.2f} TF/s analytic, "
+                     f"MFU {100 * tfs / env_tfs:.1f}% of {env_tfs:.0f} "
+                     "TF/s envelope")
+        except Exception as e:
+            line += f"  |  cost-analysis failed: {type(e).__name__}"
+    print(line)
 
 
 if __name__ == "__main__":

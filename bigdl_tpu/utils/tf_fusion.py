@@ -1,0 +1,314 @@
+"""TF graph pattern fusion → structured modules (the reference's
+TensorflowToBigDL fusion table, utils/tf/TensorflowToBigDL.scala:1).
+
+``TFModule`` (utils/tf_loader.py) executes an imported GraphDef
+op-by-op; that runs and trains, but an op soup cannot be ``quantize()``d
+(the rewrite looks for Linear/SpatialConvolution modules), re-exported
+through the Caffe/module serializers, or inspected as layers. This pass
+pattern-matches the node chain into REAL ``bigdl_tpu.nn`` modules:
+
+    Conv2D [+ BiasAdd]        -> SpatialConvolution
+    MatMul [+ BiasAdd]        -> Linear
+    FusedBatchNorm{,V2,V3}    -> SpatialBatchNormalization (+ stats)
+    MaxPool / AvgPool         -> SpatialMaxPooling / SpatialAveragePooling
+    Relu / Softmax / Reshape  -> ReLU / SoftMax / View
+
+Layout: TF graphs are NHWC, the nn modules are NCHW. The pass tracks
+the live layout and inserts the minimal ``Transpose`` adapters (one
+entering the conv stack, one before a TF-semantics flatten/output), so
+the fused module's outputs equal the TF graph's EXACTLY — including the
+H,W,C flatten order feeding a Linear.
+
+Scope: linear chains of the ops above (the classic TF1 conv net). An
+unsupported op raises with its name — the general fallback path stays
+``TFModule``, which executes everything.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.utils.tf_loader import TFNode, parse_graphdef
+
+
+def _require(node: TFNode, attr: str, allowed) -> None:
+    """Fail fast on attrs the fusion table cannot express — a silently
+    wrong module is worse than falling back to TFModule."""
+    v = node.attrs.get(attr)
+    if isinstance(v, bytes):
+        v = v.decode()
+    if v not in allowed:
+        raise ValueError(
+            f"fusion: {node.op} with {attr}={v!r} unsupported "
+            f"({node.name}); import with TFModule instead")
+
+
+def _same_pad(n: Optional[int], k: int, s: int) -> int:
+    """TF SAME padding for one spatial dim; None size means unknown.
+    Returns the symmetric per-side pad or raises if asymmetric."""
+    if s == 1:
+        total = k - 1
+    else:
+        if n is None:
+            raise ValueError(
+                "SAME padding with stride>1 needs a known input size "
+                "(give the Placeholder a shape)")
+        total = max((-(-n // s) - 1) * s + k - n, 0)
+    if total % 2:
+        raise ValueError(
+            f"TF SAME padding is asymmetric here (total {total}); "
+            "SpatialConvolution cannot express it — repad the graph")
+    return total // 2
+
+
+def _out_size(n: Optional[int], k: int, s: int, pad: int,
+              ceil_mode: bool = False) -> Optional[int]:
+    if n is None:
+        return None
+    m = n + 2 * pad - k
+    return (-(-m // s) if ceil_mode else m // s) + 1
+
+
+class _Fuser:
+    def __init__(self, nodes: List[TFNode], inputs, outputs):
+        self.by_name = {n.name: n for n in nodes}
+        self.nodes = nodes
+        self.consts: Dict[str, np.ndarray] = {
+            n.name: np.asarray(n.attrs.get("value"))
+            for n in nodes if n.op == "Const"}
+        self.input_names = list(inputs) if inputs else [
+            n.name for n in nodes if n.op == "Placeholder"]
+        if outputs:
+            self.output_names = list(outputs)
+        else:
+            consumed = {i.split(":")[0].lstrip("^")
+                        for n in nodes for i in n.inputs}
+            self.output_names = [n.name for n in nodes
+                                 if n.name not in consumed
+                                 and n.op not in ("Const", "Placeholder",
+                                                  "NoOp")]
+
+    def const(self, ref: str) -> np.ndarray:
+        nm = ref.split(":")[0].lstrip("^")
+        node = self.by_name[nm]
+        while node.op == "Identity":
+            nm = node.inputs[0].split(":")[0].lstrip("^")
+            node = self.by_name[nm]
+        if nm not in self.consts:
+            raise ValueError(
+                f"fusion needs a constant weight at {ref}, found "
+                f"{node.op} (freeze the graph first)")
+        return self.consts[nm]
+
+    def _bias_of(self, node: Optional[TFNode]) -> Optional[np.ndarray]:
+        """The constant bias when ``node`` is a bias-add form: BiasAdd,
+        or Add/AddV2 with a rank-1 const operand (TF2 freezing lowers
+        `y + b` to AddV2)."""
+        if node is None or node.op not in ("BiasAdd", "Add", "AddV2"):
+            return None
+        try:
+            b = self.const(node.inputs[1])
+        except (ValueError, KeyError):
+            return None
+        return b if b.ndim == 1 else None
+
+    def fuse(self):
+        """Chain walk from the single input to the single output."""
+        import bigdl_tpu.nn as nn
+
+        if len(self.input_names) != 1 or len(self.output_names) != 1:
+            raise ValueError(
+                "fusion covers single-input single-output chains; use "
+                "TFModule for general graphs")
+        # build the producer chain output <- ... <- input, following the
+        # first TENSOR input of each node (weights are const operands)
+        chain: List[TFNode] = []
+        cur = self.by_name[self.output_names[0]]
+        guard = 0
+        while cur.name != self.input_names[0]:
+            chain.append(cur)
+            data_in = None
+            for ref in cur.inputs:
+                nm = ref.split(":")[0].lstrip("^")
+                node = self.by_name[nm]
+                while node.op == "Identity":
+                    nm = node.inputs[0].split(":")[0].lstrip("^")
+                    node = self.by_name[nm]
+                if node.op != "Const":
+                    data_in = node
+                    break
+            if data_in is None:
+                raise ValueError(f"no tensor input at node {cur.name}")
+            cur = data_in
+            guard += 1
+            if guard > 10000:
+                raise ValueError("graph is not a chain")
+        chain.reverse()
+
+        placeholder = self.by_name[self.input_names[0]]
+        shape = placeholder.attrs.get("shape")
+        # spatial sizes tracked through the chain for SAME padding
+        h, w = (None, None)
+        if shape is not None and len(shape) == 4:
+            h = None if shape[1] in (-1, None) else int(shape[1])
+            w = None if shape[2] in (-1, None) else int(shape[2])
+
+        seq = nn.Sequential()
+        layout = "NHWC"  # the TF graph's native layout
+        presets = []     # (module, params, state) to install after init
+
+        def to_nchw():
+            nonlocal layout
+            if layout == "NHWC":
+                seq.add(nn.Transpose([(2, 4), (3, 4)]))
+                layout = "NCHW"
+
+        def to_nhwc():
+            nonlocal layout
+            if layout == "NCHW":
+                seq.add(nn.Transpose([(2, 3), (3, 4)]))
+                layout = "NHWC"
+
+        i = 0
+        while i < len(chain):
+            node = chain[i]
+            op = node.op
+            nxt = chain[i + 1] if i + 1 < len(chain) else None
+            if op == "Identity":
+                i += 1
+            elif op == "Conv2D":
+                _require(node, "data_format", ("NHWC", None))
+                _require(node, "padding", ("SAME", "VALID"))
+                dil = node.attrs.get("dilations")
+                if dil is not None and any(d != 1 for d in dil):
+                    raise ValueError(
+                        f"fusion: dilated Conv2D unsupported ({node.name})"
+                        "; import with TFModule instead")
+                wgt = self.const(node.inputs[1])  # HWIO
+                kh, kw_ = wgt.shape[0], wgt.shape[1]
+                cin, cout = wgt.shape[2], wgt.shape[3]
+                sh, sw = node.attrs["strides"][1:3]
+                pad = node.attrs["padding"]
+                ph = 0 if pad == "VALID" else _same_pad(h, kh, sh)
+                pw = 0 if pad == "VALID" else _same_pad(w, kw_, sw)
+                bias = self._bias_of(nxt)
+                if bias is not None:
+                    i += 1
+                m = nn.SpatialConvolution(cin, cout, kw_, kh, sw, sh,
+                                          pw, ph,
+                                          with_bias=bias is not None)
+                p = {"weight": np.transpose(wgt, (3, 2, 0, 1))}
+                if bias is not None:
+                    p["bias"] = bias
+                presets.append((m, p, None))
+                to_nchw()
+                seq.add(m)
+                h, w = _out_size(h, kh, sh, ph), _out_size(w, kw_, sw, pw)
+                i += 1
+            elif op == "MatMul":
+                if node.attrs.get("transpose_a") or \
+                        node.attrs.get("transpose_b"):
+                    raise ValueError(
+                        f"fusion: transposed MatMul unsupported "
+                        f"({node.name}); import with TFModule instead")
+                wgt = self.const(node.inputs[1])  # [in, out]
+                bias = self._bias_of(nxt)
+                if bias is not None:
+                    i += 1
+                m = nn.Linear(wgt.shape[0], wgt.shape[1],
+                              with_bias=bias is not None)
+                p = {"weight": wgt.T}
+                if bias is not None:
+                    p["bias"] = bias
+                presets.append((m, p, None))
+                seq.add(m)
+                i += 1
+            elif op in ("FusedBatchNorm", "FusedBatchNormV2",
+                        "FusedBatchNormV3"):
+                scale = self.const(node.inputs[1])
+                offset = self.const(node.inputs[2])
+                mean = self.const(node.inputs[3])
+                var = self.const(node.inputs[4])
+                eps = float(node.attrs.get("epsilon", 1e-3))
+                m = nn.SpatialBatchNormalization(len(scale), eps)
+                presets.append((m, {"weight": scale, "bias": offset},
+                                {"running_mean": mean,
+                                 "running_var": var}))
+                to_nchw()
+                seq.add(m)
+                i += 1
+            elif op in ("MaxPool", "AvgPool"):
+                _require(node, "data_format", ("NHWC", None))
+                _require(node, "padding", ("SAME", "VALID"))
+                kh, kw_ = node.attrs["ksize"][1:3]
+                sh, sw = node.attrs["strides"][1:3]
+                pad = node.attrs["padding"]
+                ph = 0 if pad == "VALID" else _same_pad(h, kh, sh)
+                pw = 0 if pad == "VALID" else _same_pad(w, kw_, sw)
+                ceil = pad == "SAME"  # TF SAME pooling covers the tail
+                if op == "MaxPool":
+                    m = nn.SpatialMaxPooling(kw_, kh, sw, sh, pw, ph)
+                else:
+                    # TF AvgPool excludes padding from the divisor, the
+                    # Torch count_include_pad=False convention
+                    m = nn.SpatialAveragePooling(
+                        kw_, kh, sw, sh, pw, ph, count_include_pad=False)
+                if ceil:
+                    m = m.ceil()
+                to_nchw()
+                seq.add(m)
+                h = _out_size(h, kh, sh, ph, ceil)
+                w = _out_size(w, kw_, sw, pw, ceil)
+                i += 1
+            elif op == "Relu":
+                seq.add(nn.ReLU())
+                i += 1
+            elif op == "Softmax":
+                to_nhwc()
+                seq.add(nn.SoftMax())
+                i += 1
+            elif op == "Reshape":
+                tgt = [int(v) for v in
+                       np.asarray(self.const(node.inputs[1])).ravel()]
+                # TF flatten reshapes in H,W,C order — return to NHWC
+                # first so the following Linear's weights line up
+                to_nhwc()
+                if len(tgt) == 2 and tgt[0] == -1:
+                    seq.add(nn.View(tgt[1]))
+                else:
+                    seq.add(nn.Reshape(tuple(tgt[1:])))
+                i += 1
+            else:
+                raise ValueError(
+                    f"fusion table has no pattern for op {op} (node "
+                    f"{node.name}); import with TFModule instead")
+        to_nhwc()  # a 4-D output leaves in the graph's own layout
+
+        import jax.numpy as jnp
+        # install weights BEFORE the container initializes: Container.init
+        # adopts a child's already-materialized params (the importer
+        # contract, nn/container.py adopt_or_init)
+        for m, p, s in presets:
+            m.set_parameters({k: jnp.asarray(v) for k, v in p.items()})
+            if s is not None:
+                m.set_state({k: jnp.asarray(v) for k, v in s.items()})
+        seq.evaluate()
+        seq.ensure_initialized()
+        return seq
+
+
+def fuse_tf_graph(nodes_or_bytes,
+                  inputs: Optional[Sequence[str]] = None,
+                  outputs: Optional[Sequence[str]] = None):
+    """GraphDef (bytes or parsed TFNode list) -> a Sequential of real
+    nn modules with the TF weights installed (TensorflowToBigDL.scala:1).
+
+    The fused module is NHWC-in/NHWC-out like the TF graph, survives
+    ``nn.quantized.quantize`` and the module serializer, and — unlike
+    ``TFModule`` — reads as layers."""
+    if isinstance(nodes_or_bytes, (bytes, bytearray)):
+        nodes = parse_graphdef(bytes(nodes_or_bytes))
+    else:
+        nodes = list(nodes_or_bytes)
+    return _Fuser(nodes, inputs, outputs).fuse()

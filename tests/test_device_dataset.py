@@ -314,3 +314,50 @@ def test_optimizer_trains_from_rotating_dataset():
     preds = np.asarray(trained.evaluate().forward(
         xs.astype(np.float32))).argmax(-1) + 1
     assert (preds == np.arange(1, 5)).mean() >= 0.75
+
+
+def test_set_validation_accepts_device_cached_dataset():
+    """Trigger-driven validation rides the HBM cache directly (the
+    fastest eval path is reachable from the Optimizer: the device form
+    of DistriOptimizer.scala:607-686 validating on the cached
+    distributed dataset). Scores must equal the host-fed Sample path."""
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+    from bigdl_tpu.optim import (LocalOptimizer, SGD, Top1Accuracy,
+                                 every_epoch, max_iteration)
+    from bigdl_tpu.utils.random import RandomGenerator
+
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 255, (32, 3, 8, 8), np.uint8)
+    lbls = (rng.randint(0, 2, 32) + 1).astype(np.float32)
+    train = DeviceCachedArrayDataSet(imgs, lbls, 8, flip=False,
+                                     mean=(127,) * 3, std=(64,) * 3)
+    vimgs = rng.randint(0, 255, (20, 3, 8, 8), np.uint8)
+    vlbls = (rng.randint(0, 2, 20) + 1).astype(np.float32)
+    # batch 8 over 20 rows: exercises the wrapped-tail trim too
+    val_dev = DeviceCachedArrayDataSet(vimgs, vlbls, 8, flip=False,
+                                       mean=(127,) * 3, std=(64,) * 3)
+
+    def build():
+        RandomGenerator.set_seed(4)
+        return (nn.Sequential().add(nn.Reshape((3 * 8 * 8,)))
+                .add(nn.Linear(3 * 8 * 8, 2)).add(nn.LogSoftMax()))
+
+    scores = {}
+    for kind in ("device", "host"):
+        model = build()
+        opt = LocalOptimizer(model, train, nn.ClassNLLCriterion(),
+                             batch_size=8)
+        opt.set_optim_method(SGD(learning_rate=0.1))
+        if kind == "device":
+            opt.set_validation(every_epoch(), val_dev, [Top1Accuracy()])
+        else:
+            x_norm = ((vimgs.astype(np.float32) - 127.0) / 64.0)
+            vs = [Sample(x_norm[i], vlbls[i]) for i in range(20)]
+            opt.set_validation(
+                every_epoch(), DataSet.array(vs).transform(
+                    SampleToMiniBatch(8)), [Top1Accuracy()])
+        opt.set_end_when(max_iteration(4))
+        opt.optimize()
+        scores[kind] = opt.driver_state["score"]
+    assert scores["device"] == scores["host"], scores
