@@ -36,7 +36,8 @@ class DeviceCachedArrayDataSet:
                  pad: int = 0, flip: bool = True,
                  mean: Sequence[float] = (0.0, 0.0, 0.0),
                  std: Sequence[float] = (1.0, 1.0, 1.0),
-                 sharding=None, shuffle_seed: int = 0):
+                 sharding=None, shuffle_seed: int = 0,
+                 put_chunk_bytes: Optional[int] = None):
         images = np.ascontiguousarray(images)
         if images.dtype != np.uint8:
             if images.max() <= 1.0:
@@ -64,8 +65,28 @@ class DeviceCachedArrayDataSet:
         if pc > 1:
             self.n = n = n * pc
 
+        if put_chunk_bytes is not None and sharding is not None:
+            raise ValueError(
+                "put_chunk_bytes stages single-device caches only; for "
+                "sharded/multi-host caches use ShardRotator, whose pump() "
+                "already stages piecewise")
+
         def put(a):
             if sharding is None:
+                if (put_chunk_bytes is not None
+                        and a.nbytes > put_chunk_bytes):
+                    # stage in cliff-safe pieces: one huge device_put
+                    # falls off the tunnel's transfer cliff (BASELINE.md
+                    # feed note) and can even break the transport
+                    rows = max(1, put_chunk_bytes // max(1, a[0].nbytes))
+                    dest = jnp.zeros(a.shape, a.dtype)
+                    off = 0
+                    while off < len(a):
+                        piece = jnp.asarray(
+                            np.ascontiguousarray(a[off:off + rows]))
+                        dest = _write_rows(dest, piece, jnp.int32(off))
+                        off += len(piece)
+                    return jax.block_until_ready(dest)
                 return jax.device_put(a)
             if pc > 1:
                 a = np.asarray(a)

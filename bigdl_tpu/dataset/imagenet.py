@@ -230,6 +230,7 @@ class ImageFolderDataSet(AbstractDataSet):
 
     def __init__(self, folder: Optional[str] = None, *,
                  record_shards: Optional[Sequence[str]] = None,
+                 seq_files: Optional[Sequence[str]] = None,
                  batch_size: int = 32, crop: int = 224, scale: int = 256,
                  mean: Sequence[float] = IMAGENET_MEAN,
                  std: Sequence[float] = IMAGENET_STD,
@@ -237,16 +238,29 @@ class ImageFolderDataSet(AbstractDataSet):
                  process_index: int = 0, process_count: int = 1,
                  seed: int = 0, color_jitter: bool = False,
                  lighting: bool = False):
-        if (folder is None) == (record_shards is None):
-            raise ValueError("pass exactly one of folder / record_shards")
+        sources = [s for s in (folder, record_shards, seq_files)
+                   if s is not None]
+        if len(sources) != 1:
+            raise ValueError(
+                "pass exactly one of folder / record_shards / seq_files")
         if folder is not None:
             paths, labels, self.classes = list_image_folder(folder)
             self._items: List = list(zip(paths, labels))
-        else:
+        elif record_shards is not None:
             self.classes = None
             self._items = []
             for shard in record_shards:
                 for data, label, _ in read_image_records(shard):
+                    self._items.append((data, label))
+        else:
+            # Hadoop SequenceFile shards — wire-compatible with datasets
+            # packed by the reference's ImageNetSeqFileGenerator
+            # (DataSet.scala:470-552 SeqFileFolder)
+            from bigdl_tpu.dataset.seqfile import read_seq_image_records
+            self.classes = None
+            self._items = []
+            for shard in seq_files:
+                for data, label, _ in read_seq_image_records(shard):
                     self._items.append((data, label))
         self._total = len(self._items)
         self._items = self._items[process_index::process_count]

@@ -36,9 +36,19 @@ import numpy as np
 
 def make_dataset(n: int, seed: int, classes: int = 10, hw: int = 32):
     # prototypes are the TASK, fixed across splits; `seed` only draws
-    # the split's samples
-    protos = np.random.RandomState(1234).randn(
-        classes, 3, hw, hw).astype(np.float32)
+    # the split's samples. At high resolution the prototypes are
+    # LOW-FREQUENCY (8x block-upsampled): iid per-pixel prototypes put
+    # all class signal at the Nyquist band, which an ImageNet-style
+    # stem (7x7/2 conv + pool) averages to nothing — measured as a
+    # chance-level flatline on Inception-v1 @224.
+    truth = np.random.RandomState(1234)
+    if hw > 64:
+        base = hw // 8
+        protos = np.repeat(np.repeat(
+            truth.randn(classes, 3, base, base).astype(np.float32),
+            8, axis=2), 8, axis=3)
+    else:
+        protos = truth.randn(classes, 3, hw, hw).astype(np.float32)
     rng = np.random.RandomState(seed)
     ys = rng.randint(0, classes, n)
     gains = 0.5 + rng.rand(n, 1, 1, 1).astype(np.float32)
@@ -57,7 +67,7 @@ def make_dataset(n: int, seed: int, classes: int = 10, hw: int = 32):
 
 def run_image(name: str, build_model, optim, lr_for_epoch, epochs: int,
               n_train: int, batch: int, hw: int, pad: int,
-              eval_batch: int = 256):
+              eval_batch: int = 256, criterion=None):
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -67,8 +77,15 @@ def run_image(name: str, build_model, optim, lr_for_epoch, epochs: int,
     from bigdl_tpu.optim.optimizer import build_train_step
     from bigdl_tpu.utils.random import RandomGenerator
 
+    n_val = 2048 if hw <= 64 else 1024
     xs, ys = make_dataset(n_train, seed=0, hw=hw)
-    xv, yv = make_dataset(2048, seed=1, hw=hw)
+    xv, yv = make_dataset(n_val, seed=1, hw=hw)
+    # large caches must stage in cliff-safe pieces (tunnel transport
+    # breaks on multi-GB single device_puts); size by the measured probe
+    chunk = None
+    if hw > 64:
+        from bigdl_tpu.utils.transfer import probe_device_put_chunk
+        chunk = probe_device_put_chunk()
 
     RandomGenerator.set_seed(1)
     model = build_model().training()
@@ -76,20 +93,30 @@ def run_image(name: str, build_model, optim, lr_for_epoch, epochs: int,
     params = model.get_parameters()
     mstate = model.get_state()
     opt_state = optim.init_state(params)
-    step = build_train_step(model, nn.CrossEntropyCriterion(), optim)
+    # the recipe's own pairing: raw-logit models use CE, LogSoftMax
+    # heads (inception) use ClassNLL — CE on log-probs barely
+    # propagates gradient (measured: loss pinned at ln(10))
+    step = build_train_step(
+        model, criterion or nn.CrossEntropyCriterion(), optim)
 
     mean, std = (128.0,) * 3, (64.0,) * 3
     ds = DeviceCachedArrayDataSet(xs, ys, batch, crop=(hw, hw), pad=pad,
-                                  flip=False, mean=mean, std=std)
+                                  flip=False, mean=mean, std=std,
+                                  put_chunk_bytes=chunk)
     ev = DeviceCachedArrayDataSet(xv, yv, eval_batch, crop=(hw, hw),
-                                  flip=False, mean=mean, std=std)
+                                  flip=False, mean=mean, std=std,
+                                  put_chunk_bytes=chunk)
 
     steps_per_epoch = max(1, n_train // batch)
 
-    def body(carry, key):
+    # the caches ride as ARGUMENTS, never jit-closure constants: on the
+    # tunneled backend remote_compile must not carry a multi-hundred-MB
+    # captured buffer (it broke the transport at 224px), and arguments
+    # are the Optimizer's own contract for device feeds
+    def body(images, labels, carry, key):
         params, opt_state, mstate, ep, pos, lr = carry
         kb, kr = jax.random.split(key)
-        x, y = ds.batch_fn(kb, epoch=ep, pos=pos)
+        x, y = ds.batch_fn_on(images, labels, kb, epoch=ep, pos=pos)
         params, opt_state, mstate, loss = step(
             params, opt_state, mstate, kr, lr, x, y)
         pos = pos + batch
@@ -98,13 +125,14 @@ def run_image(name: str, build_model, optim, lr_for_epoch, epochs: int,
         return (params, opt_state, mstate, ep, pos, lr), loss
 
     @jax.jit
-    def run_epoch(carry, keys):
-        return lax.scan(body, carry, keys)
+    def run_epoch(carry, keys, images, labels):
+        return lax.scan(lambda c, k: body(images, labels, c, k),
+                        carry, keys)
 
     @jax.jit
-    def eval_acc(params, mstate):
+    def eval_acc(params, mstate, images, labels):
         def one(start):
-            x, y = ev.eval_batch_fn(start)
+            x, y = ev.eval_batch_fn_on(images, labels, start)
             out, _ = model.apply(params, mstate, x, training=False)
             return (jnp.argmax(out, -1) + 1 == y).mean()
         starts = jnp.arange(0, ev.n, eval_batch)
@@ -119,8 +147,8 @@ def run_image(name: str, build_model, optim, lr_for_epoch, epochs: int,
         carry = carry[:5] + (jnp.float32(lr_for_epoch(e + 1)),)
         keys = jax.random.split(jax.random.fold_in(root, e),
                                 steps_per_epoch)
-        carry, losses = run_epoch(carry, keys)
-        acc = float(eval_acc(carry[0], carry[2]))
+        carry, losses = run_epoch(carry, keys, ds.images, ds.labels)
+        acc = float(eval_acc(carry[0], carry[2], ev.images, ev.labels))
         history.append(round(acc, 4))
         print(f"epoch {e + 1}: loss={float(losses.mean()):.4f} "
               f"val_acc={acc:.4f}", flush=True)
@@ -282,12 +310,12 @@ def run_recipe(recipe: str, epochs: int, n: int):
             epochs, n, batch=256, hw=32, pad=4)
     if recipe == "inception":
         from bigdl_tpu.models import Inception_v1_NoAuxClassifier
-        optim = SGD(learning_rate=0.01, momentum=0.9, weight_decay=2e-4,
+        optim = SGD(learning_rate=0.05, momentum=0.9, weight_decay=2e-4,
                     dampening=0.0)
         return run_image(
             recipe, lambda: Inception_v1_NoAuxClassifier(10), optim,
-            lambda e: 0.01, epochs, n, batch=64, hw=224, pad=8,
-            eval_batch=128)
+            lambda e: 0.05, epochs, n, batch=64, hw=224, pad=8,
+            eval_batch=128, criterion=nn.ClassNLLCriterion())
     if recipe == "lstm":
         from bigdl_tpu.models import PTBModel
         vocab = 256

@@ -1,0 +1,98 @@
+"""Generate docs/api.md from the package's docstrings (the role of the
+reference's mkdocs APIGuide tree — one command regenerates the index).
+
+    python -m bigdl_tpu.tools.gen_api_docs [out_path]
+"""
+from __future__ import annotations
+
+import importlib
+import inspect
+import sys
+
+MODULES = [
+    "bigdl_tpu.nn",
+    "bigdl_tpu.nn.attention",
+    "bigdl_tpu.nn.sparse",
+    "bigdl_tpu.nn.quantized",
+    "bigdl_tpu.dataset",
+    "bigdl_tpu.dataset.device_dataset",
+    "bigdl_tpu.optim",
+    "bigdl_tpu.parallel",
+    "bigdl_tpu.models",
+    "bigdl_tpu.ml",
+    "bigdl_tpu.utils.engine",
+    "bigdl_tpu.utils.serialization",
+    "bigdl_tpu.utils.tf_loader",
+    "bigdl_tpu.utils.tf_fusion",
+    "bigdl_tpu.utils.caffe",
+    "bigdl_tpu.utils.torch_file",
+    "bigdl_tpu.visualization",
+]
+
+
+def _first_line(doc) -> str:
+    if not doc:
+        return ""
+    return doc.strip().splitlines()[0].rstrip()
+
+
+def _public_members(mod):
+    names = getattr(mod, "__all__", None) or [
+        n for n in vars(mod) if not n.startswith("_")]
+    out = []
+    for n in sorted(set(names)):
+        obj = getattr(mod, n, None)
+        if obj is None or inspect.ismodule(obj):
+            continue
+        if not (inspect.isclass(obj) or inspect.isfunction(obj)):
+            continue
+        home = getattr(obj, "__module__", "")
+        if not home.startswith("bigdl_tpu"):
+            continue
+        kind = "class" if inspect.isclass(obj) else "def"
+        try:
+            sig = str(inspect.signature(obj))
+        except (TypeError, ValueError):
+            sig = "(...)"
+        if len(sig) > 70:
+            sig = sig[:67] + "..."
+        out.append((kind, n, sig, _first_line(inspect.getdoc(obj))))
+    return out
+
+
+def generate() -> str:
+    lines = ["# API index",
+             "",
+             "Generated from docstrings by "
+             "`python -m bigdl_tpu.tools.gen_api_docs` — regenerate "
+             "after adding public API.", ""]
+    for name in MODULES:
+        mod = importlib.import_module(name)
+        lines.append(f"## `{name}`")
+        head = _first_line(inspect.getdoc(mod))
+        if head:
+            lines.append(f"\n{head}\n")
+        members = _public_members(mod)
+        if not members:
+            lines.append("")
+            continue
+        for kind, n, sig, doc in members:
+            entry = f"- **`{n}{sig}`**"
+            if doc:
+                entry += f" — {doc}"
+            lines.append(entry)
+        lines.append("")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None):
+    args = argv if argv is not None else sys.argv[1:]
+    out = args[0] if args else "docs/api.md"
+    text = generate()
+    with open(out, "w") as f:
+        f.write(text)
+    print(f"wrote {out} ({text.count(chr(10))} lines)")
+
+
+if __name__ == "__main__":
+    main()
