@@ -67,7 +67,7 @@ def make_dataset(n: int, seed: int, classes: int = 10, hw: int = 32):
 
 def run_image(name: str, build_model, optim, lr_for_epoch, epochs: int,
               n_train: int, batch: int, hw: int, pad: int,
-              eval_batch: int = 256, criterion=None):
+              eval_batch: int = 256, criterion=None, eval_head=None):
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -134,6 +134,8 @@ def run_image(name: str, build_model, optim, lr_for_epoch, epochs: int,
         def one(start):
             x, y = ev.eval_batch_fn_on(images, labels, start)
             out, _ = model.apply(params, mstate, x, training=False)
+            if eval_head is not None:  # multi-head: score the main head
+                out = eval_head(out)
             return (jnp.argmax(out, -1) + 1 == y).mean()
         starts = jnp.arange(0, ev.n, eval_batch)
         return jax.vmap(one)(starts).mean()
@@ -309,13 +311,31 @@ def run_recipe(recipe: str, epochs: int, n: int):
             lambda e: 0.01 * (0.5 ** ((e - 1) // 25)),
             epochs, n, batch=256, hw=32, pad=4)
     if recipe == "inception":
-        from bigdl_tpu.models import Inception_v1_NoAuxClassifier
+        from bigdl_tpu.models import Inception_v1
         optim = SGD(learning_rate=0.05, momentum=0.9, weight_decay=2e-4,
                     dampening=0.0)
+
+        class AuxNLL:
+            """GoogLeNet's 3-head objective (main + 0.3*aux2 + 0.3*aux1
+            over the channel-concat output): the aux classifiers exist
+            precisely because the 22-layer no-aux net's gradient
+            vanishes — measured here as a chance-level flatline."""
+
+            def apply(self, input, target):
+                c = input.shape[-1] // 3
+                nll = nn.ClassNLLCriterion()
+                return (nll.apply(input[:, :c], target)
+                        + 0.3 * nll.apply(input[:, c:2 * c], target)
+                        + 0.3 * nll.apply(input[:, 2 * c:], target))
+
+        def eval_slice(out):
+            return out[:, :out.shape[-1] // 3]
+
         return run_image(
-            recipe, lambda: Inception_v1_NoAuxClassifier(10), optim,
+            recipe, lambda: Inception_v1(10), optim,
             lambda e: 0.05, epochs, n, batch=64, hw=224, pad=8,
-            eval_batch=128, criterion=nn.ClassNLLCriterion())
+            eval_batch=128, criterion=AuxNLL(),
+            eval_head=eval_slice)
     if recipe == "lstm":
         from bigdl_tpu.models import PTBModel
         vocab = 256

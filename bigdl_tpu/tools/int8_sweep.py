@@ -21,13 +21,19 @@ def _time_chunk(fn, args, scan: int, iters: int):
     import functools
 
     import jax
+    import jax.numpy as jnp
     from jax import lax
 
     @functools.partial(jax.jit, static_argnums=())
     def chunk(*a):
-        def body(acc, _):
-            return acc + fn(*a).astype(np.float32).sum(), None
-        out, _ = lax.scan(body, 0.0, None, length=scan)
+        def body(carry, _):
+            # the carry perturbs the first operand so every scan step
+            # DEPENDS on the previous one — a loop-invariant body gets
+            # hoisted by XLA and the scan would time nothing but adds
+            a0 = a[0] + jnp.asarray(carry, a[0].dtype)
+            r = fn(a0, *a[1:])
+            return r.astype(jnp.float32).sum() * 1e-30, None
+        out, _ = lax.scan(body, jnp.float32(0.0), None, length=scan)
         return out
 
     r = chunk(*args)
@@ -45,9 +51,13 @@ def main(argv=None):
     from bigdl_tpu.ops.pallas_kernels import pallas_quantized_matmul
     from bigdl_tpu.ops.quant import quantize_symmetric, quantized_linear
 
+    import os
     args = argv if argv is not None else sys.argv[1:]
-    iters = int(args[0]) if args else 4
-    scan = 8
+    iters = int(args[0]) if args else 3
+    # scan long enough that compute dominates the ~100 ms tunnel
+    # roundtrip per chunk; at scan 8 every shape measured ~13 ms/step
+    # (pure dispatch latency) regardless of FLOPs
+    scan = int(os.environ.get("BENCH_SCAN", 64))
     on_tpu = jax.devices()[0].platform == "tpu"
 
     shapes = [
@@ -92,14 +102,14 @@ def main(argv=None):
                                     iters)
             except Exception as e:
                 t_pl8 = f"failed: {type(e).__name__}"
+        best8 = min([t for t in (t_jnp8, t_pl8)
+                     if isinstance(t, float)])
         row = {"shape": [b, cin, cout],
                "bf16_ms": round(t_bf16 * 1e3, 3),
                "jnp_int8_ms": round(t_jnp8 * 1e3, 3),
                "pallas_int8_ms": (round(t_pl8 * 1e3, 3)
                                   if isinstance(t_pl8, float) else t_pl8),
-               "int8_speedup_vs_bf16": round(
-                   t_bf16 / t_pl8, 3) if isinstance(t_pl8, float)
-               else round(t_bf16 / t_jnp8, 3)}
+               "int8_speedup_vs_bf16": round(t_bf16 / best8, 3)}
         rows.append(row)
         print(json.dumps(row), flush=True)
 

@@ -156,10 +156,11 @@ def main(argv=None):
             if isinstance(cost, (list, tuple)):
                 cost = cost[0]
             tfs = float(cost["flops"]) / med / 1e12
-            env_tfs = float(os.environ.get("BIGDL_DEVICE_TFS", 30.0))
+            # denominator: v5e peak bf16; override via BIGDL_DEVICE_TFS
+            env_tfs = float(os.environ.get("BIGDL_DEVICE_TFS", 197.0))
             line += (f"  |  {tfs:.2f} TF/s analytic, "
                      f"MFU {100 * tfs / env_tfs:.1f}% of {env_tfs:.0f} "
-                     "TF/s envelope")
+                     "TF/s peak")
         except Exception as e:
             line += f"  |  cost-analysis failed: {type(e).__name__}"
     print(line)
