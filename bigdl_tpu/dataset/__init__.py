@@ -11,6 +11,9 @@ from bigdl_tpu.dataset.imagenet import (
     ImageFolderDataSet, ImageRecordWriter, list_image_folder, decode_image,
     read_image_records, write_image_record_shards,
     IMAGENET_MEAN, IMAGENET_STD)
+from bigdl_tpu.dataset.fetch import (
+    get_glove_w2v, get_news20, maybe_download, mnist_read_data_sets,
+    movielens_read_data_sets)
 from bigdl_tpu.dataset.seqfile import (
     SequenceFileWriter, read_sequence_file, read_seq_image_records,
     write_seq_image_shards)

@@ -1,0 +1,197 @@
+"""Standard-corpus fetch/prep helpers (reference:
+pyspark/bigdl/dataset/{base,mnist,news20,movielens}.py — the
+download-and-parse surface users call before building a DataSet).
+
+Download is a thin `maybe_download` (skips when the file exists, so
+pre-seeded offline caches work unchanged); every parser is pure and
+testable against local fixtures. Gzip/zip/tar handling matches the
+reference's formats byte-for-byte:
+
+- MNIST: idx gzip files -> (images [N,28,28], labels [N]) —
+  mnist.py:38/62 extract_images/extract_labels.
+- News20: 20news-bydate tar -> [(text, 1-based label)] and GloVe 6B
+  -> {word: vec} — news20.py:53/82.
+- MovieLens 1M: ml-1m.zip ratings.dat -> int array
+  [user, item, rating, timestamp] — movielens.py read_data_sets.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+import tarfile
+import zipfile
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+# yann.lecun.com has 403'd for years (the reference's URL is dead);
+# the ossci mirror serves the identical files
+MNIST_URL = "https://ossci-datasets.s3.amazonaws.com/mnist/"
+NEWS20_URL = ("http://qwone.com/~jason/20Newsgroups/"
+              "20news-19997.tar.gz")
+GLOVE_URL = "http://nlp.stanford.edu/data/glove.6B.zip"
+MOVIELENS_URL = "http://files.grouplens.org/datasets/movielens/ml-1m.zip"
+
+
+def maybe_download(filename: str, work_dir: str, source_url: str) -> str:
+    """Download ``source_url`` into ``work_dir/filename`` unless it is
+    already there (base.py:176). Offline environments pre-seed the file
+    and never hit the network."""
+    os.makedirs(work_dir, exist_ok=True)
+    filepath = os.path.join(work_dir, filename)
+    if not os.path.exists(filepath):
+        from urllib.request import urlretrieve
+        print(f"downloading {source_url} -> {filepath}")
+        tmp = filepath + ".part"
+        urlretrieve(source_url, tmp)
+        os.replace(tmp, filepath)
+    return filepath
+
+
+# ------------------------------------------------------------------ MNIST
+
+def _extract_idx(path: str, magic: int) -> np.ndarray:
+    """gzip idx file -> uint8 array, delegating the idx payload walk to
+    the one parser the package already has (dataset/image.py
+    _parse_idx_py / the native fast path)."""
+    with gzip.open(path, "rb") as f:
+        buf = f.read()
+    got = struct.unpack(">I", buf[:4])[0]
+    if got != magic:
+        raise ValueError(f"{path}: bad idx magic {got} (want {magic})")
+    try:
+        from bigdl_tpu import native
+        return np.asarray(native.parse_idx(buf), np.uint8)
+    except Exception:
+        from bigdl_tpu.dataset.image import _parse_idx_py
+        return _parse_idx_py(buf).astype(np.uint8)
+
+
+def extract_mnist_images(path: str) -> np.ndarray:
+    """idx3 gzip -> uint8 [N, 28, 28] (mnist.py:38)."""
+    return _extract_idx(path, 2051)
+
+
+def extract_mnist_labels(path: str) -> np.ndarray:
+    """idx1 gzip -> uint8 [N] (mnist.py:62)."""
+    return _extract_idx(path, 2049)
+
+
+def mnist_read_data_sets(train_dir: str, data_type: str = "train"
+                         ) -> Tuple[np.ndarray, np.ndarray]:
+    """Download-if-missing + parse (mnist.py:76). Returns
+    (images [N,28,28] u8, labels [N] u8); labels are 0-based here —
+    add 1 for the Torch-convention criterions."""
+    prefix = "train" if data_type == "train" else "t10k"
+    imgs = maybe_download(f"{prefix}-images-idx3-ubyte.gz", train_dir,
+                          MNIST_URL + f"{prefix}-images-idx3-ubyte.gz")
+    lbls = maybe_download(f"{prefix}-labels-idx1-ubyte.gz", train_dir,
+                          MNIST_URL + f"{prefix}-labels-idx1-ubyte.gz")
+    return extract_mnist_images(imgs), extract_mnist_labels(lbls)
+
+
+# ----------------------------------------------------------------- News20
+
+def get_news20(source_dir: str = "/tmp/news20/"
+               ) -> List[Tuple[str, int]]:
+    """Download-if-missing + parse the 20 Newsgroups tree into
+    [(document_text, 1-based category label)] (news20.py:53)."""
+    tar_path = maybe_download("20news-19997.tar.gz", source_dir,
+                              NEWS20_URL)
+    extracted = os.path.join(source_dir, "20_newsgroups")
+    if not os.path.exists(extracted):
+        def _untar(dst):
+            with tarfile.open(tar_path) as t:
+                t.extractall(dst, filter="data")  # no path traversal
+        _atomic_extract(extracted, _untar)
+    return parse_news20_tree(extracted)
+
+
+def _atomic_extract(final_dir: str, extract_into) -> None:
+    """Extract into a temp sibling and rename into place: an
+    interrupted extraction must never pass the exists-skip guard and
+    feed a truncated corpus (the download half already uses
+    .part + os.replace for the same reason)."""
+    import shutil
+    import tempfile
+
+    parent = os.path.dirname(final_dir) or "."
+    tmp = tempfile.mkdtemp(prefix=".extract-", dir=parent)
+    try:
+        extract_into(tmp)
+        entries = os.listdir(tmp)
+        # an archive with a single root dir moves that dir; a flat one
+        # moves the temp dir itself
+        src = os.path.join(tmp, entries[0]) if len(entries) == 1 else tmp
+        os.rename(src, final_dir)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def parse_news20_tree(root: str) -> List[Tuple[str, int]]:
+    """Category-subfolder text tree -> [(text, 1-based label)]; label
+    order is the sorted category names. Only numeric-named article
+    files count (news20.py:61-79's isdigit filter — stray editor/cache
+    files in a user-managed tree must not become documents)."""
+    texts = []
+    for label, category in enumerate(sorted(os.listdir(root)), start=1):
+        cat_dir = os.path.join(root, category)
+        if not os.path.isdir(cat_dir):
+            continue
+        for fname in sorted(os.listdir(cat_dir)):
+            if not fname.isdigit():
+                continue
+            fpath = os.path.join(cat_dir, fname)
+            with open(fpath, "rb") as f:
+                texts.append((f.read().decode("latin-1"), label))
+    return texts
+
+
+def get_glove_w2v(source_dir: str = "/tmp/news20/", dim: int = 100
+                  ) -> Dict[str, List[float]]:
+    """Download-if-missing + parse GloVe 6B vectors into
+    {word: [float] * dim} (news20.py:82)."""
+    zip_path = maybe_download("glove.6B.zip", source_dir, GLOVE_URL)
+    txt = os.path.join(source_dir, f"glove.6B.{dim}d.txt")
+    if not os.path.exists(txt):
+        with zipfile.ZipFile(zip_path) as z:
+            z.extract(f"glove.6B.{dim}d.txt", source_dir)
+    return parse_glove_txt(txt)
+
+
+def parse_glove_txt(path: str) -> Dict[str, List[float]]:
+    out = {}
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            parts = line.rstrip().split(" ")
+            if len(parts) > 1:
+                out[parts[0]] = [float(v) for v in parts[1:]]
+    return out
+
+
+# -------------------------------------------------------------- MovieLens
+
+def movielens_read_data_sets(data_dir: str) -> np.ndarray:
+    """Download-if-missing + parse MovieLens 1M ratings into an int
+    array [[user, item, rating, timestamp], ...] (movielens.py
+    read_data_sets; '::'-separated ratings.dat)."""
+    zip_path = maybe_download("ml-1m.zip", data_dir, MOVIELENS_URL)
+    extracted = os.path.join(data_dir, "ml-1m")
+    if not os.path.exists(extracted):
+        def _unzip(dst):
+            with zipfile.ZipFile(zip_path) as z:
+                z.extractall(dst)
+        _atomic_extract(extracted, _unzip)
+    return parse_movielens_ratings(os.path.join(extracted, "ratings.dat"))
+
+
+def parse_movielens_ratings(path: str) -> np.ndarray:
+    rows = []
+    with open(path, encoding="latin-1") as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                rows.append([int(v) for v in line.split("::")])
+    return np.asarray(rows, np.int64)

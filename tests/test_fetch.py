@@ -1,0 +1,119 @@
+"""Dataset fetch/prep helpers against local fixtures (reference:
+pyspark/bigdl/dataset/{mnist,news20,movielens}.py — download is
+maybe_download-gated, parsers are pure and tested offline)."""
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from bigdl_tpu.dataset.fetch import (extract_mnist_images,
+                                     extract_mnist_labels, maybe_download,
+                                     mnist_read_data_sets,
+                                     parse_glove_txt,
+                                     parse_movielens_ratings,
+                                     parse_news20_tree)
+
+
+def _write_idx(tmp_path, rng):
+    imgs = rng.randint(0, 255, (5, 28, 28), dtype=np.uint8)
+    lbls = rng.randint(0, 10, 5, dtype=np.uint8)
+    ip = tmp_path / "train-images-idx3-ubyte.gz"
+    lp = tmp_path / "train-labels-idx1-ubyte.gz"
+    with gzip.open(ip, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, 5, 28, 28))
+        f.write(imgs.tobytes())
+    with gzip.open(lp, "wb") as f:
+        f.write(struct.pack(">II", 2049, 5))
+        f.write(lbls.tobytes())
+    return imgs, lbls, ip, lp
+
+
+def test_mnist_idx_gzip_roundtrip(tmp_path):
+    rng = np.random.RandomState(0)
+    imgs, lbls, ip, lp = _write_idx(tmp_path, rng)
+    np.testing.assert_array_equal(extract_mnist_images(str(ip)), imgs)
+    np.testing.assert_array_equal(extract_mnist_labels(str(lp)), lbls)
+    # read_data_sets finds the pre-seeded files without any network
+    gi, gl = mnist_read_data_sets(str(tmp_path), "train")
+    np.testing.assert_array_equal(gi, imgs)
+    np.testing.assert_array_equal(gl, lbls)
+
+
+def test_maybe_download_skips_existing(tmp_path):
+    p = tmp_path / "cached.bin"
+    p.write_bytes(b"seeded")
+    # an invalid URL proves no network attempt happens for cached files
+    got = maybe_download("cached.bin", str(tmp_path),
+                         "http://invalid.invalid/cached.bin")
+    assert got == str(p) and p.read_bytes() == b"seeded"
+
+
+def test_news20_tree_parse(tmp_path):
+    for ci, cat in enumerate(("alt.atheism", "sci.space")):
+        d = tmp_path / cat
+        d.mkdir()
+        for j in range(2):
+            (d / f"{j}").write_text(f"doc {cat} {j}")
+    texts = parse_news20_tree(str(tmp_path))
+    assert len(texts) == 4
+    labels = sorted({lbl for _, lbl in texts})
+    assert labels == [1, 2]  # 1-based, sorted category order
+    assert any("sci.space" in t for t, lbl in texts if lbl == 2)
+
+
+def test_glove_txt_parse(tmp_path):
+    p = tmp_path / "glove.6B.50d.txt"
+    p.write_text("the 0.1 0.2 0.3\ncat -1.0 2.0 3.5\n")
+    w2v = parse_glove_txt(str(p))
+    assert w2v["cat"] == [-1.0, 2.0, 3.5]
+    assert len(w2v) == 2
+
+
+def test_movielens_ratings_parse(tmp_path):
+    p = tmp_path / "ratings.dat"
+    p.write_text("1::1193::5::978300760\n2::661::3::978302109\n")
+    arr = parse_movielens_ratings(str(p))
+    assert arr.shape == (2, 4)
+    assert arr[0].tolist() == [1, 1193, 5, 978300760]
+
+
+def test_atomic_extract_failure_leaves_nothing(tmp_path):
+    """An interrupted extraction must not pass the exists-skip guard
+    (a half-populated corpus would silently train truncated)."""
+    from bigdl_tpu.dataset.fetch import _atomic_extract
+
+    final = tmp_path / "corpus"
+
+    def boom(dst):
+        os.makedirs(os.path.join(dst, "partial"))
+        raise RuntimeError("disk full")
+
+    try:
+        _atomic_extract(str(final), boom)
+    except RuntimeError:
+        pass
+    assert not final.exists()
+    assert not any(p.name.startswith(".extract-")
+                   for p in tmp_path.iterdir())
+
+    def ok(dst):
+        d = os.path.join(dst, "root")
+        os.makedirs(d)
+        with open(os.path.join(d, "f.txt"), "w") as f:
+            f.write("x")
+
+    _atomic_extract(str(final), ok)
+    assert (final / "f.txt").read_text() == "x"
+
+
+def test_news20_skips_non_article_files(tmp_path):
+    from bigdl_tpu.dataset.fetch import parse_news20_tree
+
+    d = tmp_path / "sci.space"
+    d.mkdir()
+    (d / "12345").write_text("real article")
+    (d / ".DS_Store").write_text("junk")
+    (d / "backup~").write_text("junk")
+    texts = parse_news20_tree(str(tmp_path))
+    assert texts == [("real article", 1)]
