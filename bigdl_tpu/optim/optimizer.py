@@ -292,6 +292,19 @@ class Optimizer:
         from per-process local data."""
         return self.mesh is not None and jax.process_count() > 1
 
+    def _data_parallel(self) -> bool:
+        """True when the mesh actually splits the batch: a data axis of
+        size > 1 (a size-1 axis — what the recipe's mesh builder emits
+        when TP/PP consume every device — is the replicated regime)."""
+        return self.mesh.shape.get(self.data_axis, 1) > 1
+
+    def _batch_sharding(self):
+        """Batch layout on the mesh: sharded over the data axis when it
+        really splits, else replicated (pure TP/PP meshes)."""
+        spec = jax.sharding.PartitionSpec(self.data_axis) \
+            if self._data_parallel() else jax.sharding.PartitionSpec()
+        return jax.sharding.NamedSharding(self.mesh, spec)
+
     def _put_batch(self, arr):
         from bigdl_tpu.dataset.sample import HostBatchedCOO
         if isinstance(arr, HostBatchedCOO):
@@ -309,8 +322,14 @@ class Optimizer:
             idx = self._put_batch(arr.indices)
             return arr.to_bcoo(indices=idx, values=vals)
         if self.mesh is not None:
-            sh = jax.sharding.NamedSharding(
-                self.mesh, jax.sharding.PartitionSpec(self.data_axis))
+            sh = self._batch_sharding()
+            if self._multiprocess() and not self._data_parallel():
+                # pure TP/PP mesh (no data axis): the batch is
+                # REPLICATED and every process must feed the identical
+                # rows — cross-process model collectives then see one
+                # consistent batch (megatron's broadcast-input regime)
+                from bigdl_tpu.parallel.tp import put_global
+                return put_global(np.asarray(arr), sh)
             if self._multiprocess():
                 # each process contributes ITS batch rows; the global
                 # batch is their concatenation in process order (the
@@ -472,10 +491,8 @@ class Optimizer:
         fn = self._dc_eval[1] if (self._dc_eval is not None
                                   and self._dc_eval[0] is ds) else None
         if fn is None:
-            ev_sh = None
-            if self.mesh is not None:
-                ev_sh = jax.sharding.NamedSharding(
-                    self.mesh, jax.sharding.PartitionSpec(self.data_axis))
+            ev_sh = self._batch_sharding() if self.mesh is not None \
+                else None
 
             def _ev(p, m, start, images, labels):
                 x, y = ds.eval_batch_fn_on(images, labels, start)
@@ -560,10 +577,7 @@ class Optimizer:
         model_state = self._put_replicated(model_state)
 
         step = build_train_step(model, self.criterion, self.optim_method)
-        ev_sh = None
-        if self.mesh is not None:
-            ev_sh = jax.sharding.NamedSharding(
-                self.mesh, jax.sharding.PartitionSpec(self.data_axis))
+        ev_sh = self._batch_sharding() if self.mesh is not None else None
         eval_step = build_eval_step(model, ev_sh)
 
         ds_size = self.dataset.size()

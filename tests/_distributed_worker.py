@@ -108,6 +108,49 @@ def _imagefolder_mode(pid: int, folder: str):
                       "last_loss": opt.driver_state["Loss"]}))
 
 
+def _tp_mode(pid: int):
+    """Megatron TP on a PURE model mesh SPANNING two OS processes (4
+    devices = 2 from each): every tensor-parallel collective crosses
+    the real inter-process transport. The batch is replicated — both
+    processes feed the IDENTICAL rows (megatron's broadcast-input
+    regime, which Optimizer._put_batch now supports for meshes with no
+    data axis). The parent compares the final loss against a
+    single-process 4-device run of the same batches."""
+    import jax
+    import numpy as np
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+    from bigdl_tpu.models import TransformerLM
+    from bigdl_tpu.optim import SGD, max_iteration
+    from bigdl_tpu.optim.optimizer import Optimizer
+    from bigdl_tpu.parallel import make_mesh
+    from bigdl_tpu.utils.random import RandomGenerator
+
+    # the size-1 data axis is what the flagship recipe's mesh builder
+    # emits when TP consumes every device — it must route batches down
+    # the replicated regime, not the per-process-concat DP branch
+    mesh = make_mesh([1, 4], ["data", "model"], jax.devices())
+    rng = np.random.RandomState(11)
+    toks = rng.randint(0, 32, (32, 9))
+    samples = [Sample(toks[i, :-1].astype(np.int32),
+                      toks[i, 1:].astype(np.int32)) for i in range(32)]
+    ds = DataSet.array(samples).transform(SampleToMiniBatch(8))
+
+    RandomGenerator.set_seed(42)
+    lm = TransformerLM(vocab_size=32, hidden_size=16, num_layers=2,
+                       num_heads=4, max_len=8)
+    opt = Optimizer(lm, ds, nn.SequenceCrossEntropyCriterion(),
+                    batch_size=8, mesh=mesh,
+                    sharding_rules=lm.sharding_rules(model_axis="model"))
+    opt.set_optim_method(SGD(learning_rate=0.5))
+    opt.set_end_when(max_iteration(4))
+    opt.optimize()
+    print(json.dumps({"ok": True, "pid": pid,
+                      "last_loss": opt.driver_state["Loss"],
+                      "neval": opt.driver_state["neval"]}))
+
+
 def _rotate_mode(pid: int):
     """ShardRotator with slots sharded over a mesh SPANNING both
     processes: each process's provider returns its local shard rows,
@@ -172,7 +215,7 @@ def main():
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = (
         "--xla_force_host_platform_device_count="
-        + ("4" if mode != "smoke" else "1"))
+        + {"smoke": "1", "tp": "2"}.get(mode, "4"))
 
     import numpy as np
 
@@ -198,13 +241,15 @@ def main():
                                 initialization_timeout=60)
         assert jax.process_count() == 2, jax.process_count()
         assert Engine.node_number() == 2
-        if mode in ("optimizer", "imagefolder", "rotate"):
+        if mode in ("optimizer", "imagefolder", "rotate", "tp"):
             # bring-up succeeded: failures past this point are REAL
             # regressions and must crash the worker (SystemExit bypasses
             # the skip-catch below), not print a skip
             try:
                 if mode == "optimizer":
                     _optimizer_mode(pid)
+                elif mode == "tp":
+                    _tp_mode(pid)
                 elif mode == "rotate":
                     _rotate_mode(pid)
                 else:

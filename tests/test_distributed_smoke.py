@@ -293,3 +293,69 @@ def test_kill_worker_mid_training_resumes_to_same_loss(tmp_path):
                if l.startswith("[") and '"ok"' in l)
     for (pa, va), (pb, vb) in zip(la, lb):
         assert pa == pb and abs(va - vb) < 1e-6, (la, lb)
+
+
+def test_two_process_tensor_parallel_matches_single_process():
+    """Megatron TP whose model axis SPANS two OS processes: the
+    column/row-parallel collectives cross the real inter-process
+    transport, and training must match a single-process 4-device run
+    of the identical batches (the multi-host form of the dryrun's
+    dp x tp part — beyond-DP parallelism at true multi-host)."""
+    import numpy as np
+
+    port = _free_port()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))) + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen(
+        [sys.executable, _WORKER, str(port), str(i), "tp"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
+        for i in range(2)]
+    try:
+        outs = [p.communicate(timeout=300) for p in procs]
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.skip("distributed rendezvous timed out on this runtime")
+
+    results = []
+    for p, (out, err) in zip(procs, outs):
+        if p.returncode != 0:
+            pytest.fail(f"worker crashed (rc={p.returncode}):\n{err[-2000:]}")
+        line = [l for l in out.strip().splitlines()
+                if l.startswith("{")][-1]
+        results.append(json.loads(line))
+    if any("skip" in r for r in results):
+        pytest.skip(f"no cross-process CPU collectives: {results}")
+
+    # single-process oracle: same mesh shape, same batches
+    import jax
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+    from bigdl_tpu.models import TransformerLM
+    from bigdl_tpu.optim import SGD, max_iteration
+    from bigdl_tpu.optim.optimizer import Optimizer
+    from bigdl_tpu.parallel import make_mesh
+    from bigdl_tpu.utils.random import RandomGenerator
+
+    rng = np.random.RandomState(11)
+    toks = rng.randint(0, 32, (32, 9))
+    samples = [Sample(toks[i, :-1].astype(np.int32),
+                      toks[i, 1:].astype(np.int32)) for i in range(32)]
+    ds = DataSet.array(samples).transform(SampleToMiniBatch(8))
+    mesh = make_mesh([1, 4], ["data", "model"], jax.devices()[:4])
+    RandomGenerator.set_seed(42)
+    lm = TransformerLM(vocab_size=32, hidden_size=16, num_layers=2,
+                       num_heads=4, max_len=8)
+    opt = Optimizer(lm, ds, nn.SequenceCrossEntropyCriterion(),
+                    batch_size=8, mesh=mesh,
+                    sharding_rules=lm.sharding_rules(model_axis="model"))
+    opt.set_optim_method(SGD(learning_rate=0.5))
+    opt.set_end_when(max_iteration(4))
+    opt.optimize()
+    ref_loss = opt.driver_state["Loss"]
+
+    for r in results:
+        assert r["ok"] and r["neval"] == 5
+        np.testing.assert_allclose(r["last_loss"], ref_loss, atol=1e-5)
