@@ -151,6 +151,45 @@ def _tp_mode(pid: int):
                       "neval": opt.driver_state["neval"]}))
 
 
+def _pp_mode(pid: int):
+    """GPipe pipeline parallelism on a pipe axis SPANNING two OS
+    processes: the ppermute activation ring crosses the real
+    inter-process transport every microbatch hop. Batch replicated
+    (no data axis); the parent compares the final loss against a
+    single-process run of the identical batches."""
+    import jax
+    import numpy as np
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+    from bigdl_tpu.models import PipelinedTransformerLM
+    from bigdl_tpu.optim import SGD, max_iteration
+    from bigdl_tpu.optim.optimizer import Optimizer
+    from bigdl_tpu.parallel import make_mesh
+    from bigdl_tpu.utils.random import RandomGenerator
+
+    mesh = make_mesh([1, 4], ["data", "pipe"], jax.devices())
+    rng = np.random.RandomState(13)
+    toks = rng.randint(0, 32, (32, 9))
+    samples = [Sample(toks[i, :-1].astype(np.int32),
+                      toks[i, 1:].astype(np.int32)) for i in range(32)]
+    ds = DataSet.array(samples).transform(SampleToMiniBatch(8))
+
+    RandomGenerator.set_seed(42)
+    lm = PipelinedTransformerLM(vocab_size=32, hidden_size=16,
+                                num_layers=4, num_heads=2, max_len=8,
+                                n_microbatches=4, mesh=mesh)
+    opt = Optimizer(lm, ds, nn.SequenceCrossEntropyCriterion(),
+                    batch_size=8, mesh=mesh,
+                    sharding_rules=lm.sharding_rules())
+    opt.set_optim_method(SGD(learning_rate=0.5))
+    opt.set_end_when(max_iteration(4))
+    opt.optimize()
+    print(json.dumps({"ok": True, "pid": pid,
+                      "last_loss": opt.driver_state["Loss"],
+                      "neval": opt.driver_state["neval"]}))
+
+
 def _rotate_mode(pid: int):
     """ShardRotator with slots sharded over a mesh SPANNING both
     processes: each process's provider returns its local shard rows,
@@ -215,7 +254,7 @@ def main():
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = (
         "--xla_force_host_platform_device_count="
-        + {"smoke": "1", "tp": "2"}.get(mode, "4"))
+        + {"smoke": "1", "tp": "2", "pp": "2"}.get(mode, "4"))
 
     import numpy as np
 
@@ -241,7 +280,7 @@ def main():
                                 initialization_timeout=60)
         assert jax.process_count() == 2, jax.process_count()
         assert Engine.node_number() == 2
-        if mode in ("optimizer", "imagefolder", "rotate", "tp"):
+        if mode in ("optimizer", "imagefolder", "rotate", "tp", "pp"):
             # bring-up succeeded: failures past this point are REAL
             # regressions and must crash the worker (SystemExit bypasses
             # the skip-catch below), not print a skip
@@ -250,6 +289,8 @@ def main():
                     _optimizer_mode(pid)
                 elif mode == "tp":
                     _tp_mode(pid)
+                elif mode == "pp":
+                    _pp_mode(pid)
                 elif mode == "rotate":
                     _rotate_mode(pid)
                 else:

@@ -25,17 +25,21 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_engine_psum_and_dp_step():
+def _run_workers(mode=None, extra_args=(), timeout=300, nproc=2):
+    """Spawn the two-process worker in ``mode`` and return the parsed
+    per-worker JSON results; skips when the runtime lacks cross-process
+    collectives or the rendezvous times out."""
     port = _free_port()
     env = dict(os.environ)
     env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))) + os.pathsep + env.get("PYTHONPATH", "")
+    argv_tail = ([mode] if mode else []) + [str(a) for a in extra_args]
     procs = [subprocess.Popen(
-        [sys.executable, _WORKER, str(port), str(i)],
+        [sys.executable, _WORKER, str(port), str(i)] + argv_tail,
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
-        for i in range(2)]
+        for i in range(nproc)]
     try:
-        outs = [p.communicate(timeout=240) for p in procs]
+        outs = [p.communicate(timeout=timeout) for p in procs]
     except subprocess.TimeoutExpired:
         for p in procs:
             p.kill()
@@ -45,13 +49,17 @@ def test_two_process_engine_psum_and_dp_step():
     for p, (out, err) in zip(procs, outs):
         if p.returncode != 0:
             pytest.fail(f"worker crashed (rc={p.returncode}):\n{err[-2000:]}")
-        line = [l for l in out.strip().splitlines()
-                if l.startswith("{")][-1]
-        results.append(json.loads(line))
-
+        lines = [l for l in out.strip().splitlines() if l.startswith("{")]
+        if not lines:
+            pytest.fail(f"worker produced no JSON:\n{out[-2000:]}")
+        results.append(json.loads(lines[-1]))
     if any("skip" in r for r in results):
         pytest.skip(f"no cross-process CPU collectives: {results}")
+    return results
 
+
+def test_two_process_engine_psum_and_dp_step():
+    results = _run_workers(timeout=240)
     for r in results:
         assert r["ok"] and r["psum"] == 3.0
     # both processes computed the identical replicated weight
@@ -68,30 +76,7 @@ def test_two_process_distri_optimizer_matches_single_process():
     replicated — the match proves both equivalences at once."""
     import numpy as np
 
-    port = _free_port()
-    env = dict(os.environ)
-    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))) + os.pathsep + env.get("PYTHONPATH", "")
-    procs = [subprocess.Popen(
-        [sys.executable, _WORKER, str(port), str(i), "optimizer"],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
-        for i in range(2)]
-    try:
-        outs = [p.communicate(timeout=300) for p in procs]
-    except subprocess.TimeoutExpired:
-        for p in procs:
-            p.kill()
-        pytest.skip("distributed rendezvous timed out on this runtime")
-
-    results = []
-    for p, (out, err) in zip(procs, outs):
-        if p.returncode != 0:
-            pytest.fail(f"worker crashed (rc={p.returncode}):\n{err[-2000:]}")
-        line = [l for l in out.strip().splitlines()
-                if l.startswith("{")][-1]
-        results.append(json.loads(line))
-    if any("skip" in r for r in results):
-        pytest.skip(f"no cross-process CPU collectives: {results}")
+    results = _run_workers("optimizer")
 
     # single-process reference on the same global batches: global batch
     # i is concat(proc0 batch i, proc1 batch i), so order the samples as
@@ -181,31 +166,7 @@ def test_two_process_imagefolder_reader_sharding(tmp_path):
             Image.fromarray(rng.randint(0, 255, (20, 20, 3), np.uint8)) \
                 .save(d / f"{i}.jpg")
 
-    port = _free_port()
-    env = dict(os.environ)
-    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))) + os.pathsep + env.get("PYTHONPATH", "")
-    procs = [subprocess.Popen(
-        [sys.executable, _WORKER, str(port), str(i), "imagefolder",
-         str(tmp_path)],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
-        for i in range(2)]
-    try:
-        outs = [p.communicate(timeout=300) for p in procs]
-    except subprocess.TimeoutExpired:
-        for p in procs:
-            p.kill()
-        pytest.skip("distributed rendezvous timed out on this runtime")
-
-    results = []
-    for p, (out, err) in zip(procs, outs):
-        if p.returncode != 0:
-            pytest.fail(f"worker crashed (rc={p.returncode}):\n{err[-2000:]}")
-        line = [l for l in out.strip().splitlines()
-                if l.startswith("{")][-1]
-        results.append(json.loads(line))
-    if any("skip" in r for r in results):
-        pytest.skip(f"no cross-process CPU collectives: {results}")
+    results = _run_workers("imagefolder", extra_args=(tmp_path,))
     for r in results:
         assert r["ok"] and np.isfinite(r["last_loss"])
     # synchronous DP: both processes observed the same global loss
@@ -216,29 +177,7 @@ def test_two_process_shard_rotation_on_spanning_mesh():
     """Rotating HBM slots sharded across BOTH processes: per-process
     shard providers, global piece assembly, argument-rebind swaps —
     the pod-scale rotating-cache composition end to end."""
-    port = _free_port()
-    env = dict(os.environ)
-    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))) + os.pathsep + env.get("PYTHONPATH", "")
-    procs = [subprocess.Popen(
-        [sys.executable, _WORKER, str(port), str(i), "rotate"],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
-        for i in range(2)]
-    try:
-        outs = [p.communicate(timeout=300) for p in procs]
-    except subprocess.TimeoutExpired:
-        for p in procs:
-            p.kill()
-        pytest.skip("distributed rendezvous timed out on this runtime")
-    results = []
-    for p, (out, err) in zip(procs, outs):
-        if p.returncode != 0:
-            pytest.fail(f"worker crashed (rc={p.returncode}):\n{err[-2000:]}")
-        line = [l for l in out.strip().splitlines()
-                if l.startswith("{")][-1]
-        results.append(json.loads(line))
-    if any("skip" in r for r in results):
-        pytest.skip(f"no cross-process CPU collectives: {results}")
+    results = _run_workers("rotate")
     for r in results:
         assert r["ok"] and r["means"] == [8.5, 108.5, 208.5]
 
@@ -303,30 +242,7 @@ def test_two_process_tensor_parallel_matches_single_process():
     dp x tp part — beyond-DP parallelism at true multi-host)."""
     import numpy as np
 
-    port = _free_port()
-    env = dict(os.environ)
-    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))) + os.pathsep + env.get("PYTHONPATH", "")
-    procs = [subprocess.Popen(
-        [sys.executable, _WORKER, str(port), str(i), "tp"],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env)
-        for i in range(2)]
-    try:
-        outs = [p.communicate(timeout=300) for p in procs]
-    except subprocess.TimeoutExpired:
-        for p in procs:
-            p.kill()
-        pytest.skip("distributed rendezvous timed out on this runtime")
-
-    results = []
-    for p, (out, err) in zip(procs, outs):
-        if p.returncode != 0:
-            pytest.fail(f"worker crashed (rc={p.returncode}):\n{err[-2000:]}")
-        line = [l for l in out.strip().splitlines()
-                if l.startswith("{")][-1]
-        results.append(json.loads(line))
-    if any("skip" in r for r in results):
-        pytest.skip(f"no cross-process CPU collectives: {results}")
+    results = _run_workers("tp")
 
     # single-process oracle: same mesh shape, same batches
     import jax
@@ -351,6 +267,48 @@ def test_two_process_tensor_parallel_matches_single_process():
     opt = Optimizer(lm, ds, nn.SequenceCrossEntropyCriterion(),
                     batch_size=8, mesh=mesh,
                     sharding_rules=lm.sharding_rules(model_axis="model"))
+    opt.set_optim_method(SGD(learning_rate=0.5))
+    opt.set_end_when(max_iteration(4))
+    opt.optimize()
+    ref_loss = opt.driver_state["Loss"]
+
+    for r in results:
+        assert r["ok"] and r["neval"] == 5
+        np.testing.assert_allclose(r["last_loss"], ref_loss, atol=1e-5)
+
+
+def test_two_process_pipeline_parallel_matches_single_process():
+    """GPipe PP whose pipe axis SPANS two OS processes: the ppermute
+    activation ring crosses the inter-process transport every
+    microbatch hop, and training must match a single-process run of
+    the identical batches."""
+    import numpy as np
+
+    results = _run_workers("pp")
+
+    import jax
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+    from bigdl_tpu.models import PipelinedTransformerLM
+    from bigdl_tpu.optim import SGD, max_iteration
+    from bigdl_tpu.optim.optimizer import Optimizer
+    from bigdl_tpu.parallel import make_mesh
+    from bigdl_tpu.utils.random import RandomGenerator
+
+    rng = np.random.RandomState(13)
+    toks = rng.randint(0, 32, (32, 9))
+    samples = [Sample(toks[i, :-1].astype(np.int32),
+                      toks[i, 1:].astype(np.int32)) for i in range(32)]
+    ds = DataSet.array(samples).transform(SampleToMiniBatch(8))
+    mesh = make_mesh([1, 4], ["data", "pipe"], jax.devices()[:4])
+    RandomGenerator.set_seed(42)
+    lm = PipelinedTransformerLM(vocab_size=32, hidden_size=16,
+                                num_layers=4, num_heads=2, max_len=8,
+                                n_microbatches=4, mesh=mesh)
+    opt = Optimizer(lm, ds, nn.SequenceCrossEntropyCriterion(),
+                    batch_size=8, mesh=mesh,
+                    sharding_rules=lm.sharding_rules())
     opt.set_optim_method(SGD(learning_rate=0.5))
     opt.set_end_when(max_iteration(4))
     opt.optimize()
