@@ -108,86 +108,75 @@ def _imagefolder_mode(pid: int, folder: str):
                       "last_loss": opt.driver_state["Loss"]}))
 
 
-def _tp_mode(pid: int):
-    """Megatron TP on a PURE model mesh SPANNING two OS processes (4
-    devices = 2 from each): every tensor-parallel collective crosses
-    the real inter-process transport. The batch is replicated — both
-    processes feed the IDENTICAL rows (megatron's broadcast-input
-    regime, which Optimizer._put_batch now supports for meshes with no
-    data axis). The parent compares the final loss against a
-    single-process 4-device run of the same batches."""
-    import jax
+def run_parallel_case(kind: str, devices):
+    """ONE definition of the TP/PP equivalence case, imported by both
+    the worker (spanning mesh over ``jax.devices()``) and the parent
+    test's single-process oracle (local devices) — hyperparameters and
+    data cannot drift between the two sides. Returns driver_state.
+
+    tp: megatron TP on a [1, 4] ("data","model") mesh — the size-1
+    data axis is what the flagship recipe's mesh builder emits when TP
+    consumes every device, so batches must route down the replicated
+    regime, not the per-process-concat DP branch.
+    pp: GPipe on a [1, 4] ("data","pipe") mesh — the ppermute
+    activation ring crosses whatever transport separates the devices.
+    """
     import numpy as np
 
     import bigdl_tpu.nn as nn
     from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
-    from bigdl_tpu.models import TransformerLM
     from bigdl_tpu.optim import SGD, max_iteration
     from bigdl_tpu.optim.optimizer import Optimizer
     from bigdl_tpu.parallel import make_mesh
     from bigdl_tpu.utils.random import RandomGenerator
 
-    # the size-1 data axis is what the flagship recipe's mesh builder
-    # emits when TP consumes every device — it must route batches down
-    # the replicated regime, not the per-process-concat DP branch
-    mesh = make_mesh([1, 4], ["data", "model"], jax.devices())
-    rng = np.random.RandomState(11)
+    if kind == "tp":
+        from bigdl_tpu.models import TransformerLM
+        mesh = make_mesh([1, 4], ["data", "model"], devices)
+        seed = 11
+
+        def build():
+            lm = TransformerLM(vocab_size=32, hidden_size=16,
+                               num_layers=2, num_heads=4, max_len=8)
+            return lm, lm.sharding_rules(model_axis="model")
+    else:
+        from bigdl_tpu.models import PipelinedTransformerLM
+        mesh = make_mesh([1, 4], ["data", "pipe"], devices)
+        seed = 13
+
+        def build():
+            lm = PipelinedTransformerLM(vocab_size=32, hidden_size=16,
+                                        num_layers=4, num_heads=2,
+                                        max_len=8, n_microbatches=4,
+                                        mesh=mesh)
+            return lm, lm.sharding_rules()
+
+    rng = np.random.RandomState(seed)
     toks = rng.randint(0, 32, (32, 9))
     samples = [Sample(toks[i, :-1].astype(np.int32),
                       toks[i, 1:].astype(np.int32)) for i in range(32)]
     ds = DataSet.array(samples).transform(SampleToMiniBatch(8))
 
     RandomGenerator.set_seed(42)
-    lm = TransformerLM(vocab_size=32, hidden_size=16, num_layers=2,
-                       num_heads=4, max_len=8)
+    lm, rules = build()
     opt = Optimizer(lm, ds, nn.SequenceCrossEntropyCriterion(),
-                    batch_size=8, mesh=mesh,
-                    sharding_rules=lm.sharding_rules(model_axis="model"))
+                    batch_size=8, mesh=mesh, sharding_rules=rules)
     opt.set_optim_method(SGD(learning_rate=0.5))
     opt.set_end_when(max_iteration(4))
     opt.optimize()
-    print(json.dumps({"ok": True, "pid": pid,
-                      "last_loss": opt.driver_state["Loss"],
-                      "neval": opt.driver_state["neval"]}))
+    return opt.driver_state
 
 
-def _pp_mode(pid: int):
-    """GPipe pipeline parallelism on a pipe axis SPANNING two OS
-    processes: the ppermute activation ring crosses the real
-    inter-process transport every microbatch hop. Batch replicated
-    (no data axis); the parent compares the final loss against a
-    single-process run of the identical batches."""
+def _tp_or_pp_mode(pid: int, kind: str):
+    """TP/PP whose parallel axis SPANS two OS processes: every
+    collective crosses the real inter-process transport; the batch is
+    replicated (both processes feed identical rows)."""
     import jax
-    import numpy as np
 
-    import bigdl_tpu.nn as nn
-    from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
-    from bigdl_tpu.models import PipelinedTransformerLM
-    from bigdl_tpu.optim import SGD, max_iteration
-    from bigdl_tpu.optim.optimizer import Optimizer
-    from bigdl_tpu.parallel import make_mesh
-    from bigdl_tpu.utils.random import RandomGenerator
-
-    mesh = make_mesh([1, 4], ["data", "pipe"], jax.devices())
-    rng = np.random.RandomState(13)
-    toks = rng.randint(0, 32, (32, 9))
-    samples = [Sample(toks[i, :-1].astype(np.int32),
-                      toks[i, 1:].astype(np.int32)) for i in range(32)]
-    ds = DataSet.array(samples).transform(SampleToMiniBatch(8))
-
-    RandomGenerator.set_seed(42)
-    lm = PipelinedTransformerLM(vocab_size=32, hidden_size=16,
-                                num_layers=4, num_heads=2, max_len=8,
-                                n_microbatches=4, mesh=mesh)
-    opt = Optimizer(lm, ds, nn.SequenceCrossEntropyCriterion(),
-                    batch_size=8, mesh=mesh,
-                    sharding_rules=lm.sharding_rules())
-    opt.set_optim_method(SGD(learning_rate=0.5))
-    opt.set_end_when(max_iteration(4))
-    opt.optimize()
+    state = run_parallel_case(kind, jax.devices())
     print(json.dumps({"ok": True, "pid": pid,
-                      "last_loss": opt.driver_state["Loss"],
-                      "neval": opt.driver_state["neval"]}))
+                      "last_loss": state["Loss"],
+                      "neval": state["neval"]}))
 
 
 def _rotate_mode(pid: int):
@@ -280,6 +269,10 @@ def main():
                                 initialization_timeout=60)
         assert jax.process_count() == 2, jax.process_count()
         assert Engine.node_number() == 2
+        # the harness distinguishes "runtime lacks collectives" (no
+        # marker -> skip) from "post-rendezvous deadlock" (marker then
+        # timeout -> FAIL)
+        print(f"RENDEZVOUS_OK {pid}", flush=True)
         if mode in ("optimizer", "imagefolder", "rotate", "tp", "pp"):
             # bring-up succeeded: failures past this point are REAL
             # regressions and must crash the worker (SystemExit bypasses
@@ -287,10 +280,8 @@ def main():
             try:
                 if mode == "optimizer":
                     _optimizer_mode(pid)
-                elif mode == "tp":
-                    _tp_mode(pid)
-                elif mode == "pp":
-                    _pp_mode(pid)
+                elif mode in ("tp", "pp"):
+                    _tp_or_pp_mode(pid, mode)
                 elif mode == "rotate":
                     _rotate_mode(pid)
                 else:

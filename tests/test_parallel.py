@@ -140,7 +140,6 @@ def test_dp_tp_train_step(devices8):
 def test_sp_ring_train_step(devices8):
     """Sequence-parallel training: mesh (data=2, seq=4), ring attention
     inside shard_map, gradients match the unsharded reference."""
-    from jax.experimental.shard_map import shard_map
     from bigdl_tpu.models import TransformerLM
 
     mesh = make_mesh([2, 4], ["data", "seq"], devices8)
@@ -172,10 +171,11 @@ def test_sp_ring_train_step(devices8):
         def inner(p, tok):
             pos0 = jax.lax.axis_index("seq") * tok.shape[1]
             return fwd(p, tok, pos0)
-        return shard_map(
+        return jax.jit(jax.shard_map(
             inner, mesh=mesh,
             in_specs=(P(), P("data", "seq")),
-            out_specs=P("data", "seq", None), check_rep=False)(p, tokens)
+            out_specs=P("data", "seq", None),
+            check_vma=False))(p, tokens)
 
     out = np.asarray(sharded_fwd(params, jnp.asarray(tokens)))
     np.testing.assert_allclose(out, ref, atol=3e-4)
