@@ -162,9 +162,23 @@ def run_parallel_case(kind: str, devices):
     opt = Optimizer(lm, ds, nn.SequenceCrossEntropyCriterion(),
                     batch_size=8, mesh=mesh, sharding_rules=rules)
     opt.set_optim_method(SGD(learning_rate=0.5))
-    opt.set_end_when(max_iteration(4))
+    opt.set_end_when(_step_marker(max_iteration(4)))
     opt.optimize()
     return opt.driver_state
+
+
+def _step_marker(base_trigger):
+    """Wrap an end trigger to print STEP_OK once the first training
+    step completed — the harness uses it to tell a mid-run collective
+    deadlock (FAIL) from a slow compile on a loaded host (skip)."""
+    state_seen = {"printed": False}
+
+    def trig(state):
+        if state["neval"] > 1 and not state_seen["printed"]:
+            print("STEP_OK", flush=True)
+            state_seen["printed"] = True
+        return base_trigger(state)
+    return trig
 
 
 def _tp_or_pp_mode(pid: int, kind: str):
@@ -174,6 +188,68 @@ def _tp_or_pp_mode(pid: int, kind: str):
     import jax
 
     state = run_parallel_case(kind, jax.devices())
+    print(json.dumps({"ok": True, "pid": pid,
+                      "last_loss": state["Loss"],
+                      "neval": state["neval"]}))
+
+
+def run_sparse_case(pid_or_none, devices):
+    """Shared sparse-feed case (SparseMiniBatch at multi-host): COO
+    samples with FIXED-nnz padding feed SparseLinear over a spanning
+    data mesh. Worker passes its process id (feeds its half of the
+    global batch); the single-process oracle passes None (feeds all
+    rows as interleaved per-process blocks). Returns driver_state."""
+    import numpy as np
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import (DataSet, PaddingParam, Sample,
+                                   SampleToMiniBatch, SparseFeature)
+    from bigdl_tpu.optim import SGD, max_iteration
+    from bigdl_tpu.optim.optimizer import Optimizer
+    from bigdl_tpu.parallel import make_mesh
+    from bigdl_tpu.utils.random import RandomGenerator
+
+    mesh = make_mesh([len(devices)], ["data"], devices)
+    rng = np.random.RandomState(17)
+    dim = 32
+    hots = [rng.choice(dim, size=rng.randint(1, 4), replace=False)
+            for _ in range(32)]
+    labels = [float(h[0] % 2 + 1) for h in hots]
+    all_samples = [Sample(
+        SparseFeature(h[:, None], np.ones(len(h), np.float32), (dim,)),
+        labels[i]) for i, h in enumerate(hots)]
+    if pid_or_none is None:
+        # oracle: global batch i = concat(p0 batch i, p1 batch i)
+        order = []
+        for i in range(4):
+            order += list(range(i * 4, i * 4 + 4))
+            order += list(range(16 + i * 4, 16 + i * 4 + 4))
+        samples, bs = [all_samples[i] for i in order], 8
+    else:
+        lo = pid_or_none * 16
+        samples, bs = all_samples[lo:lo + 16], 4
+    pad = PaddingParam(fixed_length=4)
+    ds = DataSet.array(samples).transform(
+        SampleToMiniBatch(bs, feature_padding=pad))
+
+    RandomGenerator.set_seed(42)
+    model = nn.Sequential().add(nn.SparseLinear(dim, 2)) \
+        .add(nn.LogSoftMax())
+    opt = Optimizer(model, ds, nn.ClassNLLCriterion(), batch_size=bs,
+                    mesh=mesh)
+    opt.set_optim_method(SGD(learning_rate=0.5))
+    opt.set_end_when(_step_marker(max_iteration(4)))
+    opt.optimize()
+    return opt.driver_state
+
+
+def _sparse_mode(pid: int):
+    """SparseMiniBatch feed over a mesh spanning two OS processes:
+    fixed-nnz COO batches assemble into global BCOOs whose leaves shard
+    over the cross-process data axis."""
+    import jax
+
+    state = run_sparse_case(pid, jax.devices())
     print(json.dumps({"ok": True, "pid": pid,
                       "last_loss": state["Loss"],
                       "neval": state["neval"]}))
@@ -273,7 +349,8 @@ def main():
         # marker -> skip) from "post-rendezvous deadlock" (marker then
         # timeout -> FAIL)
         print(f"RENDEZVOUS_OK {pid}", flush=True)
-        if mode in ("optimizer", "imagefolder", "rotate", "tp", "pp"):
+        if mode in ("optimizer", "imagefolder", "rotate", "tp", "pp",
+                    "sparse"):
             # bring-up succeeded: failures past this point are REAL
             # regressions and must crash the worker (SystemExit bypasses
             # the skip-catch below), not print a skip
@@ -282,6 +359,8 @@ def main():
                     _optimizer_mode(pid)
                 elif mode in ("tp", "pp"):
                     _tp_or_pp_mode(pid, mode)
+                elif mode == "sparse":
+                    _sparse_mode(pid)
                 elif mode == "rotate":
                     _rotate_mode(pid)
                 else:

@@ -28,9 +28,10 @@ def _free_port() -> int:
 def _run_workers(mode=None, extra_args=(), timeout=300):
     """Spawn the two-process worker in ``mode`` and return the parsed
     per-worker JSON results. Skips when the runtime lacks cross-process
-    collectives or the RENDEZVOUS times out; a timeout AFTER the worker
-    printed its rendezvous marker is a post-bring-up deadlock and FAILS
-    (a hung collective must not read as an environment skip)."""
+    collectives or rendezvous/compile time out; a timeout AFTER a
+    worker completed training steps (its STEP_OK marker) is a mid-run
+    collective deadlock and FAILS with both workers' output (a hung
+    collective must not read as an environment skip)."""
     port = _free_port()
     env = dict(os.environ)
     env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
@@ -45,11 +46,21 @@ def _run_workers(mode=None, extra_args=(), timeout=300):
     except subprocess.TimeoutExpired:
         for p in procs:
             p.kill()
-        tails = [p.communicate()[0] for p in procs]
-        if any("RENDEZVOUS_OK" in t for t in tails):
-            pytest.fail("workers rendezvoused but then hung — "
-                        "post-bring-up deadlock, not an environment skip")
-        pytest.skip("distributed rendezvous timed out on this runtime")
+        tails = [p.communicate() for p in procs]
+        if any("STEP_OK" in t[0] for t in tails):
+            # at least one worker got PAST compilation and completed
+            # training steps, then the gang hung — a real collective
+            # deadlock, not environment slowness (slow compile on a
+            # loaded host prints RENDEZVOUS_OK but no STEP_OK and
+            # still skips)
+            dump = "\n".join(
+                f"--- worker {i} stdout ---\n{t[0][-2000:]}\n"
+                f"--- worker {i} stderr ---\n{t[1][-2000:]}"
+                for i, t in enumerate(tails))
+            pytest.fail("workers trained past compile but then hung — "
+                        f"collective deadlock:\n{dump}")
+        pytest.skip("distributed rendezvous/compile timed out on this "
+                    "runtime")
 
     results = []
     for p, (out, err) in zip(procs, outs):
@@ -277,6 +288,27 @@ def test_two_process_pipeline_parallel_matches_single_process():
     import _distributed_worker as W
 
     ref_loss = W.run_parallel_case("pp", jax.devices()[:4])["Loss"]
+
+    for r in results:
+        assert r["ok"] and r["neval"] == 5
+        np.testing.assert_allclose(r["last_loss"], ref_loss, atol=1e-5)
+
+
+def test_two_process_sparse_feed_matches_single_process():
+    """SparseMiniBatch at TRUE multi-host: fixed-nnz COO batches from
+    two OS processes assemble into global BCOOs sharded over the
+    spanning data axis, and training matches a single-process run of
+    the identical global batches (the multi-host half of the sparse
+    feed — the fixed-nnz requirement exists exactly for this)."""
+    import numpy as np
+
+    results = _run_workers("sparse")
+
+    import jax
+
+    import _distributed_worker as W
+
+    ref_loss = W.run_sparse_case(None, jax.devices()[:8])["Loss"]
 
     for r in results:
         assert r["ok"] and r["neval"] == 5
