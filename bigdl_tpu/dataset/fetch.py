@@ -29,16 +29,21 @@ import numpy as np
 # yann.lecun.com has 403'd for years (the reference's URL is dead);
 # the ossci mirror serves the identical files
 MNIST_URL = "https://ossci-datasets.s3.amazonaws.com/mnist/"
+# https where the hosts support it (qwone.com is plain-http only; pin a
+# sha256 there or pre-seed the file when transport integrity matters)
 NEWS20_URL = ("http://qwone.com/~jason/20Newsgroups/"
               "20news-19997.tar.gz")
-GLOVE_URL = "http://nlp.stanford.edu/data/glove.6B.zip"
-MOVIELENS_URL = "http://files.grouplens.org/datasets/movielens/ml-1m.zip"
+GLOVE_URL = "https://nlp.stanford.edu/data/glove.6B.zip"
+MOVIELENS_URL = "https://files.grouplens.org/datasets/movielens/ml-1m.zip"
 
 
-def maybe_download(filename: str, work_dir: str, source_url: str) -> str:
+def maybe_download(filename: str, work_dir: str, source_url: str,
+                   sha256: str = None) -> str:
     """Download ``source_url`` into ``work_dir/filename`` unless it is
     already there (base.py:176). Offline environments pre-seed the file
-    and never hit the network."""
+    and never hit the network. When ``sha256`` is given the download is
+    verified before it is moved into place (a corrupt or tampered file
+    never lands under the cache name)."""
     os.makedirs(work_dir, exist_ok=True)
     filepath = os.path.join(work_dir, filename)
     if not os.path.exists(filepath):
@@ -46,6 +51,17 @@ def maybe_download(filename: str, work_dir: str, source_url: str) -> str:
         print(f"downloading {source_url} -> {filepath}")
         tmp = filepath + ".part"
         urlretrieve(source_url, tmp)
+        if sha256 is not None:
+            import hashlib
+            h = hashlib.sha256()
+            with open(tmp, "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    h.update(chunk)
+            if h.hexdigest() != sha256:
+                os.remove(tmp)
+                raise IOError(
+                    f"{source_url}: sha256 mismatch "
+                    f"(got {h.hexdigest()}, want {sha256})")
         os.replace(tmp, filepath)
     return filepath
 

@@ -249,6 +249,18 @@ class Optimizer:
     def set_validation(self, trigger: Trigger, dataset: AbstractDataSet,
                        methods: Sequence[ValidationMethod],
                        batch_size: Optional[int] = None) -> "Optimizer":
+        # a DeviceCachedArrayDataSet bakes its batch size into the
+        # compiled sample+forward — a conflicting request would be
+        # silently dropped, so reject it up front, BEFORE any state
+        # mutation (a caller catching the error keeps its old config)
+        ds_bs = getattr(dataset, "batch_size", None)
+        if batch_size is not None and ds_bs is not None \
+                and hasattr(dataset, "eval_batch_fn_on") \
+                and batch_size != ds_bs:
+            raise ValueError(
+                f"device-cached validation runs at the dataset's own "
+                f"batch_size={ds_bs}; got conflicting batch_size="
+                f"{batch_size} (omit it or rebuild the dataset)")
         self.validation_trigger = trigger
         self.validation_dataset = dataset
         self.validation_methods = list(methods)

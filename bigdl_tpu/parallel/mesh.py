@@ -55,7 +55,7 @@ def resolve_axis_mesh(mesh: Optional[Mesh], axis: str) -> Optional[Mesh]:
     return None
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=32)
 def seq_sharded_attention(kern, mesh: Mesh, seq_axis: str, causal: bool):
     """Jitted partial-manual shard_map wrapper for a sequence-parallel
     attention kernel (``ring_attention`` / ``ulysses_attention``):

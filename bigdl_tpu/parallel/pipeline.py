@@ -71,7 +71,10 @@ def spmd_pipeline(block_fn: Callable, stage_params, x, *,
     return jax.lax.psum(out, axis_name)
 
 
-@functools.lru_cache(maxsize=None)
+# bounded: entries key on bound methods, pinning the model instance and
+# its compiled executable — unbounded growth across repeated model
+# construction (tests, sweeps) would leak host memory
+@functools.lru_cache(maxsize=32)
 def _pipeline_callable(block_fn: Callable, mesh: Mesh, axis_name: str,
                        n_stages: int):
     """Cached jitted partial-manual pipeline over ``axis_name``.

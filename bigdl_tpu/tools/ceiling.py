@@ -26,6 +26,7 @@ Usage: python -m bigdl_tpu.tools.ceiling <mode> [iters]
 """
 import functools
 import json
+import math
 import os
 import sys
 import time
@@ -79,10 +80,20 @@ def mfu_fields(rate_per_sec, per_item_flops=None):
     chunk's analytic flops (fallback: caller-supplied per-item flops).
 
     XLA's cost_analysis counts a scan BODY once, not times its length
-    (verified), so the reported chunk flops are one step's — divide by
-    BATCH alone."""
-    if _FLOPS["per_chunk"] is not None:
-        tfs = _FLOPS["per_chunk"] / BATCH * rate_per_sec / 1e12
+    (verified on this backend) — but that is backend/version-dependent,
+    so when the caller supplies a hand-computed per-item estimate we use
+    it to pick the interpretation (body-once vs body×SCAN) closest to
+    it, and fall back to the estimate outright when neither is within
+    4× (a silently-wrong convention would inflate MFU by SCAN×)."""
+    if _FLOPS["per_chunk"] is not None and _FLOPS["per_chunk"] > 0:
+        per_item = _FLOPS["per_chunk"] / BATCH  # body counted once
+        if per_item_flops:
+            cands = (per_item, _FLOPS["per_chunk"] / (BATCH * SCAN))
+            per_item = min(cands,
+                           key=lambda c: abs(math.log(c / per_item_flops)))
+            if not 0.25 < per_item / per_item_flops < 4.0:
+                per_item = per_item_flops
+        tfs = per_item * rate_per_sec / 1e12
     elif per_item_flops:
         tfs = per_item_flops * rate_per_sec / 1e12
     else:

@@ -226,6 +226,15 @@ class _Fuser:
                 i += 1
             elif op in ("FusedBatchNorm", "FusedBatchNormV2",
                         "FusedBatchNormV3"):
+                # is_training=True means TF ignores the mean/var const
+                # inputs (batch stats instead) — fusing those consts in
+                # would silently diverge from the graph; NCHW would put
+                # the stats on the wrong channel axis. Fail fast to
+                # TFModule like Conv2D/pooling do. NOTE: the op-def
+                # DEFAULT for is_training is True, so an absent attr is
+                # training mode too — only an explicit False may fuse.
+                _require(node, "is_training", (False,))
+                _require(node, "data_format", ("NHWC", None))
                 scale = self.const(node.inputs[1])
                 offset = self.const(node.inputs[2])
                 mean = self.const(node.inputs[3])
