@@ -19,6 +19,9 @@ from bigdl_tpu.utils.random import RandomGenerator
 
 
 class AbstractDataSet:
+    """DataSet contract (dataset/DataSet.scala:48): ``data(train)``
+    yields elements, ``size``/``shuffle``/``transform`` mirror the
+    reference's RDD-backed surface."""
     def data(self, train: bool) -> Iterator:
         raise NotImplementedError
 
@@ -62,6 +65,8 @@ class LocalDataSet(AbstractDataSet):
 
 
 class TransformedDataSet(AbstractDataSet):
+    """A dataset viewed through a Transformer chain
+    (DataSet.scala:146 ``transform``)."""
     def __init__(self, base: AbstractDataSet, transformer: Transformer):
         self.base = base
         self.transformer = transformer

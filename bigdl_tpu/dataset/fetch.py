@@ -35,6 +35,10 @@ NEWS20_URL = ("http://qwone.com/~jason/20Newsgroups/"
               "20news-19997.tar.gz")
 GLOVE_URL = "https://nlp.stanford.edu/data/glove.6B.zip"
 MOVIELENS_URL = "https://files.grouplens.org/datasets/movielens/ml-1m.zip"
+# the rnn recipe's default corpus (models/rnn/README.md points at the
+# tiny-shakespeare text the reference recipes trained on)
+SHAKESPEARE_URL = ("https://raw.githubusercontent.com/karpathy/char-rnn/"
+                   "master/data/tinyshakespeare/input.txt")
 
 
 def maybe_download(filename: str, work_dir: str, source_url: str,
@@ -178,6 +182,7 @@ def get_glove_w2v(source_dir: str = "/tmp/news20/", dim: int = 100
 
 
 def parse_glove_txt(path: str) -> Dict[str, List[float]]:
+    """GloVe text file -> {word: [float] * dim} (news20.py:82)."""
     out = {}
     with open(path, encoding="utf-8") as f:
         for line in f:
@@ -204,6 +209,8 @@ def movielens_read_data_sets(data_dir: str) -> np.ndarray:
 
 
 def parse_movielens_ratings(path: str) -> np.ndarray:
+    """'::'-separated ratings.dat -> int64 array
+    [user, item, rating, timestamp] (movielens.py read_data_sets)."""
     rows = []
     with open(path, encoding="latin-1") as f:
         for line in f:
@@ -211,3 +218,12 @@ def parse_movielens_ratings(path: str) -> np.ndarray:
             if line:
                 rows.append([int(v) for v in line.split("::")])
     return np.asarray(rows, np.int64)
+
+
+# ------------------------------------------------------ text LM corpus
+
+def get_text_corpus(source_dir: str) -> str:
+    """Download-if-missing the rnn/transformer recipes' default text
+    corpus into ``source_dir/train.txt`` (the role Train.scala's
+    readme download step played) and return its path."""
+    return maybe_download("train.txt", source_dir, SHAKESPEARE_URL)

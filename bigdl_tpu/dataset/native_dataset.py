@@ -18,6 +18,8 @@ from bigdl_tpu.dataset.sample import MiniBatch
 
 
 def native_available() -> bool:
+    """True when the C++ dataloader (native/src/dataloader.cpp) is
+    built and loadable."""
     try:
         from bigdl_tpu import native
         return native.native_available()

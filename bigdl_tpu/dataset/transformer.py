@@ -13,6 +13,9 @@ from bigdl_tpu.dataset.sample import (MiniBatch, PaddingParam, Sample,
 
 
 class Transformer:
+    """Iterator-to-iterator preprocessing stage
+    (dataset/Transformer.scala:40); compose with ``>>`` (the
+    reference's ``->``)."""
     def apply(self, it: Iterator) -> Iterator:
         raise NotImplementedError
 
@@ -28,6 +31,7 @@ class Transformer:
 
 
 class ChainedTransformer(Transformer):
+    """Composition of two transformers (Transformer.scala ``->``)."""
     def __init__(self, first: Transformer, second: Transformer):
         self.first = first
         self.second = second

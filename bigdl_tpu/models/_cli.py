@@ -84,9 +84,30 @@ def mnist_arrays(folder: str, train: bool,
                 rng.randint(1, 11, synthetic).astype(np.float32))
     from bigdl_tpu.dataset.image import load_mnist
     prefix = "train" if train else "t10k"
-    imgs, lbls = load_mnist(
-        os.path.join(folder, f"{prefix}-images-idx3-ubyte"),
-        os.path.join(folder, f"{prefix}-labels-idx1-ubyte"))
+    img_path = os.path.join(folder, f"{prefix}-images-idx3-ubyte")
+    if not os.path.exists(img_path) and \
+            not os.path.exists(img_path + ".gz"):
+        # the reference's recipes materialize their corpus from nothing
+        # (pyspark/bigdl/models/lenet/lenet5.py:24-30): download-if-
+        # missing into -f, with a clear offline story
+        from bigdl_tpu.dataset import fetch
+        try:
+            imgs, lbls = fetch.mnist_read_data_sets(
+                folder, "train" if train else "test")
+        except Exception as e:
+            raise SystemExit(
+                f"MNIST not found under '{folder}' and auto-download "
+                f"failed ({type(e).__name__}: {e}). Pre-stage the idx "
+                "files there, or use --synthetic N.")
+        imgs = imgs[:, None, :, :].astype(np.float32)
+        lbls = (lbls + 1).astype(np.float32)  # 1-based criterion labels
+    else:
+        if not os.path.exists(img_path):
+            img_path += ".gz"
+        lbl_path = os.path.join(folder, f"{prefix}-labels-idx1-ubyte")
+        if not os.path.exists(lbl_path):
+            lbl_path += ".gz"
+        imgs, lbls = load_mnist(img_path, lbl_path)
     mean, std = ((0.13066047, 0.3081078) if train
                  else (0.13251461, 0.31048024))
     return ((imgs / 255.0 - mean) / std).astype(np.float32), lbls

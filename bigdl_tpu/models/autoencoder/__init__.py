@@ -9,6 +9,8 @@ FEATURE_SIZE = ROW_N * COL_N
 
 
 def Autoencoder(class_num: int = 32) -> nn.Sequential:
+    """MNIST 784-classNum-784 sigmoid autoencoder
+    (models/autoencoder/Autoencoder.scala:25)."""
     m = nn.Sequential()
     m.add(nn.Reshape((FEATURE_SIZE,)))
     m.add(nn.Linear(FEATURE_SIZE, class_num))

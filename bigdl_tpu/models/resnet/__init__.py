@@ -55,6 +55,9 @@ def ResNet(class_num: int, depth: int = 18,
            shortcut_type: str = ShortcutType.B,
            dataset: str = DatasetType.CIFAR10,
            conv_bias: bool = False) -> nn.Sequential:
+    """ResNet for CIFAR-10 (depth 20/32/44/56/110) or ImageNet
+    (depth 18-200) — models/resnet/ResNet.scala:88 (shortcut types,
+    v1/v2 blocks, optimnet init)."""
     st = _State()
 
     import bigdl_tpu.models.resnet as _mod

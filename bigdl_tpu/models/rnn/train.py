@@ -41,6 +41,17 @@ def main(argv=None):
     else:
         train_txt = args.folder if os.path.isfile(args.folder) else \
             os.path.join(args.folder, "train.txt")
+        if not os.path.exists(train_txt):
+            # recipes run from nothing on a networked host (the
+            # reference's readme download step, Train.scala:60-133)
+            from bigdl_tpu.dataset import fetch
+            try:
+                train_txt = fetch.get_text_corpus(args.folder)
+            except Exception as e:
+                raise SystemExit(
+                    f"no corpus at '{train_txt}' and auto-download "
+                    f"failed ({type(e).__name__}: {e}). Pre-stage a "
+                    "train.txt there, or use --synthetic N.")
         splits, d = load_ptb(train_txt, vocab_size=args.vocabSize)
         stream, vocab = splits["train"], d.vocab_size()
         if args.checkpoint:

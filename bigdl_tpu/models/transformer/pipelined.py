@@ -7,17 +7,29 @@ surface: construct :class:`PipelinedTransformerLM` on a mesh with a
 ``pipe`` axis, hand its :meth:`sharding_rules` to the Optimizer, and the
 jitted train step runs GPipe-style microbatch pipelining over the pipe
 ring (parallel/pipeline.py) — composing with data parallelism on the
-batch dim and megatron tensor parallelism inside blocks, all in ONE
-``jax.shard_map(axis_names={'pipe'})`` region whose other mesh axes stay
-GSPMD-auto.
+batch dim, megatron tensor parallelism inside blocks, sequence
+parallelism (``ring_axis=`` ring/ulysses attention, manual collectives
+inside each pipeline stage), and expert parallelism (``moe_experts=``
+stacked routed FFNs, expert dim GSPMD-sharded) — the full
+DP×TP×PP×SP(×EP) product in ONE ``jax.shard_map`` region whose
+data/model/expert axes stay GSPMD-auto.
 
 TPU-first design notes:
 - blocks are HOMOGENEOUS and stored STACKED ([L, ...] leaves) — that is
   what lets a stage run its layers as a ``lax.scan`` and the pipeline
-  ship one microbatch per ``ppermute`` hop with zero retracing;
+  ship one microbatch per ``ppermute`` hop with zero retracing; with
+  ``moe_experts`` EVERY block is a routed MoE (a mixed dense/MoE stack
+  would break homogeneity — use the non-pipelined TransformerLM's
+  ``moe_every`` for that);
 - off the mesh (or pipe axis absent / size 1) the same params run a
   plain ``lax.scan`` over layers — identical math, so single-chip
-  tests, checkpoints, and the grads≡dense assertion all share one model;
+  tests, checkpoints, and the grads≡dense assertion all share one
+  model. With MoE the fallback loops the microbatches explicitly so the
+  load-balance aux loss (per-microbatch statistics, averaged) is
+  BIT-COMPARABLE to the pipelined path;
+- the MoE aux statistics are ``pmean``-ed over the sequence axis when
+  sequence parallelism is active, so SP-sharded routing reproduces the
+  full-sequence statistics exactly (mean of equal-size shard means);
 - dropout is intentionally unsupported: per-microbatch rng threading
   through the pipeline ring would make the objective depend on the
   stage count.
@@ -30,8 +42,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from bigdl_tpu.nn.attention import dot_product_attention
-from bigdl_tpu.nn.module import Module
+from bigdl_tpu.nn.attention import _inside_axis, dot_product_attention
+from bigdl_tpu.nn.module import AUX_LOSS_KEY, Module
 from bigdl_tpu.utils.engine import Engine
 
 
@@ -48,14 +60,22 @@ class PipelinedTransformerLM(Module):
     ``num_layers`` must divide by the pipe-axis size; the global batch
     must divide by ``n_microbatches`` (which should be >= the stage
     count to keep the pipeline bubble small: bubble fraction =
-    (stages-1)/(microbatches+stages-1))."""
+    (stages-1)/(microbatches+stages-1)).
+
+    ``ring_axis``/``sp_impl`` enable sequence parallelism inside each
+    stage (ring or ulysses attention over that mesh axis);
+    ``moe_experts`` makes every block a top-k routed MoE whose stacked
+    expert dim shards over ``sharding_rules(expert_axis=...)``."""
 
     def __init__(self, vocab_size: int, hidden_size: int = 512,
                  num_layers: int = 8, num_heads: int = 8,
                  ffn_size: Optional[int] = None, max_len: int = 2048,
                  n_microbatches: int = 4, pipe_axis: str = "pipe",
                  mesh: Optional[jax.sharding.Mesh] = None,
-                 tie_embeddings: bool = True):
+                 tie_embeddings: bool = True,
+                 ring_axis: Optional[str] = None, sp_impl: str = "ring",
+                 moe_experts: int = 0, moe_top_k: int = 2,
+                 pp_schedule: str = "gpipe", pp_rounds: int = 2):
         super().__init__()
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
@@ -69,10 +89,22 @@ class PipelinedTransformerLM(Module):
         self.pipe_axis = pipe_axis
         self.mesh = mesh
         self.tie_embeddings = tie_embeddings
+        if sp_impl not in ("ring", "ulysses"):
+            raise ValueError(f"sp_impl must be ring|ulysses, got {sp_impl}")
+        self.ring_axis = ring_axis
+        self.sp_impl = sp_impl
+        if pp_schedule not in ("gpipe", "interleaved"):
+            raise ValueError(
+                f"pp_schedule must be gpipe|interleaved, got {pp_schedule}")
+        self.pp_schedule = pp_schedule
+        self.pp_rounds = pp_rounds
+        self.moe_experts = moe_experts
+        self.moe_top_k = min(moe_top_k, moe_experts) if moe_experts else 0
         # stable bound-method identity: pipeline_forward's cache keys on
         # the block callable, and `self._block` creates a fresh bound
         # method on every attribute access
         self._block_fn = self._block
+        self._block_aux_fn = self._block_aux
 
     # ------------------------------------------------------------ params
     def init(self, rng):
@@ -94,11 +126,17 @@ class PipelinedTransformerLM(Module):
             "wo": u(keys[3], (L, E, E), s), "bo": jnp.zeros((L, E), dtype),
             "ln2_scale": jnp.ones((L, E), dtype),
             "ln2_bias": jnp.zeros((L, E), dtype),
-            "w_up": u(keys[4], (L, E, F), s),
-            "b_up": jnp.zeros((L, F), dtype),
-            "w_down": u(keys[5], (L, F, E), sf),
-            "b_down": jnp.zeros((L, E), dtype),
         }
+        if self.moe_experts:
+            X = self.moe_experts
+            blocks["router"] = u(keys[9], (L, E, X), s)
+            blocks["w_up"] = u(keys[4], (L, X, E, F), s)
+            blocks["w_down"] = u(keys[5], (L, X, F, E), sf)
+        else:
+            blocks["w_up"] = u(keys[4], (L, E, F), s)
+            blocks["b_up"] = jnp.zeros((L, F), dtype)
+            blocks["w_down"] = u(keys[5], (L, F, E), sf)
+            blocks["b_down"] = jnp.zeros((L, E), dtype)
         p = {"embed": jax.random.normal(
                  keys[6], (self.vocab_size, E), dtype) * s,
              "pos_embed": jax.random.normal(
@@ -111,10 +149,22 @@ class PipelinedTransformerLM(Module):
                 keys[8], (E, self.vocab_size), dtype) * s
         return p
 
+    def initial_state(self):
+        if self.moe_experts:
+            return {AUX_LOSS_KEY: jnp.zeros((), jnp.float32)}
+        return {}
+
+    def aux_loss(self, state) -> jnp.ndarray:
+        """Total MoE load-balance loss (mean over microbatches, summed
+        over layers) — same contract as TransformerLM.aux_loss."""
+        return state.get(AUX_LOSS_KEY, jnp.zeros((), jnp.float32))
+
     # ------------------------------------------------------- block forward
-    def _block(self, lp, h):
-        """One pre-norm transformer block. lp: this layer's slice of the
-        stacked params (leading L dim scanned away); h: [mb, S, E]."""
+    def _attention(self, lp, h):
+        """Self-attention sublayer; SP-aware: inside the pipeline
+        shard_map the ring axis is BOUND and the kernel runs its manual
+        collectives directly; in the dense fallback a mesh-resolved
+        shard_map wrapper is used; no SP -> plain causal attention."""
         b, s, e = h.shape
         hd, nh = self.head_dim, self.num_heads
 
@@ -125,44 +175,164 @@ class PipelinedTransformerLM(Module):
         q = split(x @ lp["wq"] + lp["bq"])
         k = split(x @ lp["wk"] + lp["bk"])
         v = split(x @ lp["wv"] + lp["bv"])
-        att = dot_product_attention(q, k, v, causal=True)
+        att = None
+        if self.ring_axis is not None:
+            kern = self._sp_kernel()
+            if _inside_axis(self.ring_axis):
+                att = kern(q, k, v, axis_name=self.ring_axis, causal=True)
+            else:
+                from bigdl_tpu.parallel.mesh import (resolve_axis_mesh,
+                                                     seq_sharded_attention)
+                mesh = resolve_axis_mesh(self.mesh, self.ring_axis)
+                if mesh is not None:
+                    att = seq_sharded_attention(
+                        kern, mesh, self.ring_axis, True)(q, k, v)
+        if att is None:
+            att = dot_product_attention(q, k, v, causal=True)
         att = att.transpose(0, 2, 1, 3).reshape(b, s, e)
-        h = h + att @ lp["wo"] + lp["bo"]
-        x = _layernorm(h, lp["ln2_scale"], lp["ln2_bias"])
-        ffn = jax.nn.gelu(x @ lp["w_up"] + lp["b_up"]) @ lp["w_down"] \
-            + lp["b_down"]
-        return h + ffn
+        return h + att @ lp["wo"] + lp["bo"]
 
-    def forward_fn(self, params, input, *, training=False, rng=None):
+    def _sp_kernel(self):
+        if self.sp_impl == "ulysses":
+            from bigdl_tpu.parallel.ulysses import ulysses_attention
+            return ulysses_attention
+        from bigdl_tpu.parallel.ring_attention import ring_attention
+        return ring_attention
+
+    def _moe(self, lp, x):
+        """Top-k routed stacked-expert FFN (one layer's slice; mirrors
+        nn/moe.py's dense-dispatch design). Returns (out, aux). The aux
+        statistics are pmean-ed over the SP axis when it is bound, so
+        shard-local routing stats reproduce the full-sequence ones."""
+        X, K = self.moe_experts, self.moe_top_k
+        logits = x @ lp["router"]                          # [b,s,X]
+        probs = jax.nn.softmax(logits, axis=-1)
+        top_p, top_idx = jax.lax.top_k(probs, K)
+        top_p = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
+        combine = jnp.sum(
+            jax.nn.one_hot(top_idx, X, dtype=x.dtype)
+            * top_p[..., None], axis=2)
+        h = jnp.einsum("bsm,xmf->xbsf", x, lp["w_up"])
+        h = jax.nn.gelu(h)
+        y = jnp.einsum("xbsf,xfm->xbsm", h, lp["w_down"])
+        out = jnp.einsum("xbsm,bsx->bsm", y, combine)
+        frac = jnp.mean(jax.nn.one_hot(top_idx[..., 0], X), axis=(0, 1))
+        meanp = jnp.mean(probs, axis=(0, 1))
+        if self.ring_axis is not None and _inside_axis(self.ring_axis):
+            frac = jax.lax.pmean(frac, self.ring_axis)
+            meanp = jax.lax.pmean(meanp, self.ring_axis)
+        aux = X * jnp.sum(frac * meanp)
+        return out, aux.astype(jnp.float32)
+
+    def _block_aux(self, lp, h):
+        """One pre-norm transformer block returning (h, aux). lp: this
+        layer's slice of the stacked params (leading L dim scanned
+        away); h: [mb, S, E]."""
+        h = self._attention(lp, h)
+        x = _layernorm(h, lp["ln2_scale"], lp["ln2_bias"])
+        if self.moe_experts:
+            ffn, aux = self._moe(lp, x)
+        else:
+            ffn = jax.nn.gelu(x @ lp["w_up"] + lp["b_up"]) @ lp["w_down"] \
+                + lp["b_down"]
+            aux = jnp.zeros((), jnp.float32)
+        return h + ffn, aux
+
+    def _block(self, lp, h):
+        """aux-less view of :meth:`_block_aux` (the dense-FFN pipeline
+        path scans this one)."""
+        out, _ = self._block_aux(lp, h)
+        return out
+
+    # ------------------------------------------------------------ forward
+    def _forward_aux(self, params, input):
+        """Shared forward: returns (logits, aux)."""
         from bigdl_tpu.parallel.mesh import resolve_axis_mesh
         tokens = input.astype(jnp.int32)
         b, s = tokens.shape
         x = params["embed"][tokens] + params["pos_embed"][:s][None]
         mesh = resolve_axis_mesh(self.mesh, self.pipe_axis)
+        aux = jnp.zeros((), jnp.float32)
         if mesh is not None:
+            from jax.sharding import PartitionSpec as P
             from bigdl_tpu.parallel.pipeline import pipeline_forward
-            x = pipeline_forward(self._block_fn, params["blocks"], x,
-                                 mesh, axis_name=self.pipe_axis,
-                                 n_microbatches=self.n_microbatches)
+            extra, x_spec = (), None
+            if self.ring_axis is not None and \
+                    resolve_axis_mesh(mesh, self.ring_axis) is not None:
+                # SP inside the pipeline: activations' sequence dim is
+                # manual over the ring axis so the stage-body kernels
+                # run their own collectives ([M, mb, S, E])
+                extra = (self.ring_axis,)
+                x_spec = P(None, None, self.ring_axis, None)
+            sched = dict(schedule=self.pp_schedule,
+                         n_rounds=self.pp_rounds)
+            if self.moe_experts:
+                x, aux = pipeline_forward(
+                    self._block_aux_fn, params["blocks"], x, mesh,
+                    axis_name=self.pipe_axis,
+                    n_microbatches=self.n_microbatches,
+                    x_spec=x_spec, extra_axes=extra, with_aux=True,
+                    **sched)
+            else:
+                x = pipeline_forward(
+                    self._block_fn, params["blocks"], x, mesh,
+                    axis_name=self.pipe_axis,
+                    n_microbatches=self.n_microbatches,
+                    x_spec=x_spec, extra_axes=extra, **sched)
+        elif self.moe_experts:
+            # dense fallback, microbatch-looped so the per-microbatch
+            # aux statistics (then averaged) match the pipeline exactly
+            m = self.n_microbatches if b % self.n_microbatches == 0 else 1
+            mb = b // m
+            outs, auxs = [], []
+            for mi in range(m):
+                h = x[mi * mb:(mi + 1) * mb]
+
+                def body(carry, lp):
+                    h, a = carry
+                    h, ai = self._block_aux(lp, h)
+                    return (h, a + ai), None
+                (h, a), _ = jax.lax.scan(
+                    body, (h, jnp.zeros((), jnp.float32)),
+                    params["blocks"])
+                outs.append(h)
+                auxs.append(a)
+            x = jnp.concatenate(outs, axis=0)
+            aux = jnp.mean(jnp.stack(auxs))
         else:
             def body(h, lp):
                 return self._block(lp, h), None
             x, _ = jax.lax.scan(body, x, params["blocks"])
         x = _layernorm(x, params["ln_f_scale"], params["ln_f_bias"])
         if self.tie_embeddings:
-            return x @ params["embed"].T
-        return x @ params["lm_head"]
+            return x @ params["embed"].T, aux
+        return x @ params["lm_head"], aux
+
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        logits, _ = self._forward_aux(params, input)
+        return logits
+
+    def apply(self, params, state, input, *, training=False, rng=None):
+        logits, aux = self._forward_aux(params, input)
+        if self.moe_experts:
+            return logits, {AUX_LOSS_KEY: aux}
+        return logits, {}
 
     # ------------------------------------------------------------ sharding
     def sharding_rules(self, pipe_axis: Optional[str] = None,
-                       model_axis: Optional[str] = None):
+                       model_axis: Optional[str] = None,
+                       expert_axis: Optional[str] = None):
         """Rules for ``Optimizer(sharding_rules=...)``: stacked block
-        leaves shard their layer dim over the pipe axis, and (when a
-        model axis is given) megatron column/row TP on the inner dims —
-        the composed DP×TP×PP layout in one table."""
+        leaves shard their layer dim over the pipe axis, (when a model
+        axis is given) megatron column/row TP on the inner dims, and
+        stacked MoE experts over the expert axis — the composed
+        DP×TP×PP(×EP) layout in one table. Rules are rank-matched, so
+        the 4-D MoE leaves pick the expert rule and 3-D dense FFN
+        leaves the megatron one."""
         from jax.sharding import PartitionSpec as P
         pa = pipe_axis or self.pipe_axis
         ma = model_axis
+        ea = expert_axis or model_axis
         return [
             ("pos_embed", P()),
             (r"(^|/)embed$", P(ma, None) if ma else P()),
@@ -171,6 +341,11 @@ class PipelinedTransformerLM(Module):
             (r"blocks/b[qkv]$", P(pa, ma)),
             (r"blocks/wo$", P(pa, ma, None)),       # row-parallel
             (r"blocks/bo$", P(pa, None)),
+            (r"blocks/router$", P(pa, None, None)),
+            # MoE stacked experts [L, X, ., .]: expert dim over EP axis
+            (r"blocks/w_up$", P(pa, ea, None, None)),
+            (r"blocks/w_down$", P(pa, ea, None, None)),
+            # dense FFN [L, ., .] (megatron column/row)
             (r"blocks/w_up$", P(pa, None, ma)),
             (r"blocks/b_up$", P(pa, ma)),
             (r"blocks/w_down$", P(pa, ma, None)),

@@ -14,6 +14,9 @@ Parallelism is CONFIG, not code:
         --sp ring --spSize 4 --seqLen 2048
     # Ulysses all-to-all SP instead of ring
     python -m bigdl_tpu.models.transformer.train ... --sp ulysses
+    # the full product: pipeline x tensor x sequence x expert x data
+    python -m bigdl_tpu.models.transformer.train --synthetic 20000 \
+        --pp 2 --tp 2 --sp ring --spSize 2 --moeExperts 4
 
 Corpus input mirrors the RNN recipe (models/rnn/Train.scala:60-133):
 ``-f dir`` reads ``train.txt`` through the PTB tokenizer/Dictionary.
@@ -69,6 +72,15 @@ def _corpus(args):
     else:
         train_txt = args.folder if os.path.isfile(args.folder) else \
             os.path.join(args.folder, "train.txt")
+        if not os.path.exists(train_txt):
+            from bigdl_tpu.dataset import fetch
+            try:
+                train_txt = fetch.get_text_corpus(args.folder)
+            except Exception as e:
+                raise SystemExit(
+                    f"no corpus at '{train_txt}' and auto-download "
+                    f"failed ({type(e).__name__}: {e}). Pre-stage a "
+                    "train.txt there, or use --synthetic N.")
         splits, d = load_ptb(train_txt, vocab_size=args.vocabSize)
         stream, vocab = splits["train"], d.vocab_size()
         if args.checkpoint:
@@ -97,6 +109,12 @@ def main(argv=None):
                     help="pipeline stages (PipelinedTransformerLM)")
     ap.add_argument("--microbatches", type=int, default=0,
                     help="pipeline microbatches (default: 2*pp)")
+    ap.add_argument("--ppSchedule", choices=("gpipe", "interleaved"),
+                    default="gpipe",
+                    help="pipeline schedule (interleaved shrinks the "
+                    "bubble by --ppRounds virtual stages)")
+    ap.add_argument("--ppRounds", type=int, default=2,
+                    help="virtual chunks per stage for interleaved")
     ap.add_argument("--tp", type=int, default=1,
                     help="megatron tensor-parallel degree")
     ap.add_argument("--sp", choices=("none", "ring", "ulysses"),
@@ -114,12 +132,11 @@ def main(argv=None):
 
     if args.sp != "none" and args.spSize < 2:
         args.spSize = 2
-    if args.pp > 1 and (args.sp != "none" or args.moeExperts
-                        or args.dropout):
+    if args.pp > 1 and args.dropout:
         raise ValueError(
-            "--pp composes with --tp (and data parallelism); sequence "
-            "parallelism / MoE / dropout ride the non-pipelined "
-            "TransformerLM")
+            "--pp does not support dropout (per-microbatch rng through "
+            "the pipeline ring would tie the objective to the stage "
+            "count); use the non-pipelined TransformerLM for dropout")
 
     x, y, vocab = _corpus(args)
     bs = args.batchSize or 8
@@ -133,14 +150,20 @@ def main(argv=None):
         build = lambda: PipelinedTransformerLM(
             vocab, hidden_size=args.hiddenSize, num_layers=args.layers,
             num_heads=args.heads, max_len=args.seqLen,
-            n_microbatches=mb, mesh=mesh)
+            n_microbatches=mb, mesh=mesh,
+            ring_axis="seq" if args.sp != "none" else None,
+            sp_impl=args.sp if args.sp != "none" else "ring",
+            moe_experts=args.moeExperts,
+            pp_schedule=args.ppSchedule, pp_rounds=args.ppRounds)
         model = load_model_or(args, build)
         # snapshots strip the mesh (runtime placement, not identity) —
         # reattach or a resumed run would silently fall back to the
         # dense path while the CLI still promises --pp
         model.mesh = mesh
         rules = model.sharding_rules(
-            model_axis="model" if args.tp > 1 else None)
+            model_axis="model" if args.tp > 1 else None,
+            expert_axis="model" if (args.tp > 1 and args.moeExperts)
+            else None)
     else:
         build = lambda: TransformerLM(
             vocab, hidden_size=args.hiddenSize, num_layers=args.layers,

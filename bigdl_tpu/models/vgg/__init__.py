@@ -94,11 +94,13 @@ def _vgg_blocks(cfg, class_num):
 
 
 def Vgg_16(class_num: int = 1000) -> nn.Sequential:
+    """VGG-16 ImageNet (models/vgg/Vgg_16.scala)."""
     return _vgg_blocks([64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
                         512, 512, 512, "M", 512, 512, 512, "M"], class_num)
 
 
 def Vgg_19(class_num: int = 1000) -> nn.Sequential:
+    """VGG-19 ImageNet (models/vgg/Vgg_19.scala)."""
     return _vgg_blocks([64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
                         512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
                        class_num)

@@ -528,6 +528,27 @@ class TimeDistributedCriterion(Criterion):
     def apply(self, input, target):
         axis = self.dimension - 1
         steps = input.shape[axis]
+        # fast path: a per-timestep target with a mean/sum-reducing
+        # inner criterion folds the time axis into the batch and
+        # applies ONCE — the unrolled per-step form would emit `steps`
+        # separate softmax+gather reductions (measurably slower on the
+        # LM head: T=35 slices of [B, vocab])
+        sa = getattr(self.critrn, "size_average", None)
+        inner = getattr(self.critrn, "inner", None)
+        if sa is None:  # CrossEntropyCriterion wraps ClassNLL
+            sa = getattr(inner, "size_average", None)
+        # per-class weights break the identity (each step normalizes by
+        # ITS batch's total weight) — weighted criteria keep the loop
+        weighted = getattr(self.critrn, "weights", None) is not None \
+            or getattr(inner, "weights", None) is not None
+        if axis == 1 and sa is not None and not weighted \
+                and target.ndim > 1 and target.shape[1] == steps:
+            flat_x = input.reshape((-1,) + input.shape[2:])
+            flat_t = target.reshape((-1,) + target.shape[2:])
+            flat = self.critrn.apply(flat_x, flat_t)
+            # sum_t mean_B == steps * mean_{B,T}; plain sums are equal
+            total = steps * flat if sa else flat
+            return total / steps if self.size_average else total
         total = 0.0
         for i in range(steps):
             xi = jnp.take(input, i, axis=axis)

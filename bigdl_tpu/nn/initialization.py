@@ -13,6 +13,8 @@ import jax.numpy as jnp
 
 
 class InitializationMethod:
+    """Weight-init contract (nn/InitializationMethod.scala): subclasses
+    implement ``init(rng, shape, fan_in, fan_out)``."""
     def __call__(self, rng, shape, fan_in, fan_out, dtype=jnp.float32):
         raise NotImplementedError
 

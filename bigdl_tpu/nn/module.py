@@ -264,13 +264,23 @@ class Module:
         from bigdl_tpu.nn.quantized import quantize as _q
         return _q(self)
 
-    def predict(self, dataset, batch_size: int = 32):
-        from bigdl_tpu.optim.predictor import LocalPredictor
-        return LocalPredictor(self).predict(dataset, batch_size=batch_size)
+    def predict(self, dataset, batch_size: int = 32, mesh=None,
+                sharding_rules=None):
+        """Batched predictions; ``mesh`` distributes the forward over
+        the mesh's data axis (optim/Predictor.scala:35)."""
+        from bigdl_tpu.optim.predictor import Predictor
+        return Predictor(self, mesh=mesh,
+                         sharding_rules=sharding_rules).predict(
+            dataset, batch_size=batch_size)
 
-    def evaluate_on(self, dataset, methods, batch_size: int = 32):
+    def evaluate_on(self, dataset, methods, batch_size: int = 32,
+                    mesh=None, sharding_rules=None):
+        """Scored evaluation; ``mesh`` distributes the forward and
+        reduces results across processes (optim/Evaluator.scala:37)."""
         from bigdl_tpu.optim.evaluator import Evaluator
-        return Evaluator(self).test(dataset, methods, batch_size=batch_size)
+        return Evaluator(self, mesh=mesh,
+                         sharding_rules=sharding_rules).test(
+            dataset, methods, batch_size=batch_size)
 
 
 class Criterion:

@@ -55,7 +55,9 @@ class MoE(Module):
         }
 
     def initial_state(self):
-        return {AUX_LOSS_KEY: jnp.zeros((), jnp.float32)}
+        return {AUX_LOSS_KEY: jnp.zeros((), jnp.float32),
+                "expert_frac": jnp.zeros((self.num_experts,),
+                                         jnp.float32)}
 
     def apply(self, params, state, input, *, training=False, rng=None):
         x = input                                     # [B,S,Em]
@@ -80,4 +82,7 @@ class MoE(Module):
             jax.nn.one_hot(top_idx[..., 0], self.num_experts), axis=(0, 1))
         mean_prob = jnp.mean(probs, axis=(0, 1))
         aux = self.num_experts * jnp.sum(frac_routed * mean_prob)
-        return out, {AUX_LOSS_KEY: aux.astype(jnp.float32)}
+        # expert utilization (top-1 routing fraction per expert) rides
+        # the state so tools/convergence can report load balance
+        return out, {AUX_LOSS_KEY: aux.astype(jnp.float32),
+                     "expert_frac": frac_routed.astype(jnp.float32)}

@@ -363,6 +363,10 @@ class Recurrent(Module):
         h0 = self._h0(x)
         xs = jnp.moveaxis(x, 1, 0)  # (T, B, ...)
         n_steps = xs.shape[0]
+        if getattr(self.cell, "p", 0.0) == 0.0:
+            # dropout-free cell: don't split/carry T per-step keys the
+            # cell will ignore (pure scan-carry overhead)
+            rng = None
         keys = (jax.random.split(rng, n_steps) if rng is not None
                 else jnp.zeros((n_steps, 2), jnp.uint32))
 

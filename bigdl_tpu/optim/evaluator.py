@@ -1,21 +1,33 @@
-"""Evaluator / Validator (BigDL optim/Evaluator.scala:37, Validator.scala:43)."""
+"""Evaluator / Validator (BigDL optim/Evaluator.scala:37, Validator.scala:43).
+
+Like the Predictor, one class covers the reference's local AND
+distributed evaluators: ``Evaluator(model, mesh=...)`` runs the forward
+batch-sharded over the mesh's data axis, scores each process's LOCAL
+rows, and reduces the ValidationResults across processes (the
+reference reduce(+)d per-executor results, Evaluator.scala:65) — every
+host reports the GLOBAL score. Datasets exposing the device-cached
+contract are swept straight off their HBM arrays. On a mesh the final
+ragged batch is right-padded to ``batch_size`` and the pad rows trimmed
+before scoring (fixed shapes: no recompiles, no SPMD desync)."""
 from __future__ import annotations
 
-from typing import Dict, List, Sequence
+from typing import Dict, Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
-from bigdl_tpu.dataset.dataset import AbstractDataSet
-from bigdl_tpu.dataset.sample import MiniBatch
-from bigdl_tpu.dataset.transformer import SampleToMiniBatch
 from bigdl_tpu.nn.module import Module
+from bigdl_tpu.optim.predictor import Predictor, _batches, _pad_rows
 from bigdl_tpu.optim.validation import ValidationMethod, ValidationResult
 
 
-class Evaluator:
-    def __init__(self, model: Module):
-        self.model = model
+class Evaluator(Predictor):
+    def __init__(self, model: Module,
+                 mesh: Optional[jax.sharding.Mesh] = None,
+                 data_axis: str = "data", sharding_rules=None):
+        super().__init__(model, mesh=mesh, data_axis=data_axis,
+                         sharding_rules=sharding_rules)
 
     def test(self, dataset, methods: Sequence[ValidationMethod],
              batch_size: int = 32) -> Dict[str, ValidationResult]:
@@ -25,35 +37,90 @@ class Evaluator:
         params = model.get_parameters()
         state = model.get_state()
 
+        if self.mesh is None:
+            results = self._test_local(params, state, dataset, methods,
+                                       batch_size)
+        else:
+            params, state = self._place_params(params, state)
+            out_sh = self._batch_sharding()
+            if hasattr(dataset, "eval_batch_fn_on"):
+                results = self._test_device_cached(params, state,
+                                                   dataset, methods,
+                                                   out_sh)
+            else:
+                results = self._test_mesh(params, state, dataset,
+                                          methods, batch_size, out_sh)
+            if results is not None and self._multiprocess():
+                from bigdl_tpu.optim.optimizer import _allreduce_result
+                results = [_allreduce_result(r) for r in results]
+        if results is None:
+            return {}
+        return {m.name: r for m, r in zip(methods, results)}
+
+    def _test_local(self, params, state, dataset, methods, batch_size):
+        model = self.model
+
         @jax.jit
         def step(p, s, x):
             out, _ = model.apply(p, s, x, training=False)
             return out
 
-        if isinstance(dataset, AbstractDataSet):
-            it = dataset.data(train=False)
-        else:
-            it = iter(dataset)
-        first = []
-        for el in it:
-            first.append(el)
-            break
-        if not first:
-            return {}
-        import itertools
-        full = itertools.chain(first, it)
-        batches = full if isinstance(first[0], MiniBatch) \
-            else SampleToMiniBatch(batch_size).apply(full)
-        results = None
         from bigdl_tpu.dataset.sample import minibatch_input_to_device
-        for b in batches:
+        results = None
+        for b in _batches(dataset, batch_size):
             out = np.asarray(step(params, state,
                                   minibatch_input_to_device(b.get_input())))
             tgt = np.asarray(b.get_target())
             batch_res = [m(out, tgt) for m in methods]
             results = batch_res if results is None \
                 else [r + br for r, br in zip(results, batch_res)]
-        return {m.name: r for m, r in zip(methods, results)}
+        return results
+
+    def _test_mesh(self, params, state, dataset, methods, batch_size,
+                   out_sh):
+        model = self.model
+        step = jax.jit(
+            lambda p, s, x: model.apply(p, s, x, training=False)[0],
+            out_shardings=out_sh)
+        from bigdl_tpu.optim.optimizer import _local_rows
+        results = None
+        for b in _batches(dataset, batch_size):
+            x = np.asarray(b.get_input())
+            valid = x.shape[0]
+            x = self._put_batch(_pad_rows(x, batch_size))
+            out = _local_rows(step(params, state, x))[:valid]
+            tgt = np.asarray(b.get_target())[:valid]
+            batch_res = [m(out, tgt) for m in methods]
+            results = batch_res if results is None \
+                else [r + br for r, br in zip(results, batch_res)]
+        return results
+
+    def _test_device_cached(self, params, state, ds, methods, out_sh):
+        model = self.model
+
+        def _ev(p, s, start, images, labels):
+            x, y = ds.eval_batch_fn_on(images, labels, start)
+            out, _ = model.apply(p, s, x, training=False)
+            return out, y
+
+        fn = jax.jit(_ev, out_shardings=(out_sh, out_sh))
+        from bigdl_tpu.optim.optimizer import _local_rows
+        n, b = ds.size(), ds.batch_size
+        if self._multiprocess() and n % b:
+            raise ValueError(
+                "device-cached multi-host evaluation needs batch_size "
+                "to divide the dataset")
+        results = None
+        for start in range(0, n, b):
+            out, y = fn(params, state, jnp.int32(start),
+                        ds.images, ds.labels)
+            valid = min(b, n - start)
+            out_np = _local_rows(out)[:valid]
+            tgt_np = _local_rows(y)[:valid]
+            batch_res = [m(out_np, tgt_np) for m in methods]
+            results = batch_res if results is None \
+                else [r + br for r, br in zip(results, batch_res)]
+        return results
 
 
 LocalValidator = Evaluator

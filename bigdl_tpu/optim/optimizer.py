@@ -426,12 +426,20 @@ class Optimizer:
         neval = self.driver_state["neval"]
         suffix = "" if self.is_overwrite else f".{neval}"
         path = os.path.join(self.checkpoint_path, f"checkpoint{suffix}")
+        # single-writer in multi-host runs (the reference wrote once
+        # from the driver, DistriOptimizer.scala:433-463): every process
+        # participates in the collective host materialization inside
+        # save_checkpoint, but only process 0 touches the (shared)
+        # checkpoint storage — no N× duplicated IO
+        writer = not self._multiprocess() or jax.process_index() == 0
         save_checkpoint(path, params=params, opt_state=opt_state,
                         model_state=model_state,
                         optim_host_state=self.optim_method.get_state(),
                         driver_state={k: v for k, v in
-                                      self.driver_state.items()})
-        logger.info("checkpointed to %s", path)
+                                      self.driver_state.items()},
+                        writer=writer)
+        if writer:
+            logger.info("checkpointed to %s", path)
 
     def _try_resume(self):
         from bigdl_tpu.utils.serialization import (find_latest_checkpoint,

@@ -1,56 +1,196 @@
-"""Predictor (BigDL optim/Predictor.scala:35, LocalPredictor.scala:37)."""
+"""Predictor (BigDL optim/Predictor.scala:35, LocalPredictor.scala:37).
+
+The reference shipped TWO predictors — LocalPredictor (threaded local
+forward) and a distributed Predictor whose partitions ran model forward
+on executors. Here one class covers both regimes, like the Optimizer:
+
+- ``Predictor(model)`` — plain single-device jitted forward;
+- ``Predictor(model, mesh=...)`` — the batch is laid out over the mesh's
+  data axis (sharded when the axis splits, replicated on pure-TP/PP
+  meshes), params are placed by ``sharding_rules`` (TP/EP) or
+  replicated, the output sharding is PINNED to the batch layout (GSPMD
+  may otherwise replicate and desynchronize multi-host local-row
+  reads), and in multi-host runs each process feeds ITS dataset shard
+  and gets back exactly ITS rows' predictions;
+- datasets exposing the device-cached contract
+  (``eval_batch_fn_on`` — DeviceCachedArrayDataSet) are swept straight
+  off their HBM-resident arrays: one jitted gather+forward per batch,
+  zero per-batch host→device traffic.
+
+Batches on a mesh are right-padded to a fixed ``batch_size`` (the
+ragged final batch would recompile the step and desynchronize SPMD
+programs across hosts); the pad rows are trimmed from the returned
+predictions.
+"""
 from __future__ import annotations
 
-from typing import Iterator, List
+from typing import List, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from bigdl_tpu.dataset.dataset import AbstractDataSet
-from bigdl_tpu.dataset.sample import MiniBatch, Sample
+from bigdl_tpu.dataset.sample import MiniBatch
 from bigdl_tpu.dataset.transformer import SampleToMiniBatch
 from bigdl_tpu.nn.module import Module
 
 
-class LocalPredictor:
-    """Batched forward over a dataset with an eval-mode jitted step."""
+def _batches(dataset, batch_size):
+    """Yield MiniBatches from an AbstractDataSet, a MiniBatch iterable,
+    or a Sample iterable."""
+    if isinstance(dataset, AbstractDataSet):
+        it = dataset.data(train=False)
+    else:
+        it = iter(dataset)
+    first = []
+    for el in it:
+        first.append(el)
+        break
+    if not first:
+        return
+    import itertools
+    full = itertools.chain(first, it)
+    if isinstance(first[0], MiniBatch):
+        yield from full
+    else:
+        yield from SampleToMiniBatch(batch_size).apply(full)
 
-    def __init__(self, model: Module):
+
+def _pad_rows(a: np.ndarray, n: int) -> np.ndarray:
+    """Right-pad dim 0 to n rows by repeating the last row."""
+    if a.shape[0] == n:
+        return a
+    if a.shape[0] > n:
+        raise ValueError(
+            f"batch of {a.shape[0]} rows exceeds batch_size={n}: a "
+            "pre-batched dataset must use a batch size <= the "
+            "predictor's (pass batch_size= matching the dataset's)")
+    reps = np.repeat(a[-1:], n - a.shape[0], axis=0)
+    return np.concatenate([a, reps], axis=0)
+
+
+class Predictor:
+    """Batched forward over a dataset with an eval-mode jitted step,
+    single-device or mesh-distributed (see module docstring)."""
+
+    def __init__(self, model: Module,
+                 mesh: Optional[jax.sharding.Mesh] = None,
+                 data_axis: str = "data", sharding_rules=None):
         self.model = model
+        self.mesh = mesh
+        self.data_axis = data_axis
+        self.sharding_rules = sharding_rules
 
+    # ---- mesh layout helpers (the Optimizer's regimes, forward-only)
+    def _multiprocess(self) -> bool:
+        return self.mesh is not None and jax.process_count() > 1
+
+    def _data_parallel(self) -> bool:
+        return self.mesh.shape.get(self.data_axis, 1) > 1
+
+    def _batch_sharding(self):
+        spec = jax.sharding.PartitionSpec(self.data_axis) \
+            if self._data_parallel() else jax.sharding.PartitionSpec()
+        return jax.sharding.NamedSharding(self.mesh, spec)
+
+    def _put_batch(self, arr):
+        sh = self._batch_sharding()
+        a = np.asarray(arr)
+        if self._multiprocess() and not self._data_parallel():
+            from bigdl_tpu.parallel.tp import put_global
+            return put_global(a, sh)
+        if self._multiprocess():
+            gshape = (a.shape[0] * jax.process_count(),) + a.shape[1:]
+            return jax.make_array_from_process_local_data(sh, a, gshape)
+        return jax.device_put(jnp.asarray(a), sh)
+
+    def _place_params(self, params, state):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        repl = NamedSharding(self.mesh, P())
+        if self.sharding_rules is not None:
+            from bigdl_tpu.parallel.tp import shard_params
+            params = shard_params(params, self.mesh, self.sharding_rules)
+        else:
+            from bigdl_tpu.parallel.tp import put_global
+            params = jax.tree.map(lambda a: put_global(a, repl), params)
+        from bigdl_tpu.parallel.tp import put_global
+        state = jax.tree.map(lambda a: put_global(a, repl), state)
+        return params, state
+
+    # ------------------------------------------------------------ predict
     def predict(self, dataset, batch_size: int = 32) -> List[np.ndarray]:
+        """Per-sample predictions (this process's rows in multi-host)."""
         model = self.model
         model.evaluate()
         model.ensure_initialized()
         params = model.get_parameters()
         state = model.get_state()
 
+        if self.mesh is None:
+            return self._predict_local(params, state, dataset, batch_size)
+
+        params, state = self._place_params(params, state)
+        out_sh = self._batch_sharding()
+        step = jax.jit(
+            lambda p, s, x: model.apply(p, s, x, training=False)[0],
+            out_shardings=out_sh)
+
+        if hasattr(dataset, "eval_batch_fn_on"):
+            return self._predict_device_cached(params, state, dataset,
+                                               out_sh)
+
+        from bigdl_tpu.optim.optimizer import _local_rows
+        outs: List[np.ndarray] = []
+        for b in _batches(dataset, batch_size):
+            x = np.asarray(b.get_input())
+            valid = x.shape[0]
+            x = self._put_batch(_pad_rows(x, batch_size))
+            out = _local_rows(step(params, state, x))
+            outs.extend(out[:valid])
+        return outs
+
+    def _predict_local(self, params, state, dataset, batch_size):
+        model = self.model
+
         @jax.jit
         def step(p, s, x):
             out, _ = model.apply(p, s, x, training=False)
             return out
 
-        if isinstance(dataset, AbstractDataSet):
-            it = dataset.data(train=False)
-        else:
-            it = iter(dataset)
-        batcher = SampleToMiniBatch(batch_size)
-        outs = []
-        first = []
-        for el in it:
-            first.append(el)
-            break
-        if not first:
-            return []
-        import itertools
-        full = itertools.chain(first, it)
-        batches = full if isinstance(first[0], MiniBatch) \
-            else batcher.apply(full)
         from bigdl_tpu.dataset.sample import minibatch_input_to_device
-        for b in batches:
+        outs: List[np.ndarray] = []
+        for b in _batches(dataset, batch_size):
             out = step(params, state,
                        minibatch_input_to_device(b.get_input()))
             outs.extend(np.asarray(out))
+        return outs
+
+    def _predict_device_cached(self, params, state, ds, out_sh):
+        """Forward sweep straight off the HBM cache: the batch is
+        gathered + normalized INSIDE the jitted step
+        (DeviceCachedArrayDataSet.eval_batch_fn_on), so the only
+        per-batch host traffic is the prediction readback."""
+        model = self.model
+
+        def _ev(p, s, start, images, labels):
+            x, _ = ds.eval_batch_fn_on(images, labels, start)
+            out, _ = model.apply(p, s, x, training=False)
+            return out
+
+        fn = jax.jit(_ev, out_shardings=out_sh)
+        from bigdl_tpu.optim.optimizer import _local_rows
+        n, b = ds.size(), ds.batch_size
+        if self._multiprocess() and n % b:
+            raise ValueError(
+                "device-cached multi-host predict needs batch_size to "
+                "divide the dataset (a wrapped final batch cannot be "
+                "trimmed consistently across processes)")
+        outs: List[np.ndarray] = []
+        for start in range(0, n, b):
+            out = _local_rows(fn(params, state, jnp.int32(start),
+                                 ds.images, ds.labels))
+            outs.extend(out[:min(b, n - start)])
         return outs
 
     def predict_class(self, dataset, batch_size: int = 32) -> List[int]:
@@ -59,4 +199,9 @@ class LocalPredictor:
                 for o in self.predict(dataset, batch_size)]
 
 
-Predictor = LocalPredictor  # distributed prediction == sharded local on TPU
+class LocalPredictor(Predictor):
+    """Single-device predictor (LocalPredictor.scala:37) — Predictor
+    with no mesh."""
+
+    def __init__(self, model: Module):
+        super().__init__(model, mesh=None)

@@ -11,6 +11,8 @@ import jax.numpy as jnp
 
 
 class Regularizer:
+    """Weight-penalty contract (optim/Regularizer.scala): ``loss(w)``
+    joins the training objective."""
     def loss(self, w):
         raise NotImplementedError
 

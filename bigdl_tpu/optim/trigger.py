@@ -9,6 +9,8 @@ from typing import Callable, Dict, Any
 
 
 class Trigger:
+    """Composable predicate over driver state (optim/Trigger.scala);
+    ``and_``/``or_`` build the reference's trigger algebra."""
     def __init__(self, fn: Callable[[Dict[str, Any]], bool]):
         self._fn = fn
 

@@ -10,6 +10,8 @@ import numpy as np
 
 
 class ValidationResult:
+    """Accumulable metric result (optim/ValidationMethod.scala):
+    ``+`` merges batch results, ``result()`` -> (value, count)."""
     def result(self):
         """(value, count)"""
         raise NotImplementedError
@@ -19,6 +21,7 @@ class ValidationResult:
 
 
 class AccuracyResult(ValidationResult):
+    """correct/count accuracy accumulator (ValidationMethod.scala:83)."""
     def __init__(self, correct: int, count: int):
         self.correct = int(correct)
         self.count = int(count)
@@ -36,6 +39,7 @@ class AccuracyResult(ValidationResult):
 
 
 class LossResult(ValidationResult):
+    """summed-loss accumulator (ValidationMethod.scala:162)."""
     def __init__(self, loss: float, count: int):
         self.loss = float(loss)
         self.count = int(count)
@@ -52,6 +56,8 @@ class LossResult(ValidationResult):
 
 
 class ValidationMethod:
+    """Scoring contract (optim/ValidationMethod.scala): call with
+    (output, target) -> ValidationResult."""
     name = "ValidationMethod"
 
     def __call__(self, output, target) -> ValidationResult:

@@ -35,10 +35,12 @@ def data_parallel_mesh(devices=None) -> Mesh:
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
+    """Fully-replicated NamedSharding on ``mesh``."""
     return NamedSharding(mesh, P())
 
 
 def batch_sharded(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    """Batch-dim-sharded NamedSharding over ``axis``."""
     return NamedSharding(mesh, P(axis))
 
 

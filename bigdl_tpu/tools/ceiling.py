@@ -537,8 +537,287 @@ def hand_tlm(iters):
     return timed(run_chunk, params, iters)
 
 
+# --------------------------------------------------- Inception-v1 pair
+
+def framework_inception(iters):
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.models.inception import Inception_v1_NoAuxClassifier
+    from bigdl_tpu.optim import SGD
+    from bigdl_tpu.optim.optimizer import build_train_step
+    from bigdl_tpu.utils.engine import Engine
+    from bigdl_tpu.utils.random import RandomGenerator
+
+    Engine.set_compute_dtype(jnp.bfloat16)
+    RandomGenerator.set_seed(1)
+    model = Inception_v1_NoAuxClassifier(1000).training()
+    model.ensure_initialized()
+    optim = SGD(learning_rate=0.01, momentum=0.9)
+    params = model.get_parameters()
+    mstate = model.get_state()
+    opt_state = optim.init_state(params)
+    step = build_train_step(model, nn.ClassNLLCriterion(), optim)
+
+    def scan_body(carry, key):
+        params, opt_state, mstate = carry
+        kx, ky, kr = jax.random.split(key, 3)
+        x = jax.random.uniform(kx, (BATCH, 3, 224, 224), jnp.float32)
+        y = jax.random.randint(ky, (BATCH,), 1, 1001).astype(jnp.float32)
+        params, opt_state, mstate, loss = step(params, opt_state, mstate,
+                                               kr, 0.01, x, y)
+        return (params, opt_state, mstate), loss
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def run_chunk(carry, keys):
+        return lax.scan(scan_body, carry, keys)
+
+    return timed(run_chunk, (params, opt_state, mstate), iters)
+
+
+# (input_size, (n1x1, (n3r, n3), (n5r, n5), npool)) per inception block
+INC_CFG = [
+    ("3a", 192, (64, (96, 128), (16, 32), 32)),
+    ("3b", 256, (128, (128, 192), (32, 96), 64)),
+    ("P", 0, None),
+    ("4a", 480, (192, (96, 208), (16, 48), 64)),
+    ("4b", 512, (160, (112, 224), (24, 64), 64)),
+    ("4c", 512, (128, (128, 256), (24, 64), 64)),
+    ("4d", 512, (112, (144, 288), (32, 64), 64)),
+    ("4e", 528, (256, (160, 320), (32, 128), 128)),
+    ("P", 0, None),
+    ("5a", 832, (256, (160, 320), (32, 128), 128)),
+    ("5b", 832, (384, (192, 384), (48, 128), 128)),
+]
+
+
+def _maxpool_ceil(x, k, s, pad=0):
+    """Torch ceil-mode maxpool with symmetric base padding: the tail is
+    additionally padded with -inf so the last partial window counts
+    (matches nn.SpatialMaxPooling(...).ceil())."""
+    n = x.shape[2] + 2 * pad
+    out = -(-(n - k) // s) + 1
+    extra = max((out - 1) * s + k - n, 0)
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max, (1, 1, k, k), (1, 1, s, s),
+        ((0, 0), (0, 0), (pad, pad + extra), (pad, pad + extra)))
+
+
+def hand_inception(iters):
+    """Raw-JAX GoogLeNet with the zoo model's exact op semantics
+    (Inception_v1_NoAuxClassifier: biased Xavier convs + ReLU, LRN(5),
+    ceil-mode pools, 4-branch channel concat, avgpool 7, Dropout(0.4),
+    Linear 1024->1000, LogSoftMax+NLL, SGD momentum, bf16 compute /
+    f32 master)."""
+    key = jax.random.PRNGKey(1)
+    ks = iter(jax.random.split(key, 256))
+
+    def conv_p(cin, cout, k):
+        fan_in, fan_out = cin * k * k, cout * k * k
+        lim = np.sqrt(6.0 / (fan_in + fan_out))
+        return {"w": jax.random.uniform(next(ks), (cout, cin, k, k),
+                                        jnp.float32, -lim, lim),
+                "b": jnp.zeros((cout,), jnp.float32)}
+
+    params = {"stem1": conv_p(3, 64, 7), "stem2": conv_p(64, 64, 1),
+              "stem3": conv_p(64, 192, 3)}
+    for name, cin, cfg in INC_CFG:
+        if cfg is None:
+            continue
+        n1, (n3r, n3), (n5r, n5), npool = cfg
+        params[name] = {
+            "b1": conv_p(cin, n1, 1),
+            "b3r": conv_p(cin, n3r, 1), "b3": conv_p(n3r, n3, 3),
+            "b5r": conv_p(cin, n5r, 1), "b5": conv_p(n5r, n5, 5),
+            "bp": conv_p(cin, npool, 1)}
+    lim = np.sqrt(6.0 / (1024 + 1000))
+    params["fc"] = {"w": jax.random.uniform(next(ks), (1024, 1000),
+                                            jnp.float32, -lim, lim),
+                    "b": jnp.zeros((1000,), jnp.float32)}
+
+    def cv(x, p, stride=1, pad=0):
+        return conv(x, p["w"].astype(x.dtype), stride, pad) \
+            + p["b"].astype(x.dtype)[None, :, None, None]
+
+    def lrn(x, size=5, alpha=1e-4, beta=0.75):
+        sq = x * x
+        half = (size - 1) // 2
+        # init must be a python scalar: a traced init value breaks
+        # reduce_window's reverse-mode linearization
+        summed = lax.reduce_window(
+            sq, 0.0, lax.add, (1, size, 1, 1), (1, 1, 1, 1),
+            ((0, 0), (half, size - 1 - half), (0, 0), (0, 0)))
+        return x / jnp.power(1.0 + alpha / size * summed, beta)
+
+    def block(x, p):
+        b1 = jax.nn.relu(cv(x, p["b1"]))
+        b3 = jax.nn.relu(cv(jax.nn.relu(cv(x, p["b3r"])), p["b3"],
+                            1, 1))
+        b5 = jax.nn.relu(cv(jax.nn.relu(cv(x, p["b5r"])), p["b5"],
+                            1, 2))
+        bp = jax.nn.relu(cv(_maxpool_ceil(x, 3, 1, pad=1), p["bp"]))
+        return jnp.concatenate([b1, b3, b5, bp], axis=1)
+
+    def fwd(p, x, key):
+        x = jax.nn.relu(cv(x, p["stem1"], 2, 3))
+        x = _maxpool_ceil(x, 3, 2)
+        x = lrn(x)
+        x = jax.nn.relu(cv(x, p["stem2"]))
+        x = jax.nn.relu(cv(x, p["stem3"], 1, 1))
+        x = lrn(x)
+        x = _maxpool_ceil(x, 3, 2)
+        for name, _, cfg in INC_CFG:
+            if cfg is None:
+                x = _maxpool_ceil(x, 3, 2)
+            else:
+                x = block(x, p[name])
+        x = lax.reduce_window(x, 0.0, lax.add,
+                              (1, 1, 7, 7), (1, 1, 1, 1), "VALID") / 49.0
+        keep = jax.random.bernoulli(key, 0.6, x.shape)
+        x = jnp.where(keep, x / 0.6, 0.0)
+        x = x.reshape(x.shape[0], 1024)
+        logits = x @ p["fc"]["w"].astype(x.dtype) \
+            + p["fc"]["b"].astype(x.dtype)
+        return jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+
+    def loss_fn(p, x, y, key):
+        p16 = jax.tree.map(lambda a: a.astype(jnp.bfloat16), p)
+        logp = fwd(p16, x.astype(jnp.bfloat16), key)
+        return -jnp.take_along_axis(logp, y[:, None], axis=-1).mean()
+
+    mom = jax.tree.map(jnp.zeros_like, params)
+
+    def scan_body(carry, key):
+        params, mom = carry
+        kx, ky, kd = jax.random.split(key, 3)
+        x = jax.random.uniform(kx, (BATCH, 3, 224, 224), jnp.float32)
+        y = jax.random.randint(ky, (BATCH,), 0, 1000)
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y, kd)
+        params, mom = _sgd_momentum_tree(params, grads, mom)
+        return (params, mom), loss
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def run_chunk(carry, keys):
+        return lax.scan(scan_body, carry, keys)
+
+    return timed(run_chunk, (params, mom), iters)
+
+
+# ------------------------------------------------------ PTB LSTM pair
+
+PTB = dict(vocab=10000, hidden=650, layers=2, seq=35)
+
+
+def framework_lstm(iters):
+    """The scan-heavy zoo family: PTBModel (embedding + stacked
+    Recurrent(LSTM) + TimeDistributed(Linear)), the recipe's
+    TimeDistributedCriterion(CrossEntropy) objective."""
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.models.rnn import PTBModel
+    from bigdl_tpu.optim import SGD
+    from bigdl_tpu.optim.optimizer import build_train_step
+    from bigdl_tpu.utils.engine import Engine
+    from bigdl_tpu.utils.random import RandomGenerator
+
+    Engine.set_compute_dtype(jnp.bfloat16)
+    RandomGenerator.set_seed(1)
+    model = PTBModel(PTB["vocab"], PTB["hidden"], PTB["vocab"],
+                     num_layers=PTB["layers"]).training()
+    model.ensure_initialized()
+    optim = SGD(learning_rate=0.1)
+    params = model.get_parameters()
+    mstate = model.get_state()
+    opt_state = optim.init_state(params)
+    crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion())
+    step = build_train_step(model, crit, optim)
+
+    def scan_body(carry, key):
+        params, opt_state, mstate = carry
+        kx, kr = jax.random.split(key)
+        x = jax.random.randint(kx, (BATCH, PTB["seq"]), 1,
+                               PTB["vocab"] + 1)
+        y = x.astype(jnp.float32)
+        params, opt_state, mstate, loss = step(params, opt_state, mstate,
+                                               kr, 0.1, x, y)
+        return (params, opt_state, mstate), loss
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def run_chunk(carry, keys):
+        return lax.scan(scan_body, carry, keys)
+
+    return timed(run_chunk, (params, opt_state, mstate), iters)
+
+
+def hand_lstm(iters):
+    """Raw-JAX stacked LSTM LM with the zoo model's exact semantics:
+    1-based embedding lookup, fused (4H) i,f,g,o gates per step under a
+    time-major lax.scan per layer, time-distributed linear head, CE,
+    plain SGD, bf16 compute / f32 master."""
+    V, H, L, S = PTB["vocab"], PTB["hidden"], PTB["layers"], PTB["seq"]
+    key = jax.random.PRNGKey(1)
+    ks = iter(jax.random.split(key, 16))
+    stdv = 1.0 / np.sqrt(H)
+
+    def u(shape, scale):
+        return jax.random.uniform(next(ks), shape, jnp.float32,
+                                  -scale, scale)
+
+    params = {"emb": jax.random.normal(next(ks), (V, H)) * 0.1,
+              "cells": [{"w_ih": u((4 * H, H), stdv),
+                         "w_hh": u((4 * H, H), stdv),
+                         "bias": u((4 * H,), stdv)} for _ in range(L)],
+              "fc": {"w": u((H, V), stdv), "b": jnp.zeros((V,))}}
+
+    def lstm_layer(p, xs):
+        # xs: [S, B, H] time-major
+        def step(hc, x):
+            h, c = hc
+            gates = x @ p["w_ih"].T.astype(x.dtype) \
+                + h @ p["w_hh"].T.astype(x.dtype) \
+                + p["bias"].astype(x.dtype)
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = (jax.nn.sigmoid(i), jax.nn.sigmoid(f),
+                       jax.nn.sigmoid(o))
+            c2 = f * c + i * jnp.tanh(g)
+            h2 = o * jnp.tanh(c2)
+            return (h2, c2), h2
+
+        b = xs.shape[1]
+        z = jnp.zeros((b, H), xs.dtype)
+        _, hs = lax.scan(step, (z, z), xs)
+        return hs
+
+    def loss_fn(p, toks):
+        p16 = jax.tree.map(lambda a: a.astype(jnp.bfloat16), p)
+        x = p16["emb"][toks - 1]                    # 1-based LookupTable
+        x = x.transpose(1, 0, 2)                    # [S, B, H]
+        for cell in p16["cells"]:
+            x = lstm_layer(cell, x)
+        logits = x @ p16["fc"]["w"].astype(x.dtype) \
+            + p16["fc"]["b"].astype(x.dtype)
+        logits = logits.astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        t = (toks - 1).transpose(1, 0)
+        return -jnp.take_along_axis(logp, t[..., None], axis=-1).mean()
+
+    def scan_body(carry, key):
+        params = carry
+        x = jax.random.randint(key, (BATCH, S), 1, V + 1)
+        loss, grads = jax.value_and_grad(loss_fn)(params, x)
+        params = jax.tree.map(
+            lambda p, g: p - 0.1 * g.astype(jnp.float32), params, grads)
+        return params, loss
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def run_chunk(carry, keys):
+        return lax.scan(scan_body, carry, keys)
+
+    return timed(run_chunk, params, iters)
+
+
 MODES = {"fw_vgg16": framework_vgg16, "hand_vgg16": hand_vgg16,
-         "fw_tlm": framework_tlm, "hand_tlm": hand_tlm}
+         "fw_tlm": framework_tlm, "hand_tlm": hand_tlm,
+         "fw_inception": framework_inception,
+         "hand_inception": hand_inception,
+         "fw_lstm": framework_lstm, "hand_lstm": hand_lstm}
 
 
 if __name__ == "__main__":
@@ -550,6 +829,10 @@ if __name__ == "__main__":
         BATCH = 16
     if "vgg" in mode and "BENCH_BATCH" not in os.environ:
         BATCH = 128
+    if "inception" in mode and "BENCH_BATCH" not in os.environ:
+        BATCH = 128
+    if "lstm" in mode and "BENCH_BATCH" not in os.environ:
+        BATCH = 64
     if mode in MODES:
         r = MODES[mode](iters)
     elif mode.startswith("hand"):
@@ -559,5 +842,7 @@ if __name__ == "__main__":
     out = {"mode": mode, "items_per_sec": round(r, 1)}
     if "tlm" in mode:
         out["tokens_per_sec"] = round(r * TLM["seq"], 1)
+    if "lstm" in mode:
+        out["tokens_per_sec"] = round(r * PTB["seq"], 1)
     out.update(mfu_fields(r))
     print(json.dumps(out))

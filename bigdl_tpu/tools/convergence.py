@@ -198,7 +198,8 @@ def make_markov_corpus(n_tokens: int, seed: int, vocab: int = 256,
 
 def run_lm(name: str, build_model, criterion, optim, lr: float,
            epochs: int, n_tokens: int, seq: int = 32, batch: int = 256,
-           one_based: bool = False, vocab: int = 256):
+           one_based: bool = False, vocab: int = 256,
+           aux_loss_weight: float = 0.01, report_experts: bool = False):
     """Shared LM convergence loop: device-resident token windows, jitted
     epoch scans, held-out per-token perplexity vs the chain's floor."""
     import jax
@@ -232,7 +233,8 @@ def run_lm(name: str, build_model, criterion, optim, lr: float,
     params = model.get_parameters()
     mstate = model.get_state()
     opt_state = optim.init_state(params)
-    step = build_train_step(model, criterion, optim)
+    step = build_train_step(model, criterion, optim,
+                            aux_loss_weight=aux_loss_weight)
 
     steps_per_epoch = max(1, n_win // batch)
 
@@ -278,9 +280,26 @@ def run_lm(name: str, build_model, criterion, optim, lr: float,
     result = {"recipe": name, "final_val_ppl": history[-1],
               "best_val_ppl": min(history), "ppl_floor": round(floor, 3),
               "epochs": epochs, "n_tokens": n_tokens,
+              "aux_loss_weight": aux_loss_weight,
               "tokens_per_sec": round(
                   epochs * steps_per_epoch * batch * seq / dt, 1),
               "history": history}
+    if report_experts:
+        # per-MoE-block top-1 routing fractions over one held-out batch
+        @jax.jit
+        def route(params, mstate):
+            _, st = model.apply(params, mstate, xv[:batch],
+                                training=False)
+            return st
+        st = route(carry[0], carry[2])
+        fracs = {}
+        flat, _ = jax.tree_util.tree_flatten_with_path(st)
+        for path, leaf in flat:
+            key = "/".join(str(getattr(p, "key", p)) for p in path)
+            if key.endswith("expert_frac"):
+                fracs[key.split("/")[0]] = [round(float(v), 3)
+                                            for v in np.asarray(leaf)]
+        result["expert_utilization"] = fracs
     print(json.dumps(result))
     return result
 
@@ -357,6 +376,24 @@ def run_recipe(recipe: str, epochs: int, n: int):
                                           max_len=32),
             crit, optim, 1e-3, epochs, n, seq=32, batch=256,
             one_based=False, vocab=vocab)
+    if recipe == "moe":
+        # the dense transformer recipe's MoE twin (same corpus/oracle):
+        # BIGDL_MOE_AUX_W sweeps the load-balance weight
+        import os
+
+        from bigdl_tpu.models import TransformerLM
+        vocab = 256
+        optim = Adam(learning_rate=1e-3)
+        crit = nn.SequenceCrossEntropyCriterion()
+        aux_w = float(os.environ.get("BIGDL_MOE_AUX_W", "0.01"))
+        return run_lm(
+            "moe", lambda: TransformerLM(vocab, hidden_size=128,
+                                         num_layers=4, num_heads=8,
+                                         max_len=32, moe_experts=4,
+                                         moe_every=2),
+            crit, optim, 1e-3, epochs, n, seq=32, batch=256,
+            one_based=False, vocab=vocab, aux_loss_weight=aux_w,
+            report_experts=True)
     raise ValueError(f"unknown recipe {recipe}")
 
 

@@ -54,6 +54,10 @@ def main(argv=None):
     ap.add_argument("--mode", choices=["train", "inference"],
                     default="train")
     ap.add_argument("--dtype", choices=["f32", "bf16"], default="bf16")
+    ap.add_argument("--quantize", action="store_true",
+                    help="int8 inference rewrite (inference mode only — "
+                    "the reference's quantized serving story, "
+                    "nn/quantized/Quantization.scala:168)")
     args = ap.parse_args(argv)
 
     import jax
@@ -88,6 +92,11 @@ def main(argv=None):
 
     model.training() if args.mode == "train" else model.evaluate()
     model.ensure_initialized()
+    if args.quantize:
+        if args.mode != "inference":
+            raise SystemExit("--quantize is inference-only")
+        model = model.quantize().evaluate()
+        model.ensure_initialized()
     params = model.get_parameters()
     mstate = model.get_state()
 

@@ -22,6 +22,8 @@ _REGISTRY: Dict[str, type] = {}
 
 
 def register_module_class(cls: type, name: Optional[str] = None) -> type:
+    """Register a custom Module class so save_module/load_module can
+    reconstruct it by name (ModuleSerializer.registerModule)."""
     _REGISTRY[name or cls.__name__] = cls
     return cls
 

@@ -100,6 +100,7 @@ def save_tree(path_prefix: str, tree) -> None:
 
 
 def load_tree(path_prefix: str):
+    """Read a pytree saved by :func:`save_tree`."""
     with file_io.open_file(path_prefix + ".json") as f:
         template = json.load(f)
     with file_io.open_file(path_prefix + ".npz", "rb") as f:
@@ -108,21 +109,144 @@ def load_tree(path_prefix: str):
     return _rebuild(template, arrays)
 
 
+MANIFEST = "MANIFEST.json"
+_CKPT_FILES = ("params", "opt_state", "model_state")
+
+
+def _fsync(f) -> None:
+    try:
+        f.flush()
+        os.fsync(f.fileno())
+    except (OSError, AttributeError):
+        pass  # remote file objects / fs without fsync
+
+
+def _write_json(path: str, obj) -> None:
+    with open(path, "w") as f:
+        json.dump(obj, f)
+        _fsync(f)
+
+
+def _fsync_dir(d: str) -> None:
+    try:
+        fd = os.open(d, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:
+        pass
+
+
+def _write_ckpt_files(d: str, flats) -> None:
+    """Write the three tree parts (pre-materialized host arrays) into
+    ``d``, fsyncing each file."""
+    for name, (arrays, template) in flats.items():
+        _write_json(os.path.join(d, name + ".json"), template)
+        with open(os.path.join(d, name + ".npz"), "wb") as f:
+            np.savez(f, **arrays)
+            _fsync(f)
+
+
+def _maybe_scripted_crash(driver_state) -> None:
+    """Test-only fault injection (the reference scripted worker deaths
+    the same way, ExceptionTest / TestUtils.scala:103-131): SIGKILL this
+    process MID-checkpoint-write — after the tree files, before the
+    MANIFEST — when BIGDL_TEST_CRASH_IN_CHECKPOINT names this neval."""
+    at = os.environ.get("BIGDL_TEST_CRASH_IN_CHECKPOINT")
+    if at and int(at) == driver_state.get("neval", -1):
+        import signal
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
 def save_checkpoint(path: str, *, params, opt_state, model_state,
                     optim_host_state: Dict[str, Any],
-                    driver_state: Dict[str, Any]) -> None:
-    """Checkpoint a training run (DistriOptimizer.checkpoint :433-463)."""
-    file_io.makedirs(path)
-    save_tree(file_io.join(path, "params"), params)
-    save_tree(file_io.join(path, "opt_state"), opt_state)
-    save_tree(file_io.join(path, "model_state"), model_state)
+                    driver_state: Dict[str, Any],
+                    writer: bool = True) -> None:
+    """Checkpoint a training run (DistriOptimizer.checkpoint :433-463),
+    crash-safely:
+
+    - everything is staged in ``<path>.tmp-*``, fsynced, and the
+      directory atomically renamed into place — a process killed at ANY
+      point leaves either the previous complete checkpoint or a stray
+      tmp/old dir that ``find_latest_checkpoint`` never selects, never
+      a torn ``<path>``;
+    - a ``MANIFEST.json`` is written LAST (after a dir fsync), so even
+      on remote filesystems without atomic rename its presence certifies
+      completeness;
+    - in multi-host runs pass ``writer=jax.process_index() == 0``: every
+      process participates in the all-gather that materializes sharded
+      leaves (``_host_leaf`` resharding is collective), but only the
+      single writer touches storage — the reference wrote once from the
+      driver, not N× from executors (DistriOptimizer.scala:433-463).
+    """
+    # host materialization runs on EVERY process (collective resharding
+    # of ZeRO-1/TP-sharded leaves) and in deterministic order
+    parts = {"params": params, "opt_state": opt_state,
+             "model_state": model_state}
+    flats = {k: (_flatten_leaves(t), _tree_to_template(t))
+             for k, t in parts.items()}
+    if not writer:
+        return
     host = {"optim_host_state": optim_host_state,
             "driver_state": driver_state}
-    with file_io.open_file(file_io.join(path, "host_state.json"), "w") as f:
-        json.dump(host, f)
+    manifest = {"format": 1,
+                "neval": driver_state.get("neval"),
+                "files": [f"{n}.{ext}" for n in _CKPT_FILES
+                          for ext in ("json", "npz")] +
+                         ["host_state.json"]}
+    if file_io.is_remote(path):
+        # no atomic rename on object stores: MANIFEST-last ordering is
+        # the completeness certificate
+        file_io.makedirs(path)
+        for name, (arrays, template) in flats.items():
+            with file_io.open_file(
+                    file_io.join(path, name + ".json"), "w") as f:
+                json.dump(template, f)
+            with file_io.open_file(
+                    file_io.join(path, name + ".npz"), "wb") as f:
+                np.savez(f, **arrays)
+        with file_io.open_file(
+                file_io.join(path, "host_state.json"), "w") as f:
+            json.dump(host, f)
+        _maybe_scripted_crash(driver_state)
+        with file_io.open_file(file_io.join(path, MANIFEST), "w") as f:
+            json.dump(manifest, f)
+        return
+
+    import shutil
+    path = os.path.abspath(path)
+    parent = os.path.dirname(path)
+    base = os.path.basename(path)
+    os.makedirs(parent, exist_ok=True)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    if os.path.exists(tmp):  # our own earlier failed attempt
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    _write_ckpt_files(tmp, flats)
+    _write_json(os.path.join(tmp, "host_state.json"), host)
+    _maybe_scripted_crash(driver_state)
+    _write_json(os.path.join(tmp, MANIFEST), manifest)
+    _fsync_dir(tmp)
+    # commit: the destination only ever transitions complete->complete
+    # (a stray complete tmp/old dir is still found by
+    # find_latest_checkpoint via its MANIFEST, so no crash point leaves
+    # the latest state unreachable)
+    old = f"{path}.old-{os.getpid()}"
+    if os.path.exists(path):
+        os.rename(path, old)
+    os.rename(tmp, path)
+    _fsync_dir(parent)
+    # only AFTER the new checkpoint is committed: drop superseded debris
+    for name in os.listdir(parent):
+        if name.startswith(base + ".tmp-") or name.startswith(
+                base + ".old-"):
+            shutil.rmtree(os.path.join(parent, name), ignore_errors=True)
 
 
 def load_checkpoint(path: str) -> Dict[str, Any]:
+    """Read one complete checkpoint dir written by
+    :func:`save_checkpoint`."""
     with file_io.open_file(file_io.join(path, "host_state.json")) as f:
         host = json.load(f)
     return {
@@ -135,24 +259,46 @@ def load_checkpoint(path: str) -> Dict[str, Any]:
 
 
 def find_latest_checkpoint(directory: str) -> Optional[str]:
-    """Latest ``checkpoint.N`` dir (DistriOptimizer.getLatestFile :867-880)."""
+    """Latest COMPLETE checkpoint dir
+    (DistriOptimizer.getLatestFile :867-880). Completeness is certified
+    by the MANIFEST written last by ``save_checkpoint`` — a torn dir
+    from a mid-write crash is never selected, so a resume after a
+    checkpoint-time death lands on the previous intact checkpoint.
+    Recency comes from the MANIFEST's recorded neval, and stray-but-
+    complete ``*.tmp-*``/``*.old-*`` dirs (a crash between the MANIFEST
+    write and the final rename) still count — no crash point makes the
+    newest complete state unreachable."""
     if not file_io.isdir(directory):
         return None
-    best, best_n = None, -1
+    best, best_key = None, None
     for name in file_io.listdir(directory):
         full = file_io.join(directory, name)
-        if not file_io.isdir(full):
+        if not name.startswith("checkpoint") or not file_io.isdir(full):
             continue
-        if name == "checkpoint":
-            n = 0
-        else:
-            m = re.match(r"checkpoint\.(\d+)$", name)
-            if not m:
+        if not file_io.exists(file_io.join(full, "host_state.json")):
+            continue
+        proper = re.match(r"checkpoint(\.\d+)?$", name) is not None
+        has_manifest = file_io.exists(file_io.join(full, MANIFEST))
+        if has_manifest:
+            try:
+                with file_io.open_file(file_io.join(full, MANIFEST)) as f:
+                    neval = json.load(f).get("neval") or 0
+            except (OSError, ValueError):
                 continue
-            n = int(m.group(1))
-        if n >= best_n and file_io.exists(
-                file_io.join(full, "host_state.json")):
-            best, best_n = full, n
+        elif proper:
+            # format-0 back-compat: checkpoints written before the
+            # MANIFEST existed carry no completeness certificate —
+            # accept properly-named ones (the pre-change behavior;
+            # strays without a manifest stay torn-write debris) with
+            # neval from the dir suffix
+            m = re.match(r"checkpoint\.(\d+)$", name)
+            neval = int(m.group(1)) if m else 0
+        else:
+            continue
+        # a properly-named dir wins over a same-neval stray
+        key = (neval, proper)
+        if best_key is None or key > best_key:
+            best, best_key = full, key
     return best
 
 

@@ -19,9 +19,17 @@ entering the conv stack, one before a TF-semantics flatten/output), so
 the fused module's outputs equal the TF graph's EXACTLY — including the
 H,W,C flatten order feeding a Linear.
 
-Scope: linear chains of the ops above (the classic TF1 conv net). An
-unsupported op raises with its name — the general fallback path stays
-``TFModule``, which executes everything.
+Scope: DAGs of the ops above plus branch/merge structure —
+``Concat/ConcatV2`` → JoinTable, two-tensor ``Add/AddV2`` → CAddTable
+(the branch-and-concat topology of real Inception-class imports, which
+the reference's per-pattern fusion table also covered,
+TensorflowToBigDL.scala:1). A pure chain fuses to a ``Sequential``, a
+branchy graph to a ``Graph`` of the same modules. An unsupported op
+raises with its name — unless ``mixed=True``, which wraps each
+unsupported single-tensor-input node in a one-op ``TFModule`` island
+(rebuilt from the original NodeDef bytes, so the result still
+serializes) and keeps fusing everything around it; the islands are
+listed on the returned module's ``fused_islands``.
 """
 from __future__ import annotations
 
@@ -307,17 +315,402 @@ class _Fuser:
         return seq
 
 
+class _DagFuser:
+    """Branch/concat-capable fuser: maps the tensor-dataflow DAG onto a
+    ``Graph`` of real nn modules. Layout is tracked PER VALUE — each TF
+    tensor may exist as an NHWC and/or NCHW nn node, adapters inserted
+    once on demand — so every branch sees exactly the layout its ops
+    need and the fused output equals the TF graph's."""
+
+    # NHWC axis -> NCHW axis (concat remap)
+    _NHWC2NCHW = {0: 0, 1: 2, 2: 3, 3: 1}
+
+    def __init__(self, fuser: _Fuser, mixed: bool):
+        self.f = fuser
+        self.mixed = mixed
+        self.presets = []
+        self.islands: List[str] = []
+        self.vals: Dict[str, Dict[str, object]] = {}  # name->layout->Node
+        self.kind: Dict[str, str] = {}                # "4D" | "FLAT"
+        self.hw: Dict[str, tuple] = {}
+
+    # -------------------------------------------------------- graph walk
+    def _resolve(self, ref: str) -> TFNode:
+        nm = ref.split(":")[0].lstrip("^")
+        node = self.f.by_name[nm]
+        while node.op == "Identity":
+            nm = node.inputs[0].split(":")[0].lstrip("^")
+            node = self.f.by_name[nm]
+        return node
+
+    def _tensor_inputs(self, node: TFNode) -> List[TFNode]:
+        out = []
+        for ref in node.inputs:
+            if ref.startswith("^"):
+                continue  # control edge
+            p = self._resolve(ref)
+            if p.op != "Const":
+                out.append(p)
+        return out
+
+    def _value_as(self, name: str, layout: str):
+        """The nn node holding TF tensor ``name`` in ``layout``,
+        inserting a Transpose adapter once if needed."""
+        import bigdl_tpu.nn as nn
+        d = self.vals[name]
+        if layout in d:
+            return d[layout]
+        if layout == "NCHW":
+            node = nn.Transpose([(2, 4), (3, 4)])(d["NHWC"])
+        elif layout == "NHWC":
+            node = nn.Transpose([(2, 3), (3, 4)])(d["NCHW"])
+        else:
+            raise ValueError(f"no {layout} form of {name} ({list(d)})")
+        d[layout] = node
+        return node
+
+    def _natural(self, name: str) -> str:
+        """A layout ``name`` already exists in (avoids adapters for
+        layout-agnostic ops like ReLU)."""
+        return next(iter(self.vals[name]))
+
+    def _set(self, name: str, layout: str, node, kind: str, hw=None):
+        self.vals[name] = {layout: node}
+        self.kind[name] = kind
+        self.hw[name] = hw if hw is not None else (None, None)
+
+    # ------------------------------------------------------------- fuse
+    def fuse(self):
+        import bigdl_tpu.nn as nn
+        f = self.f
+        if len(f.input_names) != 1 or len(f.output_names) != 1:
+            raise ValueError(
+                "fusion covers single-input single-output graphs; use "
+                "TFModule for general graphs")
+        placeholder = f.by_name[f.input_names[0]]
+        shape = placeholder.attrs.get("shape")
+        hw = (None, None)
+        kind = "FLAT"
+        if shape is not None and len(shape) == 4:
+            hw = tuple(None if s in (-1, None) else int(s)
+                       for s in shape[1:3])
+            kind = "4D"
+        elif shape is None:
+            kind = "4D"  # assume image input like the TF graphs we fuse
+
+        # reachable tensor nodes + consumer map (tensor edges only)
+        consumers: Dict[str, List[TFNode]] = {}
+        order: List[TFNode] = []
+        seen = {}
+
+        def visit(node: TFNode):
+            if id(node) in seen:
+                if seen[id(node)] == 1:
+                    raise ValueError("graph has a cycle")
+                return
+            seen[id(node)] = 1
+            for p in self._tensor_inputs(node):
+                consumers.setdefault(p.name, []).append(node)
+                visit(p)
+            seen[id(node)] = 2
+            if node.op not in ("Const", "Placeholder"):
+                order.append(node)
+
+        out_node = f.by_name[f.output_names[0]]
+        visit(self._resolve(out_node.name) if out_node.op == "Identity"
+              else out_node)
+
+        inp = nn.Input()()
+        self._set(placeholder.name, "NHWC" if kind == "4D" else "FLAT",
+                  inp, kind, hw)
+
+        absorbed: set = set()
+        for node in order:
+            if node.name in absorbed or node.name in self.vals:
+                continue
+            try:
+                self._emit(node, consumers, absorbed)
+            except ValueError:
+                if not self.mixed:
+                    raise
+                self._emit_island(node)
+
+        out_name = (self._resolve(out_node.name).name
+                    if out_node.op == "Identity" else out_node.name)
+        final_kind = self.kind[out_name]
+        out = self._value_as(out_name,
+                             "NHWC" if final_kind == "4D" else "FLAT")
+
+        import jax.numpy as jnp
+        for m, p, s in self.presets:
+            m.set_parameters({k: jnp.asarray(v) for k, v in p.items()})
+            if s is not None:
+                m.set_state({k: jnp.asarray(v) for k, v in s.items()})
+        g = nn.Graph(inp, out)
+        g.fused_islands = list(self.islands)
+        g.evaluate()
+        g.ensure_initialized()
+        return g
+
+    # ------------------------------------------------- per-op emission
+    def _absorb_bias(self, node: TFNode, consumers, absorbed):
+        """Absorb a following bias-add into a Conv2D/MatMul when it is
+        the node's sole consumer. Returns (bias, out_name)."""
+        cons = consumers.get(node.name, [])
+        if len(cons) == 1:
+            b = self.f._bias_of(cons[0])
+            if b is not None and [t.name for t in
+                                  self._tensor_inputs(cons[0])] \
+                    == [node.name]:
+                absorbed.add(cons[0].name)
+                return b, cons[0].name
+        return None, node.name
+
+    def _emit(self, node: TFNode, consumers, absorbed):
+        import bigdl_tpu.nn as nn
+        f, op = self.f, node.op
+        tin = self._tensor_inputs(node)
+
+        if op == "Conv2D":
+            _require(node, "data_format", ("NHWC", None))
+            _require(node, "padding", ("SAME", "VALID"))
+            dil = node.attrs.get("dilations")
+            if dil is not None and any(d != 1 for d in dil):
+                raise ValueError(
+                    f"fusion: dilated Conv2D unsupported ({node.name})")
+            wgt = f.const(node.inputs[1])  # HWIO
+            kh, kw_ = wgt.shape[0], wgt.shape[1]
+            h, w = self.hw[tin[0].name]
+            sh, sw = node.attrs["strides"][1:3]
+            pad = node.attrs["padding"]
+            ph = 0 if pad == "VALID" else _same_pad(h, kh, sh)
+            pw = 0 if pad == "VALID" else _same_pad(w, kw_, sw)
+            bias, out_name = self._absorb_bias(node, consumers, absorbed)
+            m = nn.SpatialConvolution(wgt.shape[2], wgt.shape[3], kw_,
+                                      kh, sw, sh, pw, ph,
+                                      with_bias=bias is not None)
+            p = {"weight": np.transpose(wgt, (3, 2, 0, 1))}
+            if bias is not None:
+                p["bias"] = bias
+            self.presets.append((m, p, None))
+            gnode = m(self._value_as(tin[0].name, "NCHW"))
+            self._set(out_name, "NCHW", gnode, "4D",
+                      (_out_size(h, kh, sh, ph), _out_size(w, kw_, sw,
+                                                           pw)))
+        elif op == "MatMul":
+            if node.attrs.get("transpose_a") or \
+                    node.attrs.get("transpose_b"):
+                raise ValueError(
+                    f"fusion: transposed MatMul unsupported ({node.name})")
+            wgt = f.const(node.inputs[1])
+            bias, out_name = self._absorb_bias(node, consumers, absorbed)
+            m = nn.Linear(wgt.shape[0], wgt.shape[1],
+                          with_bias=bias is not None)
+            p = {"weight": wgt.T}
+            if bias is not None:
+                p["bias"] = bias
+            self.presets.append((m, p, None))
+            gnode = m(self._value_as(tin[0].name, "FLAT"))
+            self._set(out_name, "FLAT", gnode, "FLAT")
+        elif op in ("FusedBatchNorm", "FusedBatchNormV2",
+                    "FusedBatchNormV3"):
+            _require(node, "is_training", (False,))
+            _require(node, "data_format", ("NHWC", None))
+            scale = f.const(node.inputs[1])
+            m = nn.SpatialBatchNormalization(
+                len(scale), float(node.attrs.get("epsilon", 1e-3)))
+            self.presets.append(
+                (m, {"weight": scale, "bias": f.const(node.inputs[2])},
+                 {"running_mean": f.const(node.inputs[3]),
+                  "running_var": f.const(node.inputs[4])}))
+            gnode = m(self._value_as(tin[0].name, "NCHW"))
+            self._set(node.name, "NCHW", gnode, "4D",
+                      self.hw[tin[0].name])
+        elif op in ("MaxPool", "AvgPool"):
+            _require(node, "data_format", ("NHWC", None))
+            _require(node, "padding", ("SAME", "VALID"))
+            kh, kw_ = node.attrs["ksize"][1:3]
+            sh, sw = node.attrs["strides"][1:3]
+            h, w = self.hw[tin[0].name]
+            pad = node.attrs["padding"]
+            ph = 0 if pad == "VALID" else _same_pad(h, kh, sh)
+            pw = 0 if pad == "VALID" else _same_pad(w, kw_, sw)
+            ceil = pad == "SAME"
+            if op == "MaxPool":
+                m = nn.SpatialMaxPooling(kw_, kh, sw, sh, pw, ph)
+            else:
+                m = nn.SpatialAveragePooling(
+                    kw_, kh, sw, sh, pw, ph, count_include_pad=False)
+            if ceil:
+                m = m.ceil()
+            gnode = m(self._value_as(tin[0].name, "NCHW"))
+            self._set(node.name, "NCHW", gnode, "4D",
+                      (_out_size(h, kh, sh, ph, ceil),
+                       _out_size(w, kw_, sw, pw, ceil)))
+        elif op == "Relu":
+            lay = self._natural(tin[0].name)
+            gnode = nn.ReLU()(self.vals[tin[0].name][lay])
+            self._set(node.name, lay, gnode, self.kind[tin[0].name],
+                      self.hw[tin[0].name])
+        elif op == "Softmax":
+            if self.kind[tin[0].name] == "4D":
+                gnode = nn.SoftMax()(self._value_as(tin[0].name, "NHWC"))
+                self._set(node.name, "NHWC", gnode, "4D",
+                          self.hw[tin[0].name])
+            else:
+                gnode = nn.SoftMax()(self._value_as(tin[0].name, "FLAT"))
+                self._set(node.name, "FLAT", gnode, "FLAT")
+        elif op == "Reshape":
+            tgt = [int(v) for v in
+                   np.asarray(f.const(node.inputs[1])).ravel()]
+            # TF flatten reshapes in H,W,C order — feed from NHWC
+            src = self._value_as(
+                tin[0].name,
+                "NHWC" if self.kind[tin[0].name] == "4D" else "FLAT")
+            if len(tgt) == 2 and tgt[0] == -1:
+                gnode = nn.View(tgt[1])(src)
+                self._set(node.name, "FLAT", gnode, "FLAT")
+            elif len(tgt) == 4:
+                gnode = nn.Reshape(tuple(tgt[1:]))(src)
+                self._set(node.name, "NHWC", gnode, "4D",
+                          (tgt[1], tgt[2]))
+            else:
+                gnode = nn.Reshape(tuple(tgt[1:]))(src)
+                self._set(node.name, "FLAT", gnode, "FLAT")
+        elif op in ("Concat", "ConcatV2"):
+            axis_ref = node.inputs[0] if op == "Concat" \
+                else node.inputs[-1]
+            axis = int(np.asarray(f.const(axis_ref)).ravel()[0])
+            kinds = {self.kind[t.name] for t in tin}
+            if len(kinds) != 1:
+                raise ValueError(
+                    f"fusion: concat of mixed-rank values ({node.name})")
+            if kinds == {"4D"}:
+                if axis < 0:
+                    axis += 4
+                nchw_axis = self._NHWC2NCHW[axis]
+                srcs = [self._value_as(t.name, "NCHW") for t in tin]
+                gnode = nn.JoinTable(nchw_axis + 1)(*srcs)
+                h, w = self.hw[tin[0].name]
+                if axis in (1, 2):  # spatial concat changes H or W
+                    sizes = [self.hw[t.name][axis - 1] for t in tin]
+                    tot = None if any(s is None for s in sizes) \
+                        else sum(sizes)
+                    h, w = (tot, w) if axis == 1 else (h, tot)
+                self._set(node.name, "NCHW", gnode, "4D", (h, w))
+            else:
+                if axis < 0:
+                    axis += 2
+                srcs = [self._value_as(t.name, "FLAT") for t in tin]
+                gnode = nn.JoinTable(axis + 1)(*srcs)
+                self._set(node.name, "FLAT", gnode, "FLAT")
+        elif op in ("Add", "AddV2") and len(tin) == 2:
+            kinds = {self.kind[t.name] for t in tin}
+            if len(kinds) != 1:
+                raise ValueError(
+                    f"fusion: add of mixed-rank values ({node.name})")
+            lay = "NCHW" if kinds == {"4D"} else "FLAT"
+            gnode = nn.CAddTable()(self._value_as(tin[0].name, lay),
+                                   self._value_as(tin[1].name, lay))
+            self._set(node.name, lay, gnode, self.kind[tin[0].name],
+                      self.hw[tin[0].name])
+        elif op in ("Add", "AddV2", "BiasAdd") and len(tin) == 1:
+            # un-absorbed bias-add (producer has other consumers): a
+            # real standalone module would need a broadcast-add layer;
+            # fall back (mixed mode wraps it)
+            raise ValueError(
+                f"fusion: standalone bias-add ({node.name}) not "
+                "absorbed; import with TFModule instead")
+        else:
+            raise ValueError(
+                f"fusion table has no pattern for op {op} (node "
+                f"{node.name}); import with TFModule instead")
+
+    def _emit_island(self, node: TFNode):
+        """Wrap one unsupported node as a single-op TFModule rebuilt
+        from raw NodeDef bytes (stays serializable)."""
+        from bigdl_tpu.utils.tf_loader import TFModule
+        from bigdl_tpu.utils import proto
+        tin = self._tensor_inputs(node)
+        if len(tin) != 1:
+            raise ValueError(
+                f"fusion: cannot island multi-input op {node.op} "
+                f"({node.name}); import with TFModule instead")
+        if getattr(node, "raw", None) is None:
+            raise ValueError(
+                f"fusion: no raw NodeDef bytes for {node.name} (parse "
+                "the graph from bytes to enable mixed mode)")
+        # placeholder standing in for the tensor input + the const
+        # (and Identity) dependencies this node references
+        blob = b""
+        ph_name = None
+        for ref in node.inputs:
+            if ref.startswith("^"):
+                continue
+            nm = ref.split(":")[0]
+            dep = self.f.by_name[nm]
+            chain = []
+            while dep.op == "Identity":
+                chain.append(dep)
+                dep = self.f.by_name[
+                    dep.inputs[0].split(":")[0].lstrip("^")]
+            if dep.op == "Const":
+                for c in chain + [dep]:
+                    blob += c.raw
+            else:
+                ph_name = nm
+                msg = proto.encode_field(1, nm) + \
+                    proto.encode_field(2, "Placeholder")
+                blob += proto.encode_message(1, msg)
+        blob += node.raw
+        m = TFModule(blob, inputs=[ph_name], outputs=[node.name])
+        kind = self.kind[tin[0].name]
+        lay = "NHWC" if kind == "4D" else "FLAT"
+        gnode = m(self._value_as(tin[0].name, lay))
+        # unknown op: layout assumed preserved, spatial size UNKNOWN —
+        # a downstream stride>1 SAME conv/pool then fails loudly in
+        # _same_pad instead of computing padding from a stale H,W
+        self._set(node.name, lay, gnode, kind, (None, None))
+        self.islands.append(f"{node.name}:{node.op}")
+
+
+def _is_chain(nodes: List[TFNode], fuser: _Fuser) -> bool:
+    """True when every reachable tensor value feeds exactly one
+    consumer and no table op (Concat/two-tensor Add) appears."""
+    dag = _DagFuser(fuser, mixed=False)
+    counts: Dict[str, int] = {}
+    for n in nodes:
+        if n.op in ("Const", "Placeholder"):
+            continue
+        if n.op in ("Concat", "ConcatV2"):
+            return False
+        tin = dag._tensor_inputs(n)
+        if n.op in ("Add", "AddV2") and len(tin) == 2:
+            return False
+        for p in tin:
+            counts[p.name] = counts.get(p.name, 0) + 1
+    return all(c <= 1 for c in counts.values())
+
+
 def fuse_tf_graph(nodes_or_bytes,
                   inputs: Optional[Sequence[str]] = None,
-                  outputs: Optional[Sequence[str]] = None):
-    """GraphDef (bytes or parsed TFNode list) -> a Sequential of real
-    nn modules with the TF weights installed (TensorflowToBigDL.scala:1).
+                  outputs: Optional[Sequence[str]] = None,
+                  mixed: bool = False):
+    """GraphDef (bytes or parsed TFNode list) -> real nn modules with
+    the TF weights installed (TensorflowToBigDL.scala:1): a
+    ``Sequential`` for a pure chain, a ``Graph`` for a branchy DAG
+    (Inception-style branch/concat, residual adds).
 
     The fused module is NHWC-in/NHWC-out like the TF graph, survives
     ``nn.quantized.quantize`` and the module serializer, and — unlike
-    ``TFModule`` — reads as layers."""
+    ``TFModule`` — reads as layers. With ``mixed=True`` unsupported
+    single-input nodes become one-op TFModule islands (listed on
+    ``fused_islands``) instead of failing the whole import."""
     if isinstance(nodes_or_bytes, (bytes, bytearray)):
         nodes = parse_graphdef(bytes(nodes_or_bytes))
     else:
         nodes = list(nodes_or_bytes)
-    return _Fuser(nodes, inputs, outputs).fuse()
+    fuser = _Fuser(nodes, inputs, outputs)
+    if not mixed and _is_chain(nodes, fuser):
+        return fuser.fuse()
+    return _DagFuser(fuser, mixed).fuse()

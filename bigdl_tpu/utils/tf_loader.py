@@ -148,6 +148,8 @@ def _parse_attr(buf: bytes) -> Any:
 
 
 class TFNode:
+    """One parsed GraphDef NodeDef (name/op/inputs/attrs; ``raw``
+    keeps the wire record for re-emission)."""
     def __init__(self, name: str, op: str, inputs: List[str],
                  attrs: Dict[str, Any]):
         self.name = name
@@ -160,6 +162,8 @@ class TFNode:
 
 
 def parse_graphdef(data: bytes) -> List[TFNode]:
+    """Frozen GraphDef bytes -> [TFNode] via the in-repo protobuf
+    codec (no tensorflow dependency)."""
     nodes = []
     for buf in proto.parse_message(data).get(1, []):
         f = proto.parse_message(buf)
@@ -171,7 +175,12 @@ def parse_graphdef(data: bytes) -> List[TFNode]:
             af = proto.parse_message(ab)
             key = proto.as_string(af.get(1, [b""])[0])
             attrs[key] = _parse_attr(af.get(2, [b""])[0])
-        nodes.append(TFNode(name, op, inputs, attrs))
+        n = TFNode(name, op, inputs, attrs)
+        # raw wire record (length-delimited field 1): lets consumers
+        # re-emit this exact NodeDef into a sub-GraphDef (tf_fusion's
+        # mixed-mode TFModule islands stay byte-serializable)
+        n.raw = proto.encode_message(1, buf)
+        nodes.append(n)
     return nodes
 
 

@@ -108,11 +108,12 @@ def _imagefolder_mode(pid: int, folder: str):
                       "last_loss": opt.driver_state["Loss"]}))
 
 
-def run_parallel_case(kind: str, devices):
-    """ONE definition of the TP/PP equivalence case, imported by both
-    the worker (spanning mesh over ``jax.devices()``) and the parent
-    test's single-process oracle (local devices) — hyperparameters and
-    data cannot drift between the two sides. Returns driver_state.
+def run_parallel_case(kind: str, devices, pid=None):
+    """ONE definition of the TP/PP/EP/composed equivalence cases,
+    imported by both the worker (spanning mesh over ``jax.devices()``)
+    and the parent test's single-process oracle (local devices) —
+    hyperparameters and data cannot drift between the two sides.
+    Returns driver_state.
 
     tp: megatron TP on a [1, 4] ("data","model") mesh — the size-1
     data axis is what the flagship recipe's mesh builder emits when TP
@@ -120,6 +121,16 @@ def run_parallel_case(kind: str, devices):
     regime, not the per-process-concat DP branch.
     pp: GPipe on a [1, 4] ("data","pipe") mesh — the ppermute
     activation ring crosses whatever transport separates the devices.
+    ep: MoE TransformerLM on a [1, 2] ("data","model") mesh with the
+    EXPERT axis spanning the processes — routed-expert dispatch
+    collectives cross the real transport.
+    composed: PipelinedTransformerLM+MoE on a [2, 2, 2]
+    ("data","pipe","model") mesh — data axis SPANS the two processes
+    (sharded-batch regime: each side feeds its half) while pipe/model
+    run within each process: the full DP×TP×PP×EP product on one
+    spanning mesh behind one optimize() call. ``pid`` (composed only):
+    None = oracle feeds interleaved per-process blocks, else this
+    process's half.
     """
     import numpy as np
 
@@ -139,6 +150,29 @@ def run_parallel_case(kind: str, devices):
             lm = TransformerLM(vocab_size=32, hidden_size=16,
                                num_layers=2, num_heads=4, max_len=8)
             return lm, lm.sharding_rules(model_axis="model")
+    elif kind == "ep":
+        from bigdl_tpu.models import TransformerLM
+        mesh = make_mesh([1, 2], ["data", "model"], devices)
+        seed = 19
+
+        def build():
+            lm = TransformerLM(vocab_size=32, hidden_size=16,
+                               num_layers=2, num_heads=4, max_len=8,
+                               moe_experts=2, moe_every=1)
+            return lm, lm.sharding_rules(model_axis="model",
+                                         expert_axis="model")
+    elif kind == "composed":
+        from bigdl_tpu.models import PipelinedTransformerLM
+        mesh = make_mesh([2, 2, 2], ["data", "pipe", "model"], devices)
+        seed = 17
+
+        def build():
+            lm = PipelinedTransformerLM(vocab_size=32, hidden_size=16,
+                                        num_layers=4, num_heads=2,
+                                        max_len=8, n_microbatches=2,
+                                        mesh=mesh, moe_experts=2)
+            return lm, lm.sharding_rules(model_axis="model",
+                                         expert_axis="model")
     else:
         from bigdl_tpu.models import PipelinedTransformerLM
         mesh = make_mesh([1, 4], ["data", "pipe"], devices)
@@ -153,14 +187,28 @@ def run_parallel_case(kind: str, devices):
 
     rng = np.random.RandomState(seed)
     toks = rng.randint(0, 32, (32, 9))
-    samples = [Sample(toks[i, :-1].astype(np.int32),
-                      toks[i, 1:].astype(np.int32)) for i in range(32)]
-    ds = DataSet.array(samples).transform(SampleToMiniBatch(8))
+    all_samples = [Sample(toks[i, :-1].astype(np.int32),
+                          toks[i, 1:].astype(np.int32)) for i in range(32)]
+    if kind == "composed":
+        # sharded-batch regime over the spanning data axis: global batch
+        # i = concat(p0 batch i, p1 batch i)
+        if pid is None:
+            order = []
+            for i in range(4):
+                order += list(range(i * 4, i * 4 + 4))
+                order += list(range(16 + i * 4, 16 + i * 4 + 4))
+            samples, bs = [all_samples[i] for i in order], 8
+        else:
+            samples, bs = all_samples[pid * 16:pid * 16 + 16], 4
+    else:
+        # replicated-batch regime (no data axis > 1): all rows each side
+        samples, bs = all_samples, 8
 
     RandomGenerator.set_seed(42)
     lm, rules = build()
+    ds = DataSet.array(samples).transform(SampleToMiniBatch(bs))
     opt = Optimizer(lm, ds, nn.SequenceCrossEntropyCriterion(),
-                    batch_size=8, mesh=mesh, sharding_rules=rules)
+                    batch_size=bs, mesh=mesh, sharding_rules=rules)
     opt.set_optim_method(SGD(learning_rate=0.5))
     opt.set_end_when(_step_marker(max_iteration(4)))
     opt.optimize()
@@ -182,12 +230,12 @@ def _step_marker(base_trigger):
 
 
 def _tp_or_pp_mode(pid: int, kind: str):
-    """TP/PP whose parallel axis SPANS two OS processes: every
-    collective crosses the real inter-process transport; the batch is
-    replicated (both processes feed identical rows)."""
+    """TP/PP/EP/composed over a mesh spanning two OS processes (see
+    run_parallel_case for the per-kind regime)."""
     import jax
 
-    state = run_parallel_case(kind, jax.devices())
+    state = run_parallel_case(kind, jax.devices(),
+                              pid if kind == "composed" else None)
     print(json.dumps({"ok": True, "pid": pid,
                       "last_loss": state["Loss"],
                       "neval": state["neval"]}))
@@ -255,6 +303,56 @@ def _sparse_mode(pid: int):
                       "neval": state["neval"]}))
 
 
+def run_predict_case(pid_or_none, devices):
+    """Shared distributed-inference case: Predictor/Evaluator over a
+    spanning data mesh. Worker passes its process id (feeds its HALF of
+    the dataset, gets back its rows' predictions); the single-process
+    oracle passes None (all rows). Returns (preds ndarray, global
+    Top1Accuracy)."""
+    import numpy as np
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import DataSet, Sample
+    from bigdl_tpu.optim import Top1Accuracy
+    from bigdl_tpu.optim.evaluator import Evaluator
+    from bigdl_tpu.optim.predictor import Predictor
+    from bigdl_tpu.parallel import make_mesh
+    from bigdl_tpu.utils.random import RandomGenerator
+
+    mesh = make_mesh([len(devices)], ["data"], devices)
+    rng = np.random.RandomState(23)
+    xs = rng.randn(32, 10).astype(np.float32)
+    ys = (rng.randint(0, 3, 32) + 1).astype(np.float32)
+    # oracle feeds the GLOBAL batch (8 rows over 8 devices); each
+    # worker feeds its 4-row half of it
+    lo, hi, bs = (0, 32, 8) if pid_or_none is None \
+        else (pid_or_none * 16, pid_or_none * 16 + 16, 4)
+    samples = [Sample(xs[i], ys[i]) for i in range(lo, hi)]
+    ds = DataSet.array(samples)
+
+    RandomGenerator.set_seed(42)
+    model = (nn.Sequential().add(nn.Linear(10, 16)).add(nn.Tanh())
+             .add(nn.Linear(16, 3)).add(nn.LogSoftMax()))
+    preds = Predictor(model, mesh=mesh).predict(ds, batch_size=bs)
+    res = Evaluator(model, mesh=mesh).test(ds, [Top1Accuracy()],
+                                           batch_size=bs)
+    score, n = res["Top1Accuracy"].result()
+    return np.stack(preds), score, n
+
+
+def _predict_mode(pid: int):
+    """Distributed inference over a mesh spanning two OS processes:
+    each process feeds ITS dataset shard and must get back exactly its
+    rows' predictions; the evaluator reduces scores globally so both
+    processes report the same accuracy over all 32 rows."""
+    import jax
+
+    preds, score, n = run_predict_case(pid, jax.devices())
+    print(json.dumps({"ok": True, "pid": pid, "n": int(n),
+                      "score": float(score),
+                      "preds": preds.tolist()}))
+
+
 def _rotate_mode(pid: int):
     """ShardRotator with slots sharded over a mesh SPANNING both
     processes: each process's provider returns its local shard rows,
@@ -319,7 +417,7 @@ def main():
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = (
         "--xla_force_host_platform_device_count="
-        + {"smoke": "1", "tp": "2", "pp": "2"}.get(mode, "4"))
+        + {"smoke": "1", "tp": "2", "pp": "2", "ep": "1"}.get(mode, "4"))
 
     import numpy as np
 
@@ -350,17 +448,19 @@ def main():
         # timeout -> FAIL)
         print(f"RENDEZVOUS_OK {pid}", flush=True)
         if mode in ("optimizer", "imagefolder", "rotate", "tp", "pp",
-                    "sparse"):
+                    "ep", "composed", "sparse", "predict"):
             # bring-up succeeded: failures past this point are REAL
             # regressions and must crash the worker (SystemExit bypasses
             # the skip-catch below), not print a skip
             try:
                 if mode == "optimizer":
                     _optimizer_mode(pid)
-                elif mode in ("tp", "pp"):
+                elif mode in ("tp", "pp", "ep", "composed"):
                     _tp_or_pp_mode(pid, mode)
                 elif mode == "sparse":
                     _sparse_mode(pid)
+                elif mode == "predict":
+                    _predict_mode(pid)
                 elif mode == "rotate":
                     _rotate_mode(pid)
                 else:

@@ -2,8 +2,10 @@
 ``bigdl_tpu.tools.launch``; not itself a pytest file).
 
 Trains a small deterministic model over a 2-process spanning mesh with
-periodic checkpoints. When ``kill_at > 0``, process 1 SIGKILLs ITSELF
-right before that iteration — but only on the first incarnation
+periodic checkpoints into ONE shared directory (single-writer: process 0
+writes, both resume from it — the reference's driver-side checkpoint,
+DistriOptimizer.scala:433-463). When ``kill_at > 0``, process 1 SIGKILLs
+ITSELF right before that iteration — but only on the first incarnation
 (``BIGDL_RESTART_ATTEMPT == 0``), the scripted-failure pattern of the
 reference's ExceptionTest (test/.../utils/TestUtils.scala:103-131). The
 relaunched gang resumes from the latest checkpoint; because the feed is
@@ -12,7 +14,13 @@ the augmentation is deterministic, and the checkpoint captures
 params + momentum + driver state, the final loss must equal an
 uninterrupted run's bit-for-bit.
 
-argv: ckpt_root kill_at
+When ``crash_ckpt_at`` is given, the WRITER process instead dies MID
+checkpoint-write at that neval (after the tree files, before the
+MANIFEST — serialization._maybe_scripted_crash), leaving a torn tmp
+dir; the restarted gang must resume from the previous INTACT
+checkpoint and still reach the uninterrupted run's final loss.
+
+argv: ckpt_root kill_at [crash_ckpt_at]
 """
 import json
 import os
@@ -22,6 +30,12 @@ import sys
 
 def main():
     ckpt_root, kill_at = sys.argv[1], int(sys.argv[2])
+    crash_ckpt_at = int(sys.argv[3]) if len(sys.argv) > 3 else 0
+    if crash_ckpt_at and int(os.environ.get("BIGDL_RESTART_ATTEMPT",
+                                            "0")) == 0:
+        # arm the mid-checkpoint-write SIGKILL (first incarnation only —
+        # the resumed gang replays the same neval and must survive it)
+        os.environ["BIGDL_TEST_CRASH_IN_CHECKPOINT"] = str(crash_ckpt_at)
 
     import jax
     jax.config.update("jax_platforms", "cpu")
@@ -75,9 +89,9 @@ def main():
     opt = Optimizer(model, ds, nn.ClassNLLCriterion(), batch_size=8,
                     mesh=mesh)
     opt.set_optim_method(KillingSGD(learning_rate=0.2, momentum=0.9))
-    # per-process checkpoint dir: each rank restores its own latest
-    opt.set_checkpoint(os.path.join(ckpt_root, f"rank{pid}"),
-                       several_iteration(2))
+    # ONE shared checkpoint dir: process 0 writes (single-writer), both
+    # ranks resume from it
+    opt.set_checkpoint(ckpt_root, several_iteration(2))
     opt.set_end_when(max_iteration(8))
     opt.optimize()
 

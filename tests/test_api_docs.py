@@ -1,0 +1,63 @@
+"""API-reference completeness gate (the reference shipped a full
+per-layer APIGuide, docs/docs/APIGuide/, and per-model READMEs,
+models/resnet/README.md:25-56 — this suite asserts our generated
+equivalent can never silently rot)."""
+import os
+
+import pytest
+
+from bigdl_tpu.tools.gen_api_docs import (FAMILIES, generate,
+                                          generate_family, undocumented)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_every_public_symbol_documented():
+    missing = undocumented()
+    assert missing == [], (
+        f"{len(missing)} undocumented public symbols (add docstrings "
+        f"or fix __all__): {missing[:20]}")
+
+
+def test_family_pages_generate_with_content():
+    for fam in FAMILIES:
+        page = generate_family(fam)
+        # each page indexes at least a handful of symbols
+        assert page.count("- **`") >= 3, (fam, page[:500])
+
+
+def test_api_index_links_family_pages():
+    idx = generate()
+    for fam in FAMILIES:
+        assert f"api/{fam}.md" in idx
+
+
+def test_generated_docs_are_committed_and_current():
+    """docs/api.md + per-family pages exist in the tree; the index
+    must mention every module the generator covers (regenerate with
+    `python -m bigdl_tpu.tools.gen_api_docs` after API changes)."""
+    idx_path = os.path.join(REPO, "docs", "api.md")
+    assert os.path.exists(idx_path)
+    with open(idx_path) as f:
+        committed = f.read()
+    from bigdl_tpu.tools.gen_api_docs import MODULES
+    for m in MODULES:
+        assert f"`{m}`" in committed, f"docs/api.md is stale: missing {m}"
+    for fam in FAMILIES:
+        assert os.path.exists(os.path.join(REPO, "docs", "api",
+                                           fam + ".md"))
+
+
+def test_every_zoo_family_has_readme():
+    """Per-model READMEs, like the reference's models/*/README.md."""
+    zoo = os.path.join(REPO, "bigdl_tpu", "models")
+    fams = [d for d in os.listdir(zoo)
+            if os.path.isdir(os.path.join(zoo, d))
+            and not d.startswith("_")]
+    assert len(fams) >= 8
+    for fam in fams:
+        readme = os.path.join(zoo, fam, "README.md")
+        assert os.path.exists(readme), f"missing {readme}"
+        with open(readme) as f:
+            text = f.read()
+        assert "train" in text and "python -m" in text, readme

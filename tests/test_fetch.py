@@ -117,3 +117,53 @@ def test_news20_skips_non_article_files(tmp_path):
     (d / "backup~").write_text("junk")
     texts = parse_news20_tree(str(tmp_path))
     assert texts == [("real article", 1)]
+
+
+def test_lenet_cli_automaterializes_mnist(tmp_path, monkeypatch):
+    """The zoo CLI runs from NOTHING (reference:
+    pyspark/bigdl/models/lenet/lenet5.py:24-30): with -f pointing at an
+    empty dir, mnist_arrays auto-downloads via fetch (file:// mirror
+    stands in for the network) and the recipe proceeds."""
+    import bigdl_tpu.dataset.fetch as fetch
+    from bigdl_tpu.models._cli import mnist_arrays
+
+    rng = np.random.RandomState(3)
+    src = tmp_path / "mirror"
+    src.mkdir()
+    _write_idx(src, rng)
+    # the mirror serves train-* under both prefixes
+    for p in ("t10k-images-idx3-ubyte.gz", "t10k-labels-idx1-ubyte.gz"):
+        (src / p).write_bytes(
+            (src / p.replace("t10k", "train")).read_bytes())
+    monkeypatch.setattr(fetch, "MNIST_URL",
+                        "file://" + str(src) + "/")
+    dst = tmp_path / "data"
+    xs, ys = mnist_arrays(str(dst), True)
+    assert xs.shape == (5, 1, 28, 28) and xs.dtype == np.float32
+    assert ys.min() >= 1 and ys.max() <= 10  # 1-based labels
+    # second call reads the now-cached files, no URL involved
+    monkeypatch.setattr(fetch, "MNIST_URL", "http://invalid.invalid/")
+    xs2, _ = mnist_arrays(str(dst), True)
+    np.testing.assert_array_equal(xs, xs2)
+
+
+def test_rnn_cli_automaterializes_corpus(tmp_path, monkeypatch):
+    """models/rnn (and transformer) auto-fetch their text corpus when
+    -f has no train.txt; offline failure exits with a clear message."""
+    import pytest
+
+    import bigdl_tpu.dataset.fetch as fetch
+
+    corpus = tmp_path / "corpus.txt"
+    corpus.write_text("the quick brown fox jumps over the lazy dog . " * 30)
+    monkeypatch.setattr(fetch, "SHAKESPEARE_URL",
+                        "file://" + str(corpus))
+    got = fetch.get_text_corpus(str(tmp_path / "data"))
+    assert os.path.exists(got) and got.endswith("train.txt")
+
+    # offline: the CLI must exit with guidance, not a stack trace
+    from bigdl_tpu.models.rnn import train as rnn_train
+    monkeypatch.setattr(fetch, "SHAKESPEARE_URL",
+                        "file:///nonexistent/nowhere.txt")
+    with pytest.raises(SystemExit, match="auto-download"):
+        rnn_train.main(["-f", str(tmp_path / "empty"), "-e", "1"])

@@ -148,6 +148,136 @@ def test_quantized_conv_keeps_name():
     assert q.find("conv1") is not None
 
 
+def _save_ck(path, neval, val=1.0):
+    import numpy as np
+
+    from bigdl_tpu.utils.serialization import save_checkpoint
+    save_checkpoint(str(path), params={"w": np.full(3, val, np.float32)},
+                    opt_state={}, model_state={},
+                    optim_host_state={}, driver_state={"neval": neval})
+
+
+def test_checkpoint_atomic_write_and_manifest(tmp_path):
+    """save_checkpoint commits via tmp-dir + MANIFEST-last + rename: the
+    final dir always carries a MANIFEST and no staging debris remains."""
+    import os
+
+    from bigdl_tpu.utils.serialization import (MANIFEST,
+                                               find_latest_checkpoint,
+                                               load_checkpoint)
+
+    _save_ck(tmp_path / "checkpoint.2", 2, 1.0)
+    _save_ck(tmp_path / "checkpoint.4", 4, 2.0)
+    assert (tmp_path / "checkpoint.4" / MANIFEST).exists()
+    assert [n for n in os.listdir(tmp_path)
+            if ".tmp-" in n or ".old-" in n] == []
+    latest = find_latest_checkpoint(str(tmp_path))
+    assert latest.endswith("checkpoint.4")
+    assert load_checkpoint(latest)["params"]["w"][0] == 2.0
+
+
+def test_find_latest_skips_torn_checkpoint(tmp_path):
+    """A STAGING dir with tree files but NO MANIFEST (the real mid-write
+    crash artifact: writes happen in .tmp-*, never at the final name) is
+    never selected — resume lands on the previous intact checkpoint."""
+    from bigdl_tpu.utils.serialization import (MANIFEST,
+                                               find_latest_checkpoint)
+
+    _save_ck(tmp_path / "checkpoint.2", 2)
+    _save_ck(tmp_path / "checkpoint.6", 6)
+    # simulate the torn write: a .tmp- staging dir whose MANIFEST was
+    # never reached
+    (tmp_path / "checkpoint.6" / MANIFEST).unlink()
+    (tmp_path / "checkpoint.6").rename(tmp_path / "checkpoint.6.tmp-42")
+    latest = find_latest_checkpoint(str(tmp_path))
+    assert latest.endswith("checkpoint.2")
+
+
+def test_find_latest_accepts_legacy_format0_checkpoint(tmp_path):
+    """Back-compat: a properly-named pre-MANIFEST checkpoint (format 0
+    — host_state.json was its completeness marker) still resumes."""
+    from bigdl_tpu.utils.serialization import (MANIFEST,
+                                               find_latest_checkpoint,
+                                               load_checkpoint)
+
+    _save_ck(tmp_path / "checkpoint.4", 4, 2.0)
+    (tmp_path / "checkpoint.4" / MANIFEST).unlink()  # as written by r4
+    latest = find_latest_checkpoint(str(tmp_path))
+    assert latest is not None and latest.endswith("checkpoint.4")
+    assert load_checkpoint(latest)["params"]["w"][0] == 2.0
+
+
+def test_find_latest_recovers_stray_complete_tmp(tmp_path):
+    """A COMPLETE staging dir (MANIFEST written, crash before the final
+    rename) is still found: no crash point loses the newest state."""
+    from bigdl_tpu.utils.serialization import (find_latest_checkpoint,
+                                               load_checkpoint)
+
+    _save_ck(tmp_path / "checkpoint.2", 2, 1.0)
+    _save_ck(tmp_path / "checkpoint.6", 6, 3.0)
+    (tmp_path / "checkpoint.6").rename(tmp_path / "checkpoint.6.tmp-999")
+    latest = find_latest_checkpoint(str(tmp_path))
+    assert ".tmp-999" in latest
+    ck = load_checkpoint(latest)
+    assert ck["driver_state"]["neval"] == 6
+    assert ck["params"]["w"][0] == 3.0
+
+
+def test_overwrite_checkpoint_transitions_complete_to_complete(tmp_path):
+    """Re-saving the same fixed name (overwrite_checkpoint mode) swaps
+    atomically: the dir is replaced, never torn, debris cleaned."""
+    import os
+
+    from bigdl_tpu.utils.serialization import (MANIFEST,
+                                               find_latest_checkpoint,
+                                               load_checkpoint)
+
+    _save_ck(tmp_path / "checkpoint", 2, 1.0)
+    _save_ck(tmp_path / "checkpoint", 9, 5.0)
+    assert sorted(os.listdir(tmp_path)) == ["checkpoint"]
+    assert (tmp_path / "checkpoint" / MANIFEST).exists()
+    latest = find_latest_checkpoint(str(tmp_path))
+    ck = load_checkpoint(latest)
+    assert ck["driver_state"]["neval"] == 9
+    assert ck["params"]["w"][0] == 5.0
+
+
+def test_scripted_crash_in_checkpoint_leaves_previous_intact(tmp_path):
+    """End-to-end torn-write: a subprocess SIGKILLs ITSELF mid-
+    checkpoint-write (BIGDL_TEST_CRASH_IN_CHECKPOINT); the directory
+    must still resolve to the previous intact checkpoint."""
+    import os
+    import subprocess
+    import sys
+
+    from bigdl_tpu.utils.serialization import find_latest_checkpoint
+
+    code = (
+        "import numpy as np\n"
+        "from bigdl_tpu.utils.serialization import save_checkpoint\n"
+        "import sys\n"
+        "root = sys.argv[1]\n"
+        "def sv(neval):\n"
+        "    save_checkpoint(root + f'/checkpoint.{neval}',\n"
+        "        params={'w': np.full(3, float(neval), np.float32)},\n"
+        "        opt_state={}, model_state={}, optim_host_state={},\n"
+        "        driver_state={'neval': neval})\n"
+        "sv(2)\n"
+        "sv(4)\n"  # BIGDL_TEST_CRASH_IN_CHECKPOINT=4 kills here
+        "sv(6)\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))) + os.pathsep + env.get("PYTHONPATH", "")
+    env["BIGDL_TEST_CRASH_IN_CHECKPOINT"] = "4"
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    r = subprocess.run([sys.executable, "-c", code, str(tmp_path)],
+                       capture_output=True, text=True, timeout=120,
+                       env=env)
+    assert r.returncode == -9, (r.returncode, r.stderr[-500:])
+    latest = find_latest_checkpoint(str(tmp_path))
+    assert latest is not None and latest.endswith("checkpoint.2"), latest
+
+
 def test_checkpoint_roundtrip_via_memory_filesystem():
     """Remote checkpoint IO (utils/File.scala HDFS/S3 role): fsspec's
     memory:// filesystem is the transport oracle."""

@@ -111,6 +111,137 @@ def test_fusion_rejects_unknown_ops_with_name():
         fuse_tf_graph(data, inputs=ins, outputs=outs)
 
 
+def _inception_graph():
+    """A tiny Inception-style branchy net: stem conv -> three parallel
+    branches (1x1 conv / 3x3 conv / maxpool+1x1 conv) -> channel concat
+    -> relu -> flatten -> dense -> softmax — the branch-and-concat
+    topology the reference's fusion table existed for."""
+    rs = np.random.RandomState(7)
+
+    def cw(kh, kw, ci, co):
+        return tf.constant(rs.randn(kh, kw, ci, co).astype(np.float32)
+                           * 0.25)
+
+    k0 = cw(3, 3, 3, 8)
+    b0 = tf.constant(rs.randn(8).astype(np.float32) * 0.1)
+    k1 = cw(1, 1, 8, 4)
+    k3 = cw(3, 3, 8, 6)
+    b3 = tf.constant(rs.randn(6).astype(np.float32) * 0.1)
+    kp = cw(1, 1, 8, 4)
+    wd = tf.constant(rs.randn(14 * 8 * 8, 5).astype(np.float32) * 0.1)
+    bd = tf.constant(rs.randn(5).astype(np.float32) * 0.1)
+
+    def fn(x):
+        stem = tf.nn.relu(tf.nn.bias_add(
+            tf.nn.conv2d(x, k0, 1, "SAME"), b0))
+        br1 = tf.nn.conv2d(stem, k1, 1, "SAME")
+        br3 = tf.nn.relu(tf.nn.bias_add(
+            tf.nn.conv2d(stem, k3, 1, "SAME"), b3))
+        brp = tf.nn.conv2d(tf.nn.max_pool2d(stem, 3, 1, "SAME"), kp, 1,
+                           "SAME")
+        y = tf.nn.relu(tf.concat([br1, br3, brp], axis=3))
+        y = tf.reshape(y, [-1, 14 * 8 * 8])
+        return tf.nn.softmax(tf.matmul(y, wd) + bd)
+
+    return fn
+
+
+def test_branchy_inception_fusion_matches_tf():
+    fn = _inception_graph()
+    x = np.random.RandomState(11).randn(2, 8, 8, 3).astype(np.float32)
+    data, ins, outs = _freeze(fn, tf.TensorSpec([None, 8, 8, 3],
+                                                tf.float32))
+    model = fuse_tf_graph(data, inputs=ins, outputs=outs)
+    got = np.asarray(model.forward(x))
+    want = np.asarray(fn(tf.constant(x)))
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=1e-4)
+    # a branchy import fuses to a Graph of REAL layers incl. the join
+    kinds = [type(m).__name__ for m in model.modules]
+    assert type(model).__name__ == "Graph"
+    assert kinds.count("SpatialConvolution") == 4
+    assert "JoinTable" in kinds and "SpatialMaxPooling" in kinds
+
+
+def test_branchy_fusion_quantizes_and_serializes(tmp_path):
+    """The whole point of fusion for the Inception model class: the
+    branchy import survives quantize() AND the module serializer."""
+    from bigdl_tpu.nn.quantized import quantize
+    from bigdl_tpu.utils.serialization import load_module, save_module
+
+    fn = _inception_graph()
+    x = np.random.RandomState(12).randn(2, 8, 8, 3).astype(np.float32)
+    data, ins, outs = _freeze(fn, tf.TensorSpec([None, 8, 8, 3],
+                                                tf.float32))
+    model = fuse_tf_graph(data, inputs=ins, outputs=outs)
+    ref = np.asarray(model.forward(x))
+    q = quantize(model)
+    got = np.asarray(q.forward(x))
+    assert got.shape == ref.shape
+    assert (got.argmax(-1) == ref.argmax(-1)).all()
+    save_module(str(tmp_path / "m"), model)
+    back = load_module(str(tmp_path / "m")).evaluate()
+    np.testing.assert_allclose(np.asarray(back.forward(x)), ref,
+                               atol=1e-6)
+
+
+def test_residual_add_fuses_to_caddtable():
+    rs = np.random.RandomState(9)
+    k = tf.constant(rs.randn(3, 3, 4, 4).astype(np.float32) * 0.2)
+
+    def fn(x):
+        y = tf.nn.relu(tf.nn.conv2d(x, k, 1, "SAME"))
+        return x + y  # residual
+
+    x = np.random.RandomState(13).randn(2, 6, 6, 4).astype(np.float32)
+    data, ins, outs = _freeze(fn, tf.TensorSpec([None, 6, 6, 4],
+                                                tf.float32))
+    model = fuse_tf_graph(data, inputs=ins, outputs=outs)
+    np.testing.assert_allclose(np.asarray(model.forward(x)),
+                               np.asarray(fn(tf.constant(x))),
+                               atol=2e-4, rtol=1e-4)
+    kinds = [type(m).__name__ for m in model.modules]
+    assert "CAddTable" in kinds
+
+
+def test_mixed_mode_islands_unsupported_op():
+    """mixed=True keeps the structure around an exotic node: Elu
+    becomes a one-op TFModule island, everything else real layers —
+    and the result still matches TF and serializes."""
+    rs = np.random.RandomState(10)
+    k = tf.constant(rs.randn(3, 3, 3, 4).astype(np.float32) * 0.3)
+    w = tf.constant(rs.randn(4 * 4 * 4, 3).astype(np.float32) * 0.2)
+
+    def fn(x):
+        y = tf.nn.conv2d(x, k, 1, "SAME")
+        y = tf.nn.elu(y)  # not in the fusion table
+        y = tf.nn.max_pool2d(y, 2, 2, "VALID")
+        y = tf.reshape(y, [-1, 4 * 4 * 4])
+        return tf.matmul(y, w)
+
+    x = np.random.RandomState(14).randn(2, 8, 8, 3).astype(np.float32)
+    data, ins, outs = _freeze(fn, tf.TensorSpec([None, 8, 8, 3],
+                                                tf.float32))
+    with pytest.raises(ValueError, match="Elu"):
+        fuse_tf_graph(data, inputs=ins, outputs=outs)
+    model = fuse_tf_graph(data, inputs=ins, outputs=outs, mixed=True)
+    assert len(model.fused_islands) == 1 and \
+        model.fused_islands[0].endswith(":Elu")
+    kinds = [type(m).__name__ for m in model.modules]
+    assert "SpatialConvolution" in kinds and "Linear" in kinds
+    assert "TFModule" in kinds
+    np.testing.assert_allclose(np.asarray(model.forward(x)),
+                               np.asarray(fn(tf.constant(x))),
+                               atol=2e-4, rtol=1e-4)
+    # islands are rebuilt from raw NodeDef bytes: still serializable
+    from bigdl_tpu.utils.serialization import load_module, save_module
+    import tempfile
+    d = tempfile.mkdtemp()
+    save_module(d + "/m", model)
+    back = load_module(d + "/m").evaluate()
+    np.testing.assert_allclose(np.asarray(back.forward(x)),
+                               np.asarray(model.forward(x)), atol=1e-6)
+
+
 def test_fused_mlp_trains():
     """The fused model is a real module tree: it trains through the
     Optimizer like any native model."""
