@@ -386,7 +386,51 @@ def main():
     if lm_flag != "0" and (platform != "cpu" or lm_flag == "1"):
         result["transformerlm_tokens_per_sec_per_chip"] = round(
             _bench_transformer_lm(), 1)
+    # third tracked scalar: forward-only (serving) throughput — the
+    # reference's Predictor half of the product (Predictor.scala:35);
+    # the full bf16-vs-int8 inference table lives in BASELINE.md
+    inf_flag = os.environ.get("BENCH_INFER", "")
+    if inf_flag != "0" and (platform != "cpu" or inf_flag == "1"):
+        # the original params buffers were DONATED to the train chunk;
+        # the live values ride the final carry
+        result["resnet50_inference_imgs_per_sec_per_chip"] = round(
+            _bench_inference(model, carry[0], carry[2], batch), 1)
     print(json.dumps(result))
+
+
+def _bench_inference(model, params, mstate, batch):
+    """Eval-mode forward-only ResNet-50 throughput under one scanned
+    dispatch (the device serving rate; per-batch host feeds are the
+    tunnel's number, not the chip's — BASELINE.md feed note)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    scan = int(os.environ.get("BENCH_SCAN", 8))
+    iters = int(os.environ.get("BENCH_ITERS", 6))
+
+    def scan_body(carry, key):
+        x = jax.random.uniform(key, (batch, 3, 224, 224), jnp.float32)
+        out, _ = model.apply(params, mstate, x, training=False)
+        # carry a scalar data dependency so the chain cannot be elided
+        return carry + out[0, 0].astype(jnp.float32), None
+
+    @jax.jit
+    def run_chunk(carry, keys):
+        return lax.scan(scan_body, carry, keys)
+
+    root = jax.random.PRNGKey(7)
+    carry = jnp.zeros((), jnp.float32)
+    carry, _ = run_chunk(carry, jax.random.split(root, scan))
+    float(carry)
+    t0 = time.time()
+    for i in range(iters):
+        carry, _ = run_chunk(carry, jax.random.split(
+            jax.random.fold_in(root, i), scan))
+    float(carry)
+    return batch * scan * iters / (time.time() - t0)
 
 
 def _bench_transformer_lm():

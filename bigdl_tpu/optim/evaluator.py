@@ -96,27 +96,11 @@ class Evaluator(Predictor):
         return results
 
     def _test_device_cached(self, params, state, ds, methods, out_sh):
-        model = self.model
-
-        def _ev(p, s, start, images, labels):
-            x, y = ds.eval_batch_fn_on(images, labels, start)
-            out, _ = model.apply(p, s, x, training=False)
-            return out, y
-
-        fn = jax.jit(_ev, out_shardings=(out_sh, out_sh))
-        from bigdl_tpu.optim.optimizer import _local_rows
-        n, b = ds.size(), ds.batch_size
-        if self._multiprocess() and n % b:
-            raise ValueError(
-                "device-cached multi-host evaluation needs batch_size "
-                "to divide the dataset")
+        """Scores the shared Predictor HBM sweep (ONE sweep loop +
+        divisibility guard for predict and evaluate)."""
         results = None
-        for start in range(0, n, b):
-            out, y = fn(params, state, jnp.int32(start),
-                        ds.images, ds.labels)
-            valid = min(b, n - start)
-            out_np = _local_rows(out)[:valid]
-            tgt_np = _local_rows(y)[:valid]
+        for out_np, tgt_np in self._device_cached_sweep(params, state,
+                                                        ds, out_sh):
             batch_res = [m(out_np, tgt_np) for m in methods]
             results = batch_res if results is None \
                 else [r + br for r, br in zip(results, batch_res)]

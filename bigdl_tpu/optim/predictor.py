@@ -132,14 +132,17 @@ class Predictor:
 
         params, state = self._place_params(params, state)
         out_sh = self._batch_sharding()
+
+        if hasattr(dataset, "eval_batch_fn_on"):
+            outs: List[np.ndarray] = []
+            for out_np, _ in self._device_cached_sweep(params, state,
+                                                       dataset, out_sh):
+                outs.extend(out_np)
+            return outs
+
         step = jax.jit(
             lambda p, s, x: model.apply(p, s, x, training=False)[0],
             out_shardings=out_sh)
-
-        if hasattr(dataset, "eval_batch_fn_on"):
-            return self._predict_device_cached(params, state, dataset,
-                                               out_sh)
-
         from bigdl_tpu.optim.optimizer import _local_rows
         outs: List[np.ndarray] = []
         for b in _batches(dataset, batch_size):
@@ -166,32 +169,34 @@ class Predictor:
             outs.extend(np.asarray(out))
         return outs
 
-    def _predict_device_cached(self, params, state, ds, out_sh):
+    def _device_cached_sweep(self, params, state, ds, out_sh):
         """Forward sweep straight off the HBM cache: the batch is
         gathered + normalized INSIDE the jitted step
         (DeviceCachedArrayDataSet.eval_batch_fn_on), so the only
-        per-batch host traffic is the prediction readback."""
+        per-batch host traffic is the readback. Yields this process's
+        tail-trimmed (predictions, labels) BATCH arrays — the ONE
+        sweep loop shared by predict and evaluate (the collective
+        divisibility guard must not fork between them)."""
         model = self.model
 
         def _ev(p, s, start, images, labels):
-            x, _ = ds.eval_batch_fn_on(images, labels, start)
+            x, y = ds.eval_batch_fn_on(images, labels, start)
             out, _ = model.apply(p, s, x, training=False)
-            return out
+            return out, y
 
-        fn = jax.jit(_ev, out_shardings=out_sh)
+        fn = jax.jit(_ev, out_shardings=(out_sh, out_sh))
         from bigdl_tpu.optim.optimizer import _local_rows
         n, b = ds.size(), ds.batch_size
         if self._multiprocess() and n % b:
             raise ValueError(
-                "device-cached multi-host predict needs batch_size to "
-                "divide the dataset (a wrapped final batch cannot be "
-                "trimmed consistently across processes)")
-        outs: List[np.ndarray] = []
+                "device-cached multi-host inference needs batch_size "
+                "to divide the dataset (a wrapped final batch cannot "
+                "be trimmed consistently across processes)")
         for start in range(0, n, b):
-            out = _local_rows(fn(params, state, jnp.int32(start),
-                                 ds.images, ds.labels))
-            outs.extend(out[:min(b, n - start)])
-        return outs
+            out, y = fn(params, state, jnp.int32(start),
+                        ds.images, ds.labels)
+            valid = min(b, n - start)
+            yield _local_rows(out)[:valid], _local_rows(y)[:valid]
 
     def predict_class(self, dataset, batch_size: int = 32) -> List[int]:
         """1-based argmax class, like the reference's predictClass."""

@@ -398,27 +398,38 @@ class _DagFuser:
         elif shape is None:
             kind = "4D"  # assume image input like the TF graphs we fuse
 
-        # reachable tensor nodes + consumer map (tensor edges only)
+        # reachable tensor nodes + consumer map (tensor edges only);
+        # iterative DFS — imported graphs can be thousands of nodes
+        # deep and must not hit Python's recursion limit
         consumers: Dict[str, List[TFNode]] = {}
         order: List[TFNode] = []
-        seen = {}
-
-        def visit(node: TFNode):
-            if id(node) in seen:
-                if seen[id(node)] == 1:
-                    raise ValueError("graph has a cycle")
-                return
-            seen[id(node)] = 1
-            for p in self._tensor_inputs(node):
-                consumers.setdefault(p.name, []).append(node)
-                visit(p)
-            seen[id(node)] = 2
-            if node.op not in ("Const", "Placeholder"):
-                order.append(node)
-
+        seen: Dict[int, int] = {}
         out_node = f.by_name[f.output_names[0]]
-        visit(self._resolve(out_node.name) if out_node.op == "Identity"
-              else out_node)
+        root = (self._resolve(out_node.name)
+                if out_node.op == "Identity" else out_node)
+        stack = [(root, False)]
+        while stack:
+            node, done = stack.pop()
+            if done:
+                seen[id(node)] = 2
+                if node.op not in ("Const", "Placeholder"):
+                    order.append(node)
+                continue
+            if seen.get(id(node)) is not None:
+                # duplicate push from a diamond ancestor — already
+                # in progress or finished
+                continue
+            seen[id(node)] = 1
+            stack.append((node, True))
+            preds = self._tensor_inputs(node)
+            for p in preds:
+                consumers.setdefault(p.name, []).append(node)
+            for p in reversed(preds):
+                if seen.get(id(p)) is None:
+                    stack.append((p, False))
+        # a malformed (cyclic) graph would put a consumer before its
+        # producer here; _emit then fails cleanly on the missing value
+        # rather than this walk looping forever
 
         inp = nn.Input()()
         self._set(placeholder.name, "NHWC" if kind == "4D" else "FLAT",
@@ -470,6 +481,11 @@ class _DagFuser:
         import bigdl_tpu.nn as nn
         f, op = self.f, node.op
         tin = self._tensor_inputs(node)
+        for t in tin:
+            if t.name not in self.vals:
+                raise ValueError(
+                    f"fusion: input {t.name} of {node.name} has no "
+                    "emitted value (malformed or cyclic graph)")
 
         if op == "Conv2D":
             _require(node, "data_format", ("NHWC", None))
@@ -485,6 +501,11 @@ class _DagFuser:
             pad = node.attrs["padding"]
             ph = 0 if pad == "VALID" else _same_pad(h, kh, sh)
             pw = 0 if pad == "VALID" else _same_pad(w, kw_, sw)
+            # resolve the input value BEFORE mutating absorbed/presets:
+            # mixed mode islands this node on ValueError, and a
+            # half-mutated emission would drop the bias and orphan its
+            # BiasAdd node
+            x_in = self._value_as(tin[0].name, "NCHW")
             bias, out_name = self._absorb_bias(node, consumers, absorbed)
             m = nn.SpatialConvolution(wgt.shape[2], wgt.shape[3], kw_,
                                       kh, sw, sh, pw, ph,
@@ -493,7 +514,7 @@ class _DagFuser:
             if bias is not None:
                 p["bias"] = bias
             self.presets.append((m, p, None))
-            gnode = m(self._value_as(tin[0].name, "NCHW"))
+            gnode = m(x_in)
             self._set(out_name, "NCHW", gnode, "4D",
                       (_out_size(h, kh, sh, ph), _out_size(w, kw_, sw,
                                                            pw)))
@@ -503,6 +524,7 @@ class _DagFuser:
                 raise ValueError(
                     f"fusion: transposed MatMul unsupported ({node.name})")
             wgt = f.const(node.inputs[1])
+            x_in = self._value_as(tin[0].name, "FLAT")  # before mutation
             bias, out_name = self._absorb_bias(node, consumers, absorbed)
             m = nn.Linear(wgt.shape[0], wgt.shape[1],
                           with_bias=bias is not None)
@@ -510,20 +532,23 @@ class _DagFuser:
             if bias is not None:
                 p["bias"] = bias
             self.presets.append((m, p, None))
-            gnode = m(self._value_as(tin[0].name, "FLAT"))
+            gnode = m(x_in)
             self._set(out_name, "FLAT", gnode, "FLAT")
         elif op in ("FusedBatchNorm", "FusedBatchNormV2",
                     "FusedBatchNormV3"):
             _require(node, "is_training", (False,))
             _require(node, "data_format", ("NHWC", None))
             scale = f.const(node.inputs[1])
+            offset = f.const(node.inputs[2])
+            mean = f.const(node.inputs[3])
+            var = f.const(node.inputs[4])
+            x_in = self._value_as(tin[0].name, "NCHW")  # before mutation
             m = nn.SpatialBatchNormalization(
                 len(scale), float(node.attrs.get("epsilon", 1e-3)))
             self.presets.append(
-                (m, {"weight": scale, "bias": f.const(node.inputs[2])},
-                 {"running_mean": f.const(node.inputs[3]),
-                  "running_var": f.const(node.inputs[4])}))
-            gnode = m(self._value_as(tin[0].name, "NCHW"))
+                (m, {"weight": scale, "bias": offset},
+                 {"running_mean": mean, "running_var": var}))
+            gnode = m(x_in)
             self._set(node.name, "NCHW", gnode, "4D",
                       self.hw[tin[0].name])
         elif op in ("MaxPool", "AvgPool"):
