@@ -161,16 +161,21 @@ def run_parallel_case(kind: str, devices, pid=None):
                                moe_experts=2, moe_every=1)
             return lm, lm.sharding_rules(model_axis="model",
                                          expert_axis="model")
-    elif kind == "composed":
+    elif kind.startswith("composed"):
         from bigdl_tpu.models import PipelinedTransformerLM
         mesh = make_mesh([2, 2, 2], ["data", "pipe", "model"], devices)
         seed = 17
+        # "composed" runs the interleaved schedule (virtual-stage
+        # waiting-room queue + extra ring hops across the transport);
+        # "composed_gpipe" keeps the gpipe product covered too
+        sched = "gpipe" if kind == "composed_gpipe" else "interleaved"
 
         def build():
             lm = PipelinedTransformerLM(vocab_size=32, hidden_size=16,
                                         num_layers=4, num_heads=2,
                                         max_len=8, n_microbatches=2,
-                                        mesh=mesh, moe_experts=2)
+                                        mesh=mesh, moe_experts=2,
+                                        pp_schedule=sched, pp_rounds=2)
             return lm, lm.sharding_rules(model_axis="model",
                                          expert_axis="model")
     else:
@@ -189,7 +194,7 @@ def run_parallel_case(kind: str, devices, pid=None):
     toks = rng.randint(0, 32, (32, 9))
     all_samples = [Sample(toks[i, :-1].astype(np.int32),
                           toks[i, 1:].astype(np.int32)) for i in range(32)]
-    if kind == "composed":
+    if kind.startswith("composed"):
         # sharded-batch regime over the spanning data axis: global batch
         # i = concat(p0 batch i, p1 batch i)
         if pid is None:
@@ -235,7 +240,7 @@ def _tp_or_pp_mode(pid: int, kind: str):
     import jax
 
     state = run_parallel_case(kind, jax.devices(),
-                              pid if kind == "composed" else None)
+                              pid if kind.startswith("composed") else None)
     print(json.dumps({"ok": True, "pid": pid,
                       "last_loss": state["Loss"],
                       "neval": state["neval"]}))
@@ -448,14 +453,16 @@ def main():
         # timeout -> FAIL)
         print(f"RENDEZVOUS_OK {pid}", flush=True)
         if mode in ("optimizer", "imagefolder", "rotate", "tp", "pp",
-                    "ep", "composed", "sparse", "predict"):
+                    "ep", "composed", "composed_gpipe", "sparse",
+                    "predict"):
             # bring-up succeeded: failures past this point are REAL
             # regressions and must crash the worker (SystemExit bypasses
             # the skip-catch below), not print a skip
             try:
                 if mode == "optimizer":
                     _optimizer_mode(pid)
-                elif mode in ("tp", "pp", "ep", "composed"):
+                elif mode in ("tp", "pp", "ep", "composed",
+                              "composed_gpipe"):
                     _tp_or_pp_mode(pid, mode)
                 elif mode == "sparse":
                     _sparse_mode(pid)

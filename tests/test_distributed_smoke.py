@@ -354,24 +354,28 @@ def test_two_process_expert_parallel_matches_single_process():
         np.testing.assert_allclose(r["last_loss"], ref_loss, atol=1e-5)
 
 
-def test_two_process_composed_mesh_matches_single_process():
+@pytest.mark.parametrize("kind", ["composed", "composed_gpipe"])
+def test_two_process_composed_mesh_matches_single_process(kind):
     """The COMPOSED product across a real OS-process boundary: a
     (data × pipe × model) spanning mesh trains a PipelinedTransformerLM
     with MoE experts — the data axis spans the two processes (each
-    feeds its half, sharded-batch regime) while the pipe ring and
+    feeds its half, sharded-batch regime) while the pipe ring and the
     megatron/EP collectives run under the same jitted step; losses must
     match a single-process 8-device run of the identical global batches
     (DistriOptimizer.scala:728's one-call contract, now for the full
-    DP×TP×PP×EP composition at true multi-host)."""
+    DP×TP×PP×EP composition at true multi-host). Parametrized over
+    BOTH pipeline schedules: "composed" additionally drives the
+    interleaved virtual-stage waiting-room queue across the
+    transport."""
     import numpy as np
 
-    results = _run_workers("composed", timeout=420)
+    results = _run_workers(kind, timeout=420)
 
     import jax
 
     import _distributed_worker as W
 
-    ref_loss = W.run_parallel_case("composed", jax.devices()[:8])["Loss"]
+    ref_loss = W.run_parallel_case(kind, jax.devices()[:8])["Loss"]
 
     for r in results:
         assert r["ok"] and r["neval"] == 5

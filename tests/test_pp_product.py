@@ -322,3 +322,15 @@ def test_full_product_pp_sp_ep(devices8):
     mesh = make_mesh([2, 2, 2], ["data", "pipe", "seq"], devices8)
     _grads_vs_dense(mesh, {"ring_axis": "seq", "moe_experts": 2},
                     {"expert_axis": "seq"}, devices8)
+
+
+def test_interleaved_composes_with_moe_ep(devices8):
+    """The interleaved schedule's aux threading (valid-mask + psum/m
+    over V rounds) must ALSO equal the dense microbatch-looped aux —
+    the two-process composed test's oracle runs the same interleaved
+    code, so only this dense cross-check can catch aux-math bugs."""
+    mesh = make_mesh([2, 2, 2], ["data", "pipe", "model"], devices8)
+    _grads_vs_dense(mesh, {"moe_experts": 2,
+                           "pp_schedule": "interleaved", "pp_rounds": 2},
+                    {"model_axis": "model", "expert_axis": "model"},
+                    devices8)
