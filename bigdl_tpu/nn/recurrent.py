@@ -76,9 +76,25 @@ class RnnCell(Cell):
         dtype = dtype or Engine.default_dtype()
         return jnp.zeros((batch_size, self.hidden_size), dtype)
 
+    # step is DERIVED from step_projected (one copy of the gate math —
+    # the slow and hoisted paths cannot diverge); subclasses changing
+    # the equations must override step_projected (+ project_input if
+    # the input half changes)
     def step(self, params, x, hidden, *, training=False, rng=None):
-        pre = x @ params["w_ih"].T + hidden @ params["w_hh"].T + params["bias"]
-        h = self.activation.forward_fn({}, pre)
+        return self.step_projected(
+            params, x @ params["w_ih"].T + params["bias"], hidden,
+            training=training, rng=rng)
+
+    def project_input(self, params, xs):
+        """All timesteps' input contribution in ONE [T·B, I]×[I, H]
+        matmul outside the scan (big MXU tile instead of T small ones);
+        the scan body then only runs the recurrent half."""
+        return xs @ params["w_ih"].T + params["bias"]
+
+    def step_projected(self, params, gx, hidden, *, training=False,
+                       rng=None):
+        h = self.activation.forward_fn(
+            {}, gx + hidden @ params["w_hh"].T)
         return h, h
 
     def regularization_loss(self, params):
@@ -124,6 +140,9 @@ class LSTM(Cell):
         z = jnp.zeros((batch_size, self.hidden_size), dtype)
         return T(z, z)
 
+    # step is DERIVED from step_projected after the dropout gating (one
+    # copy of the gate math — slow and hoisted paths cannot diverge);
+    # subclasses changing the equations must override step_projected
     def step(self, params, x, hidden, *, training=False, rng=None):
         h, c = hidden[1], hidden[2]
         if self.p > 0 and training and rng is not None:
@@ -132,7 +151,21 @@ class LSTM(Cell):
                           x / (1 - self.p), 0.0)
             h = jnp.where(jax.random.bernoulli(kh, 1 - self.p, h.shape),
                           h / (1 - self.p), 0.0)
-        gates = x @ params["w_ih"].T + h @ params["w_hh"].T + params["bias"]
+        return self.step_projected(
+            params, x @ params["w_ih"].T + params["bias"], T(h, c),
+            training=training, rng=rng)
+
+    def project_input(self, params, xs):
+        """All timesteps' x@W_ih+b in ONE [T·B, I]×[I, 4H] matmul
+        outside the scan — the classic LSTM restructuring that turns T
+        skinny matmuls into one MXU-shaped one; the scan body keeps
+        only the inherently sequential h@W_hh half."""
+        return xs @ params["w_ih"].T + params["bias"]
+
+    def step_projected(self, params, gx, hidden, *, training=False,
+                       rng=None):
+        h, c = hidden[1], hidden[2]
+        gates = gx + h @ params["w_hh"].T
         i, f, g, o = jnp.split(gates, 4, axis=-1)
         i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
         g = jnp.tanh(g)
@@ -165,9 +198,13 @@ class LSTMPeephole(LSTM):
         p["w_co"] = _uniform(k3, (self.hidden_size,), stdv, dtype)
         return p
 
-    def step(self, params, x, hidden, *, training=False, rng=None):
+    def step_projected(self, params, gx, hidden, *, training=False,
+                       rng=None):
+        # step() is inherited from LSTM and derives from THIS method;
+        # project_input is inherited too (the x@W_ih+b half is
+        # identical) — the peephole terms live in the recurrent half
         h, c = hidden[1], hidden[2]
-        gates = x @ params["w_ih"].T + h @ params["w_hh"].T + params["bias"]
+        gates = gx + h @ params["w_hh"].T
         i, f, g, o = jnp.split(gates, 4, axis=-1)
         i = jax.nn.sigmoid(i + params["w_ci"] * c)
         f = jax.nn.sigmoid(f + params["w_cf"] * c)
@@ -209,13 +246,27 @@ class GRU(Cell):
         dtype = dtype or Engine.default_dtype()
         return jnp.zeros((batch_size, self.hidden_size), dtype)
 
+    # step derives from step_projected (single copy of the gate math);
+    # the GRU's TWO input matmuls both hoist
     def step(self, params, x, hidden, *, training=False, rng=None):
+        gx = (x @ params["w_ih"].T + params["bias"],
+              x @ params["w_ih_n"].T + params["bias_n"])
+        return self.step_projected(params, gx, hidden,
+                                   training=training, rng=rng)
+
+    def project_input(self, params, xs):
+        """Both time-independent input halves (r/z gates AND the
+        candidate) for all steps as two MXU-shaped matmuls."""
+        return (xs @ params["w_ih"].T + params["bias"],
+                xs @ params["w_ih_n"].T + params["bias_n"])
+
+    def step_projected(self, params, gx, hidden, *, training=False,
+                       rng=None):
+        gx_rz, gx_n = gx
         h = hidden
-        rz = jax.nn.sigmoid(x @ params["w_ih"].T + h @ params["w_hh"].T
-                            + params["bias"])
+        rz = jax.nn.sigmoid(gx_rz + h @ params["w_hh"].T)
         r, z = jnp.split(rz, 2, axis=-1)
-        n = jnp.tanh(x @ params["w_ih_n"].T
-                     + r * (h @ params["w_hh_n"].T) + params["bias_n"])
+        n = jnp.tanh(gx_n + r * (h @ params["w_hh_n"].T))
         h2 = (1.0 - z) * n + z * h
         return h2, h2
 
@@ -367,6 +418,22 @@ class Recurrent(Module):
             # dropout-free cell: don't split/carry T per-step keys the
             # cell will ignore (pure scan-carry overhead)
             rng = None
+        if rng is None and hasattr(self.cell, "project_input"):
+            # MXU fast path: the input half of the gates is
+            # time-independent — compute it for ALL steps in one big
+            # matmul outside the scan ([T·B, I]×[I, 4H] tiles the MXU;
+            # T skinny per-step matmuls do not), and scan only the
+            # inherently sequential recurrent half. Disabled under
+            # cell dropout (it perturbs x BEFORE the projection).
+            gx = self.cell.project_input(params["cell"], xs)
+
+            def pbody(h, gx_t):
+                out, h2 = self.cell.step_projected(params["cell"], gx_t,
+                                                   h, training=training)
+                return h2, out
+
+            _, outs = lax.scan(pbody, h0, gx)
+            return jnp.moveaxis(outs, 0, 1), state
         keys = (jax.random.split(rng, n_steps) if rng is not None
                 else jnp.zeros((n_steps, 2), jnp.uint32))
 
