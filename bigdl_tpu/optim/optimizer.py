@@ -120,7 +120,8 @@ def _local_rows(x) -> np.ndarray:
 
 def build_train_step(module: Module, criterion: Criterion,
                      optim_method: OptimMethod,
-                     aux_loss_weight: float = 0.01):
+                     aux_loss_weight: float = 0.01,
+                     gradient_clip=None):
     """The compiled hot path: loss + grad + update in one jit.
 
     Gradient normalization matches the reference (grads averaged over the
@@ -128,8 +129,18 @@ def build_train_step(module: Module, criterion: Criterion,
     param_scales implements layer-wise scaling / freeze. Auxiliary losses
     the model emits through its state (MoE load balancing) join the
     objective with weight ``aux_loss_weight`` so they actually produce
-    router gradients.
+    router gradients. ``gradient_clip`` = ("constant", min, max) or
+    ("l2norm", max_norm) applies the reference's gradient clipping
+    (Optimizer.scala setConstantGradientClipping /
+    setGradientClippingByl2Norm) to the aggregated gradients before the
+    update — the global-L2 form is what keeps edge-of-stability recipes
+    (classic PTB LSTM at lr 1.0) convergent.
     """
+    if gradient_clip is not None and gradient_clip[0] not in (
+            "constant", "l2norm"):
+        raise ValueError(
+            f"gradient_clip kind must be 'constant' or 'l2norm', got "
+            f"{gradient_clip[0]!r}")
 
     def step(params, opt_state, model_state, rng, lr, inputs, targets):
         cdtype = Engine.compute_dtype()
@@ -162,6 +173,19 @@ def build_train_step(module: Module, criterion: Criterion,
         scales = module.param_scales(params)
         if any(s != 1.0 for s in jax.tree.leaves(scales)):
             grads = jax.tree.map(lambda g, s: g * s, grads, scales)
+        if gradient_clip is not None:
+            if gradient_clip[0] == "constant":
+                lo, hi = gradient_clip[1], gradient_clip[2]
+                grads = jax.tree.map(lambda g: jnp.clip(g, lo, hi),
+                                     grads)
+            else:  # global L2 norm
+                nrm = jnp.sqrt(sum(
+                    jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    for g in jax.tree.leaves(grads)))
+                scale = jnp.minimum(
+                    1.0, gradient_clip[1] / jnp.maximum(nrm, 1e-12))
+                grads = jax.tree.map(
+                    lambda g: g * scale.astype(g.dtype), grads)
         new_params, new_opt = optim_method.update(grads, opt_state, params,
                                                   lr)
         return new_params, new_opt, new_mstate, data_loss
@@ -229,6 +253,9 @@ class Optimizer:
         self.retry_interval_s = float(
             os.environ.get("BIGDL_FAILURE_RETRY_INTERVAL", 1.0))
         self.metrics = Metrics()
+        # gradient clipping (Optimizer.scala setConstantGradientClipping
+        # / setGradientClippingByl2Norm); None = off
+        self._gradient_clip = None
         # single-slot (dataset, jitted fn) cache for device-cached
         # validation — replacing the validation dataset must free the
         # old split's HBM-resident arrays, not pin them forever
@@ -285,6 +312,28 @@ class Optimizer:
 
     def set_val_summary(self, summary) -> "Optimizer":
         self.validation_summary = summary
+        return self
+
+    def set_constant_gradient_clipping(self, min_value: float,
+                                       max_value: float) -> "Optimizer":
+        """Clip every gradient element into [min, max]
+        (Optimizer.scala setConstantGradientClipping)."""
+        self._gradient_clip = ("constant", float(min_value),
+                               float(max_value))
+        return self
+
+    def set_gradient_clipping_by_l2_norm(self,
+                                         clip_norm: float) -> "Optimizer":
+        """Scale the aggregated gradients so their GLOBAL L2 norm never
+        exceeds ``clip_norm`` (Optimizer.scala
+        setGradientClippingByl2Norm) — the classic stabilizer for RNN
+        recipes at aggressive learning rates."""
+        self._gradient_clip = ("l2norm", float(clip_norm))
+        return self
+
+    def disable_gradient_clipping(self) -> "Optimizer":
+        """Optimizer.scala disableGradientClipping."""
+        self._gradient_clip = None
         return self
 
     def set_drop_module_property(self, drop_percentage: float,
@@ -507,6 +556,12 @@ class Optimizer:
         sample+forward per batch, zero per-trigger host feed — the
         device-resident form of validation riding the same cached
         distributed dataset as training (DistriOptimizer.scala:607-686).
+
+        Intentionally NOT delegated to Predictor._device_cached_sweep:
+        validation fires every trigger, so the compiled sweep must be
+        CACHED across calls (the single-slot ``_dc_eval`` below) —
+        keep the divisibility guard and trim rules in lockstep with
+        predictor.py's one-shot sweep when changing either.
         """
         fn = self._dc_eval[1] if (self._dc_eval is not None
                                   and self._dc_eval[0] is ds) else None
@@ -596,7 +651,8 @@ class Optimizer:
         opt_state = self._put_opt_state(opt_state)
         model_state = self._put_replicated(model_state)
 
-        step = build_train_step(model, self.criterion, self.optim_method)
+        step = build_train_step(model, self.criterion, self.optim_method,
+                                gradient_clip=self._gradient_clip)
         ev_sh = self._batch_sharding() if self.mesh is not None else None
         eval_step = build_eval_step(model, ev_sh)
 

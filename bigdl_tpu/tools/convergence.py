@@ -199,7 +199,8 @@ def make_markov_corpus(n_tokens: int, seed: int, vocab: int = 256,
 def run_lm(name: str, build_model, criterion, optim, lr: float,
            epochs: int, n_tokens: int, seq: int = 32, batch: int = 256,
            one_based: bool = False, vocab: int = 256,
-           aux_loss_weight: float = 0.01, report_experts: bool = False):
+           aux_loss_weight: float = 0.01, report_experts: bool = False,
+           gradient_clip=None):
     """Shared LM convergence loop: device-resident token windows, jitted
     epoch scans, held-out per-token perplexity vs the chain's floor."""
     import jax
@@ -234,7 +235,8 @@ def run_lm(name: str, build_model, criterion, optim, lr: float,
     mstate = model.get_state()
     opt_state = optim.init_state(params)
     step = build_train_step(model, criterion, optim,
-                            aux_loss_weight=aux_loss_weight)
+                            aux_loss_weight=aux_loss_weight,
+                            gradient_clip=gradient_clip)
 
     steps_per_epoch = max(1, n_win // batch)
 
@@ -360,11 +362,16 @@ def run_recipe(recipe: str, epochs: int, n: int):
         vocab = 256
         optim = SGD(learning_rate=1.0)
         crit = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion())
+        # lr 1.0 SGD sits on the stability edge (the r4/r5 histories
+        # show chaotic early epochs in EVERY code version); the classic
+        # PTB recipe pairs it with global-L2 gradient clipping — the
+        # reference's setGradientClippingByl2Norm, now implemented
         return run_lm(
             recipe, lambda: PTBModel(vocab, 200, vocab, num_layers=2,
                                      keep_prob=2.0),
             crit, optim, 1.0, epochs, n, seq=32, batch=128,
-            one_based=True, vocab=vocab)
+            one_based=True, vocab=vocab,
+            gradient_clip=("l2norm", 5.0))
     if recipe == "transformer":
         from bigdl_tpu.models import TransformerLM
         vocab = 256

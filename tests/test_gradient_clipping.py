@@ -1,0 +1,100 @@
+"""Gradient clipping (Optimizer.scala setConstantGradientClipping /
+setGradientClippingByl2Norm — the reference's stabilizer applied to the
+aggregated gradients before the update, DistriOptimizer's
+parameterProcessers)."""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+from bigdl_tpu.optim import LocalOptimizer, SGD, max_iteration
+from bigdl_tpu.optim.optimizer import build_train_step
+from bigdl_tpu.utils.random import RandomGenerator
+
+
+def _setup(scale=100.0):
+    RandomGenerator.set_seed(5)
+    model = nn.Sequential().add(nn.Linear(4, 3)).training()
+    model.ensure_initialized()
+    crit = nn.MSECriterion()
+    optim = SGD(learning_rate=1.0)
+    params = model.get_parameters()
+    x = jnp.asarray(np.full((2, 4), scale, np.float32))
+    y = jnp.zeros((2, 3), jnp.float32)
+    return model, crit, optim, params, x, y
+
+
+def _grads_via_update(model, crit, optim, params, x, y, clip):
+    """Recover the applied gradient from a lr-1 plain-SGD update."""
+    host_p = jax.tree.map(np.asarray, params)  # step donates its inputs
+    step = build_train_step(model, crit, optim, gradient_clip=clip)
+    opt_state = optim.init_state(host_p)
+    new_p, _, _, _ = step(jax.tree.map(jnp.asarray, host_p), opt_state,
+                          model.get_state(), jax.random.PRNGKey(0),
+                          1.0, x, y)
+    return jax.tree.map(lambda a, b: np.asarray(b) - np.asarray(a),
+                        jax.tree.map(np.asarray, new_p), host_p)
+
+
+def test_l2_norm_clipping_bounds_the_global_norm():
+    model, crit, optim, params, x, y = _setup()
+    g_raw = _grads_via_update(model, crit, optim, params, x, y, None)
+    raw_norm = float(np.sqrt(sum(
+        np.sum(np.square(g)) for g in jax.tree.leaves(g_raw))))
+    assert raw_norm > 5.0  # the test is vacuous otherwise
+
+    g_clip = _grads_via_update(model, crit, optim, params, x, y,
+                               ("l2norm", 5.0))
+    clip_norm = float(np.sqrt(sum(
+        np.sum(np.square(g)) for g in jax.tree.leaves(g_clip))))
+    np.testing.assert_allclose(clip_norm, 5.0, rtol=1e-4)
+    # DIRECTION preserved: clipped = raw * (5/raw_norm)
+    for a, b in zip(jax.tree.leaves(g_clip), jax.tree.leaves(g_raw)):
+        np.testing.assert_allclose(a, b * (5.0 / raw_norm), rtol=1e-4)
+
+
+def test_l2_norm_clipping_is_noop_below_threshold():
+    model, crit, optim, params, x, y = _setup(scale=0.001)
+    g_raw = _grads_via_update(model, crit, optim, params, x, y, None)
+    g_clip = _grads_via_update(model, crit, optim, params, x, y,
+                               ("l2norm", 5.0))
+    for a, b in zip(jax.tree.leaves(g_clip), jax.tree.leaves(g_raw)):
+        np.testing.assert_allclose(a, b, rtol=1e-5)
+
+
+def test_constant_clipping_bounds_every_element():
+    model, crit, optim, params, x, y = _setup()
+    g = _grads_via_update(model, crit, optim, params, x, y,
+                          ("constant", -0.1, 0.1))
+    for leaf in jax.tree.leaves(g):
+        assert float(np.max(leaf)) <= 0.1 + 1e-6
+        assert float(np.min(leaf)) >= -0.1 - 1e-6
+
+
+def test_fluent_surface_reaches_the_step():
+    """set_gradient_clipping_by_l2_norm on the Optimizer keeps an
+    lr-1.0 run on exploding data finite (it diverges unclipped)."""
+    RandomGenerator.set_seed(7)
+    rng = np.random.RandomState(0)
+    xs = (rng.randn(32, 6) * 50).astype(np.float32)
+    ys = (rng.randn(32, 1) * 50).astype(np.float32)
+    ds = DataSet.array([Sample(xs[i], ys[i]) for i in range(32)]) \
+        .transform(SampleToMiniBatch(8))
+
+    def run(clip):
+        RandomGenerator.set_seed(7)
+        model = (nn.Sequential().add(nn.Linear(6, 8)).add(nn.Tanh())
+                 .add(nn.Linear(8, 1)))
+        opt = LocalOptimizer(model, ds, nn.MSECriterion(), batch_size=8)
+        opt.set_optim_method(SGD(learning_rate=1.0))
+        if clip:
+            opt.set_gradient_clipping_by_l2_norm(1.0)
+        opt.set_end_when(max_iteration(20))
+        opt.optimize()
+        return opt.driver_state["Loss"]
+
+    unclipped = run(False)
+    assert not np.isfinite(unclipped) or unclipped > 1e4
+    assert np.isfinite(run(True))
