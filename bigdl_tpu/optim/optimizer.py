@@ -314,10 +314,35 @@ class Optimizer:
         self.validation_summary = summary
         return self
 
+    def set_model(self, new_model: Module) -> "Optimizer":
+        """Swap the model before optimize() (Optimizer.scala:230)."""
+        self.model = new_model
+        # the device-cached validation slot closed over the OLD model's
+        # forward at trace time — drop it or validation would silently
+        # score the previous architecture
+        self._dc_eval = None
+        return self
+
+    def set_state(self, state: Dict[str, Any]) -> "Optimizer":
+        """Seed the driver's optimization state — epoch/neval counters
+        etc. (Optimizer.scala:240 setState). Counter keys also reach
+        the OptimMethod's state so epoch/iteration-driven lr schedules
+        start from the seeded position, not epoch 1."""
+        self.driver_state.update(dict(state))
+        for k in ("epoch", "neval"):
+            if k in state:
+                self.optim_method.state[k] = state[k]
+        return self
+
     def set_constant_gradient_clipping(self, min_value: float,
                                        max_value: float) -> "Optimizer":
         """Clip every gradient element into [min, max]
         (Optimizer.scala setConstantGradientClipping)."""
+        if float(min_value) > float(max_value):
+            raise ValueError(
+                f"constant gradient clipping needs min <= max, got "
+                f"[{min_value}, {max_value}] (jnp.clip would silently "
+                "collapse every gradient to max)")
         self._gradient_clip = ("constant", float(min_value),
                                float(max_value))
         return self
@@ -646,6 +671,12 @@ class Optimizer:
             model_state = resumed["model_state"]
             self.optim_method.load_state(resumed["optim_host_state"])
             self.driver_state.update(resumed["driver_state"])
+        # epoch/iteration-driven lr schedules read the OptimMethod's
+        # state: sync the driver counters in (covers set_state called
+        # before set_optim_method, and keeps both views consistent)
+        for k in ("epoch", "neval"):
+            if k in self.driver_state:
+                self.optim_method.state[k] = self.driver_state[k]
 
         params = self._put_params(params)
         opt_state = self._put_opt_state(opt_state)

@@ -98,3 +98,57 @@ def test_fluent_surface_reaches_the_step():
     unclipped = run(False)
     assert not np.isfinite(unclipped) or unclipped > 1e4
     assert np.isfinite(run(True))
+
+
+def test_fluent_set_model_and_set_state():
+    """Optimizer.scala:230/:240 — swap the model and seed the driver
+    state before optimize()."""
+    RandomGenerator.set_seed(9)
+    rng = np.random.RandomState(1)
+    xs = rng.randn(16, 4).astype(np.float32)
+    ys = (rng.randint(0, 2, 16) + 1).astype(np.float32)
+    ds = DataSet.array([Sample(xs[i], ys[i]) for i in range(16)]) \
+        .transform(SampleToMiniBatch(8))
+    placeholder = nn.Sequential().add(nn.Linear(4, 2))
+    real = (nn.Sequential().add(nn.Linear(4, 2)).add(nn.LogSoftMax()))
+    opt = (LocalOptimizer(placeholder, ds, nn.ClassNLLCriterion(),
+                          batch_size=8)
+           .set_model(real)
+           .set_state({"epoch": 3})
+           .set_end_when(max_iteration(2)))
+    opt.optimize()
+    assert opt.model is real
+    assert opt.driver_state["epoch"] >= 3  # seeded, not reset
+
+
+def test_constant_clipping_rejects_inverted_range():
+    import pytest
+    opt = LocalOptimizer(nn.Sequential().add(nn.Linear(2, 2)),
+                         DataSet.array([Sample(np.zeros(2, np.float32),
+                                               1.0)]),
+                         nn.MSECriterion(), batch_size=1)
+    with pytest.raises(ValueError, match="min <= max"):
+        opt.set_constant_gradient_clipping(0.1, -0.1)
+
+
+def test_set_state_reaches_epoch_lr_schedules():
+    """A seeded epoch must drive epoch-based schedules from step one —
+    not after the first rollover (the resume use case)."""
+    from bigdl_tpu.optim import EpochStep
+
+    RandomGenerator.set_seed(11)
+    rng = np.random.RandomState(2)
+    xs = rng.randn(16, 4).astype(np.float32)
+    ys = rng.randn(16, 1).astype(np.float32)
+    ds = DataSet.array([Sample(xs[i], ys[i]) for i in range(16)]) \
+        .transform(SampleToMiniBatch(8))
+    model = nn.Sequential().add(nn.Linear(4, 1))
+    opt = (LocalOptimizer(model, ds, nn.MSECriterion(), batch_size=8)
+           .set_state({"epoch": 26})
+           .set_end_when(max_iteration(2)))
+    # EpochStep(25, 0.5): epoch 26 -> lr * 0.5
+    opt.set_optim_method(SGD(learning_rate=0.4,
+                             learning_rate_schedule=EpochStep(25, 0.5)))
+    opt.optimize()
+    np.testing.assert_allclose(opt.driver_state["LearningRate"], 0.2,
+                               rtol=1e-6)
