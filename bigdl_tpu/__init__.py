@@ -15,6 +15,9 @@ frankfzw/BigDL, Scala/Spark/MKL) as an idiomatic JAX/XLA framework:
   collectives, replacing BigDL's AllReduceParameter/BlockManager PS.
 - ``bigdl_tpu.models``   — model zoo (LeNet, VGG, ResNet, Inception, RNN LM,
   Autoencoder) mirroring BigDL's ``models/``.
+- ``bigdl_tpu.serving``  — online inference: dynamic micro-batching, a
+  shape-bucketed compile cache, and a hot-swappable multi-model registry
+  (BigDL's local/distributed predictor serving story, request-level).
 - ``bigdl_tpu.utils``    — Table (the pytree of the system), RandomGenerator,
   DirectedGraph, File I/O, logging.
 - ``bigdl_tpu.ops``      — pallas TPU kernels for ops XLA fusion can't cover
@@ -36,11 +39,11 @@ Design notes (vs the reference, /root/reference):
 from bigdl_tpu.utils.table import Table, T
 from bigdl_tpu.utils.random import RandomGenerator
 from bigdl_tpu.utils.engine import Engine
-from bigdl_tpu import nn, optim, dataset, parallel, utils
+from bigdl_tpu import nn, optim, dataset, parallel, serving, utils
 
 __version__ = "0.1.0"
 
 __all__ = [
     "Table", "T", "RandomGenerator", "Engine",
-    "nn", "optim", "dataset", "parallel", "utils",
+    "nn", "optim", "dataset", "parallel", "serving", "utils",
 ]

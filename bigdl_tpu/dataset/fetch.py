@@ -29,10 +29,19 @@ import numpy as np
 # yann.lecun.com has 403'd for years (the reference's URL is dead);
 # the ossci mirror serves the identical files
 MNIST_URL = "https://ossci-datasets.s3.amazonaws.com/mnist/"
-# https where the hosts support it (qwone.com is plain-http only; pin a
-# sha256 there or pre-seed the file when transport integrity matters)
+# https where the hosts support it; qwone.com is plain-http only, so
+# the NEWS20 fetch pins a sha256 at the call site (see get_news20)
 NEWS20_URL = ("http://qwone.com/~jason/20Newsgroups/"
               "20news-19997.tar.gz")
+# Digest pin for the plain-http NEWS20 tarball (ADVICE r5: the sha256
+# check must not stay dead code). Upstream publishes no checksum, so the
+# pin is this env var when set — deployments that know the digest of
+# their mirror pin it here ("" disables) — falling back to a
+# trust-on-first-use `.sha256` sidecar recorded beside the tarball: the
+# first fetch (or a pre-seeded cache, which the module docstring already
+# declares trusted) records the digest, and every later re-download —
+# cache eviction, mirror swap, on-path rewrite — must match it.
+NEWS20_SHA256_ENV = "BIGDL_NEWS20_SHA256"
 GLOVE_URL = "https://nlp.stanford.edu/data/glove.6B.zip"
 MOVIELENS_URL = "https://files.grouplens.org/datasets/movielens/ml-1m.zip"
 # the rnn recipe's default corpus (models/rnn/README.md points at the
@@ -56,18 +65,54 @@ def maybe_download(filename: str, work_dir: str, source_url: str,
         tmp = filepath + ".part"
         urlretrieve(source_url, tmp)
         if sha256 is not None:
-            import hashlib
-            h = hashlib.sha256()
-            with open(tmp, "rb") as f:
-                for chunk in iter(lambda: f.read(1 << 20), b""):
-                    h.update(chunk)
-            if h.hexdigest() != sha256:
+            got = _file_sha256(tmp)
+            if got != sha256:
                 os.remove(tmp)
                 raise IOError(
                     f"{source_url}: sha256 mismatch "
-                    f"(got {h.hexdigest()}, want {sha256})")
+                    f"(got {got}, want {sha256})")
         os.replace(tmp, filepath)
     return filepath
+
+
+def _file_sha256(path: str) -> str:
+    import hashlib
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _pinned_sha256(filepath: str, env_var: str):
+    """The digest a (re-)download of ``filepath`` must match: the env
+    pin when set ("" disables checking), else the ``.sha256`` sidecar a
+    previous trusted fetch recorded, else None (nothing known yet)."""
+    pin = os.environ.get(env_var)
+    if pin is not None:
+        return pin or None
+    sidecar = filepath + ".sha256"
+    if os.path.exists(sidecar):
+        with open(sidecar) as f:
+            return f.read().strip() or None
+    return None
+
+
+def _record_sha256(filepath: str, refresh: bool = False) -> str:
+    """Trust-on-first-use: record ``filepath``'s digest in a ``.sha256``
+    sidecar (kept as-is if already recorded) so every later re-download
+    must reproduce it. ``refresh`` rewrites the sidecar from the live
+    file — used when an env pin just overrode it, so an
+    operator-accepted replacement tarball doesn't leave a stale sidecar
+    that rejects every later re-download."""
+    sidecar = filepath + ".sha256"
+    if refresh or not os.path.exists(sidecar):
+        tmp = sidecar + ".part"
+        with open(tmp, "w") as f:
+            f.write(_file_sha256(filepath) + "\n")
+        os.replace(tmp, sidecar)
+    with open(sidecar) as f:
+        return f.read().strip()
 
 
 # ------------------------------------------------------------------ MNIST
@@ -118,8 +163,21 @@ def get_news20(source_dir: str = "/tmp/news20/"
                ) -> List[Tuple[str, int]]:
     """Download-if-missing + parse the 20 Newsgroups tree into
     [(document_text, 1-based category label)] (news20.py:53)."""
+    # the one plain-http artifact: verify the tarball — downloaded OR
+    # already cached — against the pinned digest (env pin, else the
+    # recorded first-fetch digest; see NEWS20_SHA256_ENV), then record
+    # it so the pin exists. Verifying the cached file too means a pin
+    # can never be refreshed from a tampered cache.
+    tar_file = os.path.join(source_dir, "20news-19997.tar.gz")
+    pin = _pinned_sha256(tar_file, NEWS20_SHA256_ENV)
     tar_path = maybe_download("20news-19997.tar.gz", source_dir,
-                              NEWS20_URL)
+                              NEWS20_URL, sha256=pin)
+    if pin is not None and _file_sha256(tar_path) != pin:
+        raise IOError(
+            f"{tar_path}: cached file fails its sha256 pin ({pin}); "
+            "delete the file (and its .sha256 sidecar) to re-fetch")
+    _record_sha256(tar_path,
+                   refresh=os.environ.get(NEWS20_SHA256_ENV) is not None)
     extracted = os.path.join(source_dir, "20_newsgroups")
     if not os.path.exists(extracted):
         def _untar(dst):

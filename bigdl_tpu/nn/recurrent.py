@@ -30,6 +30,21 @@ class Cell(Module):
 
     hidden_size: int
 
+    #: Capability flag (ADVICE r5): does ``step`` CONSUME the per-step
+    #: rng? ``None`` (the default) derives it from the built-in dropout
+    #: convention — a ``p`` attribute != 0. A custom stochastic cell
+    #: that doesn't follow that convention MUST set ``uses_rng = True``,
+    #: or Recurrent will drop its rng (and may take the projected fast
+    #: path), silently making it deterministic.
+    uses_rng: Optional[bool] = None
+
+    def consumes_rng(self) -> bool:
+        """True when this cell wants per-step rng keys from its
+        unroller (Recurrent splits/carries T keys only then)."""
+        if self.uses_rng is not None:
+            return self.uses_rng
+        return getattr(self, "p", 0.0) != 0.0
+
     def init_hidden(self, batch_size: int, dtype=None):
         """Zero hidden state pytree (Cell.hidResize, Cell.scala:104)."""
         raise NotImplementedError
@@ -414,9 +429,13 @@ class Recurrent(Module):
         h0 = self._h0(x)
         xs = jnp.moveaxis(x, 1, 0)  # (T, B, ...)
         n_steps = xs.shape[0]
-        if getattr(self.cell, "p", 0.0) == 0.0:
-            # dropout-free cell: don't split/carry T per-step keys the
-            # cell will ignore (pure scan-carry overhead)
+        consumes = getattr(self.cell, "consumes_rng", None)
+        if not (consumes() if consumes is not None
+                else getattr(self.cell, "p", 0.0) != 0.0):
+            # rng-free cell (explicit capability, Cell.uses_rng): don't
+            # split/carry T per-step keys the cell will ignore (pure
+            # scan-carry overhead). Cells that consume rng keep it and
+            # thereby also stay off the projected fast path below.
             rng = None
         if rng is None and hasattr(self.cell, "project_input"):
             # MXU fast path: the input half of the gates is

@@ -18,7 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from bigdl_tpu.nn.module import Module
-from bigdl_tpu.optim.predictor import Predictor, _batches, _pad_rows
+from bigdl_tpu.optim.predictor import Predictor, _batches, pad_rows
 from bigdl_tpu.optim.validation import ValidationMethod, ValidationResult
 
 
@@ -58,12 +58,8 @@ class Evaluator(Predictor):
         return {m.name: r for m, r in zip(methods, results)}
 
     def _test_local(self, params, state, dataset, methods, batch_size):
-        model = self.model
-
-        @jax.jit
-        def step(p, s, x):
-            out, _ = model.apply(p, s, x, training=False)
-            return out
+        from bigdl_tpu.optim.predictor import make_eval_step
+        step = make_eval_step(self.model)
 
         from bigdl_tpu.dataset.sample import minibatch_input_to_device
         results = None
@@ -78,16 +74,18 @@ class Evaluator(Predictor):
 
     def _test_mesh(self, params, state, dataset, methods, batch_size,
                    out_sh):
-        model = self.model
-        step = jax.jit(
-            lambda p, s, x: model.apply(p, s, x, training=False)[0],
-            out_shardings=out_sh)
+        from bigdl_tpu.optim.predictor import (_require_ndarray_input,
+                                               make_eval_step)
+        step = make_eval_step(self.model, out_shardings=out_sh)
         from bigdl_tpu.optim.optimizer import _local_rows
+        batches = self._mesh_batches(dataset, batch_size,
+                                     "Evaluator(mesh=...).evaluate")
         results = None
-        for b in _batches(dataset, batch_size):
-            x = np.asarray(b.get_input())
+        for b in batches:
+            x = _require_ndarray_input(b.get_input(),
+                                       "Evaluator(mesh=...).evaluate")
             valid = x.shape[0]
-            x = self._put_batch(_pad_rows(x, batch_size))
+            x = self._put_batch(pad_rows(x, batch_size))
             out = _local_rows(step(params, state, x))[:valid]
             tgt = np.asarray(b.get_target())[:valid]
             batch_res = [m(out, tgt) for m in methods]

@@ -57,8 +57,13 @@ def _batches(dataset, batch_size):
         yield from SampleToMiniBatch(batch_size).apply(full)
 
 
-def _pad_rows(a: np.ndarray, n: int) -> np.ndarray:
-    """Right-pad dim 0 to n rows by repeating the last row."""
+def pad_rows(a: np.ndarray, n: int) -> np.ndarray:
+    """Right-pad dim 0 to n rows by repeating the last row.
+
+    The ONE padding idiom shared by the offline sweeps here and the
+    online micro-batcher (``bigdl_tpu.serving``): repeating a real row
+    keeps the pad numerically inert for row-wise models while pinning
+    the batch shape, so XLA compiles one program per padded size."""
     if a.shape[0] == n:
         return a
     if a.shape[0] > n:
@@ -68,6 +73,61 @@ def _pad_rows(a: np.ndarray, n: int) -> np.ndarray:
             "predictor's (pass batch_size= matching the dataset's)")
     reps = np.repeat(a[-1:], n - a.shape[0], axis=0)
     return np.concatenate([a, reps], axis=0)
+
+
+def make_eval_step(model: Module, *, out_shardings=None, on_trace=None):
+    """The jitted eval-mode forward ``(params, state, x) -> out`` that
+    Predictor, Evaluator, and the serving compile cache all share.
+
+    ``on_trace`` (if given) is invoked from inside the traced function
+    body — i.e. exactly once per XLA compilation (per distinct input
+    shape/dtype), never on cached executions — which is what lets
+    ``serving.CompileCache`` count compiles and tests assert bounded
+    recompilation. ``out_shardings`` pins the output layout on mesh
+    paths (GSPMD may otherwise replicate and desynchronize multi-host
+    local-row reads)."""
+    def fn(p, s, x):
+        if on_trace is not None:
+            on_trace()
+        out, _ = model.apply(p, s, x, training=False)
+        return out
+
+    if out_shardings is not None:
+        return jax.jit(fn, out_shardings=out_shardings)
+    return jax.jit(fn)
+
+
+def _require_ndarray_input(inp, where: str):
+    """Mesh sweeps lay the batch out over the data axis, which is only
+    well-defined for a single dense ndarray input; reject tables /
+    multi-tensor / sparse inputs loudly instead of letting np.asarray
+    build a ragged object array (ADVICE r5)."""
+    from bigdl_tpu.dataset.sample import HostBatchedCOO
+    from bigdl_tpu.utils.table import Table
+    if isinstance(inp, (Table, list, tuple, dict, HostBatchedCOO)):
+        raise TypeError(
+            f"{where} supports single-ndarray minibatch inputs only; "
+            f"got {type(inp).__name__}. Table/multi-tensor and sparse "
+            "inputs have no canonical layout over the mesh data axis — "
+            "use the local (mesh=None) path for those models.")
+    return np.asarray(inp)
+
+
+def _validate_equal_batch_counts(n_batches: int, where: str):
+    """Multi-host collective steps run once per batch on EVERY process;
+    unequal per-process batch counts would leave the shorter processes
+    waiting in a collective the longer ones never enter (silent
+    desync/hang). Allgather the local counts once and fail fast with
+    the full picture instead (ADVICE r5)."""
+    from jax.experimental import multihost_utils
+    counts = np.asarray(multihost_utils.process_allgather(
+        np.array([n_batches], np.int64))).reshape(-1)
+    if len(set(counts.tolist())) > 1:
+        raise ValueError(
+            f"{where}: per-process batch counts differ across the "
+            f"{counts.size} processes: {counts.tolist()}. Every process "
+            "must feed the same number of batches (pad or trim the "
+            "per-host dataset shards to equal size).")
 
 
 class Predictor:
@@ -88,6 +148,32 @@ class Predictor:
 
     def _data_parallel(self) -> bool:
         return self.mesh.shape.get(self.data_axis, 1) > 1
+
+    def _mesh_batches(self, dataset, batch_size, where: str):
+        """Batches for a mesh sweep. Multi-host runs must first agree
+        on the per-process batch COUNT (the collective step desyncs
+        otherwise) — counted with a streaming pre-pass when the dataset
+        is re-iterable, so a shard bigger than host RAM never has to be
+        materialized whole just to be counted."""
+        if not self._multiprocess():
+            return _batches(dataset, batch_size)
+        if isinstance(dataset, (list, tuple)):
+            # sized: the count needs no batching work at all
+            if dataset and isinstance(dataset[0], MiniBatch):
+                n = len(dataset)
+            else:
+                n = -(-len(dataset) // batch_size)
+            batches = _batches(dataset, batch_size)
+        elif isinstance(dataset, AbstractDataSet):
+            # re-iterable: stream a counting pre-pass, O(batch) memory
+            n = sum(1 for _ in _batches(dataset, batch_size))
+            batches = _batches(dataset, batch_size)
+        else:
+            # one-shot iterator: counting consumes it, keep the batches
+            batches = list(_batches(dataset, batch_size))
+            n = len(batches)
+        _validate_equal_batch_counts(n, where)
+        return batches
 
     def _batch_sharding(self):
         spec = jax.sharding.PartitionSpec(self.data_axis) \
@@ -140,26 +226,22 @@ class Predictor:
                 outs.extend(out_np)
             return outs
 
-        step = jax.jit(
-            lambda p, s, x: model.apply(p, s, x, training=False)[0],
-            out_shardings=out_sh)
+        step = make_eval_step(model, out_shardings=out_sh)
         from bigdl_tpu.optim.optimizer import _local_rows
+        batches = self._mesh_batches(dataset, batch_size,
+                                     "Predictor(mesh=...).predict")
         outs: List[np.ndarray] = []
-        for b in _batches(dataset, batch_size):
-            x = np.asarray(b.get_input())
+        for b in batches:
+            x = _require_ndarray_input(b.get_input(),
+                                       "Predictor(mesh=...).predict")
             valid = x.shape[0]
-            x = self._put_batch(_pad_rows(x, batch_size))
+            x = self._put_batch(pad_rows(x, batch_size))
             out = _local_rows(step(params, state, x))
             outs.extend(out[:valid])
         return outs
 
     def _predict_local(self, params, state, dataset, batch_size):
-        model = self.model
-
-        @jax.jit
-        def step(p, s, x):
-            out, _ = model.apply(p, s, x, training=False)
-            return out
+        step = make_eval_step(self.model)
 
         from bigdl_tpu.dataset.sample import minibatch_input_to_device
         outs: List[np.ndarray] = []

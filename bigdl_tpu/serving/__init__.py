@@ -1,0 +1,27 @@
+"""Online inference: dynamic micro-batching, shape-bucketed compile
+cache, multi-model registry (docs/serving.md).
+
+The offline ``optim.Predictor`` sweeps a dataset; this package turns
+any Module (float, loaded, or int8-quantized) into a request-level
+service::
+
+    from bigdl_tpu.serving import InferenceService, ServingConfig
+
+    svc = InferenceService(config=ServingConfig(max_batch_size=16,
+                                                max_wait_ms=2.0))
+    svc.load("mnist", model, warmup_shape=(28 * 28,))
+    y = svc.predict("mnist", x)            # sync, one sample
+    fut = svc.predict_async("mnist", x)    # future form
+    svc.load("mnist", new_model)           # hot-swap v2 behind the name
+"""
+from bigdl_tpu.serving.batcher import (DeadlineExceeded, MicroBatcher,
+                                       QueueFull)
+from bigdl_tpu.serving.compile_cache import BucketLadder, CompileCache
+from bigdl_tpu.serving.registry import ModelRegistry, Servable
+from bigdl_tpu.serving.service import InferenceService, ServingConfig
+
+__all__ = [
+    "BucketLadder", "CompileCache", "DeadlineExceeded", "InferenceService",
+    "MicroBatcher", "ModelRegistry", "QueueFull", "Servable",
+    "ServingConfig",
+]

@@ -1,0 +1,290 @@
+"""Dynamic micro-batcher: the request→batch coalescing core of serving.
+
+Callers submit single requests (or small row-batches) and get a Future;
+a dispatch thread coalesces queued requests up to ``max_batch_size``
+rows or until the oldest request has waited ``max_wait_ms``, right-pads
+the coalesced rows to the nearest ``BucketLadder`` rung (the
+``optim.predictor.pad_rows`` idiom — repeat the last real row), runs ONE
+forward via the injected ``run_batch`` callable, and scatters per-request
+row slices back to the futures. A full batch dispatches immediately —
+``max_wait_ms`` is the latency bound for underfilled batches, not a tax
+on busy traffic.
+
+Admission control (the production-serving table stakes the offline
+Predictor never needed):
+
+- bounded queue depth — ``submit`` raises :class:`QueueFull` at once
+  instead of buffering unboundedly;
+- per-request deadlines — a request that waits past its budget fails
+  with :class:`DeadlineExceeded` (and the batch window never waits
+  beyond the earliest queued deadline);
+- graceful drain — ``shutdown(drain=True)`` stops admission, flushes
+  everything queued, then joins the dispatch thread.
+
+The batcher is model-agnostic (``run_batch`` is any padded-rows →
+padded-rows callable), which is also what lets tests drive it with a
+slow pure-python runner to exercise the rejection/timeout paths.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Callable, Deque, List, Optional
+
+import numpy as np
+
+from bigdl_tpu.serving.compile_cache import BucketLadder
+
+
+class QueueFull(RuntimeError):
+    """Admission control: the request queue is at max_queue depth."""
+
+
+class DeadlineExceeded(TimeoutError):
+    """The request's deadline passed before a batch could serve it."""
+
+
+class _Request:
+    __slots__ = ("x", "n_rows", "future", "deadline", "t_enqueue")
+
+    def __init__(self, x: np.ndarray, deadline: Optional[float]):
+        self.x = x
+        self.n_rows = x.shape[0]
+        self.future: Future = Future()
+        self.deadline = deadline
+        self.t_enqueue = time.monotonic()
+
+
+class BatcherStats:
+    """Thread-safe counters + a bounded latency reservoir (ms)."""
+
+    def __init__(self, reservoir: int = 2048):
+        self.lock = threading.Lock()
+        self.requests = 0
+        self.rows = 0
+        self.rejected = 0
+        self.timed_out = 0
+        self.errors = 0
+        self.batches = 0
+        self.batched_rows = 0
+        self.padded_rows = 0
+        self.fill_sum = 0.0
+        self.latencies_ms: Deque[float] = deque(maxlen=reservoir)
+
+
+class MicroBatcher:
+    """Queue + dispatch thread coalescing requests into bucket-padded
+    batches for one ``run_batch`` callable (module docstring has the
+    batching window and admission-control rules)."""
+
+    def __init__(self, run_batch: Callable[[np.ndarray], np.ndarray],
+                 ladder: BucketLadder, *, max_wait_ms: float = 2.0,
+                 max_queue: int = 256, name: str = "model"):
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self._run_batch = run_batch
+        self._ladder = ladder
+        self._max_wait = max_wait_ms / 1000.0
+        self._max_queue = max_queue
+        self._name = name
+        self.stats = BatcherStats()
+        #: (feature_shape, dtype) CONFIRMED by the first successful
+        #: dispatch; requests coalesce into ONE ndarray, so a mismatch
+        #: must be rejected at admission (its whole batch would fail
+        #: on concatenate, or silently upcast and double-compile).
+        #: Until confirmed, submits are checked against what's queued —
+        #: a malformed lone first request fails its own forward without
+        #: permanently bricking the name.
+        self._sig = None
+        self._queue: Deque[_Request] = deque()
+        self._cond = threading.Condition()
+        self._stopping = False
+        self._thread = threading.Thread(
+            target=self._loop, name=f"serving-batcher-{name}", daemon=True)
+        self._thread.start()
+
+    @property
+    def max_batch_size(self) -> int:
+        return self._ladder.max_batch_size
+
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    # -------------------------------------------------------- submit
+    def submit(self, x: np.ndarray,
+               timeout_ms: Optional[float] = None) -> Future:
+        """Enqueue a (rows, features...) request; returns its Future.
+
+        Raises :class:`QueueFull` immediately when the queue is at
+        depth (explicit rejection beats unbounded buffering), and
+        ValueError for requests wider than one batch (split upstream)
+        or whose feature shape/dtype differs from the batcher's
+        established signature (one malformed request must never fail
+        the well-formed requests it would have been batched with).
+        """
+        x = np.asarray(x)
+        if x.ndim < 1 or x.shape[0] < 1:
+            raise ValueError(f"request needs >= 1 rows, got shape {x.shape}")
+        if x.shape[0] > self.max_batch_size:
+            raise ValueError(
+                f"request of {x.shape[0]} rows exceeds max_batch_size="
+                f"{self.max_batch_size}; split it upstream")
+        deadline = (time.monotonic() + timeout_ms / 1000.0
+                    if timeout_ms is not None else None)
+        req = _Request(x, deadline)
+        sig = (x.shape[1:], x.dtype)
+        with self._cond:
+            if self._stopping:
+                raise RuntimeError(f"batcher {self._name!r} is shut down")
+            ref = self._sig or (
+                (self._queue[-1].x.shape[1:], self._queue[-1].x.dtype)
+                if self._queue else None)
+            if ref is not None and sig != ref:
+                raise ValueError(
+                    f"{self._name}: request feature shape/dtype "
+                    f"{sig[0]}/{sig[1]} does not match this model's "
+                    f"established {ref[0]}/{ref[1]} — one "
+                    "micro-batched service serves one input signature")
+            if len(self._queue) >= self._max_queue:
+                with self.stats.lock:
+                    self.stats.rejected += 1
+                raise QueueFull(
+                    f"{self._name}: queue at max depth {self._max_queue}")
+            self._queue.append(req)
+            with self.stats.lock:
+                self.stats.requests += 1
+                self.stats.rows += req.n_rows
+            self._cond.notify_all()
+        return req.future
+
+    # ------------------------------------------------------ dispatch
+    def _queued_rows_locked(self) -> int:
+        rows, cap = 0, self.max_batch_size
+        for r in self._queue:
+            if rows + r.n_rows > cap:
+                break
+            rows += r.n_rows
+        return rows
+
+    def _window_end_locked(self, now: float) -> float:
+        """The moment this batch must dispatch: the head request's
+        max_wait budget, tightened by the earliest queued deadline."""
+        end = self._queue[0].t_enqueue + self._max_wait
+        for r in self._queue:
+            if r.deadline is not None:
+                end = min(end, r.deadline)
+        return end
+
+    def _take_batch_locked(self, window_open: float):
+        """Pop expired requests (failing their futures) and then up to
+        max_batch_size rows of live ones.
+
+        "Expired" means the deadline passed BEFORE this batching round
+        opened — i.e. the batcher was busy elsewhere while the budget
+        ran out. A deadline the window itself closed on is SERVED: the
+        window end is tightened to the earliest queued deadline exactly
+        so that request dispatches as its budget expires, rather than
+        being failed by the wakeup meant to serve it (a request with
+        timeout_ms <= max_wait_ms must still work on an idle server).
+        """
+        batch: List[_Request] = []
+        rows, cap = 0, self.max_batch_size
+        while self._queue:
+            r = self._queue[0]
+            if r.deadline is not None and r.deadline < window_open:
+                self._queue.popleft()
+                with self.stats.lock:
+                    self.stats.timed_out += 1
+                r.future.set_exception(DeadlineExceeded(
+                    f"{self._name}: request waited past its deadline"))
+                continue
+            if rows + r.n_rows > cap:
+                break
+            self._queue.popleft()
+            batch.append(r)
+            rows += r.n_rows
+        return batch, rows
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._stopping:
+                    self._cond.wait()
+                if not self._queue and self._stopping:
+                    return
+                # hold the window open for stragglers until the batch
+                # fills, the head request's wait budget ends, or drain
+                window_open = time.monotonic()
+                while not self._stopping:
+                    now = time.monotonic()
+                    if self._queued_rows_locked() >= self.max_batch_size:
+                        break
+                    remaining = self._window_end_locked(now) - now
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(timeout=remaining)
+                batch, rows = self._take_batch_locked(window_open)
+            if batch:
+                self._dispatch(batch, rows)
+
+    def _dispatch(self, batch: List[_Request], rows: int) -> None:
+        bucket = self._ladder.bucket_for(rows)
+        from bigdl_tpu.optim.predictor import pad_rows
+        x = np.concatenate([r.x for r in batch], axis=0) \
+            if len(batch) > 1 else batch[0].x
+        try:
+            out = np.asarray(self._run_batch(pad_rows(x, bucket)))
+            if out.shape[:1] != (bucket,):
+                # a row-reducing model would otherwise scatter empty/
+                # truncated slices into futures that "succeed"
+                raise ValueError(
+                    f"{self._name}: run_batch returned shape {out.shape} "
+                    f"for a {bucket}-row padded batch; serving requires "
+                    "one output row per input row")
+        except Exception as e:  # noqa: BLE001 — failures go to futures
+            with self.stats.lock:
+                self.stats.errors += len(batch)
+            for r in batch:
+                if not r.future.cancelled():
+                    r.future.set_exception(e)
+            return
+        with self._cond:
+            if self._sig is None:
+                # confirmed by a successful forward: from here on the
+                # name serves exactly this signature
+                self._sig = (x.shape[1:], x.dtype)
+        t_done = time.monotonic()
+        with self.stats.lock:
+            self.stats.batches += 1
+            self.stats.batched_rows += rows
+            self.stats.padded_rows += bucket - rows
+            self.stats.fill_sum += rows / bucket
+            for r in batch:
+                self.stats.latencies_ms.append(
+                    (t_done - r.t_enqueue) * 1000.0)
+        off = 0
+        for r in batch:
+            if not r.future.cancelled():
+                # pad rows live PAST every request slice: they can
+                # never leak into a scattered result
+                r.future.set_result(out[off:off + r.n_rows])
+            off += r.n_rows
+
+    # ------------------------------------------------------ shutdown
+    def shutdown(self, drain: bool = True) -> None:
+        """Stop admission; with ``drain`` serve everything queued, else
+        fail queued requests; then join the dispatch thread."""
+        with self._cond:
+            if self._stopping:
+                self._cond.notify_all()
+            self._stopping = True
+            if not drain:
+                while self._queue:
+                    r = self._queue.popleft()
+                    r.future.set_exception(
+                        RuntimeError(f"batcher {self._name!r} shut down"))
+            self._cond.notify_all()
+        self._thread.join()

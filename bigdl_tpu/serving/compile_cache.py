@@ -1,0 +1,137 @@
+"""Shape-bucketed compile cache for online inference.
+
+The XLA-centric lesson (TensorFlow paper §4.4, and BigDL's own fixed
+``batch_size`` padding in ``optim/predictor.py``): every distinct input
+shape is a fresh compilation. Offline sweeps dodge this with ONE padded
+batch size; an online service sees ragged request sizes, so it pads each
+micro-batch up to the nearest rung of a small **bucket ladder** — with K
+buckets, at most K programs ever compile per (model, dtype), no matter
+how many request sizes arrive.
+
+``CompileCache`` holds one jitted eval step per servable (built by
+``optim.predictor.make_eval_step`` — the same jitted forward the offline
+Predictor runs) and counts compilations via the step's trace hook, so
+tests can assert the bound instead of trusting it. ``warmup`` eagerly
+compiles every rung so the first real request never eats a compile.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class BucketLadder:
+    """Sorted batch-size rungs; requests pad up to the nearest rung.
+
+    Default ladder is powers of two up to ``max_batch_size`` (with
+    ``max_batch_size`` itself as the top rung), e.g. 32 -> [1, 2, 4, 8,
+    16, 32]; pass ``buckets`` for a custom ladder (deduped, sorted; its
+    max becomes the effective max batch size).
+    """
+
+    def __init__(self, max_batch_size: int,
+                 buckets: Optional[Sequence[int]] = None):
+        if buckets is not None:
+            rungs = sorted(set(int(b) for b in buckets))
+            if not rungs or rungs[0] < 1:
+                raise ValueError(f"buckets must be positive ints, got "
+                                 f"{list(buckets)}")
+        else:
+            if max_batch_size < 1:
+                raise ValueError(
+                    f"max_batch_size must be >= 1, got {max_batch_size}")
+            rungs, b = [], 1
+            while b < max_batch_size:
+                rungs.append(b)
+                b *= 2
+            rungs.append(max_batch_size)
+        self._rungs: List[int] = rungs
+
+    @property
+    def max_batch_size(self) -> int:
+        return self._rungs[-1]
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest rung >= n (the padded size a batch of n rows runs
+        at)."""
+        if n < 1:
+            raise ValueError(f"batch of {n} rows")
+        for b in self._rungs:
+            if b >= n:
+                return b
+        raise ValueError(
+            f"batch of {n} rows exceeds the ladder's max "
+            f"{self.max_batch_size}")
+
+    def __iter__(self) -> Iterator[int]:
+        return iter(self._rungs)
+
+    def __len__(self) -> int:
+        return len(self._rungs)
+
+    def __repr__(self) -> str:
+        return f"BucketLadder({self._rungs})"
+
+
+class CompileCache:
+    """Per-servable jitted eval steps + a compile counter.
+
+    Keys are opaque hashables — the registry uses ``(name, version)`` —
+    so two versions of a model never share programs and ``drop`` at
+    unload releases them. Within one key, jax.jit's own aval cache
+    provides the per-(bucket, dtype) specialization; the counter
+    increments exactly once per trace (= per compiled program), which is
+    the quantity the acceptance tests bound.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._steps: Dict = {}
+        self._compiles: Dict[Tuple, int] = {}
+
+    def step_for(self, key, model):
+        """The (cached) jitted eval step for ``key``; builds it on first
+        use with a trace hook wired to this cache's counter."""
+        with self._lock:
+            step = self._steps.get(key)
+            if step is None:
+                from bigdl_tpu.optim.predictor import make_eval_step
+
+                def on_trace(key=key):
+                    with self._lock:
+                        self._compiles[key] = self._compiles.get(key, 0) + 1
+
+                step = make_eval_step(model, on_trace=on_trace)
+                self._steps[key] = step
+            return step
+
+    def compile_count(self, key=None) -> int:
+        """Compilations so far — for ``key``, or in total when None."""
+        with self._lock:
+            if key is not None:
+                return self._compiles.get(key, 0)
+            return sum(self._compiles.values())
+
+    def drop(self, key) -> None:
+        """Release the compiled programs of an unloaded servable."""
+        with self._lock:
+            self._steps.pop(key, None)
+            self._compiles.pop(key, None)
+
+    def warmup(self, key, model, params, state,
+               feature_shape: Sequence[int], ladder: BucketLadder,
+               dtype=np.float32) -> int:
+        """Eagerly compile every ladder rung for ``key`` (zeros input of
+        shape ``(bucket,) + feature_shape``) so no real request ever
+        pays a compile. Returns the number of programs compiled by this
+        call (rungs already cached cost nothing)."""
+        import jax
+
+        step = self.step_for(key, model)
+        before = self.compile_count(key)
+        for b in ladder:
+            x = np.zeros((b,) + tuple(feature_shape), dtype)
+            jax.block_until_ready(step(params, state, x))
+        return self.compile_count(key) - before

@@ -1,0 +1,173 @@
+"""Multi-model registry: named, versioned servables with atomic hot-swap.
+
+A **servable** is an immutable snapshot of everything a forward needs —
+the module tree plus its params/state captured at load time. Snapshots
+make hot-swap trivially atomic: ``current()`` returns one object, a
+swap republishes the name→servable pointer under the registry lock, and
+any batch already dispatched keeps the snapshot it resolved — in-flight
+requests finish on the old version, later batches see only the new one,
+and no response can mix versions (one batch, one snapshot).
+
+Models arrive as live :class:`~bigdl_tpu.nn.module.Module` trees, as
+``utils/serialization.save_module`` directories (``path=``), or through
+the ``nn/quantized`` int8 rewrite (``quantize=True``) — a quantized
+model serves identically (it is just another Module snapshot).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+
+class Servable:
+    """One immutable (model, params, state) snapshot behind a
+    (name, version)."""
+
+    __slots__ = ("name", "version", "model", "params", "state")
+
+    def __init__(self, name: str, version: int, model, params, state):
+        self.name = name
+        self.version = version
+        self.model = model
+        self.params = params
+        self.state = state
+
+    @property
+    def key(self):
+        """Compile-cache key: programs are never shared across
+        versions (their param shapes/dtypes may differ)."""
+        return (self.name, self.version)
+
+    def __repr__(self) -> str:
+        return (f"Servable({self.name!r} v{self.version} "
+                f"{type(self.model).__name__})")
+
+
+class _Entry:
+    def __init__(self):
+        self.versions: Dict[int, Servable] = {}
+        self.current: Optional[Servable] = None
+
+
+class ModelRegistry:
+    """Named models, each with versions and one *current* pointer."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._models: Dict[str, _Entry] = {}
+
+    # ---------------------------------------------------------- load
+    def load(self, name: str, model=None, *, path: Optional[str] = None,
+             version: Optional[int] = None, quantize: bool = False,
+             activate: bool = True) -> Servable:
+        """Register a model version under ``name``.
+
+        Exactly one of ``model`` (a Module) or ``path`` (a
+        ``save_module`` directory) must be given; ``quantize=True``
+        rewrites it through the int8 path first. The new version
+        becomes current when ``activate`` (the default) — an atomic
+        hot-swap if the name already serves traffic. With
+        ``activate=False`` the version is STAGED only, even for a
+        fresh name (that is what lets a caller warm it up before any
+        traffic can resolve it): ``swap`` makes it current.
+        """
+        if (model is None) == (path is None):
+            raise ValueError("pass exactly one of model= or path=")
+        if path is not None:
+            from bigdl_tpu.utils.serialization import load_module
+            model = load_module(path)
+            model.evaluate()  # fresh instance: the registry owns it
+        model.ensure_initialized()
+        if quantize:
+            from bigdl_tpu.nn.quantized import quantize as _quantize
+            model = _quantize(model)  # a rewrite, original untouched
+            model.evaluate()
+        # a user-passed live module is NOT flipped to eval mode (it may
+        # still be training eagerly elsewhere) — the serving step runs
+        # apply(training=False) regardless, so serving stays inert
+        servable = None
+        with self._lock:
+            entry = self._models.setdefault(name, _Entry())
+            if version is None:
+                version = max(entry.versions, default=0) + 1
+            if version in entry.versions:
+                raise ValueError(f"{name} v{version} already loaded "
+                                 "(unload it first or pick a new version)")
+            servable = Servable(name, version, model,
+                                model.get_parameters(), model.get_state())
+            entry.versions[version] = servable
+            if activate:
+                entry.current = servable
+        return servable
+
+    # ------------------------------------------------------ resolve
+    def current(self, name: str) -> Servable:
+        """The servable behind ``name`` right now (one atomic read —
+        callers hold the returned snapshot for a whole batch)."""
+        with self._lock:
+            entry = self._models.get(name)
+            if entry is None:
+                raise KeyError(f"no model loaded under {name!r}")
+            if entry.current is None:
+                raise KeyError(
+                    f"no ACTIVE version under {name!r} (versions "
+                    f"{sorted(entry.versions)} are staged; swap one in)")
+            return entry.current
+
+    def swap(self, name: str, version: int) -> Servable:
+        """Atomically repoint ``name`` at an already-loaded version."""
+        with self._lock:
+            entry = self._models.get(name)
+            if entry is None or version not in entry.versions:
+                raise KeyError(f"{name!r} has no loaded v{version}")
+            entry.current = entry.versions[version]
+            return entry.current
+
+    def unload(self, name: str, version: Optional[int] = None) -> List:
+        """Drop one version (or the whole name). Refuses to drop the
+        version currently serving unless the whole name goes — swap
+        first. Returns the dropped servables' compile-cache keys."""
+        with self._lock:
+            entry = self._models.get(name)
+            if entry is None:
+                raise KeyError(f"no model loaded under {name!r}")
+            if version is None:
+                dropped = list(entry.versions.values())
+                del self._models[name]
+            else:
+                if version not in entry.versions:
+                    raise KeyError(f"{name!r} has no loaded v{version}")
+                if entry.current is not None and \
+                        entry.current.version == version:
+                    raise ValueError(
+                        f"{name} v{version} is the current servable; "
+                        "swap to another version before unloading it")
+                dropped = [entry.versions.pop(version)]
+            return [s.key for s in dropped]
+
+    # ------------------------------------------------------- introspect
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._models)
+
+    def versions(self, name: str) -> List[int]:
+        with self._lock:
+            entry = self._models.get(name)
+            if entry is None:
+                raise KeyError(f"no model loaded under {name!r}")
+            return sorted(entry.versions)
+
+    def describe(self, name: str) -> Dict:
+        """Stable-name status: current version + all loaded versions."""
+        with self._lock:
+            entry = self._models.get(name)
+            if entry is None:
+                raise KeyError(f"no model loaded under {name!r}")
+            return {
+                "name": name,
+                "current_version": (entry.current.version
+                                    if entry.current else None),
+                "versions": sorted(entry.versions),
+                "model_types": {v: type(s.model).__name__
+                                for v, s in entry.versions.items()},
+            }

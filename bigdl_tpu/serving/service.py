@@ -1,0 +1,235 @@
+"""InferenceService — the serving façade.
+
+``InferenceService(registry, config)`` wires the three serving pieces
+together per model name: requests enter a :class:`MicroBatcher`, batches
+resolve ONE :class:`Servable` snapshot from the :class:`ModelRegistry`
+(hot-swap atomicity), and run through the :class:`CompileCache`'s
+bucket-padded jitted forward. Everything runs on plain threads + queues
+(``JAX_PLATFORMS=cpu`` works end to end; on TPU the same code path jits
+onto the chips).
+
+Metrics: per-model request/rejection/timeout counts, queue depth,
+batch-fill ratio, and latency percentiles (via
+``utils.profiling.percentile_summary``), exportable as TensorBoard
+scalars through the existing ``visualization.summary`` writers —
+serving observability lands next to training curves.
+"""
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future
+from dataclasses import dataclass
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.serving.batcher import MicroBatcher
+from bigdl_tpu.serving.compile_cache import BucketLadder, CompileCache
+from bigdl_tpu.serving.registry import ModelRegistry, Servable
+
+
+@dataclass
+class ServingConfig:
+    """Tuning surface (see docs/serving.md for the trade-offs).
+
+    ``max_wait_ms`` trades tail latency for batch fill: a full batch
+    dispatches immediately, an underfilled one waits at most this long
+    for stragglers. ``buckets`` overrides the powers-of-two ladder
+    (its max then bounds the batch size)."""
+    max_batch_size: int = 32
+    max_wait_ms: float = 2.0
+    max_queue: int = 256
+    timeout_ms: Optional[float] = None
+    buckets: Optional[Sequence[int]] = None
+
+
+class InferenceService:
+    """The serving façade: ``predict(name, x)`` (sync + async-future
+    forms) over a hot-swappable multi-model registry, with per-model
+    micro-batching, bucket-padded compiled forwards, and exportable
+    serving metrics (module docstring has the wiring)."""
+
+    def __init__(self, registry: Optional[ModelRegistry] = None,
+                 config: Optional[ServingConfig] = None):
+        self.registry = registry or ModelRegistry()
+        self.config = config or ServingConfig()
+        self.ladder = BucketLadder(self.config.max_batch_size,
+                                   self.config.buckets)
+        self.cache = CompileCache()
+        # guards _batchers + _shut_down: batcher creation must be
+        # once-per-name (a MicroBatcher owns a dispatch thread) and
+        # must not race shutdown's iteration
+        self._lock = threading.Lock()
+        self._batchers: Dict[str, MicroBatcher] = {}
+        self._shut_down = False
+
+    # ------------------------------------------------------- lifecycle
+    def load(self, name: str, model=None, *, path: Optional[str] = None,
+             version: Optional[int] = None, quantize: bool = False,
+             activate: bool = True,
+             warmup_shape: Optional[Sequence[int]] = None,
+             warmup_dtype=np.float32) -> Servable:
+        """Registry load + (optionally) eager per-bucket compile.
+
+        Pass ``warmup_shape`` (per-sample feature shape, no batch dim)
+        to pre-compile every ladder rung before the version takes
+        traffic — the version is registered inactive, warmed, and only
+        THEN swapped in, so a hot-swap under live traffic never serves
+        a cold bucket (and the first real request never eats a
+        compile)."""
+        servable = self.registry.load(name, model, path=path,
+                                      version=version, quantize=quantize,
+                                      activate=False)
+        if warmup_shape is not None:
+            self.cache.warmup(servable.key, servable.model,
+                              servable.params, servable.state,
+                              warmup_shape, self.ladder, warmup_dtype)
+        if activate:
+            self.registry.swap(name, servable.version)
+        return servable
+
+    def warmup(self, name: str, feature_shape: Sequence[int],
+               dtype=np.float32) -> int:
+        """Pre-compile every bucket for the CURRENT version of
+        ``name``; returns how many programs that compiled."""
+        s = self.registry.current(name)
+        return self.cache.warmup(s.key, s.model, s.params, s.state,
+                                 feature_shape, self.ladder, dtype)
+
+    def swap(self, name: str, version: int) -> Servable:
+        """Atomic hot-swap: already-dispatched batches finish on the
+        snapshot they resolved; every later batch serves ``version``."""
+        return self.registry.swap(name, version)
+
+    def unload(self, name: str, version: Optional[int] = None) -> None:
+        """Unload a version (or a whole name, draining its batcher)
+        and release its compiled programs."""
+        if version is None:
+            with self._lock:
+                b = self._batchers.pop(name, None)
+            if b is not None:
+                b.shutdown(drain=True)
+        for key in self.registry.unload(name, version):
+            self.cache.drop(key)
+
+    def shutdown(self, drain: bool = True) -> None:
+        """Stop admission on every batcher; with ``drain`` flush queued
+        requests first."""
+        with self._lock:
+            self._shut_down = True
+            batchers = list(self._batchers.values())
+        for b in batchers:
+            b.shutdown(drain=drain)
+
+    # --------------------------------------------------------- predict
+    def _batcher(self, name: str) -> MicroBatcher:
+        with self._lock:
+            b = self._batchers.get(name)
+            if b is None:
+                if self._shut_down:
+                    raise RuntimeError("InferenceService is shut down")
+                self.registry.current(name)  # fail fast on unknown names
+
+                def run_batch(x, name=name):
+                    # ONE registry read per batch: the snapshot can't
+                    # change under a batch mid-forward (swap atomicity)
+                    s = self.registry.current(name)
+                    step = self.cache.step_for(s.key, s.model)
+                    return np.asarray(step(s.params, s.state, x))
+
+                b = MicroBatcher(run_batch, self.ladder,
+                                 max_wait_ms=self.config.max_wait_ms,
+                                 max_queue=self.config.max_queue,
+                                 name=name)
+                self._batchers[name] = b
+        return b
+
+    def predict_async(self, name: str, x,
+                      timeout_ms: Optional[float] = None) -> Future:
+        """One SAMPLE in -> Future of one prediction row."""
+        x = np.asarray(x)
+        fut = self._batcher(name).submit(
+            x[None], self._timeout(timeout_ms))
+        out: Future = Future()
+        fut.add_done_callback(lambda f: _chain(f, out, lambda o: o[0]))
+        return out
+
+    def predict(self, name: str, x,
+                timeout_ms: Optional[float] = None):
+        """Sync single-sample predict (blocks on the micro-batch)."""
+        return self.predict_async(name, x, timeout_ms).result()
+
+    def predict_batch_async(self, name: str, x,
+                            timeout_ms: Optional[float] = None) -> Future:
+        """(rows, features...) in -> Future of (rows, ...) predictions
+        — the rows ride one micro-batch together."""
+        return self._batcher(name).submit(np.asarray(x),
+                                          self._timeout(timeout_ms))
+
+    def predict_batch(self, name: str, x,
+                      timeout_ms: Optional[float] = None):
+        return self.predict_batch_async(name, x, timeout_ms).result()
+
+    def _timeout(self, timeout_ms: Optional[float]) -> Optional[float]:
+        return timeout_ms if timeout_ms is not None \
+            else self.config.timeout_ms
+
+    # --------------------------------------------------------- metrics
+    def compile_count(self, name: str,
+                      version: Optional[int] = None) -> int:
+        """Programs compiled for ``name`` (one version, or all)."""
+        if version is not None:
+            return self.cache.compile_count((name, version))
+        return sum(self.cache.compile_count((name, v))
+                   for v in self.registry.versions(name))
+
+    def metrics(self, name: str) -> Dict[str, float]:
+        """Point-in-time serving stats for one model name."""
+        from bigdl_tpu.utils.profiling import percentile_summary
+        with self._lock:
+            b = self._batchers.get(name)
+        out: Dict[str, float] = {
+            "request_count": 0, "rows": 0, "rejected": 0, "timed_out": 0,
+            "errors": 0, "batch_count": 0, "batch_fill": 0.0,
+            "padded_row_ratio": 0.0, "queue_depth": 0,
+        }
+        if b is not None:
+            st = b.stats
+            with st.lock:
+                lat = list(st.latencies_ms)
+                out.update(
+                    request_count=st.requests, rows=st.rows,
+                    rejected=st.rejected, timed_out=st.timed_out,
+                    errors=st.errors, batch_count=st.batches,
+                    batch_fill=(st.fill_sum / st.batches
+                                if st.batches else 0.0),
+                    padded_row_ratio=(
+                        st.padded_rows /
+                        (st.batched_rows + st.padded_rows)
+                        if st.batched_rows + st.padded_rows else 0.0))
+            out["queue_depth"] = b.queue_depth()
+            for k, v in percentile_summary(lat, (50, 99)).items():
+                out[f"latency_ms_{k}"] = v
+        out["compile_count"] = self.compile_count(name)
+        return out
+
+    def export_metrics(self, summary, step: int) -> None:
+        """Write every model's metrics as ``serving/<name>/<metric>``
+        scalars through a ``visualization.summary.Summary`` writer —
+        the same TensorBoard path training curves use."""
+        for name in self.registry.names():
+            for metric, value in self.metrics(name).items():
+                summary.add_scalar(f"serving/{name}/{metric}",
+                                   float(value), step)
+
+
+def _chain(src: Future, dst: Future, fn) -> None:
+    """Propagate src's outcome into dst through fn (row-slice views)."""
+    if src.cancelled():
+        dst.cancel()
+        return
+    e = src.exception()
+    if e is not None:
+        dst.set_exception(e)
+    else:
+        dst.set_result(fn(src.result()))

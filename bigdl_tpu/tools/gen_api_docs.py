@@ -22,6 +22,7 @@ FAMILIES = {
     "dataset": ["bigdl_tpu.dataset", "bigdl_tpu.dataset.device_dataset",
                 "bigdl_tpu.dataset.fetch"],
     "optim": ["bigdl_tpu.optim"],
+    "serving": ["bigdl_tpu.serving"],
     "parallel": ["bigdl_tpu.parallel"],
     "models": ["bigdl_tpu.models"],
     "interop": ["bigdl_tpu.utils.serialization",

@@ -67,6 +67,21 @@ def module_times(model, x, *, repeats: int = 3) -> List[Tuple[str, float]]:
     return results
 
 
+def percentile_summary(samples, qs=(50, 90, 99)):
+    """Latency-style percentile digest: ``{"p50": ..., "p99": ...}``.
+
+    The one percentile implementation shared by the serving metrics
+    (`bigdl_tpu.serving`) and ad-hoc perf tooling; empty input returns
+    ``{}`` so callers can export whatever exists without guards.
+    """
+    import numpy as np
+
+    samples = np.asarray(list(samples), np.float64)
+    if samples.size == 0:
+        return {}
+    return {f"p{int(q)}": float(np.percentile(samples, q)) for q in qs}
+
+
 @contextlib.contextmanager
 def trace(log_dir: str):
     """Profile the enclosed (compiled) computation with jax.profiler;

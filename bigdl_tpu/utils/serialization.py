@@ -148,11 +148,26 @@ def _write_ckpt_files(d: str, flats) -> None:
             _fsync(f)
 
 
+_SCRIPTED_CRASH_ARMED = False
+
+
+def arm_scripted_crash() -> None:
+    """Explicit opt-in for the fault-injection hook below. A test
+    harness must call this IN ADDITION to setting the env var — so a
+    stray BIGDL_TEST_CRASH_IN_CHECKPOINT inherited from a test
+    environment can never SIGKILL a real training run (ADVICE r5)."""
+    global _SCRIPTED_CRASH_ARMED
+    _SCRIPTED_CRASH_ARMED = True
+
+
 def _maybe_scripted_crash(driver_state) -> None:
     """Test-only fault injection (the reference scripted worker deaths
     the same way, ExceptionTest / TestUtils.scala:103-131): SIGKILL this
     process MID-checkpoint-write — after the tree files, before the
-    MANIFEST — when BIGDL_TEST_CRASH_IN_CHECKPOINT names this neval."""
+    MANIFEST — when BIGDL_TEST_CRASH_IN_CHECKPOINT names this neval AND
+    the process called :func:`arm_scripted_crash`."""
+    if not _SCRIPTED_CRASH_ARMED:
+        return
     at = os.environ.get("BIGDL_TEST_CRASH_IN_CHECKPOINT")
     if at and int(at) == driver_state.get("neval", -1):
         import signal

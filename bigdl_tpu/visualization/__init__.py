@@ -1,7 +1,8 @@
 """Observability: TensorBoard summaries (reference L6, SURVEY.md §1)."""
-from bigdl_tpu.visualization.summary import (Summary, TrainSummary,
+from bigdl_tpu.visualization.summary import (ServingSummary, Summary,
+                                             TrainSummary,
                                              ValidationSummary)
 from bigdl_tpu.visualization.tensorboard import FileReader, FileWriter
 
-__all__ = ["Summary", "TrainSummary", "ValidationSummary", "FileReader",
-           "FileWriter"]
+__all__ = ["ServingSummary", "Summary", "TrainSummary",
+           "ValidationSummary", "FileReader", "FileWriter"]

@@ -63,3 +63,14 @@ class TrainSummary(Summary):
 class ValidationSummary(Summary):
     def __init__(self, log_dir: str, app_name: str):
         super().__init__(log_dir, app_name, "validation")
+
+
+class ServingSummary(Summary):
+    """Writer for online-inference metrics
+    (``serving.InferenceService.export_metrics``): serving scalars land
+    under ``<log_dir>/<app_name>/serving`` next to the train/validation
+    runs, so TensorBoard shows queue depth, batch fill, and latency
+    percentiles beside the loss curves."""
+
+    def __init__(self, log_dir: str, app_name: str):
+        super().__init__(log_dir, app_name, "serving")

@@ -31,10 +31,12 @@ import sys
 def main():
     ckpt_root, kill_at = sys.argv[1], int(sys.argv[2])
     crash_ckpt_at = int(sys.argv[3]) if len(sys.argv) > 3 else 0
-    if crash_ckpt_at and int(os.environ.get("BIGDL_RESTART_ATTEMPT",
-                                            "0")) == 0:
-        # arm the mid-checkpoint-write SIGKILL (first incarnation only —
-        # the resumed gang replays the same neval and must survive it)
+    arm_crash = crash_ckpt_at and int(os.environ.get(
+        "BIGDL_RESTART_ATTEMPT", "0")) == 0
+    if arm_crash:
+        # the mid-checkpoint-write SIGKILL (first incarnation only —
+        # the resumed gang replays the same neval and must survive it);
+        # armed explicitly below: the env var alone is inert
         os.environ["BIGDL_TEST_CRASH_IN_CHECKPOINT"] = str(crash_ckpt_at)
 
     import jax
@@ -58,6 +60,10 @@ def main():
     from bigdl_tpu.optim import SGD, max_iteration, several_iteration
     from bigdl_tpu.optim.optimizer import Optimizer
     from bigdl_tpu.utils.random import RandomGenerator
+
+    if arm_crash:
+        from bigdl_tpu.utils import serialization
+        serialization.arm_scripted_crash()
 
     mesh = Mesh(np.array(jax.devices()), ("data",))
     sh = NamedSharding(mesh, P("data"))

@@ -111,3 +111,18 @@ def test_wide_and_deep_example_sparse_feed():
     from examples.wide_and_deep import main
     acc = main(["-n", "512", "--wideDim", "100", "-e", "3", "-b", "32"])
     assert acc > 0.8, acc
+
+
+def test_online_serving_example(tmp_path):
+    """serving example: warm start, batched traffic, int8 hot-swap,
+    metrics export — the runnable face of docs/serving.md."""
+    from examples.online_serving import main
+    metrics = main(["--requests", "24", "--batch-size", "8",
+                    "--log-dir", str(tmp_path)])
+    assert metrics["request_count"] >= 24
+    assert metrics["errors"] == 0 and metrics["timed_out"] == 0
+    from bigdl_tpu.visualization import FileReader
+    import os
+    d = os.path.join(str(tmp_path), "serving_example", "serving")
+    vals = FileReader.read_scalar(d, "serving/mnist/request_count")
+    assert vals and vals[-1][1] >= 24

@@ -167,3 +167,92 @@ def test_rnn_cli_automaterializes_corpus(tmp_path, monkeypatch):
                         "file:///nonexistent/nowhere.txt")
     with pytest.raises(SystemExit, match="auto-download"):
         rnn_train.main(["-f", str(tmp_path / "empty"), "-e", "1"])
+
+
+def _tiny_news20_tgz(path):
+    """A minimal 20news-19997-shaped tarball: one root dir with one
+    category holding one numeric-named article."""
+    import io
+    import tarfile
+
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as t:
+        data = b"From: a@b\n\nhello serving"
+        info = tarfile.TarInfo("20_newsgroups/alt.atheism/49960")
+        info.size = len(data)
+        t.addfile(info, io.BytesIO(data))
+    with open(path, "wb") as f:
+        f.write(buf.getvalue())
+
+
+def test_news20_sha256_pin_is_live_at_call_site(tmp_path, monkeypatch):
+    """ADVICE r5: get_news20 must PASS a digest pin into maybe_download
+    (trust-on-first-use sidecar / env pin) so a re-download that doesn't
+    match the recorded tarball fails loudly instead of landing."""
+    import urllib.request
+
+    import pytest
+
+    import bigdl_tpu.dataset.fetch as fetch
+
+    monkeypatch.delenv(fetch.NEWS20_SHA256_ENV, raising=False)
+    good = tmp_path / "good.tar.gz"
+    _tiny_news20_tgz(str(good))
+    payload = {"bytes": good.read_bytes()}
+
+    def fake_retrieve(url, dst):
+        with open(dst, "wb") as f:
+            f.write(payload["bytes"])
+
+    monkeypatch.setattr(urllib.request, "urlretrieve", fake_retrieve)
+    src = tmp_path / "news20"
+    texts = fetch.get_news20(str(src) + os.sep)
+    assert texts == [("From: a@b\n\nhello serving", 1)]
+    tar = src / "20news-19997.tar.gz"
+    sidecar = src / "20news-19997.tar.gz.sha256"
+    assert sidecar.exists()  # first fetch recorded the pin
+    recorded = sidecar.read_text().strip()
+
+    # cache evicted + upstream swapped: the re-download must be refused
+    # by the recorded pin, and nothing may land under the cache name
+    tar.unlink()
+    payload["bytes"] = b"not the archive that was pinned"
+    with pytest.raises(IOError, match="sha256 mismatch"):
+        fetch.get_news20(str(src) + os.sep)
+    assert not tar.exists()
+
+    # identical bytes re-download passes the same pin
+    payload["bytes"] = good.read_bytes()
+    assert fetch.get_news20(str(src) + os.sep) == texts
+    assert sidecar.read_text().strip() == recorded
+
+    # explicit env pin wins over the sidecar; "" disables checking
+    tar.unlink()
+    payload["bytes"] = b"rolled tarball, operator-approved"
+    monkeypatch.setenv(fetch.NEWS20_SHA256_ENV, recorded)
+    with pytest.raises(IOError, match="sha256 mismatch"):
+        fetch.get_news20(str(src) + os.sep)
+
+
+def test_maybe_download_sha256_verifies_before_landing(tmp_path,
+                                                       monkeypatch):
+    import hashlib
+    import urllib.request
+
+    import pytest
+
+    import bigdl_tpu.dataset.fetch as fetch
+
+    def fake_retrieve(url, dst):
+        with open(dst, "wb") as f:
+            f.write(b"payload")
+
+    monkeypatch.setattr(urllib.request, "urlretrieve", fake_retrieve)
+    want = hashlib.sha256(b"payload").hexdigest()
+    got = fetch.maybe_download("a.bin", str(tmp_path), "http://x/a.bin",
+                               sha256=want)
+    assert open(got, "rb").read() == b"payload"
+    with pytest.raises(IOError, match="sha256 mismatch"):
+        fetch.maybe_download("b.bin", str(tmp_path), "http://x/b.bin",
+                             sha256="0" * 64)
+    assert not os.path.exists(os.path.join(str(tmp_path), "b.bin"))

@@ -180,3 +180,53 @@ def test_conv_lstm_peephole_3d_shapes():
     out = np.asarray(out)
     assert out.shape == (B, T, 3, D, Hh, Ww)
     assert np.isfinite(out).all()
+
+
+def test_custom_stochastic_cell_keeps_rng_via_uses_rng_flag():
+    """ADVICE r5: rng-drop must key on the explicit Cell.uses_rng
+    capability, not on the presence of a `p` attribute — a custom
+    stochastic cell that doesn't follow the built-in dropout convention
+    must still receive per-step keys."""
+    from bigdl_tpu.nn.recurrent import Cell, Recurrent
+
+    class NoisyCell(Cell):
+        def __init__(self, size):
+            super().__init__()
+            self.hidden_size = size
+
+        def init(self, rng):
+            return {}
+
+        def init_hidden(self, batch_size, dtype=None):
+            return jnp.zeros((batch_size, self.hidden_size),
+                             dtype or jnp.float32)
+
+        def step(self, params, x, hidden, *, training=False, rng=None):
+            if rng is not None:
+                x = x + jax.random.normal(rng, x.shape)
+            h = jnp.tanh(x + hidden)
+            return h, h
+
+    x = _x((2, 5, 4))
+
+    def run(cell, seed):
+        m = Recurrent(cell)
+        m.ensure_initialized()
+        out, _ = m.apply(m.get_parameters(), m.get_state(), x,
+                         training=True, rng=jax.random.PRNGKey(seed))
+        return np.asarray(out)
+
+    # no flag, no `p`: the rng is dropped (scan-carry optimization) —
+    # the cell runs deterministically and seeds don't matter
+    assert np.allclose(run(NoisyCell(4), 0), run(NoisyCell(4), 1))
+
+    noisy = NoisyCell(4)
+    noisy.uses_rng = True  # explicit capability: keep per-step keys
+    assert noisy.consumes_rng()
+    a, b = run(noisy, 0), run(noisy, 1)
+    assert not np.allclose(a, b)  # rng actually reached the cell
+    np.testing.assert_allclose(a, run(noisy, 0), atol=1e-6)
+
+    # built-in convention still derives the default from `p`
+    assert nn.LSTM(4, 4, p=0.5).consumes_rng()
+    assert not nn.LSTM(4, 4).consumes_rng()

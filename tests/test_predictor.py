@@ -125,3 +125,25 @@ def test_tp_sharded_predict_matches_replicated():
         DataSet.array(samples), batch_size=4)
     np.testing.assert_allclose(np.stack(dist), np.stack(local),
                                atol=2e-4)
+
+
+def test_mesh_path_rejects_table_and_multi_tensor_inputs():
+    """ADVICE r5: the mesh sweep lays batches over the data axis, which
+    only exists for a single dense ndarray — table/multi-tensor inputs
+    must fail loudly, not become ragged object arrays."""
+    from bigdl_tpu.dataset.sample import MiniBatch
+
+    model = _mlp()
+    mesh = make_mesh([8], ["data"], jax.devices()[:8])
+    multi = [MiniBatch([np.zeros((8, 12), np.float32),
+                        np.zeros((8, 3), np.float32)],
+                       np.ones(8, np.float32))]
+    with pytest.raises(TypeError, match="single-ndarray"):
+        Predictor(model, mesh=mesh).predict(multi, batch_size=8)
+    with pytest.raises(TypeError, match="single-ndarray"):
+        Evaluator(model, mesh=mesh).test(multi, [Top1Accuracy()],
+                                         batch_size=8)
+    # the local path still serves them (that's the documented fallback)
+    outs = LocalPredictor(model).predict(
+        [MiniBatch(np.zeros((8, 12), np.float32))], batch_size=8)
+    assert len(outs) == 8

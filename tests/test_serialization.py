@@ -254,9 +254,12 @@ def test_scripted_crash_in_checkpoint_leaves_previous_intact(tmp_path):
 
     code = (
         "import numpy as np\n"
+        "from bigdl_tpu.utils import serialization\n"
         "from bigdl_tpu.utils.serialization import save_checkpoint\n"
         "import sys\n"
         "root = sys.argv[1]\n"
+        "if sys.argv[2] == 'armed':\n"
+        "    serialization.arm_scripted_crash()\n"
         "def sv(neval):\n"
         "    save_checkpoint(root + f'/checkpoint.{neval}',\n"
         "        params={'w': np.full(3, float(neval), np.float32)},\n"
@@ -270,12 +273,25 @@ def test_scripted_crash_in_checkpoint_leaves_previous_intact(tmp_path):
         os.path.abspath(__file__))) + os.pathsep + env.get("PYTHONPATH", "")
     env["BIGDL_TEST_CRASH_IN_CHECKPOINT"] = "4"
     env.setdefault("JAX_PLATFORMS", "cpu")
-    r = subprocess.run([sys.executable, "-c", code, str(tmp_path)],
+    r = subprocess.run([sys.executable, "-c", code, str(tmp_path), "armed"],
                        capture_output=True, text=True, timeout=120,
                        env=env)
     assert r.returncode == -9, (r.returncode, r.stderr[-500:])
     latest = find_latest_checkpoint(str(tmp_path))
     assert latest is not None and latest.endswith("checkpoint.2"), latest
+
+    # ADVICE r5: the env var ALONE is inert — a stray
+    # BIGDL_TEST_CRASH_IN_CHECKPOINT inherited by a real run must not
+    # SIGKILL it; only a process that explicitly armed the hook dies
+    unarmed = tmp_path / "unarmed"
+    unarmed.mkdir()
+    r = subprocess.run([sys.executable, "-c", code, str(unarmed),
+                        "unarmed"],
+                       capture_output=True, text=True, timeout=120,
+                       env=env)
+    assert r.returncode == 0, (r.returncode, r.stderr[-500:])
+    latest = find_latest_checkpoint(str(unarmed))
+    assert latest is not None and latest.endswith("checkpoint.6"), latest
 
 
 def test_checkpoint_roundtrip_via_memory_filesystem():

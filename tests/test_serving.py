@@ -1,0 +1,612 @@
+"""Online inference subsystem (bigdl_tpu/serving): micro-batch
+coalescing, bucket-padding correctness (pad rows never leak), the
+K-bucket compile bound under randomized request sizes, hot-swap
+atomicity mid-traffic, admission control (timeout/rejection/drain), a
+quantized-model serve smoke test, and serving metrics landing on the
+TensorBoard summary path. Everything runs on the conftest's virtual-CPU
+platform — threads + queues, no TPU-only APIs."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+from bigdl_tpu.serving import (BucketLadder, CompileCache, DeadlineExceeded,
+                               InferenceService, MicroBatcher, ModelRegistry,
+                               QueueFull, ServingConfig)
+from bigdl_tpu.utils.random import RandomGenerator
+
+
+def _mlp(din=12, dout=3, seed=7):
+    RandomGenerator.set_seed(seed)
+    return (nn.Sequential().add(nn.Linear(din, 16)).add(nn.Tanh())
+            .add(nn.Linear(16, dout)).add(nn.LogSoftMax()))
+
+
+def _const_model(v: float):
+    """Shape-preserving model whose every output element is ``v`` — the
+    rows of a response identify which model version served it."""
+    return (nn.Sequential().add(nn.MulConstant(0.0))
+            .add(nn.AddConstant(float(v))))
+
+
+# ------------------------------------------------------------- ladder
+
+def test_bucket_ladder_powers_of_two_and_custom():
+    assert list(BucketLadder(32)) == [1, 2, 4, 8, 16, 32]
+    assert list(BucketLadder(24)) == [1, 2, 4, 8, 16, 24]  # max is a rung
+    assert list(BucketLadder(1)) == [1]
+    custom = BucketLadder(0, buckets=[8, 2, 8, 5])
+    assert list(custom) == [2, 5, 8] and custom.max_batch_size == 8
+    assert custom.bucket_for(1) == 2 and custom.bucket_for(3) == 5
+    assert custom.bucket_for(8) == 8
+    with pytest.raises(ValueError):
+        custom.bucket_for(9)
+    with pytest.raises(ValueError):
+        BucketLadder(0)
+    with pytest.raises(ValueError):
+        BucketLadder(0, buckets=[0, 4])
+
+
+# -------------------------------------------------- coalescing/padding
+
+def test_single_requests_coalesce_into_few_batches():
+    svc = InferenceService(config=ServingConfig(max_batch_size=16,
+                                                max_wait_ms=20.0))
+    model = _mlp()
+    svc.load("m", model, warmup_shape=(12,))
+    try:
+        xs = np.random.RandomState(0).randn(40, 12).astype(np.float32)
+        futs = [svc.predict_async("m", xs[i]) for i in range(40)]
+        outs = np.stack([f.result(timeout=30) for f in futs])
+        ref = np.asarray(model.forward(xs))
+        np.testing.assert_allclose(outs, ref, atol=1e-5)
+        m = svc.metrics("m")
+        assert m["request_count"] == 40
+        # the whole point of the batcher: far fewer forwards than requests
+        assert 1 <= m["batch_count"] <= 10
+        assert m["batch_fill"] > 0.5
+    finally:
+        svc.shutdown()
+
+
+def test_bucket_padding_rows_never_leak_into_results():
+    """Randomized request sizes land on padded buckets; every response
+    must contain exactly the forward of its own rows."""
+    svc = InferenceService(config=ServingConfig(max_batch_size=8,
+                                                max_wait_ms=1.0))
+    model = _mlp(din=6, dout=4)
+    svc.load("m", model, warmup_shape=(6,))
+    try:
+        rng = np.random.RandomState(1)
+        reqs = [rng.randn(int(n), 6).astype(np.float32)
+                for n in rng.randint(1, 9, size=30)]
+        futs = [svc.predict_batch_async("m", x) for x in reqs]
+        for x, f in zip(reqs, futs):
+            out = f.result(timeout=30)
+            assert out.shape[0] == x.shape[0]
+            np.testing.assert_allclose(out, np.asarray(model.forward(x)),
+                                       atol=1e-5)
+    finally:
+        svc.shutdown()
+
+
+def test_oversized_and_empty_requests_rejected():
+    svc = InferenceService(config=ServingConfig(max_batch_size=4))
+    svc.load("m", _mlp(din=6), warmup_shape=(6,))
+    try:
+        with pytest.raises(ValueError, match="max_batch_size"):
+            svc.predict_batch("m", np.zeros((5, 6), np.float32))
+        with pytest.raises(ValueError, match="rows"):
+            svc.predict_batch("m", np.zeros((0, 6), np.float32))
+        with pytest.raises(KeyError):
+            svc.predict("nope", np.zeros(6, np.float32))
+    finally:
+        svc.shutdown()
+
+
+# ------------------------------------------------------- compile bound
+
+def test_compile_count_bounded_by_ladder_under_random_sizes():
+    """Acceptance: K buckets => at most K compiled programs per model,
+    no matter how many distinct request sizes arrive (N >= 100)."""
+    svc = InferenceService(config=ServingConfig(max_batch_size=8,
+                                                max_wait_ms=1.0))
+    model = _mlp(din=5, dout=2)
+    svc.load("m", model)  # no warmup: compiles happen under traffic
+    k = len(svc.ladder)
+    try:
+        rng = np.random.RandomState(2)
+        futs = [svc.predict_batch_async(
+                    "m", rng.randn(int(n), 5).astype(np.float32))
+                for n in rng.randint(1, 9, size=120)]
+        for f in futs:
+            f.result(timeout=60)
+        assert svc.metrics("m")["request_count"] == 120
+        assert 1 <= svc.compile_count("m") <= k
+    finally:
+        svc.shutdown()
+
+
+def test_warmup_precompiles_every_bucket():
+    svc = InferenceService(config=ServingConfig(max_batch_size=8,
+                                                max_wait_ms=1.0))
+    model = _mlp(din=5, dout=2)
+    svc.load("m", model)
+    k = len(svc.ladder)
+    assert svc.warmup("m", feature_shape=(5,)) == k
+    assert svc.compile_count("m") == k
+    try:
+        rng = np.random.RandomState(3)
+        futs = [svc.predict_batch_async(
+                    "m", rng.randn(int(n), 5).astype(np.float32))
+                for n in rng.randint(1, 9, size=50)]
+        for f in futs:
+            f.result(timeout=60)
+        # warm cache: traffic added ZERO compiles
+        assert svc.compile_count("m") == k
+        # warming again is free
+        assert svc.warmup("m", feature_shape=(5,)) == 0
+    finally:
+        svc.shutdown()
+
+
+def test_compile_cache_keys_isolate_versions_and_drop():
+    cache = CompileCache()
+    model = _mlp(din=4, dout=2)
+    params, state = model.get_parameters(), model.get_state()
+    ladder = BucketLadder(4)
+    assert cache.warmup(("m", 1), model, params, state, (4,),
+                        ladder) == len(ladder)
+    assert cache.compile_count(("m", 1)) == len(ladder)
+    assert cache.compile_count(("m", 2)) == 0  # other versions untouched
+    cache.drop(("m", 1))
+    assert cache.compile_count(("m", 1)) == 0
+    assert cache.compile_count() == 0
+
+
+# ----------------------------------------------------------- hot swap
+
+def test_hot_swap_atomic_no_mixed_or_dropped_responses():
+    """Swap mid-traffic: every response comes wholly from one version,
+    requests submitted after the swap see only the new version, and
+    request count in == response count out."""
+    svc = InferenceService(config=ServingConfig(max_batch_size=8,
+                                                max_wait_ms=1.0))
+    svc.load("m", _const_model(1.0), warmup_shape=(3,))
+    swapped = threading.Event()
+    stop = threading.Event()
+    results, errors = [], []
+    lock = threading.Lock()
+
+    def worker(seed):
+        rng = np.random.RandomState(seed)
+        while not stop.is_set():
+            after = swapped.is_set()
+            x = rng.randn(int(rng.randint(1, 4)), 3).astype(np.float32)
+            try:
+                out = svc.predict_batch("m", x, timeout_ms=None)
+            except Exception as e:  # noqa: BLE001 — recorded for assert
+                with lock:
+                    errors.append(e)
+                return
+            with lock:
+                results.append((after, np.asarray(out)))
+
+    threads = [threading.Thread(target=worker, args=(s,))
+               for s in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.15)
+    svc.load("m", _const_model(2.0), warmup_shape=(3,))  # atomic swap
+    swapped.set()
+    time.sleep(0.15)
+    stop.set()
+    for t in threads:
+        t.join()
+    svc.shutdown()
+
+    assert not errors, errors  # zero dropped/failed responses
+    assert len(results) > 20
+    saw = set()
+    for after, out in results:
+        vals = np.unique(out)
+        assert vals.size == 1, f"mixed-version response: {vals}"
+        v = float(vals[0])
+        assert v in (1.0, 2.0)
+        saw.add(v)
+        if after:
+            # submitted after the swap returned: new version only
+            assert v == 2.0
+    assert saw == {1.0, 2.0}  # traffic really straddled the swap
+
+
+def test_registry_swap_back_and_unload_rules():
+    reg = ModelRegistry()
+    s1 = reg.load("m", _const_model(1.0))
+    s2 = reg.load("m", _const_model(2.0))
+    assert (s1.version, s2.version) == (1, 2)
+    assert reg.current("m") is s2
+    assert reg.swap("m", 1) is s1  # roll back
+    with pytest.raises(KeyError):
+        reg.swap("m", 9)
+    with pytest.raises(ValueError, match="current"):
+        reg.unload("m", 1)  # serving version is protected
+    assert reg.unload("m", 2) == [("m", 2)]
+    assert reg.versions("m") == [1]
+    desc = reg.describe("m")
+    assert desc["current_version"] == 1 and desc["versions"] == [1]
+    assert reg.unload("m") == [("m", 1)]  # whole name
+    with pytest.raises(KeyError):
+        reg.current("m")
+    with pytest.raises(ValueError, match="exactly one"):
+        reg.load("m")
+
+
+# --------------------------------------------------- admission control
+
+def test_deadline_exceeded_while_batcher_is_busy():
+    release = threading.Event()
+    entered = threading.Event()
+
+    def slow_run(x):
+        entered.set()
+        release.wait(timeout=30)
+        return x
+
+    b = MicroBatcher(slow_run, BucketLadder(4), max_wait_ms=1.0,
+                     name="slow")
+    try:
+        f1 = b.submit(np.zeros((1, 2), np.float32))
+        assert entered.wait(timeout=10)  # dispatch thread is busy now
+        f2 = b.submit(np.zeros((1, 2), np.float32), timeout_ms=30.0)
+        time.sleep(0.1)  # f2's deadline passes while slow_run blocks
+        release.set()
+        assert f1.result(timeout=10).shape == (1, 2)
+        with pytest.raises(DeadlineExceeded):
+            f2.result(timeout=10)
+        with b.stats.lock:
+            assert b.stats.timed_out == 1
+    finally:
+        release.set()
+        b.shutdown()
+
+
+def test_queue_full_rejection():
+    release = threading.Event()
+    entered = threading.Event()
+
+    def slow_run(x):
+        entered.set()
+        release.wait(timeout=30)
+        return x
+
+    b = MicroBatcher(slow_run, BucketLadder(4), max_wait_ms=1.0,
+                     max_queue=1, name="full")
+    try:
+        f1 = b.submit(np.zeros((1, 2), np.float32))
+        assert entered.wait(timeout=10)
+        deadline = time.monotonic() + 10
+        while b.queue_depth() and time.monotonic() < deadline:
+            time.sleep(0.001)  # f1 popped: the queue is drained
+        f2 = b.submit(np.zeros((1, 2), np.float32))  # fills the queue
+        with pytest.raises(QueueFull):
+            b.submit(np.zeros((1, 2), np.float32))
+        with b.stats.lock:
+            assert b.stats.rejected == 1
+        release.set()
+        assert f1.result(timeout=10) is not None
+        assert f2.result(timeout=10) is not None
+    finally:
+        release.set()
+        b.shutdown()
+
+
+def test_shutdown_drains_queued_requests():
+    calls = []
+
+    def run(x):
+        time.sleep(0.02)
+        calls.append(x.shape[0])
+        return x * 2.0
+
+    b = MicroBatcher(run, BucketLadder(2), max_wait_ms=50.0, name="drain")
+    futs = [b.submit(np.full((1, 2), i, np.float32)) for i in range(6)]
+    b.shutdown(drain=True)  # flushes everything already queued
+    for i, f in enumerate(futs):
+        np.testing.assert_allclose(f.result(timeout=0.1),
+                                   np.full((1, 2), 2.0 * i))
+    with pytest.raises(RuntimeError, match="shut down"):
+        b.submit(np.zeros((1, 2), np.float32))
+
+
+def test_shutdown_without_drain_fails_queued_requests():
+    release = threading.Event()
+    entered = threading.Event()
+
+    def slow_run(x):
+        entered.set()
+        release.wait(timeout=30)
+        return x
+
+    b = MicroBatcher(slow_run, BucketLadder(1), max_wait_ms=1.0,
+                     name="nodrain")
+    f1 = b.submit(np.zeros((1, 2), np.float32))
+    assert entered.wait(timeout=10)
+    f2 = b.submit(np.zeros((1, 2), np.float32))
+
+    def _shutdown():
+        b.shutdown(drain=False)
+
+    t = threading.Thread(target=_shutdown)
+    t.start()
+    time.sleep(0.05)
+    release.set()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert f1.result(timeout=10) is not None  # in-flight still finishes
+    with pytest.raises(RuntimeError, match="shut down"):
+        f2.result(timeout=10)
+
+
+def test_run_batch_errors_propagate_to_futures():
+    def boom(x):
+        raise RuntimeError("kaboom")
+
+    b = MicroBatcher(boom, BucketLadder(4), max_wait_ms=1.0, name="err")
+    try:
+        f = b.submit(np.zeros((2, 2), np.float32))
+        with pytest.raises(RuntimeError, match="kaboom"):
+            f.result(timeout=10)
+        with b.stats.lock:
+            assert b.stats.errors == 1
+    finally:
+        b.shutdown()
+
+
+# ------------------------------------------------- quantized/checkpoint
+
+def test_quantized_model_serves_identically():
+    model = _mlp(din=8, dout=4)
+    model.evaluate()
+    x = np.random.RandomState(4).randn(10, 8).astype(np.float32)
+    ref = np.asarray(model.forward(x))
+    svc = InferenceService(config=ServingConfig(max_batch_size=4,
+                                                max_wait_ms=1.0))
+    svc.load("q", model, quantize=True, warmup_shape=(8,))
+    try:
+        out = np.stack([svc.predict("q", x[i]) for i in range(10)])
+        assert out.shape == ref.shape
+        # int8 path: same surface, near-float accuracy
+        assert (out.argmax(1) == ref.argmax(1)).mean() >= 0.8
+        assert 1 <= svc.compile_count("q") <= len(svc.ladder)
+    finally:
+        svc.shutdown()
+
+
+def test_serve_from_saved_checkpoint(tmp_path):
+    from bigdl_tpu.utils.serialization import save_module
+
+    model = _mlp(din=6, dout=3)
+    model.ensure_initialized()
+    save_module(str(tmp_path / "ckpt"), model)
+    svc = InferenceService()
+    svc.load("m", path=str(tmp_path / "ckpt"), warmup_shape=(6,))
+    try:
+        x = np.random.RandomState(5).randn(6).astype(np.float32)
+        np.testing.assert_allclose(
+            svc.predict("m", x), np.asarray(model.forward(x[None]))[0],
+            atol=1e-5)
+    finally:
+        svc.shutdown()
+
+
+def test_unload_releases_compiled_programs():
+    svc = InferenceService(config=ServingConfig(max_batch_size=2,
+                                                max_wait_ms=1.0))
+    s = svc.load("m", _mlp(din=4, dout=2), warmup_shape=(4,))
+    assert svc.cache.compile_count(s.key) == len(svc.ladder)
+    svc.unload("m")
+    assert svc.cache.compile_count(s.key) == 0
+    with pytest.raises(KeyError):
+        svc.predict("m", np.zeros(4, np.float32))
+
+
+# ------------------------------------------------------------- metrics
+
+def test_serving_metrics_land_on_tensorboard_path(tmp_path):
+    from bigdl_tpu.visualization import ServingSummary
+
+    svc = InferenceService(config=ServingConfig(max_batch_size=8,
+                                                max_wait_ms=1.0))
+    model = _mlp(din=5, dout=2)
+    svc.load("mnist", model, warmup_shape=(5,))
+    try:
+        rng = np.random.RandomState(6)
+        futs = [svc.predict_batch_async(
+                    "mnist", rng.randn(int(n), 5).astype(np.float32))
+                for n in rng.randint(1, 9, size=25)]
+        for f in futs:
+            f.result(timeout=30)
+        summary = ServingSummary(str(tmp_path), "app")
+        svc.export_metrics(summary, step=1)
+        svc.export_metrics(summary, step=2)
+        for tag in ("serving/mnist/request_count",
+                    "serving/mnist/queue_depth",
+                    "serving/mnist/batch_fill",
+                    "serving/mnist/compile_count",
+                    "serving/mnist/latency_ms_p50",
+                    "serving/mnist/latency_ms_p99"):
+            vals = summary.read_scalar(tag)
+            assert [s for s, _, _ in vals] == [1, 2], tag
+        (_, reqs, _) = summary.read_scalar(
+            "serving/mnist/request_count")[-1]
+        assert reqs == 25.0
+        (_, fill, _) = summary.read_scalar("serving/mnist/batch_fill")[-1]
+        assert 0.0 < fill <= 1.0
+        # the serving run dir sits beside train/validation runs
+        assert (tmp_path / "app" / "serving").is_dir()
+        summary.close()
+    finally:
+        svc.shutdown()
+
+
+def test_percentile_summary_shape():
+    from bigdl_tpu.utils.profiling import percentile_summary
+
+    assert percentile_summary([]) == {}
+    d = percentile_summary([1.0, 2.0, 3.0], (50, 99))
+    assert set(d) == {"p50", "p99"} and d["p50"] == 2.0
+
+
+# -------------------------------------------------- review hardening
+
+def test_mismatched_signature_rejected_at_admission():
+    """One malformed request must be rejected at submit — never fail
+    the well-formed requests it would have been batched with (and a
+    stray dtype must not upcast the batch past the compile bound)."""
+    svc = InferenceService(config=ServingConfig(max_batch_size=8,
+                                                max_wait_ms=20.0))
+    model = _mlp(din=6, dout=3)
+    svc.load("m", model, warmup_shape=(6,))
+    try:
+        good = np.zeros((1, 6), np.float32)
+        f1 = svc.predict_batch_async("m", good)
+        with pytest.raises(ValueError, match="signature"):
+            svc.predict_batch("m", np.zeros((1, 4), np.float32))
+        with pytest.raises(ValueError, match="signature"):
+            svc.predict_batch("m", np.zeros((1, 6), np.float64))
+        # the co-batched good request is unharmed
+        np.testing.assert_allclose(f1.result(timeout=30),
+                                   np.asarray(model.forward(good)),
+                                   atol=1e-5)
+        assert svc.compile_count("m") == len(svc.ladder)
+    finally:
+        svc.shutdown()
+
+
+def test_hot_swap_warms_new_version_before_activation():
+    """A hot-swap load must compile every bucket of the NEW version
+    before repointing the name — live traffic never hits a cold
+    bucket — and activate=False stages a version without serving it."""
+    svc = InferenceService(config=ServingConfig(max_batch_size=4,
+                                                max_wait_ms=1.0))
+    k = len(svc.ladder)
+    svc.load("m", _const_model(1.0), warmup_shape=(3,))
+    try:
+        staged = svc.load("m", _const_model(2.0), activate=False,
+                          warmup_shape=(3,))
+        assert svc.cache.compile_count(staged.key) == k  # fully warm
+        assert svc.registry.current("m").version == 1    # not serving
+        assert float(svc.predict("m", np.zeros(3, np.float32))[0]) == 1.0
+        svc.swap("m", staged.version)
+        assert float(svc.predict("m", np.zeros(3, np.float32))[0]) == 2.0
+        # the activate=True path also warms before repointing
+        v3 = svc.load("m", _const_model(3.0), warmup_shape=(3,))
+        assert svc.cache.compile_count(v3.key) == k
+        assert svc.registry.current("m").version == v3.version
+    finally:
+        svc.shutdown()
+
+
+def test_concurrent_first_predicts_create_one_batcher():
+    """The per-name MicroBatcher owns a dispatch thread: racing first
+    requests must not leak extra batchers/threads."""
+    svc = InferenceService(config=ServingConfig(max_batch_size=8,
+                                                max_wait_ms=1.0))
+    model = _mlp(din=4, dout=2)
+    svc.load("m", model, warmup_shape=(4,))
+    start = threading.Barrier(8)
+    outs = []
+
+    def first_predict():
+        start.wait()
+        outs.append(svc.predict("m", np.zeros(4, np.float32)))
+
+    threads = [threading.Thread(target=first_predict) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    try:
+        assert len(outs) == 8
+        dispatchers = [t for t in threading.enumerate()
+                       if t.name == "serving-batcher-m"]
+        assert len(dispatchers) == 1, dispatchers
+    finally:
+        svc.shutdown()
+
+
+def test_row_reducing_run_batch_fails_loudly():
+    """run_batch must return one output row per padded input row — a
+    batch-reducing model yields a loud error, not silently empty
+    per-request slices."""
+    b = MicroBatcher(lambda x: x.sum(axis=0), BucketLadder(4),
+                     max_wait_ms=1.0, name="reduce")
+    try:
+        f = b.submit(np.ones((2, 3), np.float32))
+        with pytest.raises(ValueError, match="one output row"):
+            f.result(timeout=10)
+    finally:
+        b.shutdown()
+
+
+def test_registry_load_does_not_flip_live_module_to_eval():
+    """Registering a live module for serving must not mutate it — a
+    model still training eagerly elsewhere keeps its train mode (the
+    serving step forces training=False on its own)."""
+    model = _mlp(din=4, dout=2)
+    model.training()
+    reg = ModelRegistry()
+    reg.load("m", model)
+    assert model.train_mode  # caller's module untouched
+
+
+def test_short_timeout_is_served_on_idle_batcher():
+    """A request with timeout_ms <= max_wait_ms must be SERVED on an
+    idle service — the dispatch window closes at the deadline exactly
+    to serve it, not to expire it."""
+    svc = InferenceService(config=ServingConfig(max_batch_size=8,
+                                                max_wait_ms=50.0))
+    model = _mlp(din=4, dout=2)
+    svc.load("m", model, warmup_shape=(4,))
+    try:
+        x = np.zeros(4, np.float32)
+        out = svc.predict("m", x, timeout_ms=5.0)  # << max_wait_ms
+        np.testing.assert_allclose(out, np.asarray(model.forward(x[None]))[0],
+                                   atol=1e-5)
+        assert svc.metrics("m")["timed_out"] == 0
+    finally:
+        svc.shutdown()
+
+
+def test_malformed_first_request_does_not_brick_the_name():
+    """The signature is only CONFIRMED by a successful dispatch: a bad
+    lone first request fails its own forward and later well-formed
+    requests establish theirs and serve normally."""
+    svc = InferenceService(config=ServingConfig(max_batch_size=4,
+                                                max_wait_ms=1.0))
+    model = _mlp(din=6, dout=3)
+    svc.load("m", model, warmup_shape=(6,))
+    try:
+        bad = svc.predict_batch_async("m", np.zeros((1, 4), np.float32))
+        with pytest.raises(Exception):
+            bad.result(timeout=30)  # its own forward fails...
+        good = np.zeros((1, 6), np.float32)
+        np.testing.assert_allclose(  # ...but the name still serves
+            svc.predict_batch("m", good),
+            np.asarray(model.forward(good)), atol=1e-5)
+        with pytest.raises(ValueError, match="signature"):
+            svc.predict_batch("m", np.zeros((1, 4), np.float32))
+    finally:
+        svc.shutdown()
+
+
+def test_registry_activate_false_stages_even_first_version():
+    reg = ModelRegistry()
+    reg.load("m", _const_model(1.0), activate=False)
+    with pytest.raises(KeyError, match="ACTIVE"):
+        reg.current("m")
+    reg.swap("m", 1)
+    assert reg.current("m").version == 1
