@@ -22,6 +22,9 @@ frankfzw/BigDL, Scala/Spark/MKL) as an idiomatic JAX/XLA framework:
   DirectedGraph, File I/O, logging.
 - ``bigdl_tpu.ops``      — pallas TPU kernels for ops XLA fusion can't cover
   (int8 quantized GEMM — the BigQuant equivalent) and collective primitives.
+- ``bigdl_tpu.analysis`` — pre-compile static analysis: eval_shape-based
+  shape/dtype checking with layer-path diagnostics (``Module.check``) and a
+  pluggable JAX-pitfall linter (``python -m bigdl_tpu.tools.check``).
 
 Design notes (vs the reference, /root/reference):
 - BigDL ``Tensor[T]`` (tensor/Tensor.scala:36) -> ``jax.Array``; the 104-method
@@ -39,11 +42,11 @@ Design notes (vs the reference, /root/reference):
 from bigdl_tpu.utils.table import Table, T
 from bigdl_tpu.utils.random import RandomGenerator
 from bigdl_tpu.utils.engine import Engine
-from bigdl_tpu import nn, optim, dataset, parallel, serving, utils
+from bigdl_tpu import nn, optim, dataset, parallel, serving, utils, analysis
 
 __version__ = "0.1.0"
 
 __all__ = [
     "Table", "T", "RandomGenerator", "Engine",
-    "nn", "optim", "dataset", "parallel", "serving", "utils",
+    "analysis", "nn", "optim", "dataset", "parallel", "serving", "utils",
 ]

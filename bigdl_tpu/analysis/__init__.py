@@ -1,0 +1,32 @@
+"""bigdl_tpu.analysis — pre-compile static analysis for models and source.
+
+Two passes, both free of XLA compilation:
+
+- **Shape/dtype checking** (:mod:`bigdl_tpu.analysis.shapecheck`): walk any
+  :class:`~bigdl_tpu.nn.module.Module` graph under ``jax.eval_shape`` with a
+  (symbolic) batch dimension and attribute failures to the exact layer path
+  ("``sequential[3]/linear2``") — the JAX-side equivalent of BigDL's typed
+  graph-build-time layer errors, instead of a deep XLA trace stack after a
+  30-second compile. Exposed as ``Module.check(input_spec)`` and as opt-in
+  pre-flight hooks on ``Optimizer`` and ``serving.ModelRegistry``.
+
+- **JAX-pitfall linting** (:mod:`bigdl_tpu.analysis.lint` +
+  :mod:`bigdl_tpu.analysis.rules`): a pluggable AST rule registry flagging
+  host syncs reachable from traced code, Python branching on traced values,
+  per-iteration array construction, jit static-arg mistakes, impure
+  ``apply`` methods, host clocks/global RNG in traces, and bare ``except``.
+  Findings support ``# bigdl: disable=RULE`` suppressions.
+
+``python -m bigdl_tpu.tools.check`` runs both passes; the repository
+dogfoods it over ``bigdl_tpu`` itself (tests/test_lint_self.py).
+"""
+from bigdl_tpu.analysis.shapecheck import (Diagnostic, ShapeCheckError,
+                                           ShapeReport, check_module, spec)
+from bigdl_tpu.analysis.lint import (Finding, available_rules, format_text,
+                                     lint_paths, lint_source, to_json)
+
+__all__ = [
+    "Diagnostic", "ShapeCheckError", "ShapeReport", "check_module", "spec",
+    "Finding", "available_rules", "format_text", "lint_paths",
+    "lint_source", "to_json",
+]

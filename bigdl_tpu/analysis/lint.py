@@ -1,0 +1,502 @@
+"""AST lint engine for JAX/TPU pitfalls.
+
+A pluggable rule registry over Python source. Rules receive a
+:class:`FileContext` (parsed AST with parent links, import-alias
+resolution, traced-function analysis) and yield ``(node, message)``
+findings. The engine layers suppressions, ordering and output formats on
+top, so a rule is just a generator function:
+
+    from bigdl_tpu.analysis.lint import rule
+
+    @rule("bare-except", "bare `except:` swallows KeyboardInterrupt")
+    def bare_except(ctx):
+        for node in ctx.walk(ast.ExceptHandler):
+            if node.type is None:
+                yield node, "bare `except:`; catch a concrete type"
+
+**Suppressions**: ``# bigdl: disable=rule1,rule2`` on (or on the line
+directly above) the flagged line; ``# bigdl: disable-file=rule`` anywhere
+suppresses the rule for the whole file; ``disable=all`` suppresses every
+rule. Suppressed findings are kept (``Finding.suppressed``) so tooling can
+audit them.
+
+**Traced-context analysis**: a function is considered *traced* when it is
+decorated with / passed by name to a JAX trace entry point (``jax.jit``,
+``jax.grad``, ``lax.scan`` ...), when it is a ``Module.apply`` /
+``forward_fn`` method (the framework's trace surface), or when it is
+lexically nested inside a traced function. Rules about "code reachable
+from jitted functions" anchor on this set.
+"""
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass
+from typing import (Callable, Dict, Iterable, Iterator, List, Optional,
+                    Sequence, Set, Tuple, Type)
+
+__all__ = ["Finding", "Rule", "rule", "available_rules", "FileContext",
+           "lint_source", "lint_file", "lint_paths", "format_text",
+           "to_json"]
+
+
+@dataclass
+class Finding:
+    """One lint finding: rule id, location, message, suppression state."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+
+    def format(self) -> str:
+        tag = " (suppressed)" if self.suppressed else ""
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}]" \
+               f"{tag} {self.message}"
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "suppressed": self.suppressed}
+
+
+@dataclass
+class Rule:
+    """A registered lint rule: ``fn(ctx)`` yields (node, message)."""
+
+    name: str
+    description: str
+    fn: Callable[["FileContext"], Iterator[Tuple[ast.AST, str]]]
+
+
+_RULES: Dict[str, Rule] = {}
+
+
+def rule(name: str, description: str):
+    """Decorator registering a rule function under ``name``."""
+    def deco(fn):
+        if name in _RULES:
+            raise ValueError(f"duplicate rule {name!r}")
+        _RULES[name] = Rule(name, description, fn)
+        return fn
+    return deco
+
+
+def available_rules() -> List[Rule]:
+    """All registered rules, sorted by name (importing the built-ins)."""
+    import bigdl_tpu.analysis.rules  # noqa: F401  registers on import
+    return [_RULES[k] for k in sorted(_RULES)]
+
+
+# --------------------------------------------------------------- suppression
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*bigdl:\s*(disable(?:-file)?)\s*=\s*([\w\-, ]+)")
+
+
+def _parse_suppressions(source: str):
+    """-> (line -> rule set, file-level rule set). A suppression comment on
+    a line that holds ONLY the comment also covers the next line."""
+    line_map: Dict[int, Set[str]] = {}
+    file_set: Set[str] = set()
+    lines = source.splitlines()
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return line_map, file_set
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _SUPPRESS_RE.search(tok.string)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(2).split(",") if r.strip()}
+        if m.group(1) == "disable-file":
+            file_set |= rules
+            continue
+        lineno = tok.start[0]
+        line_map.setdefault(lineno, set()).update(rules)
+        text = lines[lineno - 1] if lineno <= len(lines) else ""
+        if text.lstrip().startswith("#"):  # standalone: covers next line
+            line_map.setdefault(lineno + 1, set()).update(rules)
+    return line_map, file_set
+
+
+# -------------------------------------------------------------- file context
+
+# canonical dotted names that start a trace (the function argument /
+# decorated function is traced by JAX)
+TRACE_ENTRIES = {
+    "jax.jit", "jax.pmap", "jax.vmap", "jax.grad", "jax.value_and_grad",
+    "jax.jacfwd", "jax.jacrev", "jax.hessian", "jax.vjp", "jax.jvp",
+    "jax.linearize", "jax.checkpoint", "jax.remat", "jax.eval_shape",
+    "jax.make_jaxpr", "jax.named_call", "jax.shard_map",
+    "jax.experimental.shard_map.shard_map",
+    "jax.lax.scan", "jax.lax.cond", "jax.lax.while_loop",
+    "jax.lax.fori_loop", "jax.lax.map", "jax.lax.switch",
+    "jax.lax.associative_scan", "jax.custom_jvp", "jax.custom_vjp",
+}
+
+# method names that are the framework's trace surface — but only on
+# Module-ish classes (dataset Transformers also have an `apply`, which is
+# a host-side generator contract): see FileContext._moduleish_classes
+TRACED_METHODS = {"apply", "forward_fn", "init", "initial_state"}
+
+# base-class names that mark a class as part of the Module trace surface;
+# within-file inheritance chains are resolved transitively
+MODULEISH_BASES = {"Module", "Container", "Criterion", "Cell", "Graph"}
+
+# attribute reads that are static at trace time (never a traced value)
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding",
+                "weak_type"}
+
+# calls whose results are static / python-level even on traced operands
+STATIC_CALLS = {"isinstance", "hasattr", "getattr", "len", "callable",
+                "type", "id", "repr"}
+
+# jax entry points that return python values (backend topology queries),
+# not traced arrays
+STATIC_JAX_CALLS = {
+    "jax.default_backend", "jax.devices", "jax.local_devices",
+    "jax.device_count", "jax.local_device_count", "jax.process_count",
+    "jax.process_index",
+}
+
+
+class FileContext:
+    """Parsed source + the shared analyses rules build on."""
+
+    def __init__(self, source: str, path: str = "<string>"):
+        self.source = source
+        self.path = path
+        self.tree = ast.parse(source)
+        self._parents: Dict[int, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[id(child)] = parent
+        self.line_disables, self.file_disables = _parse_suppressions(source)
+        self.aliases = self._import_aliases()
+        self.traced = self._traced_functions()
+        self._traced_vars: Dict[int, Set[str]] = {}
+
+    # ---- generic helpers -------------------------------------------------
+    def walk(self, *types: Type[ast.AST]) -> Iterator[ast.AST]:
+        for node in ast.walk(self.tree):
+            if not types or isinstance(node, types):
+                yield node
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(id(node))
+
+    def enclosing(self, node: ast.AST,
+                  *types: Type[ast.AST]) -> Optional[ast.AST]:
+        cur = self.parent(node)
+        while cur is not None:
+            if isinstance(cur, types):
+                return cur
+            cur = self.parent(cur)
+        return None
+
+    def in_traced(self, node: ast.AST) -> bool:
+        """True when ``node`` sits inside a traced function (directly or
+        through lexical nesting)."""
+        cur = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)) and id(cur) in self.traced:
+                return True
+            cur = self.parent(cur)
+        return False
+
+    # ---- name resolution -------------------------------------------------
+    def _import_aliases(self) -> Dict[str, str]:
+        aliases: Dict[str, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    aliases[a.asname or a.name.split(".")[0]] = \
+                        a.name if a.asname else a.name.split(".")[0]
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                for a in node.names:
+                    aliases[a.asname or a.name] = f"{node.module}.{a.name}"
+        return aliases
+
+    def canon(self, node: ast.AST) -> Optional[str]:
+        """Canonical dotted name of a Name/Attribute chain, resolving
+        import aliases: with ``import jax.numpy as jnp``, ``jnp.zeros``
+        -> ``jax.numpy.zeros``."""
+        parts: List[str] = []
+        cur = node
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if not isinstance(cur, ast.Name):
+            return None
+        root = self.aliases.get(cur.id, cur.id)
+        return ".".join([root] + list(reversed(parts)))
+
+    # ---- traced-function analysis ----------------------------------------
+    def _decorator_traces(self, dec: ast.AST) -> bool:
+        if isinstance(dec, ast.Call):
+            c = self.canon(dec.func)
+            if c == "functools.partial" and dec.args:
+                return self.canon(dec.args[0]) in TRACE_ENTRIES
+            return c in TRACE_ENTRIES
+        return self.canon(dec) in TRACE_ENTRIES
+
+    def _moduleish_classes(self) -> Set[str]:
+        """Class names in this file that (transitively) extend a Module-ish
+        base — their apply/forward_fn/init methods are trace surface."""
+        bases: Dict[str, List[str]] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ClassDef):
+                names = []
+                for b in node.bases:
+                    c = self.canon(b)
+                    if c:
+                        names.append(c.split(".")[-1])
+                bases[node.name] = names
+        moduleish = {name for name, bs in bases.items()
+                     if MODULEISH_BASES & set(bs)}
+        changed = True
+        while changed:
+            changed = False
+            for name, bs in bases.items():
+                if name not in moduleish and moduleish & set(bs):
+                    moduleish.add(name)
+                    changed = True
+        return moduleish
+
+    def _traced_functions(self) -> Set[int]:
+        defs: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, []).append(node)
+        moduleish = self._moduleish_classes()
+        traced: Set[int] = set()
+        for group in defs.values():
+            for fn in group:
+                parent = self.parent(fn)
+                if any(self._decorator_traces(d) for d in fn.decorator_list):
+                    traced.add(id(fn))
+                elif fn.name in TRACED_METHODS \
+                        and isinstance(parent, ast.ClassDef) \
+                        and parent.name in moduleish:
+                    traced.add(id(fn))
+        # functions handed by name (or as a lambda) to a trace entry
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if self.canon(node.func) not in TRACE_ENTRIES:
+                continue
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Name):
+                    for fn in defs.get(arg.id, []):
+                        traced.add(id(fn))
+                elif isinstance(arg, ast.Lambda):
+                    traced.add(id(arg))
+        # propagation fixpoint:
+        # (a) lexical nesting — anything defined inside a traced fn
+        # (b) intra-class helpers — `self._helper(...)` called from a
+        #     traced method of the same class is traced too
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(self.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.Lambda)) \
+                        and id(node) not in traced:
+                    cur = self.parent(node)
+                    while cur is not None:
+                        if id(cur) in traced and isinstance(
+                                cur, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda)):
+                            traced.add(id(node))
+                            changed = True
+                            break
+                        cur = self.parent(cur)
+            for cls in ast.walk(self.tree):
+                if not isinstance(cls, ast.ClassDef):
+                    continue
+                methods = {m.name: m for m in cls.body
+                           if isinstance(m, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef))}
+                for m in methods.values():
+                    if id(m) not in traced:
+                        continue
+                    for call in ast.walk(m):
+                        if isinstance(call, ast.Call) \
+                                and isinstance(call.func, ast.Attribute) \
+                                and isinstance(call.func.value, ast.Name) \
+                                and call.func.value.id == "self":
+                            callee = methods.get(call.func.attr)
+                            if callee is not None \
+                                    and id(callee) not in traced:
+                                traced.add(id(callee))
+                                changed = True
+        return traced
+
+    # ---- traced-value dataflow (per function, cached) --------------------
+    def _is_arrayish(self, expr: ast.AST, known: Set[str]) -> bool:
+        """Heuristic: does ``expr`` produce a traced array? True for calls
+        into jnp/lax/jax namespaces and for expressions over known traced
+        names; attribute reads of STATIC_ATTRS never count."""
+        if isinstance(expr, ast.Attribute) and expr.attr in STATIC_ATTRS:
+            return False
+        if isinstance(expr, ast.Call):
+            f = expr.func
+            if isinstance(f, ast.Name) and f.id in STATIC_CALLS:
+                return False
+            c = self.canon(f)
+            if c in STATIC_JAX_CALLS:
+                return False
+            if c and (c.startswith("jax.") or c == "jax"):
+                return True
+            return any(self._is_arrayish(a, known) for a in expr.args)
+        if isinstance(expr, ast.Name):
+            return expr.id in known
+        if isinstance(expr, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+                   for op in expr.ops):
+                # identity tests are python-level; membership tests are
+                # overwhelmingly host-container lookups, not array ops
+                return False
+            return self._is_arrayish(expr.left, known) or any(
+                self._is_arrayish(c, known) for c in expr.comparators)
+        if isinstance(expr, (ast.BinOp, ast.UnaryOp, ast.BoolOp,
+                             ast.Subscript, ast.IfExp, ast.Tuple, ast.List)):
+            return any(self._is_arrayish(c, known)
+                       for c in ast.iter_child_nodes(expr)
+                       if isinstance(c, ast.expr))
+        return False
+
+    def traced_vars(self, fn: ast.AST) -> Set[str]:
+        """Names inside ``fn`` bound (transitively) to jnp/lax/jax results.
+        Parameters are deliberately NOT included — statically we cannot
+        tell an array argument from a python flag like ``training``."""
+        cached = self._traced_vars.get(id(fn))
+        if cached is not None:
+            return cached
+        known: Set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(fn):
+                targets: List[ast.AST] = []
+                value = None
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, ast.AugAssign):
+                    targets, value = [node.target], node.value
+                elif isinstance(node, ast.AnnAssign) and node.value:
+                    targets, value = [node.target], node.value
+                if value is None or not self._is_arrayish(value, known):
+                    continue
+                for t in targets:
+                    # only plain names (and unpacked name tuples) become
+                    # traced; `container[key] = arr` does NOT make the
+                    # container a traced value
+                    if isinstance(t, (ast.Tuple, ast.List)):
+                        names = [e for e in t.elts
+                                 if isinstance(e, ast.Name)]
+                    elif isinstance(t, ast.Name):
+                        names = [t]
+                    else:
+                        names = []
+                    for n in names:
+                        if n.id not in known:
+                            known.add(n.id)
+                            changed = True
+        self._traced_vars[id(fn)] = known
+        return known
+
+
+# ------------------------------------------------------------------ running
+
+DEFAULT_EXCLUDE_DIRS = {"native", "__pycache__"}
+
+
+def lint_source(source: str, path: str = "<string>",
+                rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Lint one source string; returns findings (suppressed ones flagged,
+    not dropped)."""
+    import bigdl_tpu.analysis.rules  # noqa: F401  registers built-ins
+    try:
+        ctx = FileContext(source, path)
+    except SyntaxError as e:
+        return [Finding("parse-error", path, e.lineno or 1, 0,
+                        f"could not parse: {e.msg}")]
+    selected = [_RULES[r] for r in rules] if rules else \
+        [_RULES[k] for k in sorted(_RULES)]
+    findings: List[Finding] = []
+    seen = set()
+    for r in selected:
+        for node, message in r.fn(ctx):
+            line = getattr(node, "lineno", 1)
+            col = getattr(node, "col_offset", 0)
+            key = (r.name, line, col, message)
+            if key in seen:  # e.g. one def wrapped at two jit sites
+                continue
+            seen.add(key)
+            on_line = ctx.line_disables.get(line, set())
+            suppressed = (r.name in ctx.file_disables
+                          or "all" in ctx.file_disables
+                          or r.name in on_line or "all" in on_line)
+            findings.append(Finding(r.name, path, line, col, message,
+                                    suppressed))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def lint_file(path: str,
+              rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Lint one file on disk."""
+    with open(path, encoding="utf-8") as f:
+        return lint_source(f.read(), path, rules)
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
+    """Expand files/directories into .py files, skipping native/ caches."""
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(d for d in dirs
+                             if d not in DEFAULT_EXCLUDE_DIRS)
+            for fn in sorted(files):
+                if fn.endswith(".py"):
+                    yield os.path.join(root, fn)
+
+
+def lint_paths(paths: Iterable[str],
+               rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Lint every .py file under ``paths`` (files or directories)."""
+    findings: List[Finding] = []
+    for fp in iter_python_files(paths):
+        findings.extend(lint_file(fp, rules))
+    return findings
+
+
+def format_text(findings: Sequence[Finding],
+                show_suppressed: bool = False) -> str:
+    """Human-readable report; suppressed findings shown only on request."""
+    shown = [f for f in findings if show_suppressed or not f.suppressed]
+    lines = [f.format() for f in shown]
+    active = sum(1 for f in findings if not f.suppressed)
+    muted = len(findings) - active
+    lines.append(f"{active} finding{'s' if active != 1 else ''}"
+                 f" ({muted} suppressed)")
+    return "\n".join(lines)
+
+
+def to_json(findings: Sequence[Finding]) -> str:
+    """Machine-readable report (stable keys; includes suppressed)."""
+    return json.dumps([f.to_dict() for f in findings], indent=2)

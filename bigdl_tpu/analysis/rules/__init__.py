@@ -1,0 +1,18 @@
+"""Built-in lint rules. Importing this package registers every rule with
+the :mod:`bigdl_tpu.analysis.lint` registry; third-party rules register
+the same way (the ``@rule`` decorator), so the set is pluggable.
+
+Shipped rules:
+
+- ``host-sync`` — ``float()``/``.item()``/``np.asarray`` on traced values
+- ``traced-branch`` — Python ``if``/``while`` on traced values
+- ``jnp-in-host-loop`` — per-iteration array construction in host loops
+- ``jit-static-args`` — missing/invalid/unhashable jit static arguments
+- ``apply-mutates-self`` — impure ``Module.apply``/``forward_fn``
+- ``host-state-in-trace`` — clocks / host RNG baked into traces
+- ``global-rng`` — module-global ``np.random``/``random`` state
+- ``bare-except`` — bare ``except:`` handlers
+"""
+from bigdl_tpu.analysis.rules import jit_calls, purity, style, traced
+
+__all__ = ["jit_calls", "purity", "style", "traced"]

@@ -1,0 +1,217 @@
+"""Rules about ``jax.jit`` call sites and host-loop dispatch churn."""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set, Tuple
+
+from bigdl_tpu.analysis.lint import FileContext, rule
+
+# jnp constructors whose per-iteration use in a HOST loop re-dispatches
+# (and, with changing shapes, re-compiles) every pass
+_CONSTRUCTORS = {
+    "jax.numpy." + n for n in (
+        "array", "asarray", "zeros", "ones", "full", "empty", "arange",
+        "linspace", "eye", "identity", "tri", "zeros_like", "ones_like",
+        "full_like", "empty_like")
+} | {"jax.device_put"}
+
+
+def _loop_bound_names(loop: ast.AST) -> set:
+    """Names that change per iteration: loop targets + names assigned in
+    the body."""
+    names = set()
+    targets = [loop.target] if isinstance(loop, ast.For) else []
+    for node in ast.walk(loop):
+        if isinstance(node, ast.Assign):
+            targets.extend(node.targets)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets.append(node.target)
+        elif isinstance(node, ast.comprehension):
+            targets.append(node.target)
+    for t in targets:
+        for n in ast.walk(t):
+            if isinstance(n, ast.Name):
+                names.add(n.id)
+    return names
+
+
+@rule("jnp-in-host-loop",
+      "loop-invariant jnp array construction in a host loop")
+def jnp_in_host_loop(ctx: FileContext):
+    for loop in ctx.walk(ast.For, ast.While):
+        if ctx.in_traced(loop) or ctx.enclosing(
+                loop, ast.FunctionDef, ast.AsyncFunctionDef) is None:
+            continue  # traced loops unroll; module-level loops run once
+        bound = _loop_bound_names(loop)
+        stack: List[ast.AST] = list(ast.iter_child_nodes(loop))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue  # defined per-iteration but not necessarily run
+            if isinstance(node, ast.Call):
+                c = ctx.canon(node.func)
+                if c in _CONSTRUCTORS:
+                    # per-item constructions (args depend on the loop
+                    # iteration) are intentional; only the loop-INVARIANT
+                    # ones are pure per-iteration dispatch waste
+                    arg_names = {
+                        n.id for a in list(node.args)
+                        + [kw.value for kw in node.keywords]
+                        for n in ast.walk(a) if isinstance(n, ast.Name)}
+                    if not (arg_names & bound):
+                        yield node, (
+                            f"loop-invariant `{c}` inside a host loop "
+                            "dispatches to the device every iteration; "
+                            "hoist it out of the loop (or move the loop "
+                            "into jit/lax.scan)")
+            stack.extend(ast.iter_child_nodes(node))
+
+
+# --------------------------------------------------------- jit static args
+
+def _jit_call(ctx: FileContext, node: ast.Call) -> Optional[ast.Call]:
+    """The jax.jit(...) call carried by ``node`` (direct or through
+    functools.partial(jax.jit, ...)); None otherwise."""
+    c = ctx.canon(node.func)
+    if c == "jax.jit":
+        return node
+    if c == "functools.partial" and node.args \
+            and ctx.canon(node.args[0]) == "jax.jit":
+        return node
+    return None
+
+
+def _literal_ints(node: ast.AST) -> Optional[List[int]]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if not (isinstance(e, ast.Constant)
+                    and isinstance(e.value, int)):
+                return None
+            out.append(e.value)
+        return out
+    return None
+
+
+def _positional_params(fn: ast.AST) -> List[str]:
+    a = fn.args
+    return [p.arg for p in list(a.posonlyargs) + list(a.args)]
+
+
+def _static_usage(fn: ast.AST, param: str):
+    """Places where ``param`` must be a Python value: range(), string
+    compares, truthiness tests — traced arguments break all three."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id == "range":
+            for arg in node.args:
+                if isinstance(arg, ast.Name) and arg.id == param:
+                    yield node, f"`range({param})`"
+        elif isinstance(node, ast.Compare) \
+                and isinstance(node.left, ast.Name) \
+                and node.left.id == param \
+                and any(isinstance(c, ast.Constant)
+                        and isinstance(c.value, str)
+                        for c in node.comparators):
+            yield node, f"comparing `{param}` to a string"
+        elif isinstance(node, (ast.If, ast.While)) \
+                and isinstance(node.test, ast.Name) \
+                and node.test.id == param:
+            yield node, f"`if {param}:` truthiness"
+
+
+@rule("jit-static-args",
+      "missing/invalid/unhashable static arguments at a jax.jit site")
+def jit_static_args(ctx: FileContext):
+    defs = {}
+    for fn in ctx.walk(ast.FunctionDef, ast.AsyncFunctionDef):
+        defs.setdefault(fn.name, fn)
+
+    # jitted-callable bindings: f = jax.jit(g, static_argnums=...), so
+    # call sites of f can be screened for unhashable static values
+    jitted_bindings = {}
+
+    sites: List[Tuple[ast.Call, Optional[ast.AST]]] = []
+    for node in ctx.walk(ast.Call):
+        call = _jit_call(ctx, node)
+        if call is None:
+            continue
+        wrapped = None
+        args = call.args[1:] if ctx.canon(call.func) == "functools.partial" \
+            else call.args
+        if args and isinstance(args[0], ast.Name):
+            wrapped = defs.get(args[0].id)
+        parent = ctx.parent(node)
+        if wrapped is None and isinstance(
+                parent, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node in parent.decorator_list:
+            wrapped = parent
+        sites.append((call, wrapped))
+        if isinstance(parent, ast.Assign) and len(parent.targets) == 1 \
+                and isinstance(parent.targets[0], ast.Name):
+            jitted_bindings[parent.targets[0].id] = call
+
+    for call, wrapped in sites:
+        static_nums: Set[int] = set()
+        static_names: Set[str] = set()
+        for kw in call.keywords:
+            if kw.arg == "static_argnums":
+                ints = _literal_ints(kw.value)
+                if ints is None:
+                    if not isinstance(kw.value, ast.Name):
+                        yield kw.value, (
+                            "static_argnums must be int indices; for "
+                            "names use static_argnames")
+                    continue
+                static_nums.update(ints)
+                if wrapped is not None:
+                    n = len(_positional_params(wrapped))
+                    bad = [i for i in ints if i >= n or i < -n]
+                    if bad:
+                        yield kw.value, (
+                            f"static_argnums {bad} out of range for "
+                            f"`{wrapped.name}` ({n} positional args)")
+            elif kw.arg == "static_argnames":
+                if isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, str):
+                    static_names.add(kw.value.value)
+                elif isinstance(kw.value, (ast.Tuple, ast.List)):
+                    static_names.update(
+                        e.value for e in kw.value.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str))
+        if wrapped is None:
+            continue
+        params = _positional_params(wrapped)
+        for i, p in enumerate(params):
+            if p in ("self", "cls") or i in static_nums \
+                    or p in static_names:
+                continue
+            for node, how in _static_usage(wrapped, p):
+                yield node, (
+                    f"jitted `{wrapped.name}` uses argument `{p}` as a "
+                    f"Python value ({how}) but it is not in "
+                    "static_argnums/static_argnames — this raises a "
+                    "TracerConversionError when called")
+
+    # unhashable values passed at static positions of a jitted binding
+    for node in ctx.walk(ast.Call):
+        if not isinstance(node.func, ast.Name):
+            continue
+        call = jitted_bindings.get(node.func.id)
+        if call is None:
+            continue
+        nums = set()
+        for kw in call.keywords:
+            if kw.arg == "static_argnums":
+                nums.update(_literal_ints(kw.value) or [])
+        for i in nums:
+            if 0 <= i < len(node.args) and isinstance(
+                    node.args[i], (ast.List, ast.Dict, ast.Set)):
+                yield node.args[i], (
+                    f"unhashable literal at static position {i} of "
+                    f"jitted `{node.func.id}`; static arguments must "
+                    "be hashable (use a tuple)")

@@ -1,0 +1,83 @@
+"""Purity rules: impure ``Module.apply`` and module-global RNG state."""
+from __future__ import annotations
+
+import ast
+
+from bigdl_tpu.analysis.lint import FileContext, rule
+
+# the pure-functional trace surface of the Module contract: mutating self
+# here is at best a silent no-op under jit (the traced python runs once)
+# and at worst a leaked-tracer error
+_PURE_METHODS = {"apply", "forward_fn"}
+
+# module-global numpy RNG entry points (shared mutable state; reseeding
+# races across callers and breaks reproducibility)
+_GLOBAL_NP = {
+    "seed", "rand", "randn", "randint", "random", "random_sample",
+    "ranf", "sample", "choice", "shuffle", "permutation", "uniform",
+    "normal", "standard_normal", "binomial", "poisson", "beta", "gamma",
+    "exponential", "get_state", "set_state",
+}
+_GLOBAL_STDLIB = {
+    "seed", "random", "randint", "randrange", "uniform", "choice",
+    "choices", "shuffle", "sample", "gauss", "getrandbits", "betavariate",
+    "normalvariate",
+}
+
+
+@rule("apply-mutates-self",
+      "Module.apply/forward_fn mutates self (impure trace surface)")
+def apply_mutates_self(ctx: FileContext):
+    for cls in ctx.walk(ast.ClassDef):
+        for fn in cls.body:
+            if not isinstance(fn, ast.FunctionDef) \
+                    or fn.name not in _PURE_METHODS:
+                continue
+            if not fn.args.args or fn.args.args[0].arg != "self":
+                continue
+            for node in ast.walk(fn):
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [node.target]
+                elif isinstance(node, ast.Delete):
+                    targets = node.targets
+                for t in targets:
+                    if isinstance(t, (ast.Attribute, ast.Subscript)):
+                        base = t.value
+                        while isinstance(base, (ast.Attribute,
+                                                ast.Subscript)):
+                            base = base.value
+                        if isinstance(base, ast.Name) \
+                                and base.id == "self":
+                            yield node, (
+                                f"`{fn.name}` assigns to `self` — the "
+                                "traced python runs ONCE at compile "
+                                "time, so the mutation silently "
+                                "desyncs from execution; return new "
+                                "state instead")
+
+
+@rule("global-rng",
+      "module-global RNG state (np.random.*/random.*)")
+def global_rng(ctx: FileContext):
+    for node in ctx.walk(ast.Call):
+        c = ctx.canon(node.func)
+        if c is None:
+            continue
+        parts = c.split(".")
+        if c.startswith("numpy.random.") and len(parts) == 3 \
+                and parts[2] in _GLOBAL_NP:
+            yield node, (
+                f"`{c}` mutates/reads the process-global numpy RNG; "
+                "use a seeded np.random.RandomState (see "
+                "bigdl_tpu.tools.synthetic for synthetic data) or "
+                "bigdl_tpu.utils.random.RandomGenerator")
+        elif parts[0] == "random" and len(parts) == 2 \
+                and parts[1] in _GLOBAL_STDLIB \
+                and "random" in ctx.aliases \
+                and ctx.aliases["random"] == "random":
+            yield node, (
+                f"`{c}` uses the global stdlib RNG; use a seeded "
+                "random.Random(seed) or numpy RandomState")

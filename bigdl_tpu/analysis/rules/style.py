@@ -1,0 +1,16 @@
+"""Robustness rules that keep failures diagnosable."""
+from __future__ import annotations
+
+import ast
+
+from bigdl_tpu.analysis.lint import FileContext, rule
+
+
+@rule("bare-except", "bare `except:` swallows KeyboardInterrupt/SystemExit")
+def bare_except(ctx: FileContext):
+    for node in ctx.walk(ast.ExceptHandler):
+        if node.type is None:
+            yield node, (
+                "bare `except:` catches KeyboardInterrupt/SystemExit and "
+                "hides real failures; catch `Exception` (or the concrete "
+                "error) instead")

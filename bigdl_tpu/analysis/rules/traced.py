@@ -1,0 +1,109 @@
+"""Rules over *traced* code: functions JAX will trace (jitted, grad'd,
+scanned, or the Module ``apply``/``forward_fn`` surface) where host-side
+operations either fail at runtime, silently force a device sync, or bake
+a host value in as a compile-time constant.
+"""
+from __future__ import annotations
+
+import ast
+
+from bigdl_tpu.analysis.lint import FileContext, rule
+
+# numpy calls that materialize a tracer on the host (TracerArrayConversion
+# at runtime — or a silent device round-trip when fed concrete values)
+_HOST_MATERIALIZE = {"numpy.asarray", "numpy.array"}
+
+# host clocks / host RNG: legal under trace, but evaluated ONCE at trace
+# time — every compiled execution replays the same "random"/"now" value
+_HOST_STATE = {
+    "time.time", "time.perf_counter", "time.monotonic",
+    "time.process_time", "datetime.datetime.now", "datetime.datetime.today",
+    "RandomGenerator.next_key",
+}
+_HOST_STATE_PREFIXES = ("numpy.random.", "random.")
+
+
+def _sync_attr_call(node: ast.Call) -> bool:
+    return (isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("item", "tolist", "block_until_ready")
+            and not node.args)
+
+
+@rule("host-sync",
+      "host-device synchronization reachable from traced code")
+def host_sync(ctx: FileContext):
+    for node in ctx.walk(ast.Call):
+        if not ctx.in_traced(node):
+            continue
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in ("float", "int", "bool") \
+                and node.args:
+            fn = ctx.enclosing(node, ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Lambda)
+            known = ctx.traced_vars(fn) if fn is not None else set()
+            if ctx._is_arrayish(node.args[0], known):
+                yield node, (
+                    f"`{f.id}()` on a traced value: under jit this raises "
+                    "TracerConversionError; outside it forces a blocking "
+                    "device sync — keep the value on device or move the "
+                    "conversion out of the traced function")
+            continue
+        if _sync_attr_call(node):
+            yield node, (
+                f"`.{node.func.attr}()` in traced code forces a host "
+                "sync / fails under jit; return the array instead")
+            continue
+        c = ctx.canon(f)
+        if c == "jax.device_get":
+            yield node, (
+                "`jax.device_get` in traced code forces a host sync / "
+                "fails under jit; return the array instead")
+        elif c in _HOST_MATERIALIZE and node.args:
+            # only when a traced value flows in: np.asarray over static
+            # python data (shapes, config lists) is legitimate trace-time
+            # constant folding
+            fn = ctx.enclosing(node, ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.Lambda)
+            known = ctx.traced_vars(fn) if fn is not None else set()
+            if ctx._is_arrayish(node.args[0], known):
+                yield node, (
+                    f"`{c}` materializes a traced value on host "
+                    "(TracerArrayConversionError under jit); use jnp "
+                    "instead")
+
+
+@rule("host-state-in-trace",
+      "host clock / host RNG evaluated once at trace time")
+def host_state(ctx: FileContext):
+    for node in ctx.walk(ast.Call):
+        if not ctx.in_traced(node):
+            continue
+        c = ctx.canon(node.func)
+        if c is None:
+            continue
+        if c in ("numpy.random.RandomState", "numpy.random.default_rng",
+                 "random.Random"):
+            continue  # constructing a seeded generator is host-side setup
+        if c in _HOST_STATE or c.endswith(".RandomGenerator.next_key") \
+                or any(c.startswith(p) for p in _HOST_STATE_PREFIXES):
+            yield node, (
+                f"`{c}` runs on the host at TRACE time: the compiled "
+                "program replays one frozen value forever; thread a "
+                "jax.random key / pass the value as an argument")
+
+
+@rule("traced-branch",
+      "Python control flow branching on a traced value")
+def traced_branch(ctx: FileContext):
+    for node in ctx.walk(ast.If, ast.While):
+        if not ctx.in_traced(node):
+            continue
+        fn = ctx.enclosing(node, ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.Lambda) or ctx.tree
+        known = ctx.traced_vars(fn)
+        if ctx._is_arrayish(node.test, known):
+            kind = "if" if isinstance(node, ast.If) else "while"
+            yield node, (
+                f"`{kind}` on a traced value raises "
+                "TracerBoolConversionError under jit; use jnp.where / "
+                "lax.cond / lax.while_loop (or mark the argument static)")

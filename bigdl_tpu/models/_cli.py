@@ -79,9 +79,10 @@ def mnist_arrays(folder: str, train: bool,
     """MNIST idx files -> normalized [N,1,28,28] + 1-based labels
     (lenet/Utils.scala train/test mean+std)."""
     if synthetic:
-        rng = np.random.RandomState(0 if train else 1)
-        return (rng.rand(synthetic, 1, 28, 28).astype(np.float32),
-                rng.randint(1, 11, synthetic).astype(np.float32))
+        from bigdl_tpu.tools.synthetic import (SEED_EVAL, SEED_TRAIN,
+                                               image_batch)
+        return image_batch(synthetic, (1, 28, 28), 10,
+                           seed=SEED_TRAIN if train else SEED_EVAL)
     from bigdl_tpu.dataset.image import load_mnist
     prefix = "train" if train else "t10k"
     img_path = os.path.join(folder, f"{prefix}-images-idx3-ubyte")
@@ -117,9 +118,10 @@ def cifar10_arrays(folder: str, train: bool, synthetic: int = 0):
     """CIFAR-10 binary batches -> normalized [N,3,32,32] + 1-based labels
     (vgg/resnet recipes' per-channel stats)."""
     if synthetic:
-        rng = np.random.RandomState(0 if train else 1)
-        return (rng.rand(synthetic, 3, 32, 32).astype(np.float32),
-                rng.randint(1, 11, synthetic).astype(np.float32))
+        from bigdl_tpu.tools.synthetic import (SEED_EVAL, SEED_TRAIN,
+                                               image_batch)
+        return image_batch(synthetic, (3, 32, 32), 10,
+                           seed=SEED_TRAIN if train else SEED_EVAL)
     from bigdl_tpu.dataset.image import load_cifar10
     if train:
         paths = [os.path.join(folder, f"data_batch_{i}.bin")

@@ -16,6 +16,8 @@ class LookupTable(Module):
     """Embedding lookup (nn/LookupTable.scala). Indices are 1-based like the
     reference; max_norm renormalizes rows touched by the batch."""
 
+    integer_input_ok = True  # int token ids into float rows is the contract
+
     def __init__(self, n_index: int, n_output: int,
                  padding_value: float = 0.0, max_norm: float = float("inf"),
                  norm_type: float = 2.0, should_scale_grad_by_freq: bool = False,

@@ -58,6 +58,12 @@ def _to_jax(x):
 class Module:
     """Base of every layer/container (AbstractModule.scala:56)."""
 
+    # Capability flag for the pre-compile shape checker
+    # (analysis/shapecheck.py): layers that legitimately consume integer
+    # inputs while holding floating params (LookupTable) set this True so
+    # the float-params-vs-int-input dtype diagnostic skips them.
+    integer_input_ok: bool = False
+
     def __init_subclass__(cls, **kw):
         """Auto-capture constructor args on every subclass so modules can be
         serialized by topology (the reference's reflection-driven
@@ -244,6 +250,28 @@ class Module:
     def set_state(self, state: State) -> "Module":
         self._state = state
         return self
+
+    # ---- pre-compile checking -------------------------------------------
+    def check(self, input_spec, *, training: bool = False,
+              raise_on_error: bool = True):
+        """Shape/dtype-check this module against ``input_spec`` BEFORE any
+        XLA compilation: the whole graph is walked under ``jax.eval_shape``
+        (zero FLOPs, milliseconds) and a mis-wiring is rejected with a
+        diagnostic naming the offending layer path — the JAX-side
+        equivalent of the reference's graph-build-time typed layer errors.
+
+        ``input_spec`` is ``analysis.spec(shape, dtype)``, a bare shape
+        tuple (float32), or a list of those for multi-input modules;
+        string/None dims are symbolic (checked for every batch size).
+        Returns an ``analysis.ShapeReport``; raises ``ShapeCheckError``
+        on failure unless ``raise_on_error=False``.
+        """
+        from bigdl_tpu.analysis.shapecheck import (ShapeCheckError,
+                                                   check_module)
+        report = check_module(self, input_spec, training=training)
+        if raise_on_error and not report.ok:
+            raise ShapeCheckError(report.diagnostics)
+        return report
 
     # ---- sugar -----------------------------------------------------------
     def __call__(self, *args, **kwargs):

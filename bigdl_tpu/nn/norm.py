@@ -84,7 +84,13 @@ class BatchNormalization(Module):
             mean = mean32.astype(x.dtype)
             var = var32.astype(x.dtype)
             n = x.size // self.n_output
-            unbiased = var * n / max(1, n - 1)
+            # Bessel correction n/(n-1), clamped for n==1. jnp.maximum
+            # instead of python max: under a symbolic batch dim
+            # (analysis/shapecheck) `n - 1 > 1` is inconclusive as a
+            # python comparison but fine as a traced op.
+            factor = (jnp.asarray(n, jnp.float32)
+                      / jnp.maximum(jnp.asarray(n - 1, jnp.float32), 1.0))
+            unbiased = var * factor.astype(var.dtype)
             new_state = {
                 "running_mean": (1 - self.momentum) * state["running_mean"]
                                 + self.momentum * mean,

@@ -256,6 +256,9 @@ class Optimizer:
         # gradient clipping (Optimizer.scala setConstantGradientClipping
         # / setGradientClippingByl2Norm); None = off
         self._gradient_clip = None
+        # opt-in pre-flight shape check (analysis/shapecheck.py); None =
+        # off. Set via set_preflight_spec.
+        self._preflight_spec = None
         # single-slot (dataset, jitted fn) cache for device-cached
         # validation — replacing the validation dataset must free the
         # old split's HBM-resident arrays, not pin them forever
@@ -359,6 +362,16 @@ class Optimizer:
     def disable_gradient_clipping(self) -> "Optimizer":
         """Optimizer.scala disableGradientClipping."""
         self._gradient_clip = None
+        return self
+
+    def set_preflight_spec(self, input_spec) -> "Optimizer":
+        """Opt-in pre-flight: before any compilation, ``optimize()``
+        shape/dtype-checks the model against ``input_spec`` (see
+        ``analysis.spec``; strings/None dims are symbolic) under
+        ``jax.eval_shape`` and rejects a mis-wired model with a
+        layer-path diagnostic instead of a deep XLA trace after a
+        30-second compile. Pass None to disable."""
+        self._preflight_spec = input_spec
         return self
 
     def set_drop_module_property(self, drop_percentage: float,
@@ -642,6 +655,11 @@ class Optimizer:
     def optimize(self) -> Module:
         if not Engine.is_initialized():
             Engine.init()
+        if self._preflight_spec is not None:
+            # pre-flight OUTSIDE the retry loop: a structurally broken
+            # model fails identically every attempt, so reject it once,
+            # with a layer-path diagnostic, before any init/compile work
+            self.model.check(self._preflight_spec, training=True)
         retries = 0
         while True:
             try:
@@ -778,6 +796,10 @@ class Optimizer:
                     raise ValueError(
                         "dataset must yield MiniBatch; add SampleToMiniBatch")
                 inp, tgt = self._prep_io(batch)
+                # device_put above only DISPATCHED the transfer; without
+                # this barrier the copy time would silently migrate into
+                # t_compute and the data-vs-compute attribution would lie
+                jax.block_until_ready((inp, tgt))
                 bsz = batch.size()
                 step_args = (inp, tgt)
                 run_step = step
@@ -788,6 +810,10 @@ class Optimizer:
             t1 = time.time()
             params, opt_state, model_state, loss = run_step(
                 params, opt_state, model_state, rng, lr, *step_args)
+            # fetching the loss scalar only gates on the loss VALUE; the
+            # param/optimizer updates it does not depend on may still be
+            # in flight, so close the timing window on the full outputs
+            jax.block_until_ready((params, opt_state, model_state))
             loss_f = _to_scalar(loss)
             t_compute = time.time() - t1
             if rotating:

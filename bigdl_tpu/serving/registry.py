@@ -59,7 +59,7 @@ class ModelRegistry:
     # ---------------------------------------------------------- load
     def load(self, name: str, model=None, *, path: Optional[str] = None,
              version: Optional[int] = None, quantize: bool = False,
-             activate: bool = True) -> Servable:
+             activate: bool = True, input_spec=None) -> Servable:
         """Register a model version under ``name``.
 
         Exactly one of ``model`` (a Module) or ``path`` (a
@@ -70,9 +70,16 @@ class ModelRegistry:
         ``activate=False`` the version is STAGED only, even for a
         fresh name (that is what lets a caller warm it up before any
         traffic can resolve it): ``swap`` makes it current.
+
+        ``input_spec`` (``analysis.spec`` / shape tuple / list of them)
+        opts into a pre-flight shape check: the servable-to-be is walked
+        under ``jax.eval_shape`` and a mis-wired model is rejected with a
+        layer-path diagnostic BEFORE it can be registered — nothing is
+        staged, no traffic can resolve it, and no compile is spent on it.
         """
         if (model is None) == (path is None):
             raise ValueError("pass exactly one of model= or path=")
+        user_live_module = path is None
         if path is not None:
             from bigdl_tpu.utils.serialization import load_module
             model = load_module(path)
@@ -82,6 +89,25 @@ class ModelRegistry:
             from bigdl_tpu.nn.quantized import quantize as _quantize
             model = _quantize(model)  # a rewrite, original untouched
             model.evaluate()
+            user_live_module = False
+        if input_spec is not None:
+            # checks the model that will actually SERVE (post-quantize
+            # rewrite), in inference mode; raises ShapeCheckError.
+            # Module.check temporarily intercepts every submodule's
+            # `apply`, so a USER-PASSED live module (which may be
+            # training eagerly in another thread — see the comment
+            # below) is checked through a detached topology clone when
+            # the class supports the spec roundtrip; registry-private
+            # instances (path loads, quantize rewrites) check directly.
+            target = model
+            if user_live_module:
+                try:
+                    from bigdl_tpu.utils.module_serializer import (
+                        from_spec, to_spec)
+                    target = from_spec(to_spec(model))
+                except Exception:
+                    pass  # unregistered custom class: check in place
+            target.check(input_spec, training=False)
         # a user-passed live module is NOT flipped to eval mode (it may
         # still be training eagerly elsewhere) — the serving step runs
         # apply(training=False) regardless, so serving stays inert

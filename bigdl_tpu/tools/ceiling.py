@@ -62,14 +62,20 @@ def timed(run_chunk, carry, iters):
         run_chunk = compiled
     except Exception:
         pass  # backend without AOT cost analysis: plain jit path
+    # timing audit: chunks chain through `carry`, so one sync before t0
+    # and one at the end bound ALL the dispatched work. The loss fetch
+    # alone would not gate the LAST chunk's param-update branch — block
+    # on the carry too, or the final update rides outside the window.
     for i in range(WARMUP):
         keys = jax.random.split(jax.random.fold_in(root, i), SCAN)
         carry, losses = run_chunk(carry, keys)
+    jax.block_until_ready(carry)
     float(losses.sum())
     t0 = time.time()
     for i in range(iters):
         keys = jax.random.split(jax.random.fold_in(root, 1000 + i), SCAN)
         carry, losses = run_chunk(carry, keys)
+    jax.block_until_ready(carry)
     float(losses.sum())
     dt = time.time() - t0
     return BATCH * SCAN * iters / dt
@@ -472,6 +478,10 @@ def hand_tlm(iters):
               "lnf": (jnp.ones((D,)), jnp.zeros((D,)))}
     blocks = []
     sf = 1.0 / np.sqrt(4 * D)
+    # per-block constructions must stay DISTINCT buffers: the carry is
+    # donated (donate_argnums), and XLA rejects the same buffer donated
+    # twice — hoisting/sharing these zeros breaks run_chunk.
+    # bigdl: disable-file=jnp-in-host-loop
     for _ in range(L):
         blocks.append({
             "ln1": (jnp.ones((D,)), jnp.zeros((D,))),
@@ -491,6 +501,7 @@ def hand_tlm(iters):
     def fwd(p, toks):
         b = toks.shape[0]
         x = p["embed"][toks] + p["pos"][None, :S]
+        cmask = jnp.tril(jnp.ones((S, S), bool))  # hoisted: loop-invariant
         for blk in p["blocks"]:
             h = ln(x, blk["ln1"])
             (qw, qb), (kw, kb), (vw, vb), (ow, ob) = blk["qkvo"]
@@ -501,7 +512,6 @@ def hand_tlm(iters):
             k = split(h @ kw.astype(h.dtype) + kb.astype(h.dtype))
             v = split(h @ vw.astype(h.dtype) + vb.astype(h.dtype))
             sc = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(hd)
-            cmask = jnp.tril(jnp.ones((S, S), bool))
             sc = jnp.where(cmask, sc, jnp.finfo(sc.dtype).min)
             att = jax.nn.softmax(sc, axis=-1)
             out = jnp.einsum("bhqk,bhkd->bhqd", att, v)

@@ -34,35 +34,11 @@ import numpy as np
 
 # --------------------------------------------------------------- image task
 
-def make_dataset(n: int, seed: int, classes: int = 10, hw: int = 32):
-    # prototypes are the TASK, fixed across splits; `seed` only draws
-    # the split's samples. At high resolution the prototypes are
-    # LOW-FREQUENCY (8x block-upsampled): iid per-pixel prototypes put
-    # all class signal at the Nyquist band, which an ImageNet-style
-    # stem (7x7/2 conv + pool) averages to nothing — measured as a
-    # chance-level flatline on Inception-v1 @224.
-    truth = np.random.RandomState(1234)
-    if hw > 64:
-        base = hw // 8
-        protos = np.repeat(np.repeat(
-            truth.randn(classes, 3, base, base).astype(np.float32),
-            8, axis=2), 8, axis=3)
-    else:
-        protos = truth.randn(classes, 3, hw, hw).astype(np.float32)
-    rng = np.random.RandomState(seed)
-    ys = rng.randint(0, classes, n)
-    gains = 0.5 + rng.rand(n, 1, 1, 1).astype(np.float32)
-    shifts = rng.randn(n, 3, 1, 1).astype(np.float32) * 0.3
-    xs = protos[ys] * gains + shifts
-    # random translation up to +-hw/10 px (the crop augmentation must cope)
-    t = max(1, hw // 10)
-    for i in range(n):
-        dy, dx = rng.randint(-t, t + 1, 2)
-        xs[i] = np.roll(np.roll(xs[i], dy, axis=1), dx, axis=2)
-    xs += rng.randn(n, 3, hw, hw).astype(np.float32) * 0.6
-    # into u8 range for the device cache
-    xs = np.clip((xs * 32) + 128, 0, 255).astype(np.uint8)
-    return xs, (ys + 1).astype(np.float32)
+# both oracle generators live in tools/synthetic (shared with perf,
+# int8_sweep and the model recipes' --synthetic feeds); these aliases
+# keep the historical convergence-CLI names importable
+from bigdl_tpu.tools.synthetic import markov_corpus as make_markov_corpus  # noqa: E402,F401
+from bigdl_tpu.tools.synthetic import prototype_image_dataset as make_dataset  # noqa: E402,F401
 
 
 def run_image(name: str, build_model, optim, lr_for_epoch, epochs: int,
@@ -166,35 +142,6 @@ def run_image(name: str, build_model, optim, lr_for_epoch, epochs: int,
 
 
 # ------------------------------------------------------------------ LM task
-
-def make_markov_corpus(n_tokens: int, seed: int, vocab: int = 256,
-                       branch: int = 4):
-    """Corpus from a fixed sparse Markov chain + its entropy floor.
-
-    Returns (tokens 0-based, exp(H)) where H is the chain's conditional
-    entropy under the empirical state distribution of THIS sample — the
-    perplexity a perfect model of the transitions would achieve.
-    """
-    truth = np.random.RandomState(1234)
-    succ = np.stack([truth.choice(vocab, branch, replace=False)
-                     for _ in range(vocab)])
-    probs = truth.dirichlet(np.ones(branch) * 0.7, size=vocab)
-    row_h = -np.sum(probs * np.log(probs), axis=1)
-
-    rng = np.random.RandomState(seed)
-    toks = np.empty(n_tokens, np.int64)
-    s = rng.randint(vocab)
-    # vectorized-ish generation: draw all uniforms up front
-    us = rng.rand(n_tokens)
-    cum = np.cumsum(probs, axis=1)
-    for i in range(n_tokens):
-        k = np.searchsorted(cum[s], us[i])
-        s = succ[s, min(k, branch - 1)]
-        toks[i] = s
-    visits = np.bincount(toks, minlength=vocab)
-    h = float((row_h * visits).sum() / max(1, visits.sum()))
-    return toks, float(np.exp(h))
-
 
 def run_lm(name: str, build_model, criterion, optim, lr: float,
            epochs: int, n_tokens: int, seq: int = 32, batch: int = 256,

@@ -23,6 +23,8 @@ FAMILIES = {
                 "bigdl_tpu.dataset.fetch"],
     "optim": ["bigdl_tpu.optim"],
     "serving": ["bigdl_tpu.serving"],
+    "analysis": ["bigdl_tpu.analysis", "bigdl_tpu.analysis.shapecheck",
+                 "bigdl_tpu.analysis.lint"],
     "parallel": ["bigdl_tpu.parallel"],
     "models": ["bigdl_tpu.models"],
     "interop": ["bigdl_tpu.utils.serialization",

@@ -14,7 +14,6 @@ import json
 import sys
 import time
 
-import numpy as np
 
 
 def _time_chunk(fn, args, scan: int, iters: int):
@@ -71,11 +70,12 @@ def main(argv=None):
         (16384, 2048, 2048),
         (256, 8192, 8192),
     ]
+    from bigdl_tpu.tools.synthetic import gaussian_matrix
+
     rows = []
-    rng = np.random.RandomState(0)
     for b, cin, cout in shapes:
-        x = jnp.asarray(rng.randn(b, cin).astype(np.float32))
-        w = jnp.asarray(rng.randn(cout, cin).astype(np.float32) * 0.05)
+        x = jnp.asarray(gaussian_matrix((b, cin)))
+        w = jnp.asarray(gaussian_matrix((cout, cin), scale=0.05, seed=1))
         w_q, w_s = quantize_symmetric(w, axis=0)  # per-out-channel
         x16 = x.astype(jnp.bfloat16)
         w16 = w.T.astype(jnp.bfloat16)
@@ -115,8 +115,8 @@ def main(argv=None):
 
     # one conv case: ResNet-50's 3x3/256 block conv at eval batch
     from bigdl_tpu.ops.quant import quantized_conv2d
-    x = jnp.asarray(rng.randn(64, 256, 28, 28).astype(np.float32))
-    w = jnp.asarray(rng.randn(256, 256, 3, 3).astype(np.float32) * 0.05)
+    x = jnp.asarray(gaussian_matrix((64, 256, 28, 28)))
+    w = jnp.asarray(gaussian_matrix((256, 256, 3, 3), scale=0.05, seed=1))
     w_q, w_s = quantize_symmetric(w, axis=0)  # per-out-channel
 
     def bf16_conv(x, w):

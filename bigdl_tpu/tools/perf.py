@@ -74,21 +74,19 @@ def main(argv=None):
         Engine.set_compute_dtype(jnp.bfloat16)
     RandomGenerator.set_seed(42)
 
+    from bigdl_tpu.tools import synthetic
+
     model, in_shape, class_num = build_model(args.model)
     is_lm = len(in_shape) == 1
-    rng = np.random.RandomState(0)
     if is_lm:
-        x = jnp.asarray(rng.randint(0, class_num,
-                                    (args.batch_size,) + in_shape))
-        y = jnp.asarray(rng.randint(0, class_num,
-                                    (args.batch_size,) + in_shape))
+        xs, ys = synthetic.token_batch(args.batch_size, in_shape[0],
+                                       class_num)
         criterion = nn.SequenceCrossEntropyCriterion()
     else:
-        x = jnp.asarray(rng.rand(args.batch_size, *in_shape)
-                        .astype(np.float32))
-        y = jnp.asarray(rng.randint(1, class_num + 1,
-                                    (args.batch_size,)).astype(np.float32))
+        xs, ys = synthetic.image_batch(args.batch_size, in_shape,
+                                       class_num)
         criterion = nn.CrossEntropyCriterion()
+    x, y = jnp.asarray(xs), jnp.asarray(ys)
 
     model.training() if args.mode == "train" else model.evaluate()
     model.ensure_initialized()
@@ -120,6 +118,10 @@ def main(argv=None):
             nonlocal params, opt_state, mstate
             params, opt_state, mstate, loss = step(
                 params, opt_state, mstate, key, 0.01, x, y)
+            # the loss fetch in sync() does not gate on the param update
+            # branch of the program; block here so per-iteration timings
+            # cover the WHOLE step, not just the loss path
+            jax.block_until_ready(params)
             return loss
     else:
         eval_step = build_eval_step(model)

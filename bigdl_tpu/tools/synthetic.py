@@ -1,0 +1,124 @@
+"""Shared seeded synthetic-data generators.
+
+Every CLI/benchmark that fabricates data (tools/perf, tools/convergence,
+tools/int8_sweep, the model recipes' ``--synthetic N`` flag) draws from
+THIS module, so the generators exist once and the linter's ``global-rng``
+rule has a single sanctioned surface to point at: all randomness here is
+``np.random.RandomState(seed)`` — explicit, reproducible, never the
+process-global RNG.
+
+Deterministic convention: ``seed=0`` is the training draw, ``seed=1`` the
+evaluation draw (so train/eval synthetic splits never overlap).
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+SEED_TRAIN = 0
+SEED_EVAL = 1
+
+
+def seeded_rng(seed: int) -> np.random.RandomState:
+    """The sanctioned RNG constructor for synthetic data paths."""
+    return np.random.RandomState(seed)
+
+
+def image_batch(n: int, shape: Tuple[int, ...], classes: int,
+                seed: int = SEED_TRAIN) -> Tuple[np.ndarray, np.ndarray]:
+    """Uniform float32 images [n, *shape] + 1-based float labels — the
+    shape every perf harness / ``--synthetic`` recipe feed expects
+    (criterion labels are 1-based like the reference)."""
+    rng = seeded_rng(seed)
+    x = rng.rand(n, *shape).astype(np.float32)
+    y = rng.randint(1, classes + 1, n).astype(np.float32)
+    return x, y
+
+
+def token_batch(n: int, seq_len: int, vocab: int, seed: int = SEED_TRAIN,
+                one_based: bool = False
+                ) -> Tuple[np.ndarray, np.ndarray]:
+    """Random token windows [n, seq_len] + next-token targets of the same
+    shape (language-model perf feeds)."""
+    rng = seeded_rng(seed)
+    lo = 1 if one_based else 0
+    x = rng.randint(lo, vocab + lo, (n, seq_len))
+    y = rng.randint(lo, vocab + lo, (n, seq_len))
+    return x, y
+
+
+def gaussian_matrix(shape: Tuple[int, ...], scale: float = 1.0,
+                    seed: int = SEED_TRAIN) -> np.ndarray:
+    """Seeded standard-normal float32 operand (kernel sweeps)."""
+    return (seeded_rng(seed).randn(*shape) * scale).astype(np.float32)
+
+
+def prototype_image_dataset(n: int, seed: int, classes: int = 10,
+                            hw: int = 32
+                            ) -> Tuple[np.ndarray, np.ndarray]:
+    """The convergence-oracle image task: ten fixed random prototypes;
+    a sample is its class prototype under random gain/shift/translation
+    plus heavy pixel noise — linearly inseparable in pixel space (a
+    linear probe plateaus ~60%), so high held-out accuracy requires the
+    conv stack to actually learn.
+
+    Prototypes are the TASK, fixed across splits; ``seed`` only draws
+    the split's samples. At high resolution the prototypes are
+    LOW-FREQUENCY (8x block-upsampled): iid per-pixel prototypes put all
+    class signal at the Nyquist band, which an ImageNet-style stem
+    (7x7/2 conv + pool) averages to nothing — measured as a
+    chance-level flatline on Inception-v1 @224. Returns (uint8 images
+    [n,3,hw,hw], 1-based float labels).
+    """
+    truth = seeded_rng(1234)
+    if hw > 64:
+        base = hw // 8
+        protos = np.repeat(np.repeat(
+            truth.randn(classes, 3, base, base).astype(np.float32),
+            8, axis=2), 8, axis=3)
+    else:
+        protos = truth.randn(classes, 3, hw, hw).astype(np.float32)
+    rng = seeded_rng(seed)
+    ys = rng.randint(0, classes, n)
+    gains = 0.5 + rng.rand(n, 1, 1, 1).astype(np.float32)
+    shifts = rng.randn(n, 3, 1, 1).astype(np.float32) * 0.3
+    xs = protos[ys] * gains + shifts
+    # random translation up to +-hw/10 px (the crop augmentation must cope)
+    t = max(1, hw // 10)
+    for i in range(n):
+        dy, dx = rng.randint(-t, t + 1, 2)
+        xs[i] = np.roll(np.roll(xs[i], dy, axis=1), dx, axis=2)
+    xs += rng.randn(n, 3, hw, hw).astype(np.float32) * 0.6
+    # into u8 range for the device cache
+    xs = np.clip((xs * 32) + 128, 0, 255).astype(np.uint8)
+    return xs, (ys + 1).astype(np.float32)
+
+
+def markov_corpus(n_tokens: int, seed: int, vocab: int = 256,
+                  branch: int = 4) -> Tuple[np.ndarray, float]:
+    """Corpus from a fixed sparse Markov chain + its entropy floor.
+
+    Returns (tokens 0-based, exp(H)) where H is the chain's conditional
+    entropy under the empirical state distribution of THIS sample — the
+    perplexity a perfect model of the transitions would achieve.
+    """
+    truth = seeded_rng(1234)
+    succ = np.stack([truth.choice(vocab, branch, replace=False)
+                     for _ in range(vocab)])
+    probs = truth.dirichlet(np.ones(branch) * 0.7, size=vocab)
+    row_h = -np.sum(probs * np.log(probs), axis=1)
+
+    rng = seeded_rng(seed)
+    toks = np.empty(n_tokens, np.int64)
+    s = rng.randint(vocab)
+    # vectorized-ish generation: draw all uniforms up front
+    us = rng.rand(n_tokens)
+    cum = np.cumsum(probs, axis=1)
+    for i in range(n_tokens):
+        k = np.searchsorted(cum[s], us[i])
+        s = succ[s, min(k, branch - 1)]
+        toks[i] = s
+    visits = np.bincount(toks, minlength=vocab)
+    h = float((row_h * visits).sum() / max(1, visits.sum()))
+    return toks, float(np.exp(h))

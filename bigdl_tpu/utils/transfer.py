@@ -43,7 +43,8 @@ def probe_device_put_chunk(max_mb: int = 96, *, drop_ratio: float = 0.5,
     chosen = 4 << 20
     mb = 4
     while mb <= max_mb:
-        arr = np.random.randint(0, 256, mb << 20, dtype=np.uint8)
+        arr = np.random.RandomState(mb).randint(0, 256, mb << 20,
+                                                dtype=np.uint8)
         t0 = time.time()
         out = jax.device_put(arr, dev)
         out.block_until_ready()

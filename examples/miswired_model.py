@@ -1,0 +1,86 @@
+"""Pre-flight diagnostics walkthrough: a deliberately mis-wired model,
+shown failing twice — first the OLD way (the raw error XLA tracing
+produces, deep in framework internals, naming no layer), then the NEW
+way (``Module.check`` / ``analysis.check_module``: a millisecond
+eval_shape walk that names the exact offending layer path before any
+compilation is attempted).
+
+    python examples/miswired_model.py
+
+The model: a CIFAR-style conv stack whose classifier head was copied
+from an MNIST recipe — ``Linear(1568, 10)`` where the flattened conv
+output is really 2048 wide. A classic wiring slip: every shape is
+plausible, nothing fails until the matmul deep inside the traced step.
+"""
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def build_miswired():
+    import bigdl_tpu.nn as nn
+
+    return (nn.Sequential()
+            .add(nn.SpatialConvolution(3, 32, 5, 5, 1, 1, 2, 2))
+            .add(nn.ReLU())
+            .add(nn.SpatialMaxPooling(2, 2, 2, 2))
+            .add(nn.SpatialConvolution(32, 32, 5, 5, 1, 1, 2, 2))
+            .add(nn.ReLU())
+            .add(nn.SpatialMaxPooling(2, 2, 2, 2))
+            .add(nn.Reshape((32 * 8 * 8,)))
+            # copied from an MNIST recipe: expects 1568 inputs, the
+            # conv stack above actually yields 2048
+            .add(nn.Linear(7 * 7 * 32, 10).set_name("mnist_head"))
+            .add(nn.LogSoftMax()))
+
+
+def raw_error(model) -> str:
+    """What you got WITHOUT the checker: run a batch, harvest the raw
+    trace-time error (after real param init + device work; under jit
+    this surfaces mid-compile with an XLA-internals stack)."""
+    x = np.zeros((16, 3, 32, 32), np.float32)
+    try:
+        model.forward(x)
+    except Exception as e:
+        return f"{type(e).__name__}: {e}"
+    raise AssertionError("the mis-wiring should have failed")
+
+
+def preflight_error(model) -> str:
+    """What you get WITH the checker: zero FLOPs, zero compiles, and the
+    diagnostic names `sequential[7]/mnist_head` directly."""
+    from bigdl_tpu.analysis import ShapeCheckError, spec
+    try:
+        model.check(spec(("b", 3, 32, 32)))
+    except ShapeCheckError as e:
+        return str(e)
+    raise AssertionError("the mis-wiring should have failed")
+
+
+def main(argv=None) -> dict:
+    argparse.ArgumentParser(description=__doc__).parse_args(argv)
+    model = build_miswired()
+
+    pre = preflight_error(model)
+    print("== pre-flight (Module.check, milliseconds, no compile) ==")
+    print(pre)
+
+    raw = raw_error(build_miswired())
+    print()
+    print("== the raw error it replaces (after init + device work) ==")
+    print(raw)
+
+    print()
+    print("The pre-flight names the layer (`sequential[7]/mnist_head`) "
+          "and runs under jax.eval_shape only; the raw path pays real "
+          "initialization and fails inside the matmul with no layer "
+          "attribution. Opt in before training or serving with "
+          "Optimizer.set_preflight_spec(...) / "
+          "ModelRegistry.load(..., input_spec=...).")
+    return {"preflight": pre, "raw": raw}
+
+
+if __name__ == "__main__":
+    main()

@@ -113,6 +113,16 @@ def test_wide_and_deep_example_sparse_feed():
     assert acc > 0.8, acc
 
 
+def test_miswired_model_example():
+    """analysis example: the pre-flight diagnostic names the exact layer
+    path; the raw error it replaces names no layer at all."""
+    from examples.miswired_model import main
+    out = main([])
+    assert "`sequential[7]/mnist_head`" in out["preflight"]
+    assert "dot_general" in out["raw"]
+    assert "mnist_head" not in out["raw"]  # the UX gap being closed
+
+
 def test_online_serving_example(tmp_path):
     """serving example: warm start, batched traffic, int8 hot-swap,
     metrics export — the runnable face of docs/serving.md."""

@@ -1,0 +1,314 @@
+"""Per-rule lint fixtures: every shipped rule fires on a purpose-built
+positive case AND honors a `# bigdl: disable=RULE` suppression, plus
+engine-level behaviors (file suppressions, precision exemptions, JSON)."""
+import json
+
+import pytest
+
+from bigdl_tpu.analysis import (available_rules, format_text, lint_source,
+                                to_json)
+
+HEADER = """\
+import functools
+import random
+import time
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+"""
+
+
+def names(findings, only_active=True):
+    return [f.rule for f in findings
+            if not (only_active and f.suppressed)]
+
+
+def run(body):
+    return lint_source(HEADER + body, "fixture.py")
+
+
+# One (positive, suppressed) source pair per rule. The suppressed variant
+# is the same pitfall with an explicit `# bigdl: disable=<rule>`.
+CASES = {
+    "host-sync": (
+        """
+@jax.jit
+def f(x):
+    y = jnp.sum(x)
+    return float(y)
+""",
+        """
+@jax.jit
+def f(x):
+    y = jnp.sum(x)
+    return float(y)  # bigdl: disable=host-sync
+""",
+    ),
+    "traced-branch": (
+        """
+@jax.jit
+def f(x):
+    y = jnp.sum(x)
+    if y > 0:
+        return y
+    return -y
+""",
+        """
+@jax.jit
+def f(x):
+    y = jnp.sum(x)
+    if y > 0:  # bigdl: disable=traced-branch
+        return y
+    return -y
+""",
+    ),
+    "jnp-in-host-loop": (
+        """
+def feed(batches):
+    out = []
+    for b in batches:
+        out.append(jnp.zeros((128, 128)))
+    return out
+""",
+        """
+def feed(batches):
+    out = []
+    for b in batches:
+        # bigdl: disable=jnp-in-host-loop
+        out.append(jnp.zeros((128, 128)))
+    return out
+""",
+    ),
+    "jit-static-args": (
+        """
+def g(x, mode):
+    if mode:
+        return x * 2
+    return x
+
+f = jax.jit(g)
+""",
+        """
+def g(x, mode):
+    if mode:  # bigdl: disable=jit-static-args
+        return x * 2
+    return x
+
+f = jax.jit(g)
+""",
+    ),
+    "apply-mutates-self": (
+        """
+class Layer:
+    def apply(self, params, state, input, *, training=False, rng=None):
+        self.cache = input
+        return input, state
+""",
+        """
+class Layer:
+    def apply(self, params, state, input, *, training=False, rng=None):
+        self.cache = input  # bigdl: disable=apply-mutates-self
+        return input, state
+""",
+    ),
+    "host-state-in-trace": (
+        """
+@jax.jit
+def f(x):
+    return x * time.time()
+""",
+        """
+@jax.jit
+def f(x):
+    return x * time.time()  # bigdl: disable=host-state-in-trace
+""",
+    ),
+    "global-rng": (
+        """
+def sample(n):
+    return np.random.rand(n)
+""",
+        """
+def sample(n):
+    return np.random.rand(n)  # bigdl: disable=global-rng
+""",
+    ),
+    "bare-except": (
+        """
+def f():
+    try:
+        return 1
+    except:
+        return 2
+""",
+        """
+def f():
+    try:
+        return 1
+    except:  # bigdl: disable=bare-except
+        return 2
+""",
+    ),
+}
+
+
+def test_case_table_covers_every_shipped_rule():
+    assert {r.name for r in available_rules()} == set(CASES)
+
+
+@pytest.mark.parametrize("rule", sorted(CASES))
+def test_rule_fires_on_positive_fixture(rule):
+    positive, _ = CASES[rule]
+    findings = run(positive)
+    assert rule in names(findings), \
+        f"{rule} missed its positive fixture: {format_text(findings)}"
+
+
+@pytest.mark.parametrize("rule", sorted(CASES))
+def test_rule_suppression_is_honored(rule):
+    _, suppressed = CASES[rule]
+    findings = run(suppressed)
+    assert rule not in names(findings), \
+        f"{rule} ignored its suppression: {format_text(findings)}"
+    # the finding is retained as suppressed, not silently dropped
+    assert rule in names(findings, only_active=False)
+
+
+def test_file_level_suppression():
+    _, _ = CASES["bare-except"]
+    src = "# bigdl: disable-file=bare-except\n" + HEADER + CASES[
+        "bare-except"][0]
+    findings = lint_source(src, "fixture.py")
+    assert "bare-except" not in names(findings)
+    assert "bare-except" in names(findings, only_active=False)
+
+
+def test_standalone_comment_suppresses_next_line():
+    body = """
+def f():
+    try:
+        return 1
+    # bigdl: disable=bare-except
+    except:
+        return 2
+"""
+    assert "bare-except" not in names(run(body))
+
+
+# ------------------------------------------------- precision exemptions
+
+def test_static_shape_branch_not_flagged():
+    body = """
+@jax.jit
+def f(x):
+    y = jnp.sum(x, axis=-1)
+    if y.ndim == 1:
+        y = y[None]
+    if x.shape[0] > 4:
+        y = y * 2
+    return y
+"""
+    assert names(run(body)) == []
+
+
+def test_is_none_and_membership_not_flagged():
+    body = """
+@jax.jit
+def f(x, rng=None):
+    cache = {}
+    y = jnp.sum(x)
+    cache["k"] = y
+    if rng is None:
+        return y
+    if "k" in cache:
+        return y * 2
+    return y
+"""
+    assert names(run(body)) == []
+
+
+def test_per_item_loop_construction_not_flagged():
+    body = """
+def stage(chunks):
+    return [jnp.asarray(c) for c in chunks]
+
+def stage2(chunks):
+    out = []
+    for c in chunks:
+        out.append(jnp.asarray(c))
+    return out
+"""
+    assert names(run(body)) == []
+
+
+def test_dataset_transformer_apply_is_not_trace_surface():
+    body = """
+class Normalizer(Transformer):
+    def apply(self, it):
+        for s in it:
+            yield np.asarray(s, np.float32) / 255.0
+"""
+    assert names(run(body)) == []
+
+
+def test_moduleish_subclass_chain_is_trace_surface():
+    body = """
+class Cell(Module):
+    pass
+
+class LSTM(Cell):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        self.h = input
+        return input, state
+"""
+    assert names(run(body)) == ["apply-mutates-self"]
+
+
+def test_intra_class_helper_called_from_apply_is_traced():
+    body = """
+class Layer(Module):
+    def apply(self, params, state, input, *, training=False, rng=None):
+        return self._go(input), state
+
+    def _go(self, x):
+        y = jnp.sum(x)
+        return float(y)
+"""
+    assert names(run(body)) == ["host-sync"]
+
+
+def test_unhashable_static_argument_at_call_site():
+    body = """
+def g(x, shape):
+    return x.reshape(shape)
+
+f = jax.jit(g, static_argnums=(1,))
+y = f(jnp.zeros((4,)), [2, 2])
+"""
+    assert "jit-static-args" in names(run(body))
+
+
+def test_out_of_range_static_argnums():
+    body = """
+def g(x):
+    return x
+
+f = jax.jit(g, static_argnums=(3,))
+"""
+    fs = run(body)
+    assert any(f.rule == "jit-static-args" and "out of range"
+               in f.message for f in fs)
+
+
+def test_json_output_is_stable():
+    findings = run(CASES["bare-except"][0])
+    data = json.loads(to_json(findings))
+    assert any(d["rule"] == "bare-except" for d in data)
+    assert {"rule", "path", "line", "col", "message",
+            "suppressed"} <= set(data[0])
+
+
+def test_parse_error_is_reported_not_raised():
+    fs = lint_source("def broken(:\n", "bad.py")
+    assert [f.rule for f in fs] == ["parse-error"]

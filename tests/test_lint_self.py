@@ -1,0 +1,71 @@
+"""The dogfood gate: the shipped ``bigdl_tpu`` tree must stay clean under
+its own linter and CLI — tier-1 itself is the lint gate, so a PR that
+introduces a JAX pitfall (or breaks a rule's precision) fails here."""
+import os
+import subprocess
+import sys
+
+import bigdl_tpu
+from bigdl_tpu.analysis import format_text, lint_paths
+
+PKG_DIR = os.path.dirname(os.path.abspath(bigdl_tpu.__file__))
+REPO = os.path.dirname(PKG_DIR)
+
+
+def test_package_lints_clean_in_process():
+    findings = lint_paths([PKG_DIR])
+    active = [f for f in findings if not f.suppressed]
+    assert active == [], (
+        "unsuppressed lint findings in bigdl_tpu (fix them or add an "
+        "explicit `# bigdl: disable=RULE`):\n"
+        + format_text(findings))
+
+
+def test_parse_clean_no_parse_errors():
+    findings = lint_paths([PKG_DIR])
+    assert not any(f.rule == "parse-error" for f in findings)
+
+
+def test_check_cli_lint_pass_exits_zero():
+    proc = subprocess.run(
+        [sys.executable, "-m", "bigdl_tpu.tools.check", "bigdl_tpu",
+         "--lint-only"],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 findings" in proc.stdout
+
+
+def test_check_cli_exit_code_on_findings(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f():\n    try:\n        pass\n"
+                   "    except:\n        pass\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "bigdl_tpu.tools.check", str(bad),
+         "--lint-only"],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 1
+    assert "bare-except" in proc.stdout
+
+
+def test_check_cli_list_rules():
+    proc = subprocess.run(
+        [sys.executable, "-m", "bigdl_tpu.tools.check", "--list-rules"],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0
+    for r in ("host-sync", "traced-branch", "jit-static-args",
+              "apply-mutates-self", "bare-except"):
+        assert r in proc.stdout
+
+
+def test_full_check_cli_self_run_clean():
+    """The acceptance gate: `python -m bigdl_tpu.tools.check bigdl_tpu`
+    (lint + whole-zoo shape pass) exits 0 on the shipped tree."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "bigdl_tpu.tools.check", "bigdl_tpu"],
+        cwd=REPO, capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "12/12 zoo models clean" in proc.stdout
