@@ -26,6 +26,17 @@ import time
 REFERENCE_BASELINE_IMGS_PER_SEC = 56.0
 
 
+def _maybe_metrics_snapshot(result):
+    """One flag, default off (BIGDL_METRICS_JSONL=path): append a
+    telemetry snapshot — any phase instruments the run populated plus
+    this result as meta — so BENCH trajectories carry breakdowns, not
+    just the headline number."""
+    jsonl = os.environ.get("BIGDL_METRICS_JSONL")
+    if jsonl:
+        import bigdl_tpu.telemetry as telemetry
+        telemetry.snapshot_to_jsonl(jsonl, meta=dict(result, tool="bench"))
+
+
 def _build_decoded_pool(default_n: int = 256):
     """Synthesize ImageNet-shaped JPEGs (375x500 q90), decode + scale
     shorter side to 256 + center-crop — the decode-once cost real
@@ -190,7 +201,7 @@ def main():
         float(losses.sum())
         dt = time.time() - t0
         imgs_per_sec = batch * scan * iters / dt
-        print(json.dumps({
+        result = {
             "metric":
                 "resnet50_imagenet_train_devcached_imgs_per_sec_per_chip",
             "value": round(imgs_per_sec, 2),
@@ -199,7 +210,9 @@ def main():
                 imgs_per_sec / REFERENCE_BASELINE_IMGS_PER_SEC, 3),
             "first_epoch_decode_imgs_per_sec_per_core":
                 round(decode_rate, 1),
-        }))
+        }
+        print(json.dumps(result))
+        _maybe_metrics_snapshot(result)
         return
 
     if mode == "rotate":
@@ -275,7 +288,7 @@ def main():
             rot.rotate()
         dt = t_end - t0
         imgs_per_sec = batch * done / dt
-        print(json.dumps({
+        result = {
             "metric":
                 "resnet50_imagenet_train_shardrotate_imgs_per_sec_per_chip",
             "value": round(imgs_per_sec, 2),
@@ -287,7 +300,9 @@ def main():
             "chunk_bytes": rot.chunk_bytes,
             "first_epoch_decode_imgs_per_sec_per_core":
                 round(decode_rate, 1),
-        }))
+        }
+        print(json.dumps(result))
+        _maybe_metrics_snapshot(result)
         return
 
     if mode == "fed":
@@ -331,7 +346,7 @@ def main():
         finally:
             loader.close()
         imgs_per_sec = batch * scan * iters / dt
-        print(json.dumps({
+        result = {
             "metric": "resnet50_imagenet_train_fed_imgs_per_sec_per_chip",
             "value": round(imgs_per_sec, 2),
             "unit": "images/sec",
@@ -339,7 +354,9 @@ def main():
                 imgs_per_sec / REFERENCE_BASELINE_IMGS_PER_SEC, 3),
             "first_epoch_decode_imgs_per_sec_per_core":
                 round(decode_rate, 1),
-        }))
+        }
+        print(json.dumps(result))
+        _maybe_metrics_snapshot(result)
         return
 
     def scan_body(carry, key):
@@ -396,6 +413,7 @@ def main():
         result["resnet50_inference_imgs_per_sec_per_chip"] = round(
             _bench_inference(model, carry[0], carry[2], batch), 1)
     print(json.dumps(result))
+    _maybe_metrics_snapshot(result)
 
 
 def _bench_inference(model, params, mstate, batch):

@@ -42,11 +42,13 @@ Design notes (vs the reference, /root/reference):
 from bigdl_tpu.utils.table import Table, T
 from bigdl_tpu.utils.random import RandomGenerator
 from bigdl_tpu.utils.engine import Engine
-from bigdl_tpu import nn, optim, dataset, parallel, serving, utils, analysis
+from bigdl_tpu import (nn, optim, dataset, parallel, serving, telemetry,
+                       utils, analysis)
 
 __version__ = "0.1.0"
 
 __all__ = [
     "Table", "T", "RandomGenerator", "Engine",
-    "analysis", "nn", "optim", "dataset", "parallel", "serving", "utils",
+    "analysis", "nn", "optim", "dataset", "parallel", "serving",
+    "telemetry", "utils",
 ]

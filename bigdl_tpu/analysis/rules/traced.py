@@ -92,6 +92,61 @@ def host_state(ctx: FileContext):
                 "jax.random key / pass the value as an argument")
 
 
+#: telemetry entry points that must stay host-side; under trace they run
+#: once at trace time, so every compiled execution replays one frozen
+#: span/count — the trace would lie forever
+_TELEMETRY_FACTORIES = {"counter", "gauge", "histogram"}
+_INSTRUMENT_METHODS = {"inc", "observe", "set", "add"}
+
+
+def _is_telemetry_name(c: str) -> bool:
+    return c.startswith("bigdl_tpu.telemetry.") \
+        or c == "bigdl_tpu.telemetry" \
+        or c.split(".")[0] == "telemetry"
+
+
+@rule("telemetry-in-trace",
+      "telemetry span/instrument call inside traced code")
+def telemetry_in_trace(ctx: FileContext):
+    """Spans and instruments are host-side observability: inside jit/
+    grad/scan-traced code the python runs ONCE at compile time, so the
+    span measures tracing (not execution) and the counter advances once
+    per compile, not per step. Move the call outside the traced
+    function (the optimizer's host loop is the right altitude)."""
+    # names bound from telemetry instrument factories anywhere in the
+    # file (module-level `_STEPS = telemetry.counter(...)` idiom): their
+    # .inc/.observe/.set/.add methods are telemetry surface too
+    instruments = set()
+    for node in ctx.walk(ast.Assign):
+        if not isinstance(node.value, ast.Call):
+            continue
+        c = ctx.canon(node.value.func)
+        if c and _is_telemetry_name(c) \
+                and c.rsplit(".", 1)[-1] in _TELEMETRY_FACTORIES:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    instruments.add(t.id)
+    for node in ctx.walk(ast.Call):
+        if not ctx.in_traced(node):
+            continue
+        c = ctx.canon(node.func)
+        if c is not None and _is_telemetry_name(c):
+            yield node, (
+                f"`{c}` inside traced code runs once at TRACE time — "
+                "the span/instrument records compilation, then never "
+                "fires again; telemetry must stay on the host side of "
+                "the jit boundary")
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _INSTRUMENT_METHODS \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id in instruments:
+            yield node, (
+                f"instrument update `{node.func.value.id}."
+                f"{node.func.attr}()` inside traced code advances once "
+                "per COMPILE, not per execution; hoist it to the host "
+                "loop")
+
+
 @rule("traced-branch",
       "Python control flow branching on a traced value")
 def traced_branch(ctx: FileContext):

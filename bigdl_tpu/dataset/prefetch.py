@@ -11,11 +11,26 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Iterator, Optional
 
 import jax
 
+import bigdl_tpu.telemetry as telemetry
 from bigdl_tpu.dataset.sample import MiniBatch
+
+# data-path instruments: how deep the staged queue runs (is the chip
+# fed?), how long the stager takes to build+put each batch, and how
+# long the consumer stalls waiting on it (the feed bottleneck number)
+_QUEUE_DEPTH = telemetry.gauge("data/prefetch/queue_depth",
+                               "staged device batches waiting")
+_STAGE_S = telemetry.histogram("data/prefetch/stage_s",
+                               "seconds to pull + stage one batch")
+_FETCH_WAIT_S = telemetry.histogram(
+    "data/prefetch/fetch_wait_s",
+    "seconds the consumer blocked waiting for a staged batch")
+_STAGED = telemetry.counter("data/prefetch/staged_batches",
+                            "batches staged to device")
 
 
 def _put(batch: MiniBatch, sharding) -> MiniBatch:
@@ -47,11 +62,23 @@ def device_prefetch(it: Iterator[MiniBatch], *, size: int = 2,
     q: queue.Queue = queue.Queue(maxsize=size)
     _END = object()
     error: list = []
+    it = iter(it)
 
     def stage():
         try:
-            for batch in it:
-                q.put(_put(batch, sharding))
+            while True:
+                t0 = time.perf_counter()
+                batch = next(it, _END)
+                if batch is _END:
+                    # the exhausting pull is not a staged batch: no
+                    # span, no stage_s sample
+                    break
+                with telemetry.span("data/prefetch_stage"):
+                    staged = _put(batch, sharding)
+                _STAGE_S.observe(time.perf_counter() - t0)
+                _STAGED.inc()
+                q.put(staged)
+                _QUEUE_DEPTH.set(q.qsize())
         except BaseException as e:  # re-raised in the consumer
             error.append(e)
         finally:
@@ -60,7 +87,12 @@ def device_prefetch(it: Iterator[MiniBatch], *, size: int = 2,
     t = threading.Thread(target=stage, daemon=True)
     t.start()
     while True:
+        t0 = time.perf_counter()
         item = q.get()
+        if item is not _END:
+            # waiting for the end sentinel is not feed latency
+            _FETCH_WAIT_S.observe(time.perf_counter() - t0)
+        _QUEUE_DEPTH.set(q.qsize())
         if item is _END:
             if error:
                 # a device_put/iterator failure must not masquerade as

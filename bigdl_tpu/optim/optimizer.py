@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import bigdl_tpu.telemetry as telemetry
 from bigdl_tpu.dataset.dataset import AbstractDataSet
 from bigdl_tpu.dataset.sample import MiniBatch
 from bigdl_tpu.nn.module import AUX_LOSS_KEY, Criterion, Module
@@ -40,16 +41,47 @@ from bigdl_tpu.utils.random import RandomGenerator
 
 logger = logging.getLogger("bigdl_tpu")
 
+# process-wide training throughput counters (telemetry registry; the
+# per-run phase times ride the Metrics histograms below)
+_STEP_COUNT = telemetry.counter("train/optimizer/steps",
+                                "optimizer steps completed")
+_RECORD_COUNT = telemetry.counter("train/optimizer/records",
+                                  "training records processed")
+
 
 class Metrics:
     """Named counters (optim/Metrics.scala:31) — host dict, no Spark
-    accumulators needed."""
+    accumulators needed.
 
-    def __init__(self):
+    Migrated onto the telemetry registry: every ``add`` also lands in a
+    ``train/optimizer/<metric>`` histogram, so the TensorBoard /
+    Prometheus / JSONL exporters and ``tools.diagnose`` see the SAME
+    numbers ``summary()`` prints. The local per-run list (and the
+    ``summary()`` format) are unchanged — this class stays the per-run
+    view, the registry the process-wide one."""
+
+    def __init__(self, registry=None):
         self.values: Dict[str, List[float]] = {}
+        self._registry = registry if registry is not None \
+            else telemetry.registry()
+        self._instruments: Dict[str, Any] = {}
+
+    @staticmethod
+    def _slug(name: str) -> str:
+        """'data time' -> 'data_time' (the family/component/metric
+        charset the telemetry-audit gate enforces)."""
+        import re
+        return re.sub(r"[^a-z0-9_]+", "_", name.lower()).strip("_")
 
     def add(self, name: str, value: float):
         self.values.setdefault(name, []).append(value)
+        h = self._instruments.get(name)
+        if h is None:
+            h = self._registry.histogram(
+                f"train/optimizer/{self._slug(name)}",
+                f"Optimizer Metrics series {name!r} (seconds)")
+            self._instruments[name] = h
+        h.observe(value)
 
     def summary(self) -> str:
         parts = []
@@ -804,6 +836,11 @@ class Optimizer:
                 step_args = (inp, tgt)
                 run_step = step
             t_data = time.time() - t0
+            # trace carries the EXACT t_data the Metrics dump reports,
+            # so diagnose's phase attribution and Metrics.summary()
+            # agree to the digit
+            telemetry.record("optimizer/data_wait", t_data,
+                             step=state["neval"])
 
             lr = self.optim_method.update_hyper_parameter()
             rng = RandomGenerator.next_key()
@@ -816,6 +853,10 @@ class Optimizer:
             jax.block_until_ready((params, opt_state, model_state))
             loss_f = _to_scalar(loss)
             t_compute = time.time() - t1
+            telemetry.record("optimizer/compute", t_compute,
+                             step=state["neval"])
+            _STEP_COUNT.inc()
+            _RECORD_COUNT.inc(bsz)
             if rotating:
                 # loss fetch above completed the step; stream the next
                 # shard piece now (alternation rule) and rotate slots at
@@ -882,7 +923,10 @@ class Optimizer:
             # validation / checkpoint triggers (:382-411)
             if (self.validation_trigger is not None
                     and self.validation_trigger(state)):
-                scores = self._validate(params, model_state, eval_step)
+                with telemetry.span("optimizer/validate",
+                                    step=state["neval"]):
+                    scores = self._validate(params, model_state,
+                                            eval_step)
                 if scores:
                     # The first method's result drives maxScore/Plateau —
                     # a max() across heterogeneous methods (e.g. Top1 vs
@@ -899,7 +943,9 @@ class Optimizer:
                                 k, v, state["neval"])
             if (self.checkpoint_trigger is not None
                     and self.checkpoint_trigger(state)):
-                self._checkpoint(params, opt_state, model_state)
+                with telemetry.span("optimizer/checkpoint",
+                                    step=state["neval"]):
+                    self._checkpoint(params, opt_state, model_state)
 
         logger.info("training done in %.1fs; %s", time.time() - wall_start,
                     self.metrics.summary())

@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+import bigdl_tpu.telemetry as telemetry
+
 
 def spmd_pipeline(block_fn: Callable, stage_params, x, *,
                   axis_name: str = "pipe", n_stages: int,
@@ -273,6 +275,24 @@ def pipeline_forward(block_fn: Callable, stacked_params, x, mesh: Mesh, *,
     n_stages = mesh.shape[axis_name]
     b = x.shape[0]
     assert b % n_microbatches == 0, (b, n_microbatches)
+    # telemetry marks the host-side entry into the pipeline collective
+    # (eager calls only: under an enclosing jit the python here runs
+    # once at trace time, where a span would record a lie)
+    pspan = telemetry.NOOP_SPAN if isinstance(x, jax.core.Tracer) \
+        else telemetry.span("parallel/pipeline_forward",
+                            schedule=schedule, stages=n_stages,
+                            microbatches=n_microbatches)
+    with pspan:
+        return _pipeline_forward_impl(block_fn, stacked_params, x, mesh,
+                                      axis_name, n_microbatches, x_spec,
+                                      extra_axes, with_aux, schedule,
+                                      n_rounds, n_stages)
+
+
+def _pipeline_forward_impl(block_fn, stacked_params, x, mesh, axis_name,
+                           n_microbatches, x_spec, extra_axes, with_aux,
+                           schedule, n_rounds, n_stages):
+    b = x.shape[0]
     mb = b // n_microbatches
     xm = x.reshape((n_microbatches, mb) + x.shape[1:])
     if schedule == "interleaved":

@@ -10,11 +10,21 @@ hand-written comms.
 from __future__ import annotations
 
 import re
+import time
 from typing import List, Sequence, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import bigdl_tpu.telemetry as telemetry
+
+_SHARD_PARAMS_S = telemetry.histogram(
+    "parallel/tp/shard_params_s",
+    "seconds laying a param tree out over the mesh")
+_SHARD_OPT_S = telemetry.histogram(
+    "parallel/tp/shard_opt_state_s",
+    "seconds laying ZeRO-1 optimizer state out over the mesh")
 
 Rules = Sequence[Tuple[str, P]]
 
@@ -58,9 +68,18 @@ def put_global(leaf, sharding):
 
 
 def shard_params(params, mesh: Mesh, rules: Rules):
-    """Place the param pytree according to the rules (multi-host-safe)."""
-    return jax.tree.map(put_global, params,
-                        tree_shardings(params, mesh, rules))
+    """Place the param pytree according to the rules (multi-host-safe).
+
+    The host→mesh placement cost (the boundary where AllReduceParameter
+    paid its BlockManager shuffle) is recorded as a
+    ``parallel/shard_params`` span and the
+    ``parallel/tp/shard_params_s`` telemetry histogram."""
+    t0 = time.perf_counter()
+    with telemetry.span("parallel/shard_params"):
+        out = jax.tree.map(put_global, params,
+                           tree_shardings(params, mesh, rules))
+    _SHARD_PARAMS_S.observe(time.perf_counter() - t0)
+    return out
 
 
 def validate_rules(params, mesh: Mesh, rules: Rules) -> List[str]:
@@ -96,4 +115,9 @@ def shard_opt_state_zero1(tree, mesh: Mesh, data_axis: str = "data"):
             spec = P(data_axis, *([None] * (leaf.ndim - 1)))
             return put_global(leaf, NamedSharding(mesh, spec))
         return put_global(leaf, NamedSharding(mesh, P()))
-    return jax.tree.map(put, tree)
+
+    t0 = time.perf_counter()
+    with telemetry.span("parallel/shard_opt_state_zero1"):
+        out = jax.tree.map(put, tree)
+    _SHARD_OPT_S.observe(time.perf_counter() - t0)
+    return out

@@ -35,6 +35,7 @@ from typing import Callable, Deque, List, Optional
 
 import numpy as np
 
+import bigdl_tpu.telemetry as telemetry
 from bigdl_tpu.serving.compile_cache import BucketLadder
 
 
@@ -58,20 +59,156 @@ class _Request:
 
 
 class BatcherStats:
-    """Thread-safe counters + a bounded latency reservoir (ms)."""
+    """Batcher counters, routed through a telemetry
+    :class:`~bigdl_tpu.telemetry.MetricsRegistry` (series are labelled
+    ``model=<name>``, so one service's batchers share instruments and
+    every exporter sees them).
 
-    def __init__(self, reservoir: int = 2048):
+    The pre-telemetry attribute surface (``requests``, ``timed_out``,
+    ``latencies_ms``, ... and the public ``lock``) is preserved as
+    read-only views — ``InferenceService.metrics()`` and existing
+    callers read the exact same shapes as before."""
+
+    def __init__(self, reservoir: int = 2048, registry=None,
+                 model: str = "model"):
         self.lock = threading.Lock()
-        self.requests = 0
-        self.rows = 0
-        self.rejected = 0
-        self.timed_out = 0
-        self.errors = 0
-        self.batches = 0
-        self.batched_rows = 0
-        self.padded_rows = 0
-        self.fill_sum = 0.0
-        self.latencies_ms: Deque[float] = deque(maxlen=reservoir)
+        r = registry if registry is not None \
+            else telemetry.MetricsRegistry()
+        self.registry = r
+        self._labels = {"model": model}
+        self._c_requests = r.counter(
+            "serving/batcher/requests", "requests admitted")
+        self._c_rows = r.counter(
+            "serving/batcher/rows", "request rows admitted")
+        self._c_rejected = r.counter(
+            "serving/batcher/rejected",
+            "requests rejected at admission (QueueFull)")
+        self._c_timed_out = r.counter(
+            "serving/batcher/timed_out",
+            "requests failed past their deadline (deadline misses)")
+        self._c_errors = r.counter(
+            "serving/batcher/errors", "requests failed by a batch error")
+        self._c_batches = r.counter(
+            "serving/batcher/batches", "batches dispatched")
+        self._c_batched_rows = r.counter(
+            "serving/batcher/batched_rows",
+            "real rows dispatched in batches")
+        self._c_padded_rows = r.counter(
+            "serving/batcher/padded_rows",
+            "pad rows added to reach bucket rungs")
+        self._c_fill_sum = r.counter(
+            "serving/batcher/fill_sum", "sum of per-batch fill ratios")
+        self._h_latency = r.histogram(
+            "serving/batcher/latency_ms",
+            "request latency enqueue -> result (ms)",
+            reservoir_size=reservoir)
+        self._h_queue_wait = r.histogram(
+            "serving/batcher/queue_wait_ms",
+            "request wait enqueue -> batch dispatch (ms)",
+            reservoir_size=reservoir)
+        self._h_batch_rows = r.histogram(
+            "serving/batcher/batch_rows",
+            "real rows per dispatched batch", reservoir_size=reservoir)
+        self._g_depth = r.gauge(
+            "serving/batcher/queue_depth", "requests waiting in queue")
+
+    # -- writers (called by MicroBatcher only) ---------------------------
+    def on_reject(self) -> None:
+        """Count one QueueFull admission rejection."""
+        with self.lock:
+            self._c_rejected.inc(**self._labels)
+
+    def on_submit(self, rows: int) -> None:
+        """Count one admitted request of ``rows`` rows."""
+        with self.lock:
+            self._c_requests.inc(**self._labels)
+            self._c_rows.inc(rows, **self._labels)
+
+    def on_timeout(self) -> None:
+        """Count one deadline miss."""
+        with self.lock:
+            self._c_timed_out.inc(**self._labels)
+
+    def on_error(self, n_requests: int) -> None:
+        """Count ``n_requests`` failed by one batch error."""
+        with self.lock:
+            self._c_errors.inc(n_requests, **self._labels)
+
+    def on_batch(self, rows: int, bucket: int) -> None:
+        """Count one dispatched batch of ``rows`` real rows padded to
+        ``bucket``."""
+        with self.lock:
+            self._c_batches.inc(**self._labels)
+            self._c_batched_rows.inc(rows, **self._labels)
+            self._c_padded_rows.inc(bucket - rows, **self._labels)
+            self._c_fill_sum.inc(rows / bucket, **self._labels)
+            self._h_batch_rows.observe(rows, **self._labels)
+
+    def on_latency(self, ms: float) -> None:
+        """Record one request's enqueue->result latency."""
+        self._h_latency.observe(ms, **self._labels)
+
+    def on_queue_wait(self, ms: float) -> None:
+        """Record one request's enqueue->dispatch wait."""
+        self._h_queue_wait.observe(ms, **self._labels)
+
+    def on_depth(self, depth: int) -> None:
+        """Publish the current queue depth."""
+        self._g_depth.set(depth, **self._labels)
+
+    # -- legacy read surface ---------------------------------------------
+    def _count(self, c) -> int:
+        return int(c.value(**self._labels))
+
+    @property
+    def requests(self) -> int:
+        """Requests admitted."""
+        return self._count(self._c_requests)
+
+    @property
+    def rows(self) -> int:
+        """Request rows admitted."""
+        return self._count(self._c_rows)
+
+    @property
+    def rejected(self) -> int:
+        """Requests rejected at admission."""
+        return self._count(self._c_rejected)
+
+    @property
+    def timed_out(self) -> int:
+        """Requests failed past their deadline."""
+        return self._count(self._c_timed_out)
+
+    @property
+    def errors(self) -> int:
+        """Requests failed by a batch error."""
+        return self._count(self._c_errors)
+
+    @property
+    def batches(self) -> int:
+        """Batches dispatched."""
+        return self._count(self._c_batches)
+
+    @property
+    def batched_rows(self) -> int:
+        """Real rows dispatched."""
+        return self._count(self._c_batched_rows)
+
+    @property
+    def padded_rows(self) -> int:
+        """Pad rows added."""
+        return self._count(self._c_padded_rows)
+
+    @property
+    def fill_sum(self) -> float:
+        """Sum of per-batch fill ratios."""
+        return self._c_fill_sum.value(**self._labels)
+
+    @property
+    def latencies_ms(self) -> List[float]:
+        """The bounded latency reservoir (ms, oldest first)."""
+        return self._h_latency.samples(**self._labels)
 
 
 class MicroBatcher:
@@ -81,7 +218,8 @@ class MicroBatcher:
 
     def __init__(self, run_batch: Callable[[np.ndarray], np.ndarray],
                  ladder: BucketLadder, *, max_wait_ms: float = 2.0,
-                 max_queue: int = 256, name: str = "model"):
+                 max_queue: int = 256, name: str = "model",
+                 metrics=None):
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         self._run_batch = run_batch
@@ -89,7 +227,10 @@ class MicroBatcher:
         self._max_wait = max_wait_ms / 1000.0
         self._max_queue = max_queue
         self._name = name
-        self.stats = BatcherStats()
+        # ``metrics``: the telemetry MetricsRegistry to report through
+        # (an InferenceService passes its own so concurrent services
+        # don't mix counts); default is a private registry
+        self.stats = BatcherStats(registry=metrics, model=name)
         #: (feature_shape, dtype) CONFIRMED by the first successful
         #: dispatch; requests coalesce into ONE ndarray, so a mismatch
         #: must be rejected at admission (its whole batch would fail
@@ -149,14 +290,12 @@ class MicroBatcher:
                     f"established {ref[0]}/{ref[1]} — one "
                     "micro-batched service serves one input signature")
             if len(self._queue) >= self._max_queue:
-                with self.stats.lock:
-                    self.stats.rejected += 1
+                self.stats.on_reject()
                 raise QueueFull(
                     f"{self._name}: queue at max depth {self._max_queue}")
             self._queue.append(req)
-            with self.stats.lock:
-                self.stats.requests += 1
-                self.stats.rows += req.n_rows
+            self.stats.on_submit(req.n_rows)
+            self.stats.on_depth(len(self._queue))
             self._cond.notify_all()
         return req.future
 
@@ -196,8 +335,7 @@ class MicroBatcher:
             r = self._queue[0]
             if r.deadline is not None and r.deadline < window_open:
                 self._queue.popleft()
-                with self.stats.lock:
-                    self.stats.timed_out += 1
+                self.stats.on_timeout()
                 r.future.set_exception(DeadlineExceeded(
                     f"{self._name}: request waited past its deadline"))
                 continue
@@ -227,16 +365,22 @@ class MicroBatcher:
                         break
                     self._cond.wait(timeout=remaining)
                 batch, rows = self._take_batch_locked(window_open)
+                self.stats.on_depth(len(self._queue))
             if batch:
                 self._dispatch(batch, rows)
 
     def _dispatch(self, batch: List[_Request], rows: int) -> None:
         bucket = self._ladder.bucket_for(rows)
         from bigdl_tpu.optim.predictor import pad_rows
+        t_dispatch = time.monotonic()
+        for r in batch:
+            self.stats.on_queue_wait((t_dispatch - r.t_enqueue) * 1000.0)
         x = np.concatenate([r.x for r in batch], axis=0) \
             if len(batch) > 1 else batch[0].x
         try:
-            out = np.asarray(self._run_batch(pad_rows(x, bucket)))
+            with telemetry.span("serving/batch", model=self._name,
+                                rows=rows, bucket=bucket):
+                out = np.asarray(self._run_batch(pad_rows(x, bucket)))
             if out.shape[:1] != (bucket,):
                 # a row-reducing model would otherwise scatter empty/
                 # truncated slices into futures that "succeed"
@@ -245,8 +389,7 @@ class MicroBatcher:
                     f"for a {bucket}-row padded batch; serving requires "
                     "one output row per input row")
         except Exception as e:  # noqa: BLE001 — failures go to futures
-            with self.stats.lock:
-                self.stats.errors += len(batch)
+            self.stats.on_error(len(batch))
             for r in batch:
                 if not r.future.cancelled():
                     r.future.set_exception(e)
@@ -257,14 +400,9 @@ class MicroBatcher:
                 # name serves exactly this signature
                 self._sig = (x.shape[1:], x.dtype)
         t_done = time.monotonic()
-        with self.stats.lock:
-            self.stats.batches += 1
-            self.stats.batched_rows += rows
-            self.stats.padded_rows += bucket - rows
-            self.stats.fill_sum += rows / bucket
-            for r in batch:
-                self.stats.latencies_ms.append(
-                    (t_done - r.t_enqueue) * 1000.0)
+        self.stats.on_batch(rows, bucket)
+        for r in batch:
+            self.stats.on_latency((t_done - r.t_enqueue) * 1000.0)
         off = 0
         for r in batch:
             if not r.future.cancelled():

@@ -17,9 +17,12 @@ compiles every rung so the first real request never eats a compile.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+import bigdl_tpu.telemetry as telemetry
 
 
 class BucketLadder:
@@ -86,26 +89,77 @@ class CompileCache:
     the quantity the acceptance tests bound.
     """
 
-    def __init__(self):
+    def __init__(self, metrics=None):
         self._lock = threading.Lock()
         self._steps: Dict = {}
         self._compiles: Dict[Tuple, int] = {}
+        # telemetry registry to report hit/miss/compile-duration
+        # through (an InferenceService passes its own); the cache works
+        # identically without one
+        r = metrics if metrics is not None else telemetry.MetricsRegistry()
+        self._m_hits = r.counter(
+            "serving/compile_cache/hits",
+            "step executions served by an already-compiled program")
+        self._m_misses = r.counter(
+            "serving/compile_cache/misses",
+            "step executions that paid an XLA compile")
+        self._m_compile_s = r.histogram(
+            "serving/compile_cache/compile_s",
+            "seconds per compiling execution (trace+compile+first run)")
+
+    @staticmethod
+    def _model_label(key) -> str:
+        # registry keys are (name, version); fall back to str(key)
+        if isinstance(key, tuple) and key and isinstance(key[0], str):
+            return key[0]
+        return str(key)
 
     def step_for(self, key, model):
         """The (cached) jitted eval step for ``key``; builds it on first
-        use with a trace hook wired to this cache's counter."""
+        use with a trace hook wired to this cache's counter. The
+        returned callable times itself: an execution that triggered a
+        trace counts as a cache miss (its wall-clock lands in the
+        ``serving/compile_cache/compile_s`` histogram), every other
+        execution as a hit."""
         with self._lock:
             step = self._steps.get(key)
-            if step is None:
-                from bigdl_tpu.optim.predictor import make_eval_step
+            if step is not None:
+                return step
+        from bigdl_tpu.optim.predictor import make_eval_step
 
-                def on_trace(key=key):
-                    with self._lock:
-                        self._compiles[key] = self._compiles.get(key, 0) + 1
+        label = self._model_label(key)
+        # compiles already charged to the miss series; the delta against
+        # _compiles attributes each trace to exactly ONE executing call
+        # (two requests racing the first compile must not both count a
+        # miss — the series would contradict compile_count)
+        counted = [0]
 
-                step = make_eval_step(model, on_trace=on_trace)
-                self._steps[key] = step
-            return step
+        def on_trace(key=key):
+            with self._lock:
+                self._compiles[key] = self._compiles.get(key, 0) + 1
+
+        jitted = make_eval_step(model, on_trace=on_trace)
+
+        def step(params, state, x):
+            t0 = time.perf_counter()
+            out = jitted(params, state, x)
+            dt = time.perf_counter() - t0
+            with self._lock:
+                total = self._compiles.get(key, 0)
+                fresh = total - counted[0]
+                counted[0] = total
+            if fresh > 0:  # this call (or one it raced) compiled
+                self._m_misses.inc(fresh, model=label)
+                self._m_compile_s.observe(dt, model=label)
+            else:
+                self._m_hits.inc(model=label)
+            return out
+
+        with self._lock:
+            # two racing builders: keep the first registered step so
+            # the trace counter stays tied to the cached callable
+            cached = self._steps.setdefault(key, step)
+        return cached
 
     def compile_count(self, key=None) -> int:
         """Compilations so far — for ``key``, or in total when None."""

@@ -23,6 +23,7 @@ from typing import Dict, Optional, Sequence
 
 import numpy as np
 
+import bigdl_tpu.telemetry as telemetry
 from bigdl_tpu.serving.batcher import MicroBatcher
 from bigdl_tpu.serving.compile_cache import BucketLadder, CompileCache
 from bigdl_tpu.serving.registry import ModelRegistry, Servable
@@ -50,12 +51,20 @@ class InferenceService:
     serving metrics (module docstring has the wiring)."""
 
     def __init__(self, registry: Optional[ModelRegistry] = None,
-                 config: Optional[ServingConfig] = None):
+                 config: Optional[ServingConfig] = None,
+                 metrics_registry=None):
         self.registry = registry or ModelRegistry()
         self.config = config or ServingConfig()
         self.ladder = BucketLadder(self.config.max_batch_size,
                                    self.config.buckets)
-        self.cache = CompileCache()
+        # every serving instrument (batcher admission, compile cache,
+        # latency reservoirs) reports through ONE telemetry registry,
+        # private to this service by default so concurrent services /
+        # tests never mix counts; pass telemetry.registry() to land the
+        # series in the process-wide pane instead
+        self.metrics_registry = metrics_registry \
+            if metrics_registry is not None else telemetry.MetricsRegistry()
+        self.cache = CompileCache(metrics=self.metrics_registry)
         # guards _batchers + _shut_down: batcher creation must be
         # once-per-name (a MicroBatcher owns a dispatch thread) and
         # must not race shutdown's iteration
@@ -140,7 +149,8 @@ class InferenceService:
                 b = MicroBatcher(run_batch, self.ladder,
                                  max_wait_ms=self.config.max_wait_ms,
                                  max_queue=self.config.max_queue,
-                                 name=name)
+                                 name=name,
+                                 metrics=self.metrics_registry)
                 self._batchers[name] = b
         return b
 
@@ -184,7 +194,12 @@ class InferenceService:
                    for v in self.registry.versions(name))
 
     def metrics(self, name: str) -> Dict[str, float]:
-        """Point-in-time serving stats for one model name."""
+        """Point-in-time serving stats for one model name.
+
+        The values are read from this service's telemetry registry
+        (``self.metrics_registry`` — the same series the
+        TensorBoard/Prometheus/JSONL exporters render); the key shapes
+        predate the registry and stay byte-compatible."""
         from bigdl_tpu.utils.profiling import percentile_summary
         with self._lock:
             b = self._batchers.get(name)
@@ -216,7 +231,11 @@ class InferenceService:
     def export_metrics(self, summary, step: int) -> None:
         """Write every model's metrics as ``serving/<name>/<metric>``
         scalars through a ``visualization.summary.Summary`` writer —
-        the same TensorBoard path training curves use."""
+        the same TensorBoard path training curves use. The values are
+        the registry-backed :meth:`metrics` rows (tag shapes
+        unchanged); for the raw instrument series use
+        ``telemetry.TensorBoardExporter(self.metrics_registry, ...)``
+        or ``telemetry.write_prometheus`` on the same registry."""
         for name in self.registry.names():
             for metric, value in self.metrics(name).items():
                 summary.add_scalar(f"serving/{name}/{metric}",

@@ -1,0 +1,171 @@
+"""bigdl_tpu.telemetry — unified host-side observability.
+
+One subsystem answers "where did this step's wall-clock go": a
+**span tracer** (nested, thread-aware spans into a bounded ring buffer,
+exportable as Chrome trace-event JSON for Perfetto/``chrome://tracing``)
+plus a **metrics registry** (named Counter/Gauge/Histogram instruments
+with labels, exportable as TensorBoard scalars, Prometheus text, or
+JSONL snapshots). The optimizer's step phases, the dataset prefetcher,
+the serving batcher/compile-cache, checkpoints and the ``parallel/``
+collective boundaries all report through it; ``python -m
+bigdl_tpu.tools.diagnose`` renders the where-did-the-time-go report.
+
+Usage::
+
+    from bigdl_tpu import telemetry
+
+    telemetry.enable()                      # or BIGDL_TELEMETRY=1
+    with telemetry.span("optimizer/step", step=i):
+        ...
+    telemetry.export_chrome_trace("trace.json")   # load in Perfetto
+
+    reqs = telemetry.counter("serving/batcher/requests", "...")
+    reqs.inc(model="lenet")
+
+**Disabled is the default and costs almost nothing**: ``span()`` checks
+one module flag and returns a shared no-op context manager — no clock
+read, no allocation, no background thread, no file (a micro-benchmark
+test asserts the bound). Instruments are always live (they are plain
+counters; serving's public stats depend on them) but create no threads
+or files either — only explicitly constructed exporters touch disk.
+
+Telemetry is **host-side only**: a ``span``/``inc`` inside jit/grad/
+scan-traced code would run once at trace time and then lie forever; the
+``telemetry-in-trace`` lint rule (``python -m bigdl_tpu.tools.check``)
+flags exactly that.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from bigdl_tpu.telemetry.export import (JsonlExporter, TensorBoardExporter,
+                                        parse_prometheus_text,
+                                        prometheus_text, read_jsonl,
+                                        scalarize, write_prometheus)
+from bigdl_tpu.telemetry.metrics import (NAME_RE, Counter, Gauge, Histogram,
+                                         MetricsRegistry, audit_names)
+from bigdl_tpu.telemetry.tracer import NOOP_SPAN, SpanRecord, SpanTracer
+
+__all__ = [
+    "span", "record", "enable", "disable", "enabled", "tracer",
+    "export_chrome_trace", "registry", "counter", "gauge", "histogram",
+    "snapshot_to_jsonl",
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "SpanTracer",
+    "SpanRecord", "TensorBoardExporter", "JsonlExporter",
+    "write_prometheus", "prometheus_text", "parse_prometheus_text",
+    "read_jsonl", "scalarize", "audit_names", "NAME_RE",
+]
+
+# -- the process-wide tracer ---------------------------------------------
+# _ENABLED is the ONE flag the span() fast path reads; the tracer object
+# itself is created lazily so a disabled process allocates nothing.
+_ENABLED = False
+_TRACER: Optional[SpanTracer] = None
+
+# -- the process-wide default metrics registry ---------------------------
+_REGISTRY = MetricsRegistry()
+
+
+def enabled() -> bool:
+    """Whether span tracing is currently on."""
+    return _ENABLED
+
+
+def enable(capacity: Optional[int] = None) -> SpanTracer:
+    """Turn span tracing on (idempotent); returns the tracer.
+
+    An explicit ``capacity`` re-bounds the ring (keeping the newest
+    spans) even when the tracer already exists — a memory-bounding
+    request must not be silently dropped just because ``tracer()`` was
+    touched first; omitted, the existing buffer (default 65536) is
+    kept."""
+    global _ENABLED, _TRACER
+    if _TRACER is None:
+        _TRACER = SpanTracer(capacity if capacity is not None else 65536)
+    elif capacity is not None and capacity != _TRACER.capacity:
+        _TRACER.set_capacity(capacity)
+    _ENABLED = True
+    return _TRACER
+
+
+def disable() -> None:
+    """Turn span tracing off; recorded spans stay readable via
+    ``tracer()`` until ``enable()`` is called again or they rotate
+    out of the ring."""
+    global _ENABLED
+    _ENABLED = False
+
+
+def tracer() -> SpanTracer:
+    """The process tracer (created on first use, even if disabled —
+    lets tests inspect an empty buffer)."""
+    global _TRACER
+    if _TRACER is None:
+        _TRACER = SpanTracer()
+    return _TRACER
+
+
+def span(name: str, **args):
+    """Measure the enclosed block as one named span.
+
+    Disabled fast path: one flag check, then a shared no-op context
+    manager — safe to leave in production hot loops."""
+    if not _ENABLED:
+        return NOOP_SPAN
+    return _TRACER.span(name, args or None)
+
+
+def record(name: str, duration_s: float, **args) -> None:
+    """Log a pre-measured interval ending now (no-op when disabled).
+
+    This is how the optimizer ships its exact ``t_data``/``t_compute``
+    numbers into the trace, so trace phase sums and
+    ``Metrics.summary()`` agree to the digit."""
+    if not _ENABLED:
+        return
+    _TRACER.record(name, duration_s, args or None)
+
+
+def export_chrome_trace(path: str) -> int:
+    """Write the tracer's ring buffer as Chrome trace-event JSON
+    (Perfetto / ``chrome://tracing``); returns the span-event count."""
+    return tracer().export_chrome_trace(path)
+
+
+def registry() -> MetricsRegistry:
+    """The process-wide default metrics registry (training/data paths
+    report here; an ``InferenceService`` holds its own so concurrent
+    services don't mix counts)."""
+    return _REGISTRY
+
+
+def counter(name: str, description: str = "") -> Counter:
+    """Get-or-create a Counter in the default registry."""
+    return _REGISTRY.counter(name, description)
+
+
+def gauge(name: str, description: str = "") -> Gauge:
+    """Get-or-create a Gauge in the default registry."""
+    return _REGISTRY.gauge(name, description)
+
+
+def histogram(name: str, description: str = "",
+              reservoir_size: int = 2048) -> Histogram:
+    """Get-or-create a Histogram in the default registry."""
+    return _REGISTRY.histogram(name, description, reservoir_size)
+
+
+def snapshot_to_jsonl(path: str, step: Optional[int] = None,
+                      meta: Optional[dict] = None) -> dict:
+    """Append one default-registry snapshot line to ``path`` — the
+    one-call form ``tools/perf``, ``tools/ceiling`` and ``bench.py``
+    use (flag / ``BIGDL_METRICS_JSONL``) so BENCH trajectories carry
+    phase breakdowns; returns the record written."""
+    return JsonlExporter(_REGISTRY, path).export(step=step, meta=meta)
+
+
+# opt-in via environment, for instrumenting existing entry points
+# without code changes (BIGDL_TELEMETRY=1 python -m bigdl_tpu.tools.perf)
+if os.environ.get("BIGDL_TELEMETRY", "").strip() not in ("", "0"):
+    enable()

@@ -1,0 +1,249 @@
+"""Exporters: one ``MetricsRegistry.snapshot()`` rendered three ways.
+
+- :class:`TensorBoardExporter` — scalars through the existing
+  ``visualization.tensorboard.FileWriter`` (the same event files
+  training curves live in; ``FileReader.read_scalar`` reads them back).
+- :func:`write_prometheus` / :func:`parse_prometheus_text` — the
+  Prometheus text exposition format as a file (node-exporter textfile
+  style), with proper label escaping; the parser exists so tests
+  round-trip it and ``tools.diagnose`` can ingest it.
+- :class:`JsonlExporter` / :func:`read_jsonl` — append-only JSONL
+  snapshots (one self-contained JSON object per line) for offline
+  trajectory analysis; ``tools/perf``, ``tools/ceiling`` and
+  ``bench.py`` emit these behind a flag so BENCH runs carry phase
+  breakdowns, not just totals.
+
+All three render the SAME snapshot rows, so counter totals agree
+across exporters by construction (asserted in tests).
+"""
+from __future__ import annotations
+
+import json
+import re
+import time
+from typing import Dict, List, Optional, Tuple
+
+from bigdl_tpu.telemetry.metrics import MetricsRegistry
+
+__all__ = ["TensorBoardExporter", "JsonlExporter", "write_prometheus",
+           "prometheus_text", "parse_prometheus_text", "read_jsonl",
+           "scalarize"]
+
+
+def scalarize(snapshot: List[dict]) -> Dict[str, float]:
+    """Flatten snapshot rows to ``{tag: value}`` scalars.
+
+    Tags are ``name[label=value,...]`` for labelled series (labels
+    sorted), bare ``name`` otherwise; histograms emit ``.count``,
+    ``.sum`` and percentile sub-tags. Every exporter and the diagnose
+    report read THIS flattening, so the three outputs can never
+    disagree on a value."""
+    out: Dict[str, float] = {}
+    for row in snapshot:
+        for s in row["series"]:
+            labels = s.get("labels") or {}
+            tag = row["name"]
+            if labels:
+                inner = ",".join(f"{k}={v}"
+                                 for k, v in sorted(labels.items()))
+                tag = f"{tag}[{inner}]"
+            if row["kind"] == "histogram":
+                out[f"{tag}.count"] = float(s["count"])
+                out[f"{tag}.sum"] = float(s["sum"])
+                for k, v in s.items():
+                    if k.startswith("p") and k[1:].isdigit():
+                        out[f"{tag}.{k}"] = float(v)
+            else:
+                out[tag] = float(s["value"])
+    return out
+
+
+class TensorBoardExporter:
+    """Write registry snapshots as TensorBoard scalars.
+
+    One ``export(step)`` call per cadence point; tags are the
+    ``scalarize`` flattening (slashes render as TensorBoard groups, so
+    ``serving/batcher/requests`` lands in a ``serving`` card next to
+    the training curves). Reuses ``visualization.tensorboard
+    .FileWriter`` — same wire format, readable back via
+    ``FileReader.read_scalar``."""
+
+    def __init__(self, registry: MetricsRegistry, log_dir: str):
+        from bigdl_tpu.visualization.tensorboard import FileWriter
+        self.registry = registry
+        self.log_dir = log_dir
+        self.writer = FileWriter(log_dir)
+
+    def export(self, step: int) -> int:
+        """Write the current snapshot at ``step``; returns scalar
+        count."""
+        scalars = scalarize(self.registry.snapshot())
+        for tag, value in scalars.items():
+            self.writer.add_scalar(tag, value, step)
+        return len(scalars)
+
+    def flush(self) -> None:
+        """Block until exported events are on disk."""
+        self.writer.flush()
+
+    def close(self) -> None:
+        """Flush and stop the writer thread."""
+        self.writer.close()
+
+
+# ------------------------------------------------------------- Prometheus
+
+def _prom_name(name: str, suffix: str = "") -> str:
+    # family/component/metric -> family_component_metric
+    return name.replace("/", "_").replace("-", "_") + suffix
+
+
+def _prom_escape(value: str) -> str:
+    return (value.replace("\\", r"\\").replace("\n", r"\n")
+            .replace('"', r'\"'))
+
+
+def _prom_unescape(value: str) -> str:
+    out, i = [], 0
+    while i < len(value):
+        c = value[i]
+        if c == "\\" and i + 1 < len(value):
+            nxt = value[i + 1]
+            if nxt == "n":
+                out.append("\n")
+            elif nxt in ('"', "\\"):
+                out.append(nxt)
+            else:
+                out.append(c + nxt)
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def _prom_labels(labels: Dict[str, str],
+                 extra: Optional[List[Tuple[str, str]]] = None) -> str:
+    pairs = [(k, str(v)) for k, v in sorted(labels.items())]
+    pairs += extra or []
+    if not pairs:
+        return ""
+    inner = ",".join(f'{k}="{_prom_escape(v)}"' for k, v in pairs)
+    return "{" + inner + "}"
+
+
+def prometheus_text(snapshot: List[dict]) -> str:
+    """Render a snapshot in the Prometheus text exposition format.
+
+    Counters/gauges map directly; histograms export as summaries
+    (``{quantile="0.5"}`` series plus ``_sum``/``_count``), which is
+    what the percentile reservoir actually holds."""
+    lines: List[str] = []
+    for row in snapshot:
+        name = _prom_name(row["name"])
+        ptype = {"counter": "counter", "gauge": "gauge",
+                 "histogram": "summary"}[row["kind"]]
+        if row["description"]:
+            lines.append(f"# HELP {name} "
+                         f"{_prom_escape(row['description'])}")
+        lines.append(f"# TYPE {name} {ptype}")
+        for s in row["series"]:
+            labels = s.get("labels") or {}
+            if row["kind"] == "histogram":
+                for k, v in sorted(s.items()):
+                    if k.startswith("p") and k[1:].isdigit():
+                        q = str(int(k[1:]) / 100.0)
+                        lines.append(
+                            f"{name}"
+                            f"{_prom_labels(labels, [('quantile', q)])}"
+                            f" {_fmt(v)}")
+                lines.append(f"{name}_sum{_prom_labels(labels)} "
+                             f"{_fmt(s['sum'])}")
+                lines.append(f"{name}_count{_prom_labels(labels)} "
+                             f"{_fmt(s['count'])}")
+            else:
+                lines.append(f"{name}{_prom_labels(labels)} "
+                             f"{_fmt(s['value'])}")
+    return "\n".join(lines) + "\n"
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    if f != f:
+        return "NaN"  # prometheus text legally carries NaN/±Inf
+    if f in (float("inf"), float("-inf")):
+        return "+Inf" if f > 0 else "-Inf"
+    return repr(int(f)) if f == int(f) else repr(f)
+
+
+def write_prometheus(registry: MetricsRegistry, path: str) -> str:
+    """Write the registry's current snapshot as a Prometheus text file
+    (atomic replace — a scraper never reads a half-written file);
+    returns the rendered text."""
+    import os
+    text = prometheus_text(registry.snapshot())
+    tmp = path + ".part"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)
+    return text
+
+
+_PROM_SERIES = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$')
+_PROM_LABEL = re.compile(
+    r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_prometheus_text(text: str) -> Dict[Tuple, float]:
+    """Parse exposition text back to ``{(name, ((label, value), ...)):
+    value}`` — the round-trip half the escaping tests (and diagnose
+    ingestion) rely on."""
+    out: Dict[Tuple, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _PROM_SERIES.match(line)
+        if not m:
+            raise ValueError(f"unparseable prometheus line: {line!r}")
+        name, raw_labels, value = m.groups()
+        labels = tuple(sorted(
+            (k, _prom_unescape(v))
+            for k, v in _PROM_LABEL.findall(raw_labels or "")))
+        out[(name, labels)] = float(value)
+    return out
+
+
+# ------------------------------------------------------------------ JSONL
+
+class JsonlExporter:
+    """Append-only JSONL snapshots: one self-contained JSON object per
+    ``export()`` call (wall time, optional step/run metadata, full
+    snapshot rows). Files append across runs so a BENCH trajectory
+    accumulates one line per run."""
+
+    def __init__(self, registry: MetricsRegistry, path: str):
+        self.registry = registry
+        self.path = path
+
+    def export(self, step: Optional[int] = None,
+               meta: Optional[dict] = None) -> dict:
+        """Append one snapshot line; returns the record written."""
+        rec = {"wall_time": time.time(), "step": step,
+               "meta": meta or {},
+               "metrics": self.registry.snapshot()}
+        with open(self.path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        return rec
+
+
+def read_jsonl(path: str) -> List[dict]:
+    """Read every snapshot record from a JSONL metrics file."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out

@@ -1,0 +1,294 @@
+"""Metrics registry: named Counter/Gauge/Histogram instruments with
+label support.
+
+The single pane every subsystem's counters report through (the role the
+driver-side ``Metrics`` dump + scattered serving dicts played before):
+the optimizer's phase times, the dataset prefetcher's queue depth, the
+serving batcher's admission counters and the compile cache's
+compilation costs all register here, and the ``telemetry.export``
+writers (TensorBoard / Prometheus text / JSONL) read ONE
+``MetricsRegistry.snapshot()`` so every exporter agrees on the numbers
+by construction.
+
+Conventions:
+
+- **names** follow ``family/component/metric`` (lowercase
+  ``[a-z0-9_]``) — ``serving/batcher/requests``,
+  ``train/optimizer/data_time_s``. ``audit_names`` (and
+  ``python -m bigdl_tpu.tools.check --telemetry-audit``) gate the
+  scheme so dashboards can rely on it.
+- **labels** are per-call kwargs (``requests.inc(model="resnet")``);
+  each distinct label set is an independent series.
+- **histograms** keep a bounded sample reservoir and digest it through
+  ``utils.profiling.percentile_summary`` — the same percentile
+  implementation serving latencies always used.
+
+Instruments are cheap (one lock + dict op per update) and always
+active: the serving stats must keep counting whether or not span
+tracing is enabled, because ``InferenceService.metrics()`` is public
+API. Registries create no threads and no files; only exporters do, and
+only when explicitly constructed.
+"""
+from __future__ import annotations
+
+import re
+import threading
+from collections import deque
+from typing import Dict, Iterable, List, Optional, Tuple
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "NAME_RE", "audit_names"]
+
+#: the documented instrument naming scheme: family/component/metric
+NAME_RE = re.compile(r"^[a-z0-9_]+/[a-z0-9_]+/[a-z0-9_]+$")
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Dict[str, str]) -> LabelKey:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class _Instrument:
+    """Shared name/description/lock plumbing for the three kinds."""
+
+    kind = "instrument"
+
+    def __init__(self, name: str, description: str = ""):
+        self.name = name
+        self.description = description
+        self._lock = threading.Lock()
+
+    def label_sets(self) -> List[Dict[str, str]]:
+        """Every label combination this instrument has seen."""
+        with self._lock:
+            return [dict(k) for k in self._series()]
+
+    def _series(self) -> Iterable[LabelKey]:
+        raise NotImplementedError
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}({self.name!r})"
+
+
+class Counter(_Instrument):
+    """Monotonically increasing count (requests, rows, compiles)."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, description: str = ""):
+        super().__init__(name, description)
+        self._values: Dict[LabelKey, float] = {}
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        """Add ``amount`` (>= 0) to the series selected by ``labels``."""
+        if amount < 0:
+            raise ValueError(
+                f"{self.name}: counters only go up (amount={amount}); "
+                "use a Gauge for values that can fall")
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        """Current total for one label set (0.0 if never incremented)."""
+        with self._lock:
+            return self._values.get(_label_key(labels), 0.0)
+
+    def total(self) -> float:
+        """Sum across every label set."""
+        with self._lock:
+            return sum(self._values.values())
+
+    def _series(self):
+        return list(self._values)
+
+
+class Gauge(_Instrument):
+    """Point-in-time level (queue depth, active versions)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, description: str = ""):
+        super().__init__(name, description)
+        self._values: Dict[LabelKey, float] = {}
+
+    def set(self, value: float, **labels) -> None:
+        """Publish the current level for one label set."""
+        with self._lock:
+            self._values[_label_key(labels)] = float(value)
+
+    def add(self, delta: float, **labels) -> None:
+        """Adjust the level by ``delta`` (up or down)."""
+        key = _label_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + delta
+
+    def value(self, **labels) -> float:
+        """Current level for one label set (0.0 if never set)."""
+        with self._lock:
+            return self._values.get(_label_key(labels), 0.0)
+
+    def _series(self):
+        return list(self._values)
+
+
+class _HistoSeries:
+    __slots__ = ("count", "sum", "reservoir")
+
+    def __init__(self, reservoir_size: int):
+        self.count = 0
+        self.sum = 0.0
+        self.reservoir: deque = deque(maxlen=reservoir_size)
+
+
+class Histogram(_Instrument):
+    """Distribution of observations (latencies, batch sizes): exact
+    count/sum plus a bounded reservoir digested through
+    ``utils.profiling.percentile_summary``."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 reservoir_size: int = 2048):
+        super().__init__(name, description)
+        self.reservoir_size = reservoir_size
+        self._values: Dict[LabelKey, _HistoSeries] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        """Record one observation into the series for ``labels``."""
+        key = _label_key(labels)
+        v = float(value)
+        with self._lock:
+            s = self._values.get(key)
+            if s is None:
+                s = self._values[key] = _HistoSeries(self.reservoir_size)
+            s.count += 1
+            s.sum += v
+            s.reservoir.append(v)
+
+    def count(self, **labels) -> int:
+        """Observations recorded for one label set."""
+        with self._lock:
+            s = self._values.get(_label_key(labels))
+            return s.count if s else 0
+
+    def sum(self, **labels) -> float:
+        """Exact sum of every observation for one label set (counts
+        all observations, not just the reservoir)."""
+        with self._lock:
+            s = self._values.get(_label_key(labels))
+            return s.sum if s else 0.0
+
+    def samples(self, **labels) -> List[float]:
+        """The retained reservoir for one label set (newest last)."""
+        with self._lock:
+            s = self._values.get(_label_key(labels))
+            return list(s.reservoir) if s else []
+
+    def percentiles(self, qs=(50, 90, 99), **labels) -> Dict[str, float]:
+        """``{"p50": ...}`` digest of the reservoir via
+        ``utils.profiling.percentile_summary``."""
+        from bigdl_tpu.utils.profiling import percentile_summary
+        return percentile_summary(self.samples(**labels), qs)
+
+    def series_snapshot(self, qs=(50, 90, 99), **labels) -> Dict[str, float]:
+        """Count, sum and percentile digest read under ONE lock
+        acquisition — an exporter scrape taken mid-traffic must not mix
+        a count from one instant with a sum from the next (sum/count
+        averages would lie)."""
+        from bigdl_tpu.utils.profiling import percentile_summary
+        with self._lock:
+            s = self._values.get(_label_key(labels))
+            count = s.count if s else 0
+            total = s.sum if s else 0.0
+            samples = list(s.reservoir) if s else []
+        return {"count": count, "sum": total,
+                **percentile_summary(samples, qs)}
+
+    def _series(self):
+        return list(self._values)
+
+
+_KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+
+class MetricsRegistry:
+    """Named instruments, get-or-create, one snapshot for exporters.
+
+    Subsystems call ``counter/gauge/histogram`` at module scope or
+    construction time; re-requesting a name returns the SAME instrument
+    (so two batchers for one model share series through labels) and a
+    kind conflict raises instead of silently splitting the data.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._instruments: Dict[str, _Instrument] = {}
+
+    def _get(self, cls, name: str, description: str, **kw):
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = self._instruments[name] = cls(name, description,
+                                                     **kw)
+            elif not isinstance(inst, cls):
+                raise ValueError(
+                    f"{name!r} is already registered as a {inst.kind}, "
+                    f"not a {cls.kind}")
+            return inst
+
+    def counter(self, name: str, description: str = "") -> Counter:
+        """Get-or-create the Counter registered under ``name``."""
+        return self._get(Counter, name, description)
+
+    def gauge(self, name: str, description: str = "") -> Gauge:
+        """Get-or-create the Gauge registered under ``name``."""
+        return self._get(Gauge, name, description)
+
+    def histogram(self, name: str, description: str = "",
+                  reservoir_size: int = 2048) -> Histogram:
+        """Get-or-create the Histogram registered under ``name``."""
+        return self._get(Histogram, name, description,
+                         reservoir_size=reservoir_size)
+
+    def names(self) -> List[str]:
+        """Registered instrument names, sorted."""
+        with self._lock:
+            return sorted(self._instruments)
+
+    def get(self, name: str) -> Optional[_Instrument]:
+        """The instrument under ``name``, or None."""
+        with self._lock:
+            return self._instruments.get(name)
+
+    def snapshot(self) -> List[dict]:
+        """Point-in-time dump every exporter renders from: one row per
+        instrument with per-label-set values (histograms carry count,
+        sum and the percentile digest)."""
+        with self._lock:
+            instruments = [self._instruments[n]
+                           for n in sorted(self._instruments)]
+        rows = []
+        for inst in instruments:
+            series = []
+            for labels in inst.label_sets():
+                if inst.kind == "histogram":
+                    series.append({
+                        "labels": labels,
+                        **inst.series_snapshot((50, 90, 99), **labels)})
+                else:
+                    series.append({"labels": labels,
+                                   "value": inst.value(**labels)})
+            rows.append({"name": inst.name, "kind": inst.kind,
+                         "description": inst.description,
+                         "series": series})
+        return rows
+
+
+def audit_names(registry: MetricsRegistry) -> List[str]:
+    """Instrument names violating the documented
+    ``family/component/metric`` scheme (``NAME_RE``); empty = clean.
+    ``tools.check --telemetry-audit`` wraps this with stable exit
+    codes."""
+    return [n for n in registry.names() if not NAME_RE.match(n)]

@@ -36,6 +36,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+import bigdl_tpu.telemetry as telemetry
+
+# module-level registration so `tools.check --telemetry-audit` sees the
+# REAL instrument on import
+_ITEMS_PER_S = telemetry.histogram(
+    "tools/ceiling/items_per_s", "measured throughput per ceiling run")
+
 BATCH = int(os.environ.get("BENCH_BATCH", 256))
 SCAN = int(os.environ.get("BENCH_SCAN", 8))
 WARMUP = 1
@@ -856,3 +863,12 @@ if __name__ == "__main__":
         out["tokens_per_sec"] = round(r * PTB["seq"], 1)
     out.update(mfu_fields(r))
     print(json.dumps(out))
+    # one flag, default off: append a telemetry snapshot so BENCH
+    # trajectories carry phase breakdowns, not just the one total
+    jsonl = os.environ.get("BIGDL_METRICS_JSONL")
+    if jsonl:
+        _ITEMS_PER_S.observe(r, mode=mode)
+        telemetry.snapshot_to_jsonl(jsonl,
+                                    meta=dict(out, tool="ceiling",
+                                              batch=BATCH, scan=SCAN,
+                                              iters=iters))

@@ -5,6 +5,7 @@
         --rules r1,r2                            # restrict lint rules
         --list-rules                             # rule catalogue
         --show-suppressed                        # include muted findings
+        --telemetry-audit                        # instrument-name gate
         --json                                   # machine-readable output
 
 ``paths`` default to the installed ``bigdl_tpu`` package (a bare package
@@ -97,6 +98,61 @@ def run_shape_pass(as_json: bool, training: bool = True):
     return failures, rows
 
 
+def collect_instrument_names():
+    """Every telemetry instrument name the package registers, by
+    importing the instrumented surfaces (train/data/parallel series
+    land in the default registry at import) and instantiating the
+    construction-time ones (serving batcher/compile-cache, optimizer
+    Metrics) against a scratch registry — the audit sees the REAL
+    registration calls, not a hand-maintained list."""
+    import importlib
+
+    from bigdl_tpu import telemetry
+
+    for mod in ("bigdl_tpu.optim.optimizer", "bigdl_tpu.dataset.prefetch",
+                "bigdl_tpu.utils.serialization", "bigdl_tpu.parallel.tp",
+                "bigdl_tpu.tools.perf", "bigdl_tpu.tools.ceiling"):
+        importlib.import_module(mod)
+    scratch = telemetry.MetricsRegistry()
+    from bigdl_tpu.optim.optimizer import Metrics
+    from bigdl_tpu.serving.batcher import BatcherStats
+    from bigdl_tpu.serving.compile_cache import CompileCache
+    BatcherStats(registry=scratch, model="audit")
+    CompileCache(metrics=scratch)
+    m = Metrics(registry=scratch)
+    m.add("data time", 0.0)
+    m.add("computing time", 0.0)
+    return sorted(set(telemetry.registry().names() + scratch.names()))
+
+
+def run_telemetry_audit(as_json: bool) -> int:
+    """--telemetry-audit: every registered instrument name must match
+    the documented ``family/component/metric`` scheme. Exit 0 clean,
+    1 violations, 2 internal error."""
+    import json as _json
+
+    from bigdl_tpu.telemetry import NAME_RE
+    try:
+        names = collect_instrument_names()
+    except Exception as e:  # import/registration broke: internal error
+        print(f"telemetry audit failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+    violations = [n for n in names if not NAME_RE.match(n)]
+    if as_json:
+        print(_json.dumps({"telemetry": {
+            "scheme": NAME_RE.pattern, "instruments": names,
+            "violations": violations}}, indent=2))
+    else:
+        for n in names:
+            mark = "FAIL" if n in violations else "ok  "
+            print(f"instrument {mark} {n}")
+        print(f"telemetry audit: {len(names) - len(violations)}/"
+              f"{len(names)} instrument names match "
+              "family/component/metric")
+    return 1 if violations else 0
+
+
 def resolve_paths(paths):
     """File/dir paths; a bare importable package name resolves to its
     source directory."""
@@ -128,8 +184,14 @@ def main(argv=None) -> int:
                     help="comma-separated rule subset for the lint pass")
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--show-suppressed", action="store_true")
+    ap.add_argument("--telemetry-audit", action="store_true",
+                    help="audit registered telemetry instrument names "
+                         "against the family/component/metric scheme")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
+
+    if args.telemetry_audit:
+        return run_telemetry_audit(args.json)
 
     from bigdl_tpu.analysis import (available_rules, format_text,
                                     lint_paths)

@@ -1,0 +1,276 @@
+"""Where-did-the-time-go diagnosis CLI.
+
+    python -m bigdl_tpu.tools.diagnose                # demo workload
+        --steps N --batch-size B --no-serve           # workload knobs
+        --out-trace PATH                              # Chrome trace out
+        --trace FILE                                  # ingest a trace
+        --jsonl FILE                                  # ingest snapshots
+        --json                                        # machine output
+
+Default mode runs a short INSTRUMENTED workload — a LeNet training run
+(real ``LocalOptimizer`` loop on synthetic digits) with a concurrent
+serving burst hammering an ``InferenceService`` — with telemetry
+enabled, then prints the attribution report: how much wall-clock went
+to data staging vs compiled compute vs validation/checkpoint vs serving
+batches, with queue-wait percentiles from the metrics registry. The
+span trace is written as ONE Chrome-trace JSON (``--out-trace``,
+loadable in Perfetto / ``chrome://tracing``) and the report's phase
+sums are consistent with the optimizer's ``Metrics.summary()`` numbers
+— both views are fed the same measurements (asserted in
+tests/test_telemetry.py).
+
+Ingest modes skip the workload: ``--trace`` aggregates an existing
+Chrome trace (ours or any ``traceEvents`` file with ``ph: "X"``
+events); ``--jsonl`` renders the LAST snapshot of a JSONL metrics file
+(the ones ``tools/perf --metrics-jsonl`` / ``BIGDL_METRICS_JSONL``
+emit).
+
+Exit codes: 0 report printed, 2 usage/ingest error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+
+def aggregate_spans(events: List[dict]) -> Dict[str, Dict[str, float]]:
+    """Chrome trace events -> {span name: {count, total_s}} (complete
+    ``ph: "X"`` events only; ``dur`` is microseconds per the schema)."""
+    out: Dict[str, Dict[str, float]] = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        row = out.setdefault(ev["name"], {"count": 0, "total_s": 0.0})
+        row["count"] += 1
+        row["total_s"] += float(ev.get("dur", 0.0)) / 1e6
+    return out
+
+
+_PHASE_GROUPS = (
+    ("train", ("optimizer/", "checkpoint/", "parallel/")),
+    ("data", ("data/",)),
+    ("serving", ("serving/",)),
+)
+
+
+def attribution(agg: Dict[str, Dict[str, float]]) -> List[dict]:
+    """Span aggregation -> grouped attribution rows, largest first.
+
+    Groups follow the span family prefixes (train/data/serving);
+    percentages are of the total span-covered seconds, so the report
+    reads as "of the time telemetry saw, X% went to ...". Spans nest,
+    so groups can overlap — the report attributes per NAME, which is
+    flat within a family."""
+    total = sum(r["total_s"] for r in agg.values()) or 1.0
+    rows = []
+    for group, prefixes in _PHASE_GROUPS:
+        for name in sorted(agg):
+            if not any(name.startswith(p) for p in prefixes):
+                continue
+            r = agg[name]
+            rows.append({"group": group, "name": name,
+                         "count": int(r["count"]),
+                         "total_s": r["total_s"],
+                         "share": r["total_s"] / total})
+    known = {r["name"] for r in rows}
+    for name in sorted(agg):
+        if name not in known:
+            r = agg[name]
+            rows.append({"group": "other", "name": name,
+                         "count": int(r["count"]),
+                         "total_s": r["total_s"],
+                         "share": r["total_s"] / total})
+    rows.sort(key=lambda r: (r["group"], -r["total_s"]))
+    return rows
+
+
+def _fmt_report(rows: List[dict], metrics_lines: List[str],
+                summary: Optional[str]) -> str:
+    lines = ["== where did the time go =="]
+    group = None
+    for r in rows:
+        if r["group"] != group:
+            group = r["group"]
+            lines.append(f"{group}:")
+        lines.append(f"  {r['name']:<34s} {r['total_s']:9.4f} s "
+                     f"({100 * r['share']:5.1f}%)  x{r['count']}")
+    if metrics_lines:
+        lines.append("metrics:")
+        lines.extend(f"  {m}" for m in metrics_lines)
+    if summary:
+        lines.append(f"optimizer Metrics.summary(): {summary}")
+    return "\n".join(lines)
+
+
+def _metrics_lines(snapshot: List[dict]) -> List[str]:
+    """Human lines for the interesting registry series (queue waits,
+    depths, cache hit/miss) — the queue-side attribution spans can't
+    carry."""
+    out = []
+    for row in snapshot:
+        for s in row["series"]:
+            labels = s.get("labels") or {}
+            lbl = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+            tag = row["name"] + (f"[{lbl}]" if lbl else "")
+            if row["kind"] == "histogram":
+                ps = " ".join(f"{k}={s[k]:.3f}" for k in ("p50", "p99")
+                              if k in s)
+                out.append(f"{tag}: n={s['count']} sum={s['sum']:.4f} "
+                           f"{ps}".rstrip())
+            else:
+                out.append(f"{tag}: {s['value']:g}")
+    return out
+
+
+# --------------------------------------------------------- demo workload
+
+def run_workload(steps: int = 12, batch_size: int = 32,
+                 serve: bool = True, trace_path: Optional[str] = None):
+    """The instrumented demo: LeNet training (real Optimizer loop) +
+    a concurrent serving burst, telemetry enabled, one Chrome trace
+    out. Returns (optimizer, chrome events, registry snapshot)."""
+    import threading
+
+    import numpy as np
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu import telemetry
+    from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+    from bigdl_tpu.models import LeNet5
+    from bigdl_tpu.optim import SGD, LocalOptimizer, max_iteration
+    from bigdl_tpu.serving import InferenceService, ServingConfig
+    from bigdl_tpu.tools.synthetic import seeded_rng
+
+    telemetry.enable()
+
+    rng = seeded_rng(0)
+    x = (rng.rand(max(2 * batch_size, 64), 1, 28, 28)
+         .astype(np.float32))
+    y = (rng.randint(0, 10, x.shape[0]) + 1).astype(np.float32)
+    samples = [Sample(x[i], y[i]) for i in range(x.shape[0])]
+    ds = DataSet.array(samples).transform(SampleToMiniBatch(batch_size))
+
+    model = LeNet5(10)
+    opt = LocalOptimizer(model, ds, nn.ClassNLLCriterion(),
+                         batch_size=batch_size)
+    opt.set_optim_method(SGD(learning_rate=0.05))
+    opt.set_end_when(max_iteration(steps))
+
+    svc = None
+    stop = threading.Event()
+    burst_threads = []
+    if serve:
+        # the serving burst reports into the SAME process registry the
+        # trainer uses — the single-pane-of-glass configuration
+        svc = InferenceService(
+            config=ServingConfig(max_batch_size=8, max_wait_ms=1.0,
+                                 buckets=(8,)),
+            metrics_registry=telemetry.registry())
+        serve_model = nn.Sequential().add(nn.Reshape((28 * 28,))) \
+            .add(nn.Linear(28 * 28, 10))
+        serve_model.ensure_initialized()
+        svc.load("diag", serve_model, warmup_shape=(1, 28, 28))
+        req = x[:4]
+
+        def burst():
+            while not stop.is_set():
+                try:
+                    svc.predict_batch("diag", req, timeout_ms=500)
+                except Exception:  # drained at shutdown; keep bursting
+                    pass
+
+        for _ in range(2):
+            t = threading.Thread(target=burst, name="diag-burst",
+                                 daemon=True)
+            t.start()
+            burst_threads.append(t)
+    try:
+        opt.optimize()
+    finally:
+        stop.set()
+        for t in burst_threads:
+            t.join(timeout=5)
+        if svc is not None:
+            svc.shutdown(drain=True)
+
+    events = telemetry.tracer().chrome_trace_events()
+    if trace_path:
+        telemetry.export_chrome_trace(trace_path)
+    return opt, events, telemetry.registry().snapshot()
+
+
+# ------------------------------------------------------------------ CLI
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m bigdl_tpu.tools.diagnose", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--steps", type=int, default=12)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--no-serve", action="store_true",
+                    help="skip the concurrent serving burst")
+    ap.add_argument("--out-trace", default=None,
+                    help="write the run's Chrome trace JSON here")
+    ap.add_argument("--trace", default=None,
+                    help="ingest an existing Chrome trace instead of "
+                         "running the workload")
+    ap.add_argument("--jsonl", default=None,
+                    help="ingest a JSONL metrics file instead of "
+                         "running the workload")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.trace and args.jsonl:
+        print("--trace and --jsonl are mutually exclusive",
+              file=sys.stderr)
+        return 2
+
+    summary = None
+    snapshot: List[dict] = []
+    wrote_trace = False
+    if args.trace:
+        try:
+            with open(args.trace) as f:
+                events = json.load(f).get("traceEvents", [])
+        except (OSError, ValueError) as e:
+            print(f"cannot read trace {args.trace}: {e}",
+                  file=sys.stderr)
+            return 2
+    elif args.jsonl:
+        from bigdl_tpu.telemetry import read_jsonl
+        try:
+            records = read_jsonl(args.jsonl)
+        except (OSError, ValueError) as e:
+            print(f"cannot read jsonl {args.jsonl}: {e}",
+                  file=sys.stderr)
+            return 2
+        if not records:
+            print(f"{args.jsonl}: no snapshot records", file=sys.stderr)
+            return 2
+        events = []
+        snapshot = records[-1]["metrics"]
+    else:
+        opt, events, snapshot = run_workload(
+            steps=args.steps, batch_size=args.batch_size,
+            serve=not args.no_serve, trace_path=args.out_trace)
+        summary = opt.metrics.summary()
+        wrote_trace = args.out_trace is not None
+
+    agg = aggregate_spans(events)
+    rows = attribution(agg)
+    if args.json:
+        print(json.dumps({"spans": rows,
+                          "metrics": snapshot,
+                          "optimizer_summary": summary}, indent=2))
+    else:
+        print(_fmt_report(rows, _metrics_lines(snapshot), summary))
+        if wrote_trace:
+            print(f"chrome trace written to {args.out_trace} "
+                  "(load in Perfetto / chrome://tracing)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

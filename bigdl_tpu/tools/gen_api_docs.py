@@ -25,6 +25,9 @@ FAMILIES = {
     "serving": ["bigdl_tpu.serving"],
     "analysis": ["bigdl_tpu.analysis", "bigdl_tpu.analysis.shapecheck",
                  "bigdl_tpu.analysis.lint"],
+    "telemetry": ["bigdl_tpu.telemetry", "bigdl_tpu.telemetry.tracer",
+                  "bigdl_tpu.telemetry.metrics",
+                  "bigdl_tpu.telemetry.export"],
     "parallel": ["bigdl_tpu.parallel"],
     "models": ["bigdl_tpu.models"],
     "interop": ["bigdl_tpu.utils.serialization",

@@ -14,6 +14,16 @@ import time
 
 import numpy as np
 
+import bigdl_tpu.telemetry as telemetry
+
+# module-level registration so `tools.check --telemetry-audit` sees the
+# REAL instruments on import, not a hand-maintained name list
+_ITER_S = telemetry.histogram(
+    "tools/perf/iteration_s", "seconds per timed perf iteration")
+_WARMUP_S = telemetry.histogram(
+    "tools/perf/warmup_s",
+    "seconds per warmup iteration (includes the compile)")
+
 
 def build_model(name: str, class_num: int = 1000):
     from bigdl_tpu import models
@@ -58,6 +68,11 @@ def main(argv=None):
                     help="int8 inference rewrite (inference mode only — "
                     "the reference's quantized serving story, "
                     "nn/quantized/Quantization.scala:168)")
+    ap.add_argument("--metrics-jsonl", default=None, metavar="PATH",
+                    help="append a telemetry metrics snapshot (per-"
+                    "iteration phase histograms + run meta) to PATH as "
+                    "one JSONL line; default off (BIGDL_METRICS_JSONL "
+                    "env var also enables it)")
     args = ap.parse_args(argv)
 
     import jax
@@ -144,12 +159,17 @@ def main(argv=None):
     print(f"# {args.model} {args.mode} batch={args.batch_size} "
           f"dtype={args.dtype} backend={jax.default_backend()}")
     for i in range(args.warmup):
+        t0 = time.perf_counter()
         sync(run())
+        _WARMUP_S.observe(time.perf_counter() - t0, model=args.model,
+                          mode=args.mode)
     times = []
     for i in range(args.iterations):
         t0 = time.perf_counter()
-        sync(run())
+        with telemetry.span("tools/perf_iteration", i=i):
+            sync(run())
         dt = time.perf_counter() - t0
+        _ITER_S.observe(dt, model=args.model, mode=args.mode)
         times.append(dt)
         unit = "tok/s" if is_lm else "img/s"
         rate = (args.batch_size * (in_shape[0] if is_lm else 1)) / dt
@@ -175,6 +195,17 @@ def main(argv=None):
         except Exception as e:
             line += f"  |  cost-analysis failed: {type(e).__name__}"
     print(line)
+
+    jsonl = args.metrics_jsonl or os.environ.get("BIGDL_METRICS_JSONL")
+    if jsonl:
+        telemetry.snapshot_to_jsonl(jsonl, meta={
+            "tool": "perf", "model": args.model, "mode": args.mode,
+            "batch_size": args.batch_size, "dtype": args.dtype,
+            "backend": jax.default_backend(),
+            "median_s": med,
+            "rate": (args.batch_size *
+                     (in_shape[0] if is_lm else 1)) / med})
+        print(f"# metrics snapshot appended to {jsonl}")
 
 
 if __name__ == "__main__":

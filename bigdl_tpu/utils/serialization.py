@@ -11,12 +11,19 @@ from __future__ import annotations
 import json
 import os
 import re
+import time
 from typing import Any, Dict, Optional
 
 import jax
 import numpy as np
 
+import bigdl_tpu.telemetry as telemetry
 from bigdl_tpu.utils import file_io
+
+_CKPT_SAVE_S = telemetry.histogram(
+    "train/checkpoint/save_s", "wall-clock seconds per checkpoint save")
+_CKPT_LOAD_S = telemetry.histogram(
+    "train/checkpoint/load_s", "wall-clock seconds per checkpoint load")
 
 
 def _flatten_with_paths(tree):
@@ -178,6 +185,26 @@ def save_checkpoint(path: str, *, params, opt_state, model_state,
                     optim_host_state: Dict[str, Any],
                     driver_state: Dict[str, Any],
                     writer: bool = True) -> None:
+    """Checkpoint a training run crash-safely (see
+    :func:`_save_checkpoint_impl` for the atomicity contract); the
+    wall-clock cost lands in the ``train/checkpoint/save_s`` telemetry
+    histogram and a ``checkpoint/save`` span."""
+    t0 = time.perf_counter()
+    try:
+        with telemetry.span("checkpoint/save", path=path):
+            _save_checkpoint_impl(
+                path, params=params, opt_state=opt_state,
+                model_state=model_state,
+                optim_host_state=optim_host_state,
+                driver_state=driver_state, writer=writer)
+    finally:
+        _CKPT_SAVE_S.observe(time.perf_counter() - t0)
+
+
+def _save_checkpoint_impl(path: str, *, params, opt_state, model_state,
+                          optim_host_state: Dict[str, Any],
+                          driver_state: Dict[str, Any],
+                          writer: bool = True) -> None:
     """Checkpoint a training run (DistriOptimizer.checkpoint :433-463),
     crash-safely:
 
@@ -261,16 +288,25 @@ def save_checkpoint(path: str, *, params, opt_state, model_state,
 
 def load_checkpoint(path: str) -> Dict[str, Any]:
     """Read one complete checkpoint dir written by
-    :func:`save_checkpoint`."""
-    with file_io.open_file(file_io.join(path, "host_state.json")) as f:
-        host = json.load(f)
-    return {
-        "params": load_tree(file_io.join(path, "params")),
-        "opt_state": load_tree(file_io.join(path, "opt_state")),
-        "model_state": load_tree(file_io.join(path, "model_state")),
-        "optim_host_state": host["optim_host_state"],
-        "driver_state": host["driver_state"],
-    }
+    :func:`save_checkpoint`; the wall-clock cost lands in the
+    ``train/checkpoint/load_s`` telemetry histogram and a
+    ``checkpoint/load`` span."""
+    t0 = time.perf_counter()
+    try:
+        with telemetry.span("checkpoint/load", path=path):
+            with file_io.open_file(
+                    file_io.join(path, "host_state.json")) as f:
+                host = json.load(f)
+            return {
+                "params": load_tree(file_io.join(path, "params")),
+                "opt_state": load_tree(file_io.join(path, "opt_state")),
+                "model_state": load_tree(
+                    file_io.join(path, "model_state")),
+                "optim_host_state": host["optim_host_state"],
+                "driver_state": host["driver_state"],
+            }
+    finally:
+        _CKPT_LOAD_S.observe(time.perf_counter() - t0)
 
 
 def find_latest_checkpoint(directory: str) -> Optional[str]:

@@ -136,3 +136,25 @@ def test_online_serving_example(tmp_path):
     d = os.path.join(str(tmp_path), "serving_example", "serving")
     vals = FileReader.read_scalar(d, "serving/mnist/request_count")
     assert vals and vals[-1][1] >= 24
+
+
+def test_telemetry_tour_example(tmp_path):
+    """telemetry example: one instrumented train+serve run exported as
+    Chrome trace + TensorBoard + Prometheus + JSONL — the runnable face
+    of docs/telemetry.md."""
+    import json
+    from bigdl_tpu import telemetry
+    from examples.telemetry_tour import main
+    try:
+        out = main(["--steps", "3", "--out-dir", str(tmp_path)])
+    finally:
+        telemetry.disable()
+    trace = json.load(open(out["trace"]))
+    names = {e["name"] for e in trace["traceEvents"]
+             if e.get("ph") == "X"}
+    assert "optimizer/compute" in names and "serving/batch" in names
+    parsed = telemetry.parse_prometheus_text(open(out["prometheus"]).read())
+    assert any(k[0] == "serving_batcher_requests" for k in parsed)
+    recs = telemetry.read_jsonl(out["jsonl"])
+    assert recs and recs[-1]["meta"]["tool"] == "telemetry_tour"
+    assert any(r["name"] == "optimizer/compute" for r in out["spans"])

@@ -150,6 +150,24 @@ def f():
         return 2
 """,
     ),
+    "telemetry-in-trace": (
+        """
+from bigdl_tpu import telemetry
+
+@jax.jit
+def f(x):
+    with telemetry.span("optimizer/step"):
+        return x * 2
+""",
+        """
+from bigdl_tpu import telemetry
+
+@jax.jit
+def f(x):
+    with telemetry.span("optimizer/step"):  # bigdl: disable=telemetry-in-trace
+        return x * 2
+""",
+    ),
 }
 
 
@@ -312,3 +330,46 @@ def test_json_output_is_stable():
 def test_parse_error_is_reported_not_raised():
     fs = lint_source("def broken(:\n", "bad.py")
     assert [f.rule for f in fs] == ["parse-error"]
+
+
+def test_instrument_update_in_traced_code_flagged():
+    """Module-level instruments (telemetry.counter idiom) are telemetry
+    surface: their .inc/.observe inside traced code advances once per
+    COMPILE, not per execution."""
+    body = """
+from bigdl_tpu import telemetry
+STEPS = telemetry.counter("train/loop/steps")
+
+@jax.jit
+def f(x):
+    STEPS.inc()
+    return x * 2
+"""
+    assert "telemetry-in-trace" in names(run(body))
+
+
+def test_instrument_update_on_host_not_flagged():
+    body = """
+from bigdl_tpu import telemetry
+STEPS = telemetry.counter("train/loop/steps")
+
+def host_loop(x):
+    STEPS.inc()
+    return x
+"""
+    assert "telemetry-in-trace" not in names(run(body))
+
+
+def test_telemetry_record_in_scanned_fn_flagged():
+    """The rule covers trace entries beyond jit: a lax.scan body is
+    traced too."""
+    body = """
+import bigdl_tpu.telemetry as telemetry
+
+def outer(xs):
+    def body(c, x):
+        telemetry.record("phase/x/y", 0.1)
+        return c + x, x
+    return lax.scan(body, 0.0, xs)
+"""
+    assert "telemetry-in-trace" in names(run(body))
