@@ -394,7 +394,31 @@ def main():
         "unit": "images/sec",
         "vs_baseline": round(imgs_per_sec / REFERENCE_BASELINE_IMGS_PER_SEC,
                              3),
+        "steps_per_sync": scan,
     }
+    # steps/sec at K=1 vs K=8 fused windows: quantifies what bounded
+    # async dispatch buys over per-step host sync (the Optimizer's
+    # set_steps_per_sync knob). Skipped on CPU smoke runs unless forced
+    # — two extra compiles would dominate CI.
+    cmp_flag = os.environ.get("BENCH_SYNC_COMPARE", "")
+    if cmp_flag != "0" and (platform != "cpu" or cmp_flag == "1"):
+        from bigdl_tpu.tools.sync_compare import measure_sync_compare
+
+        def build(k):
+            if k == scan:
+                return run_chunk  # identical program: reuse, no recompile
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def chunk_k(c, keys):
+                return lax.scan(scan_body, c, keys)
+            return chunk_k
+
+        rates, carry = measure_sync_compare(
+            build, carry,
+            lambda k, i: jax.random.split(
+                jax.random.fold_in(root, 7000 + 100 * k + i + 1), k),
+            total=max(8, int(os.environ.get("BENCH_SYNC_STEPS", 16))))
+        result.update({name: round(r, 3) for name, r in rates.items()})
     # second tracked metric: TransformerLM training tokens/s (the
     # net-new flagship family; a regression here must be visible to the
     # driver's scoreboard, not just ResNet-50). Skipped on CPU smoke

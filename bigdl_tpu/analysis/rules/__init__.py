@@ -12,7 +12,8 @@ Shipped rules:
 - ``host-state-in-trace`` — clocks / host RNG baked into traces
 - ``global-rng`` — module-global ``np.random``/``random`` state
 - ``bare-except`` — bare ``except:`` handlers
+- ``sync-in-loop`` — per-iteration host-device sync in host step loops
 """
-from bigdl_tpu.analysis.rules import jit_calls, purity, style, traced
+from bigdl_tpu.analysis.rules import jit_calls, perf, purity, style, traced
 
-__all__ = ["jit_calls", "purity", "style", "traced"]
+__all__ = ["jit_calls", "perf", "purity", "style", "traced"]

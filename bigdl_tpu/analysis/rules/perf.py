@@ -1,0 +1,128 @@
+"""Host-loop performance rules: per-step device synchronization.
+
+A training/serving loop that synchronizes the host every iteration
+(``jax.block_until_ready``, ``.item()``, ``float(loss)``) serializes
+dispatch against execution — the device idles while Python does
+bookkeeping, and step k+1 never overlaps the tail of step k. The
+sanctioned pattern is to sync at WINDOW boundaries only
+(``Optimizer.set_steps_per_sync`` / a ``lax.scan`` chunk) and mark the
+remaining deliberate sync points with ``# bigdl: disable=sync-in-loop``
+so they stay auditable.
+"""
+from __future__ import annotations
+
+import ast
+
+from bigdl_tpu.analysis.lint import FileContext, rule
+
+_SYNC_ATTRS = ("item", "block_until_ready")
+
+
+#: builtins whose results are host values by construction — float()
+#: over them is never a device fetch
+_HOST_BUILTINS = frozenset({
+    "len", "range", "enumerate", "zip", "sorted", "reversed", "list",
+    "tuple", "dict", "set", "str", "repr", "format", "ord", "chr", "id",
+    "hash", "open", "input", "int", "bool", "next", "getattr", "vars",
+})
+
+
+def _device_ish_call(ctx: FileContext, call: ast.Call) -> bool:
+    """Plausibly returns device values: a plain function call
+    (``step(params, x)``, the step/eval idiom — minus host-only
+    builtins) or a jax/jnp API call. Method calls on arbitrary objects
+    (``line.split(',')``, ``m.groups()``) are host-side string/object
+    work — counting those would flag pure parsing loops."""
+    if isinstance(call.func, ast.Name):
+        return call.func.id not in _HOST_BUILTINS
+    c = ctx.canon(call.func)
+    return c is not None and (c == "jax" or c.startswith(("jax.", "jnp.")))
+
+
+def _fresh_call_names(ctx: FileContext, nodes):
+    """Names bound from a device-ish Call result within the loop body —
+    a ``float()`` over one of these fetches a freshly computed device
+    value every iteration."""
+    fresh = set()
+    for node in nodes:
+        if not isinstance(node, ast.Assign):
+            continue
+        if not (isinstance(node.value, ast.Call)
+                and _device_ish_call(ctx, node.value)):
+            continue
+        for t in node.targets:
+            targets = t.elts if isinstance(t, (ast.Tuple, ast.List)) \
+                else [t]
+            for e in targets:
+                if isinstance(e, ast.Name):
+                    fresh.add(e.id)
+    return fresh
+
+
+def _imports_jax(ctx: FileContext) -> bool:
+    for node in ctx.walk(ast.Import):
+        if any(a.name == "jax" or a.name.startswith("jax.")
+               for a in node.names):
+            return True
+    for node in ctx.walk(ast.ImportFrom):
+        if node.module and (node.module == "jax"
+                            or node.module.startswith("jax.")):
+            return True
+    return False
+
+
+@rule("sync-in-loop",
+      "per-iteration host-device sync inside a host step loop")
+def sync_in_loop(ctx: FileContext):
+    """Flags ``jax.block_until_ready`` / ``.block_until_ready()`` /
+    ``.item()`` and ``float()`` over per-iteration device-ish call
+    results inside host loops — including module-level script loops,
+    the classic home of per-step-synced training drivers. Each loop is
+    analyzed at its own nesting level (a sync in an inner loop is the
+    inner loop's finding); traced loops are host-sync's territory.
+    Files that never import jax hold no device values and are
+    skipped."""
+    if not _imports_jax(ctx):
+        return
+    for loop in ctx.walk(ast.For, ast.While):
+        if ctx.in_traced(loop):
+            continue
+        body = []
+        stack = list(ast.iter_child_nodes(loop))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.For, ast.While)):
+                continue  # other scopes / the inner loop's own finding
+            body.append(node)
+            stack.extend(ast.iter_child_nodes(node))
+        fresh = _fresh_call_names(ctx, body)
+        for node in body:
+            if not isinstance(node, ast.Call):
+                continue
+            c = ctx.canon(node.func)
+            if c == "jax.block_until_ready":
+                yield node, (
+                    "`jax.block_until_ready` every loop iteration "
+                    "serializes dispatch against execution; fuse steps "
+                    "(steps_per_sync / lax.scan) and sync at window "
+                    "boundaries, or mark a deliberate sync point with "
+                    "`# bigdl: disable=sync-in-loop`")
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _SYNC_ATTRS and not node.args:
+                yield node, (
+                    f"`.{node.func.attr}()` every loop iteration blocks "
+                    "the host on the device; batch the fetch (one "
+                    "length-K vector per window) or mark a deliberate "
+                    "sync point with `# bigdl: disable=sync-in-loop`")
+            elif isinstance(node.func, ast.Name) \
+                    and node.func.id == "float" and node.args:
+                names = {n.id for n in ast.walk(node.args[0])
+                         if isinstance(n, ast.Name)}
+                if names & fresh:
+                    yield node, (
+                        "`float()` over a per-iteration result forces a "
+                        "blocking device fetch every step; fetch once "
+                        "per window (losses as a length-K vector) or "
+                        "mark a deliberate sync point with "
+                        "`# bigdl: disable=sync-in-loop`")

@@ -17,7 +17,8 @@ from bigdl_tpu.dataset.fetch import (
 from bigdl_tpu.dataset.seqfile import (
     SequenceFileWriter, read_sequence_file, read_seq_image_records,
     write_seq_image_shards)
-from bigdl_tpu.dataset.prefetch import device_prefetch
+from bigdl_tpu.dataset.prefetch import (batch_signature, device_prefetch,
+                                        stack_minibatches, stack_windows)
 from bigdl_tpu.dataset.device_dataset import (
     DeviceCachedArrayDataSet, RotatingDeviceDataSet, ShardRotator)
 from bigdl_tpu.dataset.text import (

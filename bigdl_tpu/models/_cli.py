@@ -40,6 +40,10 @@ def base_parser(desc: str) -> argparse.ArgumentParser:
     ap.add_argument("--quantize", action="store_true",
                     help="int8-quantize the model before evaluation "
                          "(AbstractModule.quantize :708)")
+    ap.add_argument("--steps-per-sync", type=int, default=1, metavar="K",
+                    help="fuse K train steps into one compiled scan and "
+                    "sync the host only at window boundaries "
+                    "(Optimizer.set_steps_per_sync; docs/performance.md)")
     return ap
 
 
@@ -69,6 +73,10 @@ def wire_optimizer(opt, args, optim_method, val_ds=None,
         opt.set_end_when(max_iteration(args.maxIterations))
     else:
         opt.set_end_when(max_epoch(args.maxEpoch or default_epochs))
+    if getattr(args, "steps_per_sync", 1) != 1:
+        # let set_steps_per_sync reject 0/negative values loudly rather
+        # than silently training per-step on a typo
+        opt.set_steps_per_sync(args.steps_per_sync)
     return opt
 
 

@@ -12,6 +12,11 @@ scalar decisions per step, not worth forcing into lax.while_loop).
 Pytree parameters are supported by flattening once per optimize() call
 (jax.flatten_util.ravel_pytree); history pairs (s, y) stay on device.
 """
+# The strong-Wolfe line search is host-driven BY CONTRACT: each
+# bracketing/zoom decision branches on the scalar objective value, so
+# the per-evaluation fetch IS the algorithm, not an accidental
+# per-step sync.
+# bigdl: disable-file=sync-in-loop
 from __future__ import annotations
 
 from typing import Callable, List, Optional, Tuple

@@ -31,6 +31,7 @@ import numpy as np
 
 import bigdl_tpu.telemetry as telemetry
 from bigdl_tpu.dataset.dataset import AbstractDataSet
+from bigdl_tpu.dataset.prefetch import batch_signature, stack_minibatches
 from bigdl_tpu.dataset.sample import MiniBatch
 from bigdl_tpu.nn.module import AUX_LOSS_KEY, Criterion, Module
 from bigdl_tpu.optim.optim_method import OptimMethod, SGD
@@ -104,14 +105,44 @@ def _collect_aux_losses(state_tree):
     return total
 
 
-def _to_scalar(x) -> float:
-    """float(loss) that also works on multi-host global arrays (a fully
-    replicated value is readable from any addressable shard)."""
+def _fetch_replicated(x) -> np.ndarray:
+    """Host-fetch a fully replicated device value, multi-host safe: a
+    replicated array spanning non-addressable devices is not plain-
+    readable, but any addressable shard holds the complete value."""
     try:
-        return float(x)
+        return np.asarray(x)
     except Exception:
-        return float(np.asarray(
-            jax.device_get(x.addressable_shards[0].data)))
+        return np.asarray(jax.device_get(x.addressable_shards[0].data))
+
+
+def _to_scalar(x) -> float:
+    """float(loss) that also works on multi-host global arrays."""
+    return float(_fetch_replicated(x))
+
+
+def _losses_list(losses, k: int):
+    """The length-k loss vector a fused window returns, as host floats —
+    ONE fetch per window."""
+    return [float(v) for v in _fetch_replicated(losses).reshape(-1)[:k]]
+
+
+def _window_stackable(batch: MiniBatch) -> bool:
+    """True when every leaf of the MiniBatch is a dense HOST array —
+    the only thing ``np.stack`` window stacking supports. Sparse COO
+    batches keep the per-step path, and so do device-resident leaves
+    (e.g. a ``device_prefetch``-staged pipeline): host-stacking those
+    would silently round-trip device->host->device with a blocking
+    sync per batch — the inverse of what windowing buys."""
+    from bigdl_tpu.dataset.sample import HostBatchedCOO, SparseFeature
+
+    def ok(x):
+        if x is None:
+            return True
+        if isinstance(x, (list, tuple)):
+            return all(ok(e) for e in x)
+        return not isinstance(x, (HostBatchedCOO, SparseFeature,
+                                  jax.Array))
+    return ok(batch.input) and ok(batch.target)
 
 
 def _allreduce_result(r):
@@ -285,6 +316,10 @@ class Optimizer:
         self.retry_interval_s = float(
             os.environ.get("BIGDL_FAILURE_RETRY_INTERVAL", 1.0))
         self.metrics = Metrics()
+        # windowed step driver (set_steps_per_sync): K train steps fused
+        # into one lax.scan dispatch, host syncs only at window
+        # boundaries. 1 = the classic per-step loop.
+        self.steps_per_sync = 1
         # gradient clipping (Optimizer.scala setConstantGradientClipping
         # / setGradientClippingByl2Norm); None = off
         self._gradient_clip = None
@@ -396,6 +431,32 @@ class Optimizer:
         self._gradient_clip = None
         return self
 
+    def set_steps_per_sync(self, k: int) -> "Optimizer":
+        """Fuse up to ``k`` train steps into ONE compiled ``lax.scan``
+        program and sync the host only at window boundaries.
+
+        The per-step loop round-trips to the host every iteration
+        (fetch the loss, run trigger/metric bookkeeping, dispatch the
+        next step), so the device idles in the gaps; with ``k > 1`` the
+        whole window runs as one donated jitted dispatch and losses
+        come back as a length-``k`` vector fetched once. Driver
+        counters (``neval``, ``recordsProcessedThisEpoch``), triggers
+        and summaries then REPLAY the ``k`` per-step increments
+        host-side after the fetch, so observable semantics match the
+        per-step loop; windows flush early at validation / checkpoint /
+        end-trigger boundaries, epoch rollovers and shard rotations,
+        and the driver falls back to ``k=1`` whenever a trigger depends
+        on runtime values (``Loss``/``score``), a trigger's
+        dependencies are unknown, or the LR schedule is metric-driven
+        (Plateau) — see ``docs/performance.md``. ``Metrics``/telemetry
+        are recorded once per window with amortized ``t_data`` /
+        ``t_compute`` attribution."""
+        k = int(k)
+        if k < 1:
+            raise ValueError(f"steps_per_sync must be >= 1, got {k}")
+        self.steps_per_sync = k
+        return self
+
     def set_preflight_spec(self, input_spec) -> "Optimizer":
         """Opt-in pre-flight: before any compilation, ``optimize()``
         shape/dtype-checks the model against ``input_spec`` (see
@@ -429,10 +490,14 @@ class Optimizer:
         when TP/PP consume every device — is the replicated regime)."""
         return self.mesh.shape.get(self.data_axis, 1) > 1
 
-    def _batch_sharding(self):
+    def _batch_sharding(self, batch_axis: int = 0):
         """Batch layout on the mesh: sharded over the data axis when it
-        really splits, else replicated (pure TP/PP meshes)."""
-        spec = jax.sharding.PartitionSpec(self.data_axis) \
+        really splits, else replicated (pure TP/PP meshes).
+        ``batch_axis`` is where the batch dimension sits — 0 for a plain
+        MiniBatch, 1 for a stacked ``[K, B, ...]`` window buffer (the
+        window axis stays unsharded)."""
+        spec = jax.sharding.PartitionSpec(
+            *([None] * batch_axis + [self.data_axis])) \
             if self._data_parallel() else jax.sharding.PartitionSpec()
         return jax.sharding.NamedSharding(self.mesh, spec)
 
@@ -523,6 +588,100 @@ class Optimizer:
             from bigdl_tpu.parallel.tp import shard_params
             return shard_params(tree, self.mesh, self.sharding_rules)
         return self._put_replicated(tree)
+
+    # -- windowed driver planning (set_steps_per_sync) ---------------------
+    def _window_limit(self, k: int, end_when, device_feed: bool):
+        """Run-wide cap on the window size, with the reason for any
+        fallback: windowed execution must be OBSERVABLY identical to the
+        per-step loop, so anything the host cannot predict before the
+        dispatch (loss-dependent or unknown triggers, metric-driven LR
+        schedules) forces per-step sync."""
+        if k <= 1:
+            return 1, ""
+        for what, t in (("end trigger", end_when),
+                        ("validation trigger", self.validation_trigger),
+                        ("checkpoint trigger", self.checkpoint_trigger)):
+            if t is None or t.plannable():
+                continue
+            dep = sorted(t.depends_on) if t.depends_on is not None else None
+            why = (f"{what} reads runtime state {dep}" if dep
+                   else f"{what} has undeclared dependencies")
+            return 1, why + "; per-step sync keeps its semantics exact"
+        sched = getattr(self.optim_method, "learning_rate_schedule", None)
+        if sched is not None and hasattr(sched, "record_metric"):
+            return 1, ("metric-driven LR schedule (Plateau) adjusts per "
+                       "step; per-step sync keeps it exact")
+        get_trig = getattr(self.train_summary, "get_summary_trigger",
+                           None) if self.train_summary is not None else None
+        if get_trig is not None and get_trig("Parameters") is not None:
+            return 1, ("train-summary Parameters histograms snapshot the "
+                       "params of EACH step; per-step sync keeps them "
+                       "exact")
+        if not device_feed and self._multiprocess():
+            return 1, ("multi-host host-feed runs per-step (stacked "
+                       "window buffers are single-process)")
+        return k, ""
+
+    def _plan_window(self, k_max: int, state, bsz: int, ds_size: int,
+                     end_when, shard_size=None) -> int:
+        """Largest k <= k_max such that the per-step loop would do NO
+        host work (trigger fire, epoch rollover, shard rotation) after
+        steps 1..k-1. The k-th step may land ON a boundary: the window
+        flushes there and the host replay handles it with the window's
+        final (current) params."""
+        if k_max <= 1:
+            return 1
+        n0 = state["neval"]
+        ep0 = state["epoch"]
+        rec = state["recordsProcessedThisEpoch"]
+        spos = ((n0 - 1) * bsz) % shard_size if shard_size else None
+        for i in range(1, k_max):
+            rec += bsz
+            if rec >= ds_size:
+                return i  # epoch rollover: shuffle/permutation bookkeeping
+            if spos is not None:
+                spos += bsz
+                if spos >= shard_size:
+                    return i  # next shard must rotate in before step i+1
+            sim = {"epoch": ep0, "neval": n0 + i,
+                   "recordsProcessedThisEpoch": rec}
+            for t in (end_when, self.validation_trigger,
+                      self.checkpoint_trigger):
+                if t is not None and t.peek(sim):
+                    return i
+        return k_max
+
+    def _window_lrs(self, k: int, state):
+        """The k learning rates the per-step loop would have computed,
+        via k real ``update_hyper_parameter()`` calls (schedule counters
+        advance exactly as they would per-step; the epoch cannot change
+        mid-window because windows flush at rollovers)."""
+        n0 = state["neval"]
+        lrs = []
+        for i in range(k):
+            self.optim_method.state["neval"] = n0 + i
+            lrs.append(self.optim_method.update_hyper_parameter())
+        return lrs
+
+    def _prep_io_window(self, batch: MiniBatch):
+        """Stage a stacked ``[K, B, ...]`` window batch
+        (``dataset.prefetch.stack_minibatches``): like :meth:`_prep_io`,
+        but the batch dimension is axis 1, so :meth:`_batch_sharding`
+        is asked for the axis-1 layout. Multi-host and sparse batches
+        never reach here (the window limiter falls back to per-step,
+        where :meth:`_put_batch` owns those regimes)."""
+        sh = self._batch_sharding(batch_axis=1) if self.mesh is not None \
+            else None
+
+        def put(x):
+            if x is None:
+                return None
+            if isinstance(x, (list, tuple)):
+                from bigdl_tpu.utils.table import T as _T
+                return _T(*[put(e) for e in x])
+            return jnp.asarray(x) if sh is None \
+                else jax.device_put(jnp.asarray(x), sh)
+        return put(batch.get_input()), put(batch.get_target())
 
     def _prep_io(self, batch: MiniBatch):
         inp = batch.get_input()
@@ -800,88 +959,134 @@ class Optimizer:
             from bigdl_tpu.optim.trigger import max_epoch
             end_when = max_epoch(10)
 
-        wall_start = time.time()
-        while not end_when(state):
-            t0 = time.time()
+        # -- windowed driver setup (set_steps_per_sync) -------------------
+        # plan_bsz: the per-step record count windows are planned with
+        # (device feeds are exact; host feeds re-check actual sizes while
+        # gathering a window)
+        plan_bsz = self.dataset.batch_size if (rotating or device_feed) \
+            else self.batch_size
+        k_cap, why = self._window_limit(self.steps_per_sync, end_when,
+                                        rotating or device_feed)
+        if k_cap < self.steps_per_sync:
+            logger.info("steps_per_sync=%d: falling back to per-step "
+                        "sync — %s", self.steps_per_sync, why)
+        shard_size = self.dataset.rot.shard_size if rotating else None
+        window_fn = None       # ONE jitted program per feed path; jax's
+        host_window_fn = None  # compile cache keys it by (k, shapes)
+        if k_cap > 1 and (rotating or device_feed):
+            modulus = shard_size if rotating else ds_size
             if rotating:
-                bsz = self.dataset.batch_size
-                visit, sp = self.dataset.shard_cursor(state["neval"])
-                step_args = (jnp.int32(visit), jnp.int32(sp),
-                             self.dataset.images, self.dataset.labels)
-                run_step = fused_step
-            elif device_feed:
-                bsz = self.dataset.batch_size
-                # neval starts at 1 (reference convention); the sample
-                # stream is 0-based so epoch boundaries line up with
-                # recordsProcessedThisEpoch rollover. The (epoch, pos)
-                # cursor is decomposed HERE with exact Python integers,
-                # so no device-int overflow however long the run.
-                e0, p0 = divmod((state["neval"] - 1) * bsz, ds_size)
-                step_args = (jnp.int32(e0), jnp.int32(p0))
-                if feed_by_arg:
-                    step_args += (self.dataset.images,
-                                  self.dataset.labels)
-                run_step = fused_step
+                def _feed(arrs, kb, ep, pos):
+                    return tmpl.batch_fn_on(arrs[0], arrs[1], kb,
+                                            epoch=ep, pos=pos)
+            elif feed_by_arg:
+                if epoch_exact:
+                    def _feed(arrs, kb, ep, pos):
+                        return ds.batch_fn_on(arrs[0], arrs[1], kb,
+                                              epoch=ep, pos=pos)
+                else:
+                    def _feed(arrs, kb, ep, pos):
+                        return ds.batch_fn_on(arrs[0], arrs[1], kb)
             else:
-                batch = next(data_iter)
-                if not isinstance(batch, MiniBatch):
-                    raise ValueError(
-                        "dataset must yield MiniBatch; add SampleToMiniBatch")
-                inp, tgt = self._prep_io(batch)
-                # device_put above only DISPATCHED the transfer; without
-                # this barrier the copy time would silently migrate into
-                # t_compute and the data-vs-compute attribution would lie
-                jax.block_until_ready((inp, tgt))
-                bsz = batch.size()
-                step_args = (inp, tgt)
-                run_step = step
-            t_data = time.time() - t0
-            # trace carries the EXACT t_data the Metrics dump reports,
-            # so diagnose's phase attribution and Metrics.summary()
-            # agree to the digit
-            telemetry.record("optimizer/data_wait", t_data,
-                             step=state["neval"])
+                if epoch_exact:
+                    def _feed(arrs, kb, ep, pos):
+                        return ds.batch_fn(kb, epoch=ep, pos=pos)
+                else:
+                    def _feed(arrs, kb, ep, pos):
+                        return ds.batch_fn(kb)
 
-            lr = self.optim_method.update_hyper_parameter()
-            rng = RandomGenerator.next_key()
-            t1 = time.time()
-            params, opt_state, model_state, loss = run_step(
-                params, opt_state, model_state, rng, lr, *step_args)
-            # fetching the loss scalar only gates on the loss VALUE; the
-            # param/optimizer updates it does not depend on may still be
-            # in flight, so close the timing window on the full outputs
-            jax.block_until_ready((params, opt_state, model_state))
-            loss_f = _to_scalar(loss)
-            t_compute = time.time() - t1
-            telemetry.record("optimizer/compute", t_compute,
-                             step=state["neval"])
-            _STEP_COUNT.inc()
-            _RECORD_COUNT.inc(bsz)
+            def _window_dev(p, o, m, keys, lrs, ep0, pos0, *arrs):
+                # K fused steps: the (epoch, pos) sample cursor advances
+                # in the scan carry (all values stay < 2*modulus — no
+                # int32 overflow however long the run); losses come back
+                # as ONE length-K vector
+                def body(carry, sl):
+                    p, o, m, ep, pos = carry
+                    key, lr = sl
+                    kb, kr = jax.random.split(key)
+                    x, yb = _feed(arrs, kb, ep, pos)
+                    p, o, m, loss = step(p, o, m, kr, lr, x, yb)
+                    pos = pos + plan_bsz
+                    ep = ep + pos // modulus
+                    pos = pos % modulus
+                    return (p, o, m, ep, pos), loss
+                (p, o, m, _, _), losses = jax.lax.scan(
+                    body, (p, o, m, ep0, pos0), (keys, lrs))
+                return p, o, m, losses
+
+            window_fn = jax.jit(_window_dev, donate_argnums=(0, 1, 2))
+        elif k_cap > 1:
+            def _window_host(p, o, m, keys, lrs, xs, ys):
+                # scan over the [K, B, ...] stacked device buffer
+                # (dataset.prefetch.stack_windows layout)
+                def body(carry, sl):
+                    p, o, m = carry
+                    key, lr, x, yb = sl
+                    p, o, m, loss = step(p, o, m, key, lr, x, yb)
+                    return (p, o, m), loss
+                (p, o, m), losses = jax.lax.scan(
+                    body, (p, o, m), (keys, lrs, xs, ys))
+                return p, o, m, losses
+
+            host_window_fn = jax.jit(_window_host, donate_argnums=(0, 1, 2))
+
+        def device_cursor_args():
+            """Step arguments for the device-resident feeds at the
+            CURRENT ``state['neval']`` — the ONE place the cursor
+            convention lives, shared by the per-step and windowed
+            dispatches (divergence here would silently split K=1 vs
+            K>1 semantics). neval starts at 1 (reference convention);
+            the sample stream is 0-based so epoch boundaries line up
+            with recordsProcessedThisEpoch rollover; the cursor is
+            decomposed HERE with exact Python integers, so no
+            device-int overflow however long the run."""
             if rotating:
-                # loss fetch above completed the step; stream the next
-                # shard piece now (alternation rule) and rotate slots at
-                # shard boundaries
-                self.dataset.after_step(state["neval"])
+                visit, sp = self.dataset.shard_cursor(state["neval"])
+                return (jnp.int32(visit), jnp.int32(sp),
+                        self.dataset.images, self.dataset.labels)
+            e0, p0 = divmod((state["neval"] - 1) * plan_bsz, ds_size)
+            args = (jnp.int32(e0), jnp.int32(p0))
+            if feed_by_arg:
+                args += (self.dataset.images, self.dataset.labels)
+            return args
 
+        pending: List[MiniBatch] = []  # host batches pulled ahead
+        warned_unstackable = False  # log the data-dependent fallback once
+
+        def pull_batch() -> MiniBatch:
+            b = pending.pop(0) if pending else next(data_iter)
+            if not isinstance(b, MiniBatch):
+                raise ValueError(
+                    "dataset must yield MiniBatch; add SampleToMiniBatch")
+            return b
+
+        def post_step(loss_f, lr, bsz_i, throughput):
+            """One step's worth of host bookkeeping. The per-step loop
+            runs it after every step; the windowed driver REPLAYS it K
+            times after the single window fetch, so counters, triggers,
+            epoch rollovers and summaries observe the identical
+            per-step sequence either way."""
+            nonlocal data_iter
+            if rotating:
+                # the window/loss fetch completed this step; stream the
+                # next shard piece now (alternation rule) and rotate
+                # slots at shard boundaries
+                self.dataset.after_step(state["neval"])
             state["neval"] += 1
             self.optim_method.state["neval"] = state["neval"]
-            state["recordsProcessedThisEpoch"] += bsz
+            state["recordsProcessedThisEpoch"] += bsz_i
             state["Loss"] = loss_f
             state["LearningRate"] = lr
-            state["Throughput"] = bsz / max(1e-9, t_data + t_compute)
-            self.metrics.add("data time", t_data)
-            self.metrics.add("computing time", t_compute)
+            state["Throughput"] = throughput
             logger.info(
                 "Epoch %d iter %d: loss %.4f lr %.5f throughput %.1f rec/s",
-                state["epoch"], state["neval"] - 1, loss_f, lr,
-                state["Throughput"])
+                state["epoch"], state["neval"] - 1, loss_f, lr, throughput)
 
             if self.train_summary is not None:
                 self.train_summary.add_scalar("Loss", loss_f, state["neval"])
                 self.train_summary.add_scalar("LearningRate", lr,
                                               state["neval"])
-                self.train_summary.add_scalar("Throughput",
-                                              state["Throughput"],
+                self.train_summary.add_scalar("Throughput", throughput,
                                               state["neval"])
                 # per-parameter histograms, opt-in via trigger
                 # (TrainSummary.scala:64; DistriOptimizer.scala:464-498)
@@ -920,7 +1125,10 @@ class Optimizer:
                     self.dataset.shuffle()
                     data_iter = self.dataset.data(train=True)
 
-            # validation / checkpoint triggers (:382-411)
+            # validation / checkpoint triggers (:382-411). Windows flush
+            # at every plannable trigger boundary, so in K>1 mode these
+            # can only fire on the LAST replayed step — where params are
+            # exactly the window's (current) outputs.
             if (self.validation_trigger is not None
                     and self.validation_trigger(state)):
                 with telemetry.span("optimizer/validate",
@@ -946,6 +1154,168 @@ class Optimizer:
                 with telemetry.span("optimizer/checkpoint",
                                     step=state["neval"]):
                     self._checkpoint(params, opt_state, model_state)
+
+        wall_start = time.time()
+        while not end_when(state):
+            k_now = 1 if k_cap <= 1 else self._plan_window(
+                k_cap, state, plan_bsz, ds_size, end_when,
+                shard_size=shard_size)
+            t0 = time.time()
+            window_batches = None
+            if k_now > 1 and not (rotating or device_feed):
+                # host feed: gather a window of stackable equal-shape
+                # prefetched batches; a shape change, sparse leaves, the
+                # epoch boundary or exhaustion close the window early
+                first = pull_batch()
+                window_batches = [first]
+                if not _window_stackable(first) and not warned_unstackable:
+                    # config-level fallbacks log via _window_limit; this
+                    # DATA-dependent one must be visible too, or a user
+                    # chases a phantom "K=8 is no faster" regression
+                    warned_unstackable = True
+                    logger.info(
+                        "steps_per_sync=%d: batches are not window-"
+                        "stackable (sparse or device-resident leaves) — "
+                        "running per-step", self.steps_per_sync)
+                if _window_stackable(first):
+                    sig = batch_signature(first)
+                    rec_sim = (state["recordsProcessedThisEpoch"]
+                               + first.size())
+
+                    def boundary_after(steps_done, rec):
+                        # _plan_window simulated with the CONFIGURED
+                        # batch size; datasets may yield other sizes,
+                        # so re-peek the plannable triggers with the
+                        # ACTUAL accumulated record counts — a fire
+                        # after the just-gathered step ends the window
+                        sim = {"epoch": state["epoch"],
+                               "neval": state["neval"] + steps_done,
+                               "recordsProcessedThisEpoch": rec}
+                        return any(t is not None and t.peek(sim)
+                                   for t in (end_when,
+                                             self.validation_trigger,
+                                             self.checkpoint_trigger))
+
+                    while len(window_batches) < k_now \
+                            and rec_sim < ds_size \
+                            and not boundary_after(len(window_batches),
+                                                   rec_sim):
+                        try:
+                            b = pull_batch()
+                        except StopIteration:
+                            break
+                        if not _window_stackable(b) \
+                                or batch_signature(b) != sig:
+                            pending.append(b)
+                            break
+                        window_batches.append(b)
+                        rec_sim += b.size()
+                k_now = len(window_batches)
+
+            if k_now > 1:
+                # ---- fused window: ONE dispatch, ONE host sync ------
+                if rotating or device_feed:
+                    sizes = [plan_bsz] * k_now
+                    wargs = device_cursor_args()
+                    t_data = time.time() - t0
+                else:
+                    sizes = [b.size() for b in window_batches]
+                    stacked = stack_minibatches(window_batches)
+                    inp, tgt = self._prep_io_window(stacked)
+                    # close the staging window before dispatch, exactly
+                    # like the per-step path (sanctioned window-boundary
+                    # sync)
+                    jax.block_until_ready((inp, tgt))  # bigdl: disable=sync-in-loop
+                    t_data = time.time() - t0
+                # LR schedule + RNG key prep sit BETWEEN the phase
+                # windows, exactly where the per-step loop runs them —
+                # K=1 and K>1 data_wait/compute stay comparable
+                lr_list = self._window_lrs(k_now, state)
+                keys = jnp.stack([RandomGenerator.next_key()
+                                  for _ in range(k_now)])
+                # scan xs are strongly typed, unlike the per-step path's
+                # weak Python-float lr: stage in default_dtype so the
+                # update math promotes identically (a strong f32 lr
+                # against bf16 master params would widen the carry)
+                lrs = jnp.asarray(lr_list, Engine.default_dtype())
+                t1 = time.time()
+                if rotating or device_feed:
+                    params, opt_state, model_state, losses = window_fn(
+                        params, opt_state, model_state, keys, lrs, *wargs)
+                else:
+                    params, opt_state, model_state, losses = \
+                        host_window_fn(params, opt_state, model_state,
+                                       keys, lrs, inp, tgt)
+                # THE one sync per window: the losses fetch only gates
+                # the loss path, so close the timing window on the full
+                # outputs first (sanctioned window-boundary sync)
+                jax.block_until_ready((params, opt_state, model_state))  # bigdl: disable=sync-in-loop
+                loss_vals = _losses_list(losses, k_now)
+                t_compute = time.time() - t1
+                if telemetry.enabled():
+                    # per-WINDOW records (amortized granularity — see
+                    # docs/performance.md); phase SUMS still equal the
+                    # Metrics sums, so diagnose's invariant holds
+                    telemetry.record("optimizer/data_wait", t_data,
+                                     step=state["neval"])
+                    telemetry.record("optimizer/compute", t_compute,
+                                     step=state["neval"], steps=k_now)
+                _STEP_COUNT.inc(k_now)
+                _RECORD_COUNT.inc(sum(sizes))
+                self.metrics.add("data time", t_data)
+                self.metrics.add("computing time", t_compute)
+                rate = sum(sizes) / max(1e-9, t_data + t_compute)
+                for i in range(k_now):
+                    post_step(loss_vals[i], lr_list[i], sizes[i], rate)
+                continue
+
+            # ---- classic per-step path (k == 1) ---------------------
+            if rotating or device_feed:
+                bsz = self.dataset.batch_size
+                step_args = device_cursor_args()
+                run_step = fused_step
+            else:
+                batch = window_batches[0] if window_batches \
+                    else pull_batch()
+                inp, tgt = self._prep_io(batch)
+                # device_put above only DISPATCHED the transfer; without
+                # this barrier the copy time would silently migrate into
+                # t_compute and the data-vs-compute attribution would lie
+                # (sanctioned per-step sync; steps_per_sync amortizes it)
+                jax.block_until_ready((inp, tgt))  # bigdl: disable=sync-in-loop
+                bsz = batch.size()
+                step_args = (inp, tgt)
+                run_step = step
+            t_data = time.time() - t0
+            # trace carries the EXACT t_data the Metrics dump reports,
+            # so diagnose's phase attribution and Metrics.summary()
+            # agree to the digit (enabled() hoist: the disabled path
+            # must do no dict/label work in the hot loop)
+            if telemetry.enabled():
+                telemetry.record("optimizer/data_wait", t_data,
+                                 step=state["neval"])
+
+            lr = self.optim_method.update_hyper_parameter()
+            rng = RandomGenerator.next_key()
+            t1 = time.time()
+            params, opt_state, model_state, loss = run_step(
+                params, opt_state, model_state, rng, lr, *step_args)
+            # fetching the loss scalar only gates on the loss VALUE; the
+            # param/optimizer updates it does not depend on may still be
+            # in flight, so close the timing window on the full outputs
+            # (sanctioned per-step sync; steps_per_sync amortizes it)
+            jax.block_until_ready((params, opt_state, model_state))  # bigdl: disable=sync-in-loop
+            loss_f = _to_scalar(loss)
+            t_compute = time.time() - t1
+            if telemetry.enabled():
+                telemetry.record("optimizer/compute", t_compute,
+                                 step=state["neval"])
+            _STEP_COUNT.inc()
+            _RECORD_COUNT.inc(bsz)
+            self.metrics.add("data time", t_data)
+            self.metrics.add("computing time", t_compute)
+            post_step(loss_f, lr, bsz,
+                      bsz / max(1e-9, t_data + t_compute))
 
         logger.info("training done in %.1fs; %s", time.time() - wall_start,
                     self.metrics.summary())

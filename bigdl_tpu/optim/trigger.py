@@ -2,26 +2,71 @@
 
 A trigger is a predicate over the driver state dict (epoch, neval, Loss,
 score ...). Combinators and the full reference set are provided.
+
+Triggers additionally declare which state keys they read
+(``depends_on``) and support a side-effect-free ``peek``: the windowed
+step driver (``Optimizer.set_steps_per_sync``) simulates counter
+advancement across a fused window and must know, BEFORE dispatching,
+whether a trigger would fire mid-window — without corrupting stateful
+triggers like ``every_epoch``. A trigger whose dependencies are unknown
+(``depends_on is None``) or that reads runtime values only the device
+can produce (``Loss``, ``score``) cannot be planned ahead, and the
+driver falls back to per-step (K=1) windows for exact semantics.
 """
 from __future__ import annotations
 
-from typing import Callable, Dict, Any
+from typing import Any, Callable, Dict, FrozenSet, Optional
+
+#: driver-state keys whose future values the windowed planner can
+#: simulate exactly on the host (pure counter arithmetic)
+PLANNABLE_KEYS = frozenset({"epoch", "neval", "recordsProcessedThisEpoch"})
+
+
+def _union(a: Optional[FrozenSet[str]], b: Optional[FrozenSet[str]]):
+    return None if a is None or b is None else a | b
 
 
 class Trigger:
     """Composable predicate over driver state (optim/Trigger.scala);
-    ``and_``/``or_`` build the reference's trigger algebra."""
-    def __init__(self, fn: Callable[[Dict[str, Any]], bool]):
+    ``and_``/``or_`` build the reference's trigger algebra.
+
+    ``depends_on`` is the set of state keys the predicate reads (None =
+    unknown, the safe default for hand-rolled triggers); ``peek`` is a
+    mutation-free evaluation used for window planning and defaults to
+    the predicate itself (correct for every stateless trigger)."""
+
+    def __init__(self, fn: Callable[[Dict[str, Any]], bool],
+                 depends_on: Optional[FrozenSet[str]] = None,
+                 peek: Optional[Callable[[Dict[str, Any]], bool]] = None):
         self._fn = fn
+        self.depends_on = frozenset(depends_on) \
+            if depends_on is not None else None
+        self._peek = peek
 
     def __call__(self, state: Dict[str, Any]) -> bool:
         return self._fn(state)
 
+    def peek(self, state: Dict[str, Any]) -> bool:
+        """Evaluate against ``state`` WITHOUT advancing any internal
+        trigger state — what the windowed driver calls on simulated
+        future states while planning a fused window."""
+        return (self._peek or self._fn)(state)
+
+    def plannable(self) -> bool:
+        """True when the windowed driver can predict this trigger's
+        firings from simulated counters alone."""
+        return self.depends_on is not None \
+            and self.depends_on <= PLANNABLE_KEYS
+
     def and_(self, other: "Trigger") -> "Trigger":
-        return Trigger(lambda s: self(s) and other(s))
+        return Trigger(lambda s: self(s) and other(s),
+                       depends_on=_union(self.depends_on, other.depends_on),
+                       peek=lambda s: self.peek(s) and other.peek(s))
 
     def or_(self, other: "Trigger") -> "Trigger":
-        return Trigger(lambda s: self(s) or other(s))
+        return Trigger(lambda s: self(s) or other(s),
+                       depends_on=_union(self.depends_on, other.depends_on),
+                       peek=lambda s: self.peek(s) or other.peek(s))
 
 
 def every_epoch() -> Trigger:
@@ -38,29 +83,41 @@ def every_epoch() -> Trigger:
             return True
         return False
 
-    return Trigger(fn)
+    def peek(state):
+        # first real call only latches the baseline; after that the
+        # predicate is a pure comparison against the latched epoch
+        if holder["last"] is None:
+            return False
+        return state.get("epoch", 1) > holder["last"]
+
+    return Trigger(fn, depends_on=frozenset({"epoch"}), peek=peek)
 
 
 def several_iteration(interval: int) -> Trigger:
     """Fires every `interval` iterations (Trigger.severalIteration)."""
-    return Trigger(lambda s: s.get("neval", 1) % interval == 0)
+    return Trigger(lambda s: s.get("neval", 1) % interval == 0,
+                   depends_on=frozenset({"neval"}))
 
 
 def max_epoch(m: int) -> Trigger:
     """End condition: epoch > m (Trigger.maxEpoch)."""
-    return Trigger(lambda s: s.get("epoch", 1) > m)
+    return Trigger(lambda s: s.get("epoch", 1) > m,
+                   depends_on=frozenset({"epoch"}))
 
 
 def max_iteration(m: int) -> Trigger:
     """End condition: neval > m (Trigger.maxIteration)."""
-    return Trigger(lambda s: s.get("neval", 1) > m)
+    return Trigger(lambda s: s.get("neval", 1) > m,
+                   depends_on=frozenset({"neval"}))
 
 
 def max_score(m: float) -> Trigger:
     """End when validation score exceeds m (Trigger.maxScore)."""
-    return Trigger(lambda s: s.get("score", float("-inf")) > m)
+    return Trigger(lambda s: s.get("score", float("-inf")) > m,
+                   depends_on=frozenset({"score"}))
 
 
 def min_loss(m: float) -> Trigger:
     """End when training loss drops below m (Trigger.minLoss)."""
-    return Trigger(lambda s: s.get("Loss", float("inf")) < m)
+    return Trigger(lambda s: s.get("Loss", float("inf")) < m,
+                   depends_on=frozenset({"Loss"}))

@@ -187,5 +187,7 @@ class CompileCache:
         before = self.compile_count(key)
         for b in ladder:
             x = np.zeros((b,) + tuple(feature_shape), dtype)
-            jax.block_until_ready(step(params, state, x))
+            # deliberately synchronous: warmup exists to GATE on the
+            # compile of every ladder bucket before serving starts
+            jax.block_until_ready(step(params, state, x))  # bigdl: disable=sync-in-loop
         return self.compile_count(key) - before

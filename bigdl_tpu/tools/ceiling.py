@@ -856,7 +856,11 @@ if __name__ == "__main__":
         r = hand(mode, iters)
     else:
         r = framework(mode, iters)
-    out = {"mode": mode, "items_per_sec": round(r, 1)}
+    # steps_per_sync: every ceiling harness dispatches SCAN fused steps
+    # per host sync — the same window the Optimizer's set_steps_per_sync
+    # knob gives training, so ablations and driver runs are comparable
+    out = {"mode": mode, "items_per_sec": round(r, 1),
+           "steps_per_sync": SCAN}
     if "tlm" in mode:
         out["tokens_per_sec"] = round(r * TLM["seq"], 1)
     if "lstm" in mode:

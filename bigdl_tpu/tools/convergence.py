@@ -126,9 +126,11 @@ def run_image(name: str, build_model, optim, lr_for_epoch, epochs: int,
         keys = jax.random.split(jax.random.fold_in(root, e),
                                 steps_per_epoch)
         carry, losses = run_epoch(carry, keys, ds.images, ds.labels)
-        acc = float(eval_acc(carry[0], carry[2], ev.images, ev.labels))
+        # sanctioned window boundary: the epoch is one fused scan
+        # dispatch; this is the once-per-epoch sync, not per-step
+        acc = float(eval_acc(carry[0], carry[2], ev.images, ev.labels))  # bigdl: disable=sync-in-loop
         history.append(round(acc, 4))
-        print(f"epoch {e + 1}: loss={float(losses.mean()):.4f} "
+        print(f"epoch {e + 1}: loss={float(losses.mean()):.4f} "  # bigdl: disable=sync-in-loop
               f"val_acc={acc:.4f}", flush=True)
     dt = time.time() - t0
     result = {"recipe": name, "final_val_acc": history[-1],
@@ -221,9 +223,10 @@ def run_lm(name: str, build_model, criterion, optim, lr: float,
         keys = jax.random.split(jax.random.fold_in(root, e),
                                 steps_per_epoch)
         carry, losses = run_epoch(carry, keys)
-        ppl = float(jnp.exp(eval_nll(carry[0], carry[2])))
+        # sanctioned window boundary: one sync per scanned epoch
+        ppl = float(jnp.exp(eval_nll(carry[0], carry[2])))  # bigdl: disable=sync-in-loop
         history.append(round(ppl, 3))
-        print(f"epoch {e + 1}: loss={float(losses.mean()):.4f} "
+        print(f"epoch {e + 1}: loss={float(losses.mean()):.4f} "  # bigdl: disable=sync-in-loop
               f"val_ppl={ppl:.3f} (floor {floor:.3f})", flush=True)
     dt = time.time() - t0
     result = {"recipe": name, "final_val_ppl": history[-1],

@@ -73,7 +73,18 @@ def main(argv=None):
                     "iteration phase histograms + run meta) to PATH as "
                     "one JSONL line; default off (BIGDL_METRICS_JSONL "
                     "env var also enables it)")
+    ap.add_argument("--steps-per-sync", type=int, default=1, metavar="K",
+                    help="train mode: fuse K steps into one scanned "
+                    "dispatch and sync the host once per window "
+                    "(Optimizer.set_steps_per_sync's measurement twin); "
+                    "1 = classic per-step dispatch")
+    ap.add_argument("--sync-compare", action="store_true",
+                    help="train mode: additionally measure steps/sec at "
+                    "K=1 vs K=8 fused windows and report both in the "
+                    "JSON tail line")
     args = ap.parse_args(argv)
+    if args.steps_per_sync < 1:
+        raise SystemExit("--steps-per-sync must be >= 1")
 
     import jax
     import jax.numpy as jnp
@@ -117,27 +128,66 @@ def main(argv=None):
     # analysis (a post-hoc step.lower().compile() would re-compile the
     # whole program a second time just to read the flop count)
     compiled_for_cost = None
+    sync_k = args.steps_per_sync if args.mode == "train" else 1
     if args.mode == "train":
+        import functools
+        from jax import lax
+
         optim = SGD(learning_rate=0.01, momentum=0.9)
         opt_state = optim.init_state(params)
-        step = build_train_step(model, criterion, optim)
+        jit_step = build_train_step(model, criterion, optim)
         key = jax.random.PRNGKey(0)
-        try:
-            step = step.lower(params, opt_state, mstate, key, 0.01,
-                              x, y).compile()
-            compiled_for_cost = step
-        except Exception as e:
-            print(f"# cost-analysis unavailable ({type(e).__name__})")
 
-        def run():
-            nonlocal params, opt_state, mstate
-            params, opt_state, mstate, loss = step(
-                params, opt_state, mstate, key, 0.01, x, y)
-            # the loss fetch in sync() does not gate on the param update
-            # branch of the program; block here so per-iteration timings
-            # cover the WHOLE step, not just the loss path
-            jax.block_until_ready(params)
-            return loss
+        def make_chunk(k):
+            # k fused train steps over the SAME resident batch, per-step
+            # keys threaded as scan xs — measures what bounded async
+            # dispatch amortizes (per-dispatch + per-sync host cost),
+            # with zero feed variance
+            def body(carry, kk):
+                p, o, m = carry
+                p, o, m, loss = jit_step(p, o, m, kk, 0.01, x, y)
+                return (p, o, m), loss
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def chunk(carry, keys):
+                return lax.scan(body, carry, keys)
+            return chunk
+
+        if sync_k > 1:
+            chunk = make_chunk(sync_k)
+            keys0 = jax.random.split(key, sync_k)
+            carry = (params, opt_state, mstate)
+            try:
+                chunk = chunk.lower(carry, keys0).compile()
+                compiled_for_cost = chunk
+            except Exception as e:
+                print(f"# cost-analysis unavailable ({type(e).__name__})")
+
+            def run():
+                nonlocal carry
+                carry, losses = chunk(carry, keys0)
+                # close the window on the full carry, not the loss path
+                jax.block_until_ready(carry[0])
+                return losses
+        else:
+            step = jit_step
+            try:
+                step = step.lower(params, opt_state, mstate, key, 0.01,
+                                  x, y).compile()
+                compiled_for_cost = step
+            except Exception as e:
+                print(f"# cost-analysis unavailable ({type(e).__name__})")
+
+            def run():
+                nonlocal params, opt_state, mstate
+                params, opt_state, mstate, loss = step(
+                    params, opt_state, mstate, key, 0.01, x, y)
+                # the loss fetch in sync() does not gate on the param
+                # update branch of the program; block here so
+                # per-iteration timings cover the WHOLE step, not just
+                # the loss path
+                jax.block_until_ready(params)
+                return loss
     else:
         eval_step = build_eval_step(model)
         try:
@@ -156,8 +206,11 @@ def main(argv=None):
         leaf = jax.tree_util.tree_leaves(out)[0]
         return float(jnp.sum(jnp.asarray(leaf).astype(jnp.float32)))
 
+    recs_per_iter = (args.batch_size * sync_k
+                     * (in_shape[0] if is_lm else 1))
     print(f"# {args.model} {args.mode} batch={args.batch_size} "
-          f"dtype={args.dtype} backend={jax.default_backend()}")
+          f"dtype={args.dtype} steps_per_sync={sync_k} "
+          f"backend={jax.default_backend()}")
     for i in range(args.warmup):
         t0 = time.perf_counter()
         sync(run())
@@ -172,10 +225,10 @@ def main(argv=None):
         _ITER_S.observe(dt, model=args.model, mode=args.mode)
         times.append(dt)
         unit = "tok/s" if is_lm else "img/s"
-        rate = (args.batch_size * (in_shape[0] if is_lm else 1)) / dt
+        rate = recs_per_iter / dt
         print(f"iter {i}: {dt*1000:.1f} ms  {rate:.1f} {unit}")
     med = float(np.median(times))
-    rate = (args.batch_size * (in_shape[0] if is_lm else 1)) / med
+    rate = recs_per_iter / med
     line = (f"median: {med*1000:.1f} ms  {rate:.1f} "
             f"{'tok/s' if is_lm else 'img/s'}")
     # analytic MFU vs the measured device envelope (BASELINE.md platform
@@ -196,15 +249,38 @@ def main(argv=None):
             line += f"  |  cost-analysis failed: {type(e).__name__}"
     print(line)
 
+    # machine-readable JSON tail (the driver's scoreboard hook): the
+    # run's steps/sec at its window size, plus the K=1-vs-K=8 dispatch
+    # comparison when requested
+    tail = {"tool": "perf", "model": args.model, "mode": args.mode,
+            "batch_size": args.batch_size, "dtype": args.dtype,
+            "backend": jax.default_backend(), "median_s": med,
+            "rate": rate, "steps_per_sync": sync_k}
+    if args.mode == "train":
+        tail["steps_per_sec"] = sync_k / med
+        if args.sync_compare:
+            from bigdl_tpu.tools.sync_compare import measure_sync_compare
+            carry2 = carry if sync_k > 1 else (params, opt_state, mstate)
+
+            def build(k):
+                # the main loop's compiled window is the same program
+                # when k matches — reuse it instead of recompiling
+                # (sync_k == 1 ran the plain per-step path: no chunk)
+                return chunk if sync_k > 1 and k == sync_k \
+                    else make_chunk(k)
+
+            rates, carry2 = measure_sync_compare(
+                build, carry2,
+                lambda k, i: jax.random.split(
+                    jax.random.fold_in(key, 100 * k + i + 1), k),
+                total=max(8, args.iterations))
+            tail.update(rates)
+    import json
+    print(json.dumps(tail))
+
     jsonl = args.metrics_jsonl or os.environ.get("BIGDL_METRICS_JSONL")
     if jsonl:
-        telemetry.snapshot_to_jsonl(jsonl, meta={
-            "tool": "perf", "model": args.model, "mode": args.mode,
-            "batch_size": args.batch_size, "dtype": args.dtype,
-            "backend": jax.default_backend(),
-            "median_s": med,
-            "rate": (args.batch_size *
-                     (in_shape[0] if is_lm else 1)) / med})
+        telemetry.snapshot_to_jsonl(jsonl, meta=tail)
         print(f"# metrics snapshot appended to {jsonl}")
 
 

@@ -47,7 +47,9 @@ def probe_device_put_chunk(max_mb: int = 96, *, drop_ratio: float = 0.5,
                                                 dtype=np.uint8)
         t0 = time.time()
         out = jax.device_put(arr, dev)
-        out.block_until_ready()
+        # the probe measures completed transfers; per-piece
+        # sync is the alternation rule under test
+        out.block_until_ready()  # bigdl: disable=sync-in-loop
         # fetch a slice: on tunneled backends block_until_ready can
         # return before the bytes actually crossed (measured: "fast"
         # puts that were pure dispatch) — a readback is the only

@@ -168,7 +168,106 @@ def f(x):
         return x * 2
 """,
     ),
+    "sync-in-loop": (
+        """
+def train(step, params, batches):
+    for x in batches:
+        params, loss = step(params, x)
+        jax.block_until_ready(params)
+        print(float(loss))
+""",
+        """
+def train(step, params, batches):
+    for x in batches:
+        params, loss = step(params, x)
+        jax.block_until_ready(params)  # bigdl: disable=sync-in-loop
+        print(float(loss))  # bigdl: disable=sync-in-loop
+""",
+    ),
 }
+
+
+def test_sync_in_loop_skips_files_without_jax():
+    # .item()/float() in a numpy-only file touch no device; the rule
+    # must not fire where jax is never imported
+    src = """
+import numpy as np
+
+def f(cols):
+    out = []
+    for c in cols:
+        out.append(c.item())
+        out.append(float(np.sum(c)))
+    return out
+"""
+    findings = lint_source(src, "fixture.py")
+    assert "sync-in-loop" not in names(findings, only_active=False)
+
+
+def test_sync_in_loop_flags_inner_loop_once():
+    src = HEADER + """
+def train(step, params, epochs, batches):
+    for e in range(epochs):
+        for x in batches:
+            params, loss = step(params, x)
+            jax.block_until_ready(params)
+"""
+    findings = lint_source(src, "fixture.py")
+    hits = [f for f in findings if f.rule == "sync-in-loop"]
+    assert len(hits) == 1  # the inner loop's finding, not doubled
+
+
+def test_sync_in_loop_ignores_float_of_host_values():
+    src = HEADER + """
+def summarize(xs):
+    total = 0.0
+    for x in xs:
+        total += float(x)  # plain python value, never assigned from a call
+    return total
+"""
+    findings = lint_source(src, "fixture.py")
+    assert "sync-in-loop" not in names(findings, only_active=False)
+
+
+def test_sync_in_loop_ignores_host_parsing_method_calls():
+    # method calls on arbitrary objects (string/regex parsing) are host
+    # work even in a jax-importing file — float() over them is fine
+    src = HEADER + """
+def parse(fh):
+    total = 0.0
+    for line in fh:
+        parts = line.split(",")
+        total += float(parts[0])
+    return total
+"""
+    findings = lint_source(src, "fixture.py")
+    assert "sync-in-loop" not in names(findings, only_active=False)
+
+
+def test_sync_in_loop_ignores_float_of_host_builtins():
+    src = HEADER + """
+def count(rows):
+    total = 0.0
+    for row in rows:
+        n = len(row)
+        total += float(n)  # host integer, not a device fetch
+    return total
+"""
+    findings = lint_source(src, "fixture.py")
+    assert "sync-in-loop" not in names(findings, only_active=False)
+
+
+def test_sync_in_loop_flags_module_level_script_loop():
+    # script-style top-level training loops are the classic per-step
+    # sync offender; module level is NOT exempt for this rule
+    src = HEADER + """
+params = init()
+for i in range(1000):
+    params, loss = step(params)
+    jax.block_until_ready(params)
+"""
+    findings = lint_source(src, "fixture.py")
+    assert "sync-in-loop" in names(findings)
 
 
 def test_case_table_covers_every_shipped_rule():

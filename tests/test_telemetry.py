@@ -156,6 +156,19 @@ class TestDisabledMode:
         per_span = (time.perf_counter() - t0) / n
         assert per_span < 5e-6, f"{per_span * 1e6:.2f}us per disabled span"
 
+    def test_disabled_record_overhead_bounded(self):
+        """record() is the optimizer hot loop's other entry point (the
+        exact t_data/t_compute shipper); disabled it must be one flag
+        check and return — no dict, no clock, no string work."""
+        assert not telemetry.enabled()
+        n = 50_000
+        t0 = time.perf_counter()
+        for i in range(n):
+            telemetry.record("optimizer/data_wait", 0.001)
+        per_call = (time.perf_counter() - t0) / n
+        assert per_call < 5e-6, \
+            f"{per_call * 1e6:.2f}us per disabled record"
+
     def test_disabled_creates_no_threads_files_or_spans(self, tmp_path):
         before_threads = set(threading.enumerate())
         cwd_before = sorted(os.listdir(tmp_path))
