@@ -25,6 +25,9 @@ frankfzw/BigDL, Scala/Spark/MKL) as an idiomatic JAX/XLA framework:
 - ``bigdl_tpu.analysis`` — pre-compile static analysis: eval_shape-based
   shape/dtype checking with layer-path diagnostics (``Module.check``) and a
   pluggable JAX-pitfall linter (``python -m bigdl_tpu.tools.check``).
+- ``bigdl_tpu.faults``   — deterministic fault injection (named faultpoints,
+  seeded schedules) + classified backoff retry; recovery is validated
+  bit-exactly by ``python -m bigdl_tpu.tools.chaos`` (docs/robustness.md).
 
 Design notes (vs the reference, /root/reference):
 - BigDL ``Tensor[T]`` (tensor/Tensor.scala:36) -> ``jax.Array``; the 104-method
@@ -42,13 +45,13 @@ Design notes (vs the reference, /root/reference):
 from bigdl_tpu.utils.table import Table, T
 from bigdl_tpu.utils.random import RandomGenerator
 from bigdl_tpu.utils.engine import Engine
-from bigdl_tpu import (nn, optim, dataset, parallel, serving, telemetry,
-                       utils, analysis)
+from bigdl_tpu import (nn, optim, dataset, faults, parallel, serving,
+                       telemetry, utils, analysis)
 
 __version__ = "0.1.0"
 
 __all__ = [
     "Table", "T", "RandomGenerator", "Engine",
-    "analysis", "nn", "optim", "dataset", "parallel", "serving",
-    "telemetry", "utils",
+    "analysis", "nn", "optim", "dataset", "faults", "parallel",
+    "serving", "telemetry", "utils",
 ]

@@ -13,7 +13,9 @@ Shipped rules:
 - ``global-rng`` — module-global ``np.random``/``random`` state
 - ``bare-except`` — bare ``except:`` handlers
 - ``sync-in-loop`` — per-iteration host-device sync in host step loops
+- ``retry-no-backoff`` — broad-except retry loops with fixed sleeps
 """
-from bigdl_tpu.analysis.rules import jit_calls, perf, purity, style, traced
+from bigdl_tpu.analysis.rules import (jit_calls, perf, purity, robust,
+                                      style, traced)
 
-__all__ = ["jit_calls", "perf", "purity", "style", "traced"]
+__all__ = ["jit_calls", "perf", "purity", "robust", "style", "traced"]

@@ -1,0 +1,118 @@
+"""Recovery-path rules: retry loops that can't actually recover.
+
+A retry loop that catches ``Exception`` and sleeps a FIXED interval
+has two failure modes this package just paid to remove from its own
+optimizer: structural errors (wrong types, shape mismatches) replay
+identically on every attempt — the loop burns its budget re-raising
+the same diagnostic — and a fleet of workers retrying on the same
+fixed clock stampedes whatever dependency just recovered. The
+sanctioned pattern is classified retry with exponential backoff +
+jitter (``bigdl_tpu.faults.retry``); deliberate fixed-sleep sites
+carry an auditable ``# bigdl: disable=retry-no-backoff``.
+"""
+from __future__ import annotations
+
+import ast
+
+from bigdl_tpu.analysis.lint import FileContext, rule
+
+
+def _catches_broadly(handler: ast.ExceptHandler) -> bool:
+    """``except:`` / ``except Exception`` / ``except (..., Exception)``
+    — the catch-everything shapes a retry loop wraps its body in."""
+    t = handler.type
+    if t is None:
+        return True
+    names = t.elts if isinstance(t, ast.Tuple) else [t]
+    for n in names:
+        if isinstance(n, ast.Name) and n.id in ("Exception",
+                                                "BaseException"):
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in ("Exception",
+                                                       "BaseException"):
+            return True
+    return False
+
+
+def _dotted(node: ast.AST):
+    """``self.delay`` -> "self.delay" (None for non-name chains)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _loop_bound_names(loop: ast.AST) -> set:
+    """Names (and dotted attribute chains like ``self.delay``)
+    assigned anywhere in the loop body — a sleep over one of these is
+    (potentially) a computed, growing delay, not a fixed interval."""
+    bound = set()
+    for node in ast.walk(loop):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                d = _dotted(t)
+                if d is not None:
+                    bound.add(d)
+                for e in ast.walk(t):
+                    if isinstance(e, ast.Name):
+                        bound.add(e.id)
+        elif isinstance(node, (ast.For, ast.comprehension)):
+            for e in ast.walk(node.target):
+                if isinstance(e, ast.Name):
+                    bound.add(e.id)
+    return bound
+
+
+def _is_fixed_interval(arg: ast.AST, loop_bound: set) -> bool:
+    """A sleep argument that cannot change across attempts: a literal,
+    an attribute never reassigned in the loop
+    (``self.retry_interval_s``, the config-knob shape — but not
+    ``self.delay`` after ``self.delay *= 2``), or a name the loop
+    never rebinds."""
+    if isinstance(arg, ast.Constant):
+        return True
+    if isinstance(arg, ast.Attribute):
+        d = _dotted(arg)
+        return d is None or d not in loop_bound
+    if isinstance(arg, ast.Name):
+        return arg.id not in loop_bound
+    return False
+
+
+@rule("retry-no-backoff",
+      "broad-except retry loop sleeping a fixed interval")
+def retry_no_backoff(ctx: FileContext):
+    """Flags ``except Exception`` (or broader) handlers inside a loop
+    whose recovery is ``time.sleep(<fixed interval>)`` — a constant,
+    an attribute like ``self.retry_interval_s``, or a name the loop
+    never rebinds. Computed delays (``time.sleep(delay)`` where the
+    handler assigns ``delay``) pass: that is the backoff pattern."""
+    for loop in ctx.walk(ast.For, ast.While):
+        loop_bound = None
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.ExceptHandler) \
+                    or not _catches_broadly(node):
+                continue
+            for call in ast.walk(node):
+                if not isinstance(call, ast.Call) or not call.args:
+                    continue
+                if ctx.canon(call.func) != "time.sleep":
+                    continue
+                if loop_bound is None:
+                    loop_bound = _loop_bound_names(loop)
+                if _is_fixed_interval(call.args[0], loop_bound):
+                    yield call, (
+                        "retry loop catches Exception and sleeps a "
+                        "fixed interval: structural errors replay "
+                        "identically (classify and fail fast) and "
+                        "synchronized retriers stampede — use "
+                        "faults.retry.retry_call / backoff_delay "
+                        "(exponential backoff + jitter), or mark a "
+                        "deliberate fixed sleep with `# bigdl: "
+                        "disable=retry-no-backoff`")

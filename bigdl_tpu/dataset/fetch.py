@@ -51,37 +51,51 @@ SHAKESPEARE_URL = ("https://raw.githubusercontent.com/karpathy/char-rnn/"
 
 
 def maybe_download(filename: str, work_dir: str, source_url: str,
-                   sha256: str = None) -> str:
+                   sha256: str = None, attempts: int = 3) -> str:
     """Download ``source_url`` into ``work_dir/filename`` unless it is
     already there (base.py:176). Offline environments pre-seed the file
     and never hit the network. When ``sha256`` is given the download is
     verified before it is moved into place (a corrupt or tampered file
-    never lands under the cache name)."""
+    never lands under the cache name).
+
+    Transient failures — connection drops, truncated bodies failing
+    their digest — retry up to ``attempts`` total tries with
+    exponential backoff + jitter (``faults.retry.retry_call``); each
+    attempt starts clean by removing any stale ``.part`` left by a
+    prior crashed or failed run, so a resumed process never verifies
+    (or ships) a half-written temp file."""
+    from bigdl_tpu import faults
+    from bigdl_tpu.faults.retry import retry_call
+
     os.makedirs(work_dir, exist_ok=True)
     filepath = os.path.join(work_dir, filename)
     if not os.path.exists(filepath):
         from urllib.request import urlretrieve
         print(f"downloading {source_url} -> {filepath}")
         tmp = filepath + ".part"
-        urlretrieve(source_url, tmp)
-        if sha256 is not None:
-            got = _file_sha256(tmp)
-            if got != sha256:
+
+        def _attempt():
+            if os.path.exists(tmp):  # stale from a crashed/failed run
                 os.remove(tmp)
-                raise IOError(
-                    f"{source_url}: sha256 mismatch "
-                    f"(got {got}, want {sha256})")
+            faults.point("fetch/download", url=source_url)
+            urlretrieve(source_url, tmp)
+            if sha256 is not None:
+                got = _file_sha256(tmp)
+                if got != sha256:
+                    os.remove(tmp)
+                    raise IOError(
+                        f"{source_url}: sha256 mismatch "
+                        f"(got {got}, want {sha256})")
+
+        retry_call(_attempt, attempts=attempts, base_delay_s=0.5,
+                   describe=f"download {source_url}")
         os.replace(tmp, filepath)
     return filepath
 
 
 def _file_sha256(path: str) -> str:
-    import hashlib
-    h = hashlib.sha256()
-    with open(path, "rb") as f:
-        for chunk in iter(lambda: f.read(1 << 20), b""):
-            h.update(chunk)
-    return h.hexdigest()
+    from bigdl_tpu.utils.file_io import file_sha256
+    return file_sha256(path)
 
 
 def _pinned_sha256(filepath: str, env_var: str):

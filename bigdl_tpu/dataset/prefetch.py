@@ -26,6 +26,7 @@ import jax
 import numpy as np
 
 import bigdl_tpu.telemetry as telemetry
+from bigdl_tpu import faults
 from bigdl_tpu.dataset.sample import MiniBatch
 
 # data-path instruments: how deep the staged queue runs (is the chip
@@ -218,6 +219,10 @@ def device_prefetch(it: Iterator[MiniBatch], *, size: int = 2,
                     # span, no stage_s sample
                     break
                 with telemetry.span("data/prefetch_stage"):
+                    # staging-thread death site: an injected failure
+                    # here rides the existing error channel to the
+                    # consumer (never a silent end-of-dataset)
+                    faults.point("prefetch/stage")
                     staged = _put(batch, sharding)
                 _STAGE_S.observe(time.perf_counter() - t0)
                 _STAGED.inc()

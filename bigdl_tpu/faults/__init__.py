@@ -1,0 +1,45 @@
+"""bigdl_tpu.faults — deterministic fault injection + hardened recovery.
+
+Failure is an *input* here, not an accident: named faultpoints sit at
+the exact sites where real systems die (mid-checkpoint-write, inside a
+download attempt, on the serving dispatch thread), and a seeded
+:class:`FaultSchedule` scripts what each one does — fail on the Nth
+call, fail with a seeded probability, inject latency, raise a chosen
+exception, or SIGKILL the process. Disarmed (the default) every
+faultpoint is one module-flag check; armed, every fired fault lands in
+the ``faults/point/injected`` telemetry counter so the chaos CLI
+(``python -m bigdl_tpu.tools.chaos``) can assert injections reconcile
+exactly against recovery counters. See docs/robustness.md.
+
+Usage::
+
+    from bigdl_tpu import faults
+
+    # in library code, at the site where a real system would die:
+    faults.point("fetch/download", url=url)
+
+    # in a test / the chaos CLI:
+    with faults.armed("fetch/download=nth:1-2,raise:OSError"):
+        mnist_read_data_sets(tmpdir)          # retries, then succeeds
+
+The sibling :mod:`bigdl_tpu.faults.retry` module is the recovery half:
+exception classification (fatal-fast vs transient-retry) and
+exponential backoff + jitter, shared by the optimizer's
+retry-from-checkpoint loop and the IO paths.
+"""
+from bigdl_tpu.faults.core import (NAMED_EXCEPTIONS, FaultRule,
+                                   FaultSchedule, InjectedFault,
+                                   active_schedule, arm, armed, disarm,
+                                   injected_total, is_armed,
+                                   parse_schedule, point)
+from bigdl_tpu.faults.retry import (FATAL_TYPES, TRANSIENT_TYPES,
+                                    backoff_delay, classify, is_transient,
+                                    retry_call)
+
+__all__ = [
+    "FaultRule", "FaultSchedule", "InjectedFault", "NAMED_EXCEPTIONS",
+    "active_schedule", "arm", "armed", "disarm", "injected_total",
+    "is_armed", "parse_schedule", "point",
+    "FATAL_TYPES", "TRANSIENT_TYPES", "backoff_delay", "classify",
+    "is_transient", "retry_call",
+]

@@ -1,0 +1,320 @@
+"""Deterministic fault injection: named faultpoints + scripted schedules.
+
+Code under test declares **faultpoints** — named host-side call sites
+(``faults.point("ckpt/write_manifest", neval=4)``) at the exact spots
+where real systems die: mid-checkpoint-write, inside a download attempt,
+on the serving dispatch thread. A test (or the chaos CLI) **arms** a
+:class:`FaultSchedule` scripting what each point does: fail on the Nth
+matching call, fail with a seeded probability, inject latency, raise a
+chosen exception type, or SIGKILL the process — the same scripted-death
+technique the reference used for its fault-tolerance suite
+(ExceptionTest / TestUtils.scala:103-131), made a reusable subsystem.
+
+Disarmed is the default and costs one module-flag check per call (the
+``telemetry.span`` discipline — safe to leave in production hot loops;
+a micro-benchmark test asserts the bound). Armed, every fired fault
+lands in the ``faults/point/injected`` telemetry counter (labelled
+``point=<name>``), so recovery becomes a *reconcilable* invariant: the
+chaos CLI asserts injected faults == observed recoveries, counter for
+counter.
+
+Schedules are deterministic by construction: per-rule call counters and
+per-rule seeded RNGs — the same schedule against the same workload
+injects the same faults, which is what lets the chaos soak demand
+bit-identical final params.
+
+String syntax (``parse_schedule``)::
+
+    point=opt,opt,...;point=opt,...
+
+    train/step=nth:3,raise:RuntimeError        # 3rd call raises
+    fetch/download=nth:1-2,raise:OSError       # calls 1 and 2 raise
+    serving/dispatch=prob:0.5,seed:7,times:2   # seeded coin, max twice
+    prefetch/stage=delay:20                    # inject 20ms latency
+    ckpt/write_manifest=match:neval=4,sigkill  # SIGKILL at neval 4
+"""
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import bigdl_tpu.telemetry as telemetry
+
+_INJECTED = telemetry.counter(
+    "faults/point/injected",
+    "faults fired by the armed schedule (labelled point=<name>)")
+
+
+class InjectedFault(RuntimeError):
+    """The default exception an armed faultpoint raises (classified
+    transient by :func:`bigdl_tpu.faults.retry.classify`, so recovery
+    paths exercise their real retry logic)."""
+
+
+#: exception types a schedule string may name (``raise:OSError``);
+#: programmatic rules accept any exception class directly
+NAMED_EXCEPTIONS: Dict[str, type] = {
+    "InjectedFault": InjectedFault,
+    "RuntimeError": RuntimeError,
+    "OSError": OSError,
+    "IOError": OSError,
+    "ConnectionError": ConnectionError,
+    "TimeoutError": TimeoutError,
+    "ValueError": ValueError,
+    "TypeError": TypeError,
+}
+
+_ACTIONS = ("raise", "sigkill", "delay")
+
+
+class FaultRule:
+    """One scripted behavior for one faultpoint.
+
+    ``when`` is the conjunction of every given matcher: call number
+    (``nth`` — a (first, last) inclusive range over MATCHING calls),
+    seeded probability (``prob``/``seed``), and context equality
+    (``match`` — compared against the kwargs the call site passes).
+    ``times`` bounds total fires. ``action`` is ``"raise"`` (with
+    ``exc``), ``"sigkill"``, or ``"delay"``; ``delay_ms`` latency is
+    injected before any action (so a rule can be pure latency)."""
+
+    def __init__(self, point: str, *, action: str = "raise",
+                 exc: type = InjectedFault, nth=None,
+                 prob: Optional[float] = None, seed: int = 0,
+                 times: Optional[int] = None,
+                 match: Optional[Dict[str, Any]] = None,
+                 predicate: Optional[Callable[[Dict[str, Any]], bool]]
+                 = None,
+                 delay_ms: float = 0.0):
+        if action not in _ACTIONS:
+            raise ValueError(
+                f"action must be one of {_ACTIONS}, got {action!r}")
+        if isinstance(nth, int):
+            nth = (nth, nth)
+        self.point = point
+        self.action = action
+        self.exc = exc
+        self.nth = nth
+        self.prob = prob
+        self.times = times
+        self.match = dict(match) if match else None
+        self.predicate = predicate
+        self.delay_ms = float(delay_ms)
+        self._rng = random.Random(seed)
+        self.calls = 0   # matching-context calls seen
+        self.fired = 0   # faults actually injected
+
+    def consider(self, ctx: Dict[str, Any]) -> bool:
+        """Whether this rule would fire for one call. Advances the
+        rule's deterministic state (matching-call counter, seeded RNG)
+        but not ``fired`` — every rule for a point observes every call,
+        so ``nth`` counting never depends on sibling-rule order; the
+        caller records the one winning fire. Caller holds the schedule
+        lock."""
+        if self.match is not None and any(
+                ctx.get(k) != v for k, v in self.match.items()):
+            return False
+        if self.predicate is not None and not self.predicate(ctx):
+            return False
+        self.calls += 1
+        ok = True
+        if self.times is not None and self.fired >= self.times:
+            ok = False
+        if self.nth is not None and not (
+                self.nth[0] <= self.calls <= self.nth[1]):
+            ok = False
+        if self.prob is not None and self._rng.random() >= self.prob:
+            ok = False
+        return ok
+
+    def __repr__(self) -> str:
+        return (f"FaultRule({self.point!r}, action={self.action!r}, "
+                f"nth={self.nth}, prob={self.prob}, times={self.times}, "
+                f"match={self.match}, fired={self.fired})")
+
+
+class FaultSchedule:
+    """An ordered set of :class:`FaultRule`; the first rule that fires
+    for a call wins. ``fired()`` reports per-point injection counts —
+    the numbers the chaos CLI reconciles against recovery counters."""
+
+    def __init__(self, rules: Optional[List[FaultRule]] = None):
+        self.rules: List[FaultRule] = list(rules or [])
+
+    def add(self, rule: FaultRule) -> "FaultSchedule":
+        """Append one rule; returns self for chaining."""
+        self.rules.append(rule)
+        return self
+
+    def fired(self) -> Dict[str, int]:
+        """Per-point counts of faults this schedule injected."""
+        out: Dict[str, int] = {}
+        for r in self.rules:
+            out[r.point] = out.get(r.point, 0) + r.fired
+        return out
+
+    def total_fired(self) -> int:
+        """Total faults injected across every rule."""
+        return sum(r.fired for r in self.rules)
+
+
+def _parse_rule(spec: str) -> FaultRule:
+    point, _, opts = spec.partition("=")
+    point = point.strip()
+    if not point or not opts:
+        raise ValueError(
+            f"bad fault spec {spec!r}: want point=opt,opt,...")
+    kw: Dict[str, Any] = {}
+    for opt in opts.split(","):
+        opt = opt.strip()
+        key, _, val = opt.partition(":")
+        if key == "raise":
+            kw["action"] = "raise"
+            if val:
+                if val not in NAMED_EXCEPTIONS:
+                    raise ValueError(
+                        f"unknown exception {val!r} (one of "
+                        f"{sorted(NAMED_EXCEPTIONS)})")
+                kw["exc"] = NAMED_EXCEPTIONS[val]
+        elif key == "sigkill":
+            kw["action"] = "sigkill"
+        elif key == "delay":
+            kw.setdefault("action", "delay")
+            kw["delay_ms"] = float(val)
+        elif key == "nth":
+            lo, _, hi = val.partition("-")
+            kw["nth"] = (int(lo), int(hi) if hi else int(lo))
+        elif key == "prob":
+            kw["prob"] = float(val)
+        elif key == "seed":
+            kw["seed"] = int(val)
+        elif key == "times":
+            kw["times"] = int(val)
+        elif key == "match":
+            mk, _, mv = val.partition("=")
+            m = kw.setdefault("match", {})
+            try:
+                m[mk] = int(mv)
+            except ValueError:
+                m[mk] = mv
+        else:
+            raise ValueError(f"unknown fault option {opt!r} in {spec!r}")
+    return FaultRule(point, **kw)
+
+
+def parse_schedule(text: str) -> FaultSchedule:
+    """Parse the compact ``point=opt,...;point=opt,...`` schedule string
+    (module docstring has the grammar) into a :class:`FaultSchedule`."""
+    rules = [_parse_rule(s) for s in text.split(";") if s.strip()]
+    if not rules:
+        raise ValueError(f"empty fault schedule {text!r}")
+    return FaultSchedule(rules)
+
+
+# -- the armed-schedule singleton ----------------------------------------
+# _ARMED is the ONE flag the disarmed point() fast path reads (same
+# discipline as telemetry._ENABLED); everything else sits behind it.
+_ARMED = False
+_SCHEDULE: Optional[FaultSchedule] = None
+_LOCK = threading.Lock()
+
+
+def is_armed() -> bool:
+    """Whether a fault schedule is currently armed."""
+    return _ARMED
+
+
+def active_schedule() -> Optional[FaultSchedule]:
+    """The armed schedule (None when disarmed) — read its ``fired()``
+    to reconcile injections against recovery counters."""
+    return _SCHEDULE
+
+
+def arm(schedule) -> FaultSchedule:
+    """Arm a :class:`FaultSchedule` (or a schedule string, parsed via
+    :func:`parse_schedule`). Replaces any armed schedule; returns it.
+    Arming is always an explicit call — there is no env-var-only path,
+    so a stray variable inherited from a test environment can never
+    fault a real run (the ``arm_scripted_crash`` double-opt-in)."""
+    global _ARMED, _SCHEDULE
+    if isinstance(schedule, str):
+        schedule = parse_schedule(schedule)
+    with _LOCK:
+        _SCHEDULE = schedule
+        _ARMED = True
+    return schedule
+
+
+def disarm() -> None:
+    """Disarm fault injection; the schedule stays readable via
+    :func:`active_schedule` for post-run reconciliation."""
+    global _ARMED
+    with _LOCK:
+        _ARMED = False
+
+
+class _Armed:
+    """Context manager form of arm()/disarm() for tests."""
+
+    def __init__(self, schedule):
+        self.schedule = arm(schedule)
+
+    def __enter__(self) -> FaultSchedule:
+        return self.schedule
+
+    def __exit__(self, *exc) -> None:
+        disarm()
+
+
+def armed(schedule) -> _Armed:
+    """``with faults.armed("train/step=nth:2,raise"):`` — arm for the
+    block, disarm on exit; yields the parsed :class:`FaultSchedule`."""
+    return _Armed(schedule)
+
+
+def injected_total() -> int:
+    """Total faults the armed (or last-armed) schedule injected."""
+    s = _SCHEDULE
+    return s.total_fired() if s is not None else 0
+
+
+def point(name: str, /, **ctx) -> None:
+    """Declare a faultpoint: no-op unless a schedule is armed AND has a
+    rule for ``name`` whose matchers accept this call. The disarmed
+    path is one module-flag check — hot-loop safe.
+
+    Armed behavior per the winning rule: optional injected latency
+    (``delay_ms``), then ``raise`` its exception, ``sigkill`` this
+    process, or return (pure-latency rules). Every fired fault counts
+    into ``faults/point/injected`` (label ``point=<name>``) *before*
+    acting, so even a SIGKILL is visible to the registry snapshot a
+    surviving exporter holds."""
+    if not _ARMED:
+        return
+    with _LOCK:
+        sched = _SCHEDULE
+        if sched is None:
+            return
+        hit = None
+        for r in sched.rules:
+            # every rule for the point observes the call (counters and
+            # seeded RNGs advance deterministically); the FIRST rule
+            # that fires wins and records it
+            if r.point == name and r.consider(ctx) and hit is None:
+                hit = r
+        if hit is not None:
+            hit.fired += 1
+    if hit is None:
+        return
+    _INJECTED.inc(point=name)
+    if hit.delay_ms:
+        time.sleep(hit.delay_ms / 1000.0)
+    if hit.action == "sigkill":
+        import signal
+        os.kill(os.getpid(), signal.SIGKILL)
+    if hit.action == "raise":
+        raise hit.exc(
+            f"injected fault at {name!r} (call {hit.calls}, ctx {ctx})")

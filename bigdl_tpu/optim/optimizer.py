@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 import bigdl_tpu.telemetry as telemetry
+from bigdl_tpu import faults
 from bigdl_tpu.dataset.dataset import AbstractDataSet
 from bigdl_tpu.dataset.prefetch import batch_signature, stack_minibatches
 from bigdl_tpu.dataset.sample import MiniBatch
@@ -48,6 +49,9 @@ _STEP_COUNT = telemetry.counter("train/optimizer/steps",
                                 "optimizer steps completed")
 _RECORD_COUNT = telemetry.counter("train/optimizer/records",
                                   "training records processed")
+_RECOVERIES = telemetry.counter(
+    "train/optimizer/recoveries",
+    "retry-from-checkpoint recoveries performed by optimize()")
 
 
 class Metrics:
@@ -313,8 +317,13 @@ class Optimizer:
         self._mp_batch_rows: Dict[str, int] = {}
         self._stream = "train"
         self.retry_times = int(os.environ.get("BIGDL_FAILURE_RETRY_TIMES", 5))
+        # base of the exponential backoff between retries: the first
+        # retry sleeps equal-jittered [base/2, base), doubling per
+        # attempt; BIGDL_FAILURE_RETRY_MAX_INTERVAL caps growth
         self.retry_interval_s = float(
             os.environ.get("BIGDL_FAILURE_RETRY_INTERVAL", 1.0))
+        self.retry_max_interval_s = float(
+            os.environ.get("BIGDL_FAILURE_RETRY_MAX_INTERVAL", 30.0))
         self.metrics = Metrics()
         # windowed step driver (set_steps_per_sync): K train steps fused
         # into one lax.scan dispatch, host syncs only at window
@@ -720,15 +729,35 @@ class Optimizer:
             logger.info("checkpointed to %s", path)
 
     def _try_resume(self):
+        """Latest INTACT checkpoint's state, or None. A checkpoint that
+        fails integrity verification (or any load error) is quarantined
+        to ``*.corrupt-<pid>`` and the walk continues to the previous
+        intact one — without this, a retry loop would re-raise on the
+        same corrupt latest dir every attempt and the run could never
+        recover. When quarantine itself is impossible (a filesystem
+        that cannot rename — remote stores without mv, a read-only
+        parent) the load error propagates: silently looping on an
+        unremovable bad dir would hang the retry loop."""
         from bigdl_tpu.utils.serialization import (find_latest_checkpoint,
-                                                   load_checkpoint)
+                                                   load_checkpoint,
+                                                   quarantine_checkpoint)
         if not self.checkpoint_path:
             return None
-        latest = find_latest_checkpoint(self.checkpoint_path)
-        if latest is None:
-            return None
-        logger.warning("retry: resuming from %s", latest)
-        return load_checkpoint(latest)
+        while True:
+            latest = find_latest_checkpoint(self.checkpoint_path)
+            if latest is None:
+                return None
+            try:
+                ck = load_checkpoint(latest)
+            except Exception as e:
+                logger.warning(
+                    "checkpoint %s unreadable (%s: %s); quarantining "
+                    "and walking back", latest, type(e).__name__, e)
+                if quarantine_checkpoint(latest) is None:
+                    raise
+                continue
+            logger.warning("retry: resuming from %s", latest)
+            return ck
 
     # -- validation (DistriOptimizer.scala:607-686) ------------------------
     def _validate(self, params, model_state, eval_step):
@@ -851,6 +880,7 @@ class Optimizer:
             # model fails identically every attempt, so reject it once,
             # with a layer-path diagnostic, before any init/compile work
             self.model.check(self._preflight_spec, training=True)
+        from bigdl_tpu.faults.retry import backoff_delay, classify
         retries = 0
         while True:
             try:
@@ -858,12 +888,23 @@ class Optimizer:
             except (KeyboardInterrupt,):
                 raise
             except Exception as e:  # retry-from-checkpoint loop
+                # classified: structural/compile errors (bad types,
+                # shape mismatches) fail identically every attempt —
+                # fail fast with the first diagnostic; transient
+                # IO/runtime errors retry with exponential backoff +
+                # jitter so a fleet doesn't stampede whatever just
+                # recovered
                 retries += 1
-                if retries > self.retry_times or self.checkpoint_path is None:
+                if classify(e) == "fatal" or retries > self.retry_times \
+                        or self.checkpoint_path is None:
                     raise
-                logger.exception("training failed (%s); retry %d/%d",
-                                 e, retries, self.retry_times)
-                time.sleep(self.retry_interval_s)
+                _RECOVERIES.inc()
+                delay = backoff_delay(retries - 1, self.retry_interval_s,
+                                      self.retry_max_interval_s)
+                logger.exception(
+                    "training failed (%s); retry %d/%d in %.2fs",
+                    e, retries, self.retry_times, delay)
+                time.sleep(delay)
 
     def _optimize_impl(self) -> Module:
         model = self.model
@@ -1157,6 +1198,12 @@ class Optimizer:
 
         wall_start = time.time()
         while not end_when(state):
+            # scripted worker-death site (ExceptionTest's role): a chaos
+            # schedule can raise (exercising the classified retry loop)
+            # or SIGKILL here, keyed on the driver counters; disarmed
+            # it's one flag check
+            faults.point("train/step", neval=state["neval"],
+                         epoch=state["epoch"])
             k_now = 1 if k_cap <= 1 else self._plan_window(
                 k_cap, state, plan_bsz, ds_size, end_when,
                 shard_size=shard_size)

@@ -15,13 +15,14 @@ service::
     svc.load("mnist", new_model)           # hot-swap v2 behind the name
 """
 from bigdl_tpu.serving.batcher import (DeadlineExceeded, MicroBatcher,
-                                       QueueFull)
+                                       QueueFull, WorkerDied)
+from bigdl_tpu.serving.breaker import CircuitBreaker, Degraded
 from bigdl_tpu.serving.compile_cache import BucketLadder, CompileCache
 from bigdl_tpu.serving.registry import ModelRegistry, Servable
 from bigdl_tpu.serving.service import InferenceService, ServingConfig
 
 __all__ = [
-    "BucketLadder", "CompileCache", "DeadlineExceeded", "InferenceService",
-    "MicroBatcher", "ModelRegistry", "QueueFull", "Servable",
-    "ServingConfig",
+    "BucketLadder", "CircuitBreaker", "CompileCache", "DeadlineExceeded",
+    "Degraded", "InferenceService", "MicroBatcher", "ModelRegistry",
+    "QueueFull", "Servable", "ServingConfig", "WorkerDied",
 ]

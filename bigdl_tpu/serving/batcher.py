@@ -36,6 +36,7 @@ from typing import Callable, Deque, List, Optional
 import numpy as np
 
 import bigdl_tpu.telemetry as telemetry
+from bigdl_tpu import faults
 from bigdl_tpu.serving.compile_cache import BucketLadder
 
 
@@ -45,6 +46,14 @@ class QueueFull(RuntimeError):
 
 class DeadlineExceeded(TimeoutError):
     """The request's deadline passed before a batch could serve it."""
+
+
+class WorkerDied(RuntimeError):
+    """The batcher's dispatch thread died outside the per-batch error
+    handling (a bug or injected fault in the batching machinery
+    itself, not the model). Every pending future fails with this —
+    typed, promptly — instead of hanging forever, and the supervisor
+    restarts the loop so the batcher keeps serving."""
 
 
 class _Request:
@@ -88,6 +97,15 @@ class BatcherStats:
             "requests failed past their deadline (deadline misses)")
         self._c_errors = r.counter(
             "serving/batcher/errors", "requests failed by a batch error")
+        self._c_failed_batches = r.counter(
+            "serving/batcher/failed_batches",
+            "batches whose dispatch raised (one per failed dispatch)")
+        self._c_worker_restarts = r.counter(
+            "serving/batcher/worker_restarts",
+            "dispatch-thread deaths survived by supervision")
+        self._c_worker_failed = r.counter(
+            "serving/batcher/worker_failed",
+            "requests failed with WorkerDied by a thread death")
         self._c_batches = r.counter(
             "serving/batcher/batches", "batches dispatched")
         self._c_batched_rows = r.counter(
@@ -133,6 +151,14 @@ class BatcherStats:
         """Count ``n_requests`` failed by one batch error."""
         with self.lock:
             self._c_errors.inc(n_requests, **self._labels)
+            self._c_failed_batches.inc(**self._labels)
+
+    def on_worker_death(self, n_requests: int) -> None:
+        """Count one dispatch-thread death that failed ``n_requests``
+        pending requests with WorkerDied."""
+        with self.lock:
+            self._c_worker_restarts.inc(**self._labels)
+            self._c_worker_failed.inc(n_requests, **self._labels)
 
     def on_batch(self, rows: int, bucket: int) -> None:
         """Count one dispatched batch of ``rows`` real rows padded to
@@ -184,6 +210,21 @@ class BatcherStats:
     def errors(self) -> int:
         """Requests failed by a batch error."""
         return self._count(self._c_errors)
+
+    @property
+    def failed_batches(self) -> int:
+        """Batches whose dispatch raised."""
+        return self._count(self._c_failed_batches)
+
+    @property
+    def worker_restarts(self) -> int:
+        """Dispatch-thread deaths survived by supervision."""
+        return self._count(self._c_worker_restarts)
+
+    @property
+    def worker_failed(self) -> int:
+        """Requests failed with WorkerDied."""
+        return self._count(self._c_worker_failed)
 
     @property
     def batches(self) -> int:
@@ -242,8 +283,14 @@ class MicroBatcher:
         self._queue: Deque[_Request] = deque()
         self._cond = threading.Condition()
         self._stopping = False
+        #: requests popped from the queue but not yet resolved by
+        #: _dispatch — the supervisor fails THESE too on a worker
+        #: death (a crash between take and dispatch must not strand
+        #: popped futures). Worker-thread-only state.
+        self._inflight: List[_Request] = []
         self._thread = threading.Thread(
-            target=self._loop, name=f"serving-batcher-{name}", daemon=True)
+            target=self._supervised, name=f"serving-batcher-{name}",
+            daemon=True)
         self._thread.start()
 
     @property
@@ -329,7 +376,7 @@ class MicroBatcher:
         being failed by the wakeup meant to serve it (a request with
         timeout_ms <= max_wait_ms must still work on an idle server).
         """
-        batch: List[_Request] = []
+        batch = self._inflight  # crash-visible to the supervisor
         rows, cap = 0, self.max_batch_size
         while self._queue:
             r = self._queue[0]
@@ -344,7 +391,48 @@ class MicroBatcher:
             self._queue.popleft()
             batch.append(r)
             rows += r.n_rows
+        # the batching-machinery death site (requests are popped but
+        # not yet dispatched — exactly where an unsupervised loop
+        # would strand futures forever)
+        faults.point("serving/take_batch", model=self._name, rows=rows)
         return batch, rows
+
+    def _supervised(self) -> None:
+        """Run ``_loop``, surviving its death: a crash OUTSIDE
+        ``_dispatch``'s per-batch error handling (the batching
+        machinery itself) fails every pending future — queued AND
+        popped-but-undispatched — with a typed :class:`WorkerDied`
+        instead of leaving them pending forever, then restarts the
+        loop so the batcher keeps serving."""
+        while True:
+            try:
+                self._loop()
+                return  # clean shutdown
+            except BaseException as e:  # noqa: BLE001 — supervision
+                with self._cond:
+                    died = list(self._inflight) + list(self._queue)
+                    self._inflight = []
+                    self._queue.clear()
+                    restart = not self._stopping
+                    self.stats.on_worker_death(len(died))
+                    self.stats.on_depth(0)
+                    self._cond.notify_all()
+                err = WorkerDied(
+                    f"batcher {self._name!r} dispatch worker died: "
+                    f"{type(e).__name__}: {e}")
+                err.__cause__ = e
+                for r in died:
+                    # in-flight requests may already be resolved (a
+                    # crash in post-dispatch bookkeeping) or racing a
+                    # caller's cancel — failing THOSE would raise
+                    # InvalidStateError and kill the supervisor itself
+                    try:
+                        if not r.future.done():
+                            r.future.set_exception(err)
+                    except Exception:
+                        pass  # resolved/cancelled in the race window
+                if not restart:
+                    return
 
     def _loop(self) -> None:
         while True:
@@ -368,6 +456,7 @@ class MicroBatcher:
                 self.stats.on_depth(len(self._queue))
             if batch:
                 self._dispatch(batch, rows)
+            self._inflight = []
 
     def _dispatch(self, batch: List[_Request], rows: int) -> None:
         bucket = self._ladder.bucket_for(rows)

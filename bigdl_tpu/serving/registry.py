@@ -18,6 +18,8 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional
 
+from bigdl_tpu import faults
+
 
 class Servable:
     """One immutable (model, params, state) snapshot behind a
@@ -142,6 +144,10 @@ class ModelRegistry:
 
     def swap(self, name: str, version: int) -> Servable:
         """Atomically repoint ``name`` at an already-loaded version."""
+        # hot-swap failure site: a chaos schedule raising here must
+        # leave the OLD version serving (the repoint below is the only
+        # mutation, so an injected failure is atomic by construction)
+        faults.point("serving/swap", name=name, version=version)
         with self._lock:
             entry = self._models.get(name)
             if entry is None or version not in entry.versions:

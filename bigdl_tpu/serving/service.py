@@ -24,7 +24,9 @@ from typing import Dict, Optional, Sequence
 import numpy as np
 
 import bigdl_tpu.telemetry as telemetry
+from bigdl_tpu import faults
 from bigdl_tpu.serving.batcher import MicroBatcher
+from bigdl_tpu.serving.breaker import CircuitBreaker, Degraded
 from bigdl_tpu.serving.compile_cache import BucketLadder, CompileCache
 from bigdl_tpu.serving.registry import ModelRegistry, Servable
 
@@ -36,12 +38,17 @@ class ServingConfig:
     ``max_wait_ms`` trades tail latency for batch fill: a full batch
     dispatches immediately, an underfilled one waits at most this long
     for stragglers. ``buckets`` overrides the powers-of-two ladder
-    (its max then bounds the batch size)."""
+    (its max then bounds the batch size). ``breaker_failures``
+    consecutive dispatch failures open a per-model circuit breaker
+    (submits fast-reject with :class:`Degraded` until a cooldown
+    half-opens it; 0 disables)."""
     max_batch_size: int = 32
     max_wait_ms: float = 2.0
     max_queue: int = 256
     timeout_ms: Optional[float] = None
     buckets: Optional[Sequence[int]] = None
+    breaker_failures: int = 8
+    breaker_cooldown_ms: float = 1000.0
 
 
 class InferenceService:
@@ -70,6 +77,10 @@ class InferenceService:
         # must not race shutdown's iteration
         self._lock = threading.Lock()
         self._batchers: Dict[str, MicroBatcher] = {}
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._c_shed = self.metrics_registry.counter(
+            "serving/service/shed",
+            "requests fast-rejected by an open circuit breaker")
         self._shut_down = False
 
     # ------------------------------------------------------- lifecycle
@@ -116,6 +127,9 @@ class InferenceService:
         if version is None:
             with self._lock:
                 b = self._batchers.pop(name, None)
+                # drop the breaker with the batcher: a reloaded name
+                # must not inherit a stale open circuit
+                self._breakers.pop(name, None)
             if b is not None:
                 b.shutdown(drain=True)
         for key in self.registry.unload(name, version):
@@ -138,13 +152,27 @@ class InferenceService:
                 if self._shut_down:
                     raise RuntimeError("InferenceService is shut down")
                 self.registry.current(name)  # fail fast on unknown names
+                breaker = CircuitBreaker(
+                    self.config.breaker_failures,
+                    self.config.breaker_cooldown_ms)
+                self._breakers[name] = breaker
 
-                def run_batch(x, name=name):
+                def run_batch(x, name=name, breaker=breaker):
                     # ONE registry read per batch: the snapshot can't
-                    # change under a batch mid-forward (swap atomicity)
-                    s = self.registry.current(name)
-                    step = self.cache.step_for(s.key, s.model)
-                    return np.asarray(step(s.params, s.state, x))
+                    # change under a batch mid-forward (swap atomicity).
+                    # Outcomes feed the breaker; the faultpoint is the
+                    # chaos harness's dispatch-failure site.
+                    try:
+                        faults.point("serving/dispatch", model=name,
+                                     rows=int(x.shape[0]))
+                        s = self.registry.current(name)
+                        step = self.cache.step_for(s.key, s.model)
+                        out = np.asarray(step(s.params, s.state, x))
+                    except Exception:
+                        breaker.on_failure()
+                        raise
+                    breaker.on_success()
+                    return out
 
                 b = MicroBatcher(run_batch, self.ladder,
                                  max_wait_ms=self.config.max_wait_ms,
@@ -154,12 +182,26 @@ class InferenceService:
                 self._batchers[name] = b
         return b
 
+    def _submit(self, name: str, x,
+                timeout_ms: Optional[float]) -> Future:
+        """Breaker-gated admission: an open circuit fast-rejects with
+        :class:`Degraded` (counted into ``serving/service/shed``)
+        instead of queueing work the dispatch path will fail anyway."""
+        b = self._batcher(name)
+        breaker = self._breakers.get(name)
+        if breaker is not None and not breaker.allow():
+            self._c_shed.inc(model=name)
+            raise Degraded(
+                f"{name}: circuit open after "
+                f"{breaker.failures} consecutive dispatch failures; "
+                f"retry after {breaker.cooldown_s * 1000:.0f}ms")
+        return b.submit(x, self._timeout(timeout_ms))
+
     def predict_async(self, name: str, x,
                       timeout_ms: Optional[float] = None) -> Future:
         """One SAMPLE in -> Future of one prediction row."""
         x = np.asarray(x)
-        fut = self._batcher(name).submit(
-            x[None], self._timeout(timeout_ms))
+        fut = self._submit(name, x[None], timeout_ms)
         out: Future = Future()
         fut.add_done_callback(lambda f: _chain(f, out, lambda o: o[0]))
         return out
@@ -173,8 +215,7 @@ class InferenceService:
                             timeout_ms: Optional[float] = None) -> Future:
         """(rows, features...) in -> Future of (rows, ...) predictions
         — the rows ride one micro-batch together."""
-        return self._batcher(name).submit(np.asarray(x),
-                                          self._timeout(timeout_ms))
+        return self._submit(name, np.asarray(x), timeout_ms)
 
     def predict_batch(self, name: str, x,
                       timeout_ms: Optional[float] = None):
@@ -207,6 +248,7 @@ class InferenceService:
             "request_count": 0, "rows": 0, "rejected": 0, "timed_out": 0,
             "errors": 0, "batch_count": 0, "batch_fill": 0.0,
             "padded_row_ratio": 0.0, "queue_depth": 0,
+            "shed": 0, "worker_restarts": 0, "failed_batches": 0,
         }
         if b is not None:
             st = b.stats
@@ -216,6 +258,8 @@ class InferenceService:
                     request_count=st.requests, rows=st.rows,
                     rejected=st.rejected, timed_out=st.timed_out,
                     errors=st.errors, batch_count=st.batches,
+                    worker_restarts=st.worker_restarts,
+                    failed_batches=st.failed_batches,
                     batch_fill=(st.fill_sum / st.batches
                                 if st.batches else 0.0),
                     padded_row_ratio=(
@@ -223,10 +267,18 @@ class InferenceService:
                         (st.batched_rows + st.padded_rows)
                         if st.batched_rows + st.padded_rows else 0.0))
             out["queue_depth"] = b.queue_depth()
+            out["shed"] = int(self._c_shed.value(model=name))
             for k, v in percentile_summary(lat, (50, 99)).items():
                 out[f"latency_ms_{k}"] = v
         out["compile_count"] = self.compile_count(name)
         return out
+
+    def breaker_state(self, name: str) -> str:
+        """The model's circuit-breaker state (``"closed"`` when no
+        breaker exists yet — no traffic has created the batcher)."""
+        with self._lock:
+            breaker = self._breakers.get(name)
+        return breaker.state if breaker is not None else "closed"
 
     def export_metrics(self, summary, step: int) -> None:
         """Write every model's metrics as ``serving/<name>/<metric>``

@@ -1,0 +1,475 @@
+"""Chaos soak: recovery as a CI-checkable invariant.
+
+    python -m bigdl_tpu.tools.chaos                   # default soak
+        --model {lenet,tiny} --steps N --leg-a M      # workload size
+        --ckpt-every C --batch-size B --seed S
+        --schedule "point=opts;..."                   # leg-B faults
+        --kill-at K                                   # + SIGKILL legs
+        --workdir DIR --json
+
+The claim under test is the reference's headline operational one —
+training survives worker death via retry-from-checkpoint
+(DistriOptimizer.scala:789-855; BigDL paper §4) — extended to every
+layer this port has grown: checkpoint integrity, IO retry, serving
+supervision. The soak *injects* a seeded schedule of faults
+(:mod:`bigdl_tpu.faults`) into a seeded training run with a concurrent
+serving burst, and asserts three invariants:
+
+1. **Bit-exactness** — the disturbed run's final params are
+   bit-identical to an undisturbed seeded run's. The feed is the
+   epoch-exact device cache (every batch a pure function of the
+   iteration number), checkpoints capture params + momentum + driver
+   state, so recovery must be EXACT, not merely "converges anyway".
+2. **No hangs** — every serving future submitted during the burst
+   resolves (result or *typed* error) within its deadline; a pending
+   future after the run is a supervision bug.
+3. **Reconciliation** — injected faults equal observed recoveries,
+   counter for counter: ``train/step`` raises == optimizer
+   ``recoveries``, ``serving/dispatch`` raises == batcher
+   ``failed_batches``, ``serving/take_batch`` raises == supervised
+   ``worker_restarts``, and (kill mode) the mid-checkpoint SIGKILL ==
+   one successful torn-write resume. Pure-latency rules are excluded
+   (they recover nothing by design).
+
+Phases: an undisturbed **reference** run; chaos **leg A** to
+``--leg-a`` steps (in ``--kill-at`` mode this leg runs as a
+subprocess, SIGKILLed mid-checkpoint-write, then relaunched to
+completion — the torn tmp dir must never be selected); a **corrupt**
+phase truncating the latest checkpoint's ``params.npz`` behind its
+MANIFEST (bit rot); chaos **leg B** resuming — which must quarantine
+the corrupt dir, walk back to the previous intact checkpoint, absorb
+the scheduled step/serving faults, and still land on the reference
+params. Exit 0 all invariants hold, 1 a violation, 2 usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+DEFAULT_SCHEDULE = (
+    "train/step=nth:3,raise:RuntimeError;"
+    "train/step=nth:6,raise:OSError;"
+    "serving/dispatch=nth:4,raise:RuntimeError;"
+    "serving/take_batch=nth:6,raise:RuntimeError;"
+    "serving/dispatch=delay:2,times:2"
+)
+
+
+def _build_workload(model_kind: str, seed: int, batch_size: int):
+    """Seeded (model, dataset, criterion): the feed is the epoch-exact
+    device cache with deterministic augmentation (full-size crop, no
+    flip), so every batch — and therefore every optimizer state — is a
+    pure function of the iteration number. That is what entitles the
+    soak to demand bit-identical recovery."""
+    import numpy as np
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset.device_dataset import DeviceCachedArrayDataSet
+    from bigdl_tpu.tools.synthetic import seeded_rng
+    from bigdl_tpu.utils.random import RandomGenerator
+
+    RandomGenerator.set_seed(seed)
+    r = seeded_rng(seed)
+    if model_kind == "lenet":
+        from bigdl_tpu.models import LeNet5
+        imgs = r.randint(0, 255, (64, 1, 28, 28)).astype(np.uint8)
+        lbls = (r.randint(0, 10, 64) + 1).astype(np.float32)
+        ds = DeviceCachedArrayDataSet(imgs, lbls, batch_size, flip=False,
+                                      mean=(127.0,), std=(64.0,),
+                                      shuffle_seed=seed)
+        model = LeNet5(10)
+    else:
+        imgs = r.randint(0, 255, (32, 3, 8, 8)).astype(np.uint8)
+        lbls = (r.randint(0, 2, 32) + 1).astype(np.float32)
+        ds = DeviceCachedArrayDataSet(imgs, lbls, batch_size, flip=False,
+                                      mean=(127.0,) * 3, std=(64.0,) * 3,
+                                      shuffle_seed=seed)
+        model = (nn.Sequential().add(nn.Reshape((3 * 8 * 8,)))
+                 .add(nn.Linear(3 * 8 * 8, 16)).add(nn.Tanh())
+                 .add(nn.Linear(16, 2)).add(nn.LogSoftMax()))
+    return model, ds, nn.ClassNLLCriterion()
+
+
+def _train_leg(model_kind: str, seed: int, batch_size: int, steps: int,
+               ckpt_dir: Optional[str], ckpt_every: int):
+    """One seeded training leg: fresh model + dataset, resume from
+    ``ckpt_dir`` if it holds checkpoints, train to ``steps`` total
+    iterations. Returns the optimizer (final params live on its
+    model)."""
+    from bigdl_tpu.optim import SGD, max_iteration, several_iteration
+    from bigdl_tpu.optim.optimizer import Optimizer
+
+    model, ds, crit = _build_workload(model_kind, seed, batch_size)
+    opt = Optimizer(model, ds, crit, batch_size=batch_size)
+    opt.set_optim_method(SGD(learning_rate=0.1, momentum=0.9))
+    opt.set_end_when(max_iteration(steps))
+    opt.retry_interval_s = 0.05  # keep the soak's backoff sleeps short
+    if ckpt_dir is not None:
+        opt.set_checkpoint(ckpt_dir, several_iteration(ckpt_every))
+    opt.optimize()
+    return opt
+
+
+def _final_params(opt) -> Dict[str, "object"]:
+    """name -> host ndarray of the trained model's params (the flat
+    form two runs are compared bit-for-bit in)."""
+    from bigdl_tpu.utils.serialization import _flatten_leaves
+    return _flatten_leaves(opt.model.get_parameters())
+
+
+def _params_equal(a: Dict, b: Dict) -> Tuple[bool, List[str]]:
+    import numpy as np
+    bad = [k for k in sorted(set(a) | set(b))
+           if k not in a or k not in b
+           or a[k].dtype != b[k].dtype
+           or not np.array_equal(a[k], b[k])]
+    return not bad, bad
+
+
+# ------------------------------------------------------- serving burst
+
+class _Burst:
+    """Background serving burst against a dedicated InferenceService;
+    collects EVERY submitted future so the no-hang invariant can be
+    checked request by request."""
+
+    def __init__(self, seed: int, threads: int = 2,
+                 breaker_failures: int = 3):
+        import numpy as np
+
+        import bigdl_tpu.nn as nn
+        from bigdl_tpu.serving import InferenceService, ServingConfig
+        from bigdl_tpu.tools.synthetic import seeded_rng
+
+        self.svc = InferenceService(config=ServingConfig(
+            max_batch_size=8, max_wait_ms=1.0, buckets=(8,),
+            breaker_failures=breaker_failures, breaker_cooldown_ms=50.0))
+        serve_model = (nn.Sequential().add(nn.Reshape((16,)))
+                       .add(nn.Linear(16, 4)))
+        serve_model.ensure_initialized()
+        self.svc.load("chaos", serve_model, warmup_shape=(4, 4))
+        self.req = seeded_rng(seed + 1).rand(4, 4, 4).astype(np.float32)
+        self.futures: List = []
+        self._fut_lock = threading.Lock()
+        self.shed = 0
+        self.stop = threading.Event()
+        self.threads = [threading.Thread(target=self._run, daemon=True,
+                                         name=f"chaos-burst-{i}")
+                        for i in range(threads)]
+
+    def _run(self):
+        from bigdl_tpu.serving import Degraded, QueueFull
+        while not self.stop.is_set():
+            try:
+                f = self.svc.predict_batch_async("chaos", self.req,
+                                                 timeout_ms=2000)
+            except Degraded:
+                self.shed += 1
+                time.sleep(0.005)
+                continue
+            except QueueFull:
+                # transient backlog (e.g. during an injected worker
+                # death): keep bursting — a thread that quit here
+                # would let the soak pass vacuously
+                time.sleep(0.005)
+                continue
+            except RuntimeError:
+                break  # service shut down under us
+            with self._fut_lock:
+                self.futures.append(f)
+            time.sleep(0.002)
+
+    def start(self):
+        for t in self.threads:
+            t.start()
+
+    def finish(self, deadline_s: float = 30.0) -> Dict[str, int]:
+        """Stop the burst, drain the service, and resolve every
+        future: {ok, typed_errors, hung}. ``hung`` > 0 is the
+        supervision failure mode this soak exists to catch."""
+        from concurrent.futures import TimeoutError as FutTimeout
+        self.stop.set()
+        for t in self.threads:
+            t.join(timeout=10)
+        self.svc.shutdown(drain=True)
+        out = {"ok": 0, "typed_errors": 0, "hung": 0}
+        end = time.monotonic() + deadline_s
+        for f in self.futures:
+            try:
+                f.result(timeout=max(0.0, end - time.monotonic()))
+                out["ok"] += 1
+            except FutTimeout:
+                out["hung"] += 1
+            except Exception:
+                out["typed_errors"] += 1
+        return out
+
+    def stats(self) -> Dict[str, float]:
+        m = self.svc.metrics("chaos")
+        m["shed_seen_by_submitters"] = self.shed
+        return m
+
+
+# ------------------------------------------------------------- worker
+
+def _run_worker(args) -> int:
+    """Subprocess leg for the SIGKILL phases: arm the given schedule,
+    train (resuming from the shared checkpoint dir), print a JSON
+    result line. Exit 0 on completion — or death by injected SIGKILL,
+    which the parent observes as rc -9."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from bigdl_tpu import faults
+    if args.schedule:
+        faults.arm(args.schedule)
+    opt = _train_leg(args.model, args.seed, args.batch_size, args.steps,
+                     args.ckpt_dir, args.ckpt_every)
+    if args.save_params:
+        import numpy as np
+        np.savez(args.save_params, **_final_params(opt))
+    print(json.dumps({"ok": True, "neval": opt.driver_state["neval"],
+                      "loss": opt.driver_state.get("Loss")}))
+    return 0
+
+
+def _spawn_worker(model: str, seed: int, batch_size: int, steps: int,
+                  ckpt_dir: str, ckpt_every: int, schedule: str,
+                  timeout_s: float = 600.0):
+    import subprocess
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    cmd = [sys.executable, "-m", "bigdl_tpu.tools.chaos", "--worker",
+           "--model", model, "--seed", str(seed),
+           "--batch-size", str(batch_size), "--steps", str(steps),
+           "--ckpt-dir", ckpt_dir, "--ckpt-every", str(ckpt_every)]
+    if schedule:
+        cmd += ["--schedule", schedule]
+    return subprocess.run(cmd, capture_output=True, text=True,
+                          timeout=timeout_s, env=env)
+
+
+# ----------------------------------------------------------- the soak
+
+def _corrupt_latest(ckpt_dir: str) -> str:
+    """Truncate the latest checkpoint's params.npz BEHIND its MANIFEST
+    — the classic bit-rot artifact: the completeness certificate says
+    done, the bytes say otherwise. Only integrity verification can
+    catch it."""
+    from bigdl_tpu.utils.serialization import find_latest_checkpoint
+    latest = find_latest_checkpoint(ckpt_dir)
+    if latest is None:
+        raise RuntimeError(f"no checkpoint to corrupt under {ckpt_dir}")
+    npz = os.path.join(latest, "params.npz")
+    with open(npz, "r+b") as f:
+        f.truncate(max(0, os.path.getsize(npz) // 2))
+    return latest
+
+
+def run_soak(model: str = "lenet", steps: int = 16, leg_a: int = 8,
+             ckpt_every: int = 2, batch_size: int = 8, seed: int = 42,
+             schedule: str = DEFAULT_SCHEDULE,
+             kill_at: Optional[int] = None,
+             workdir: Optional[str] = None) -> Dict:
+    """Run the full soak (module docstring has the phases); returns the
+    report dict (key ``"passed"`` is the verdict)."""
+    import bigdl_tpu.telemetry as telemetry
+    from bigdl_tpu import faults
+
+    own_workdir = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="bigdl-chaos-")
+    ckpt_dir = os.path.join(workdir, "ckpts")
+    report: Dict = {"model": model, "steps": steps, "leg_a": leg_a,
+                    "seed": seed, "schedule": schedule,
+                    "kill_at": kill_at, "violations": []}
+    try:
+        # -- phase 1: undisturbed reference ---------------------------
+        ref_opt = _train_leg(model, seed, batch_size, steps, None, 0)
+        p_ref = _final_params(ref_opt)
+
+        # -- phase 2: chaos leg A to leg_a steps ----------------------
+        if kill_at is not None:
+            # subprocess leg: SIGKILL mid-checkpoint-write (after the
+            # tree files, before the MANIFEST) at neval kill_at...
+            r = _spawn_worker(
+                model, seed, batch_size, leg_a, ckpt_dir, ckpt_every,
+                f"ckpt/write_manifest=match:neval={kill_at},sigkill")
+            if r.returncode != -9:
+                report["violations"].append(
+                    f"kill leg exited rc={r.returncode} (want -9); "
+                    f"stderr tail: {r.stderr[-300:]}")
+            # ...and the relaunched gang must resume past the torn tmp
+            # dir and finish the leg
+            r2 = _spawn_worker(model, seed, batch_size, leg_a, ckpt_dir,
+                               ckpt_every, "")
+            if r2.returncode != 0:
+                report["violations"].append(
+                    f"resume leg failed rc={r2.returncode}; stderr "
+                    f"tail: {r2.stderr[-300:]}")
+            report["kill"] = {"injected_sigkills": 1,
+                              "resumes": 1 if r2.returncode == 0 else 0}
+        else:
+            _train_leg(model, seed, batch_size, leg_a, ckpt_dir,
+                       ckpt_every)
+
+        # -- phase 3: corrupt the latest checkpoint -------------------
+        corrupted = _corrupt_latest(ckpt_dir)
+        report["corrupted"] = corrupted
+
+        # -- phase 4: chaos leg B — resume under the fault schedule
+        # with a concurrent serving burst ----------------------------
+        rec_counter = telemetry.counter("train/optimizer/recoveries")
+        io_counter = telemetry.counter("io/retry/retries")
+        rec0, io0 = rec_counter.value(), io_counter.value()
+        burst = _Burst(seed)
+        sched = faults.arm(schedule)
+        try:
+            burst.start()
+            leg_b = _train_leg(model, seed, batch_size, steps, ckpt_dir,
+                               ckpt_every)
+        finally:
+            faults.disarm()
+            futures = burst.finish()
+        p_chaos = _final_params(leg_b)
+
+        # -- invariant 1: bit-exactness -------------------------------
+        same, bad = _params_equal(p_ref, p_chaos)
+        report["bit_identical"] = same
+        if not same:
+            report["violations"].append(
+                f"final params differ from the undisturbed run: {bad}")
+
+        # -- invariant 2: quarantine + fallback actually happened -----
+        quarantined = [n for n in os.listdir(ckpt_dir)
+                       if ".corrupt-" in n]
+        report["quarantined"] = quarantined
+        if not quarantined:
+            report["violations"].append(
+                "corrupt checkpoint was not quarantined")
+
+        # -- invariant 3: no serving future hangs ---------------------
+        report["burst"] = futures
+        report["burst_stats"] = {
+            k: v for k, v in burst.stats().items()
+            if k in ("request_count", "errors", "shed", "timed_out",
+                     "worker_restarts", "shed_seen_by_submitters")}
+        if futures["hung"]:
+            report["violations"].append(
+                f"{futures['hung']} serving futures never resolved")
+
+        # -- invariant 4: injected == recovered, counter for counter --
+        fired = {}
+        for rule in sched.rules:
+            if rule.action not in ("raise", "sigkill"):
+                continue
+            fired[rule.point] = fired.get(rule.point, 0) + rule.fired
+            if rule.fired == 0 and rule.prob is None:
+                # a deterministic rule that never fired means the soak
+                # exercised nothing at that point — reconciling 0 == 0
+                # would pass vacuously (seeded-prob rules MAY land on
+                # zero; that is their contract)
+                report["violations"].append(
+                    f"scheduled fault never fired: {rule!r}")
+        svc_metrics = burst.svc.metrics("chaos")
+        observed = {
+            "train/step": rec_counter.value() - rec0,
+            "serving/dispatch": svc_metrics["failed_batches"],
+            "serving/take_batch": svc_metrics["worker_restarts"],
+            "fetch/download": io_counter.value() - io0,
+        }
+        report["injected"] = fired
+        report["recovered"] = {k: int(v) for k, v in observed.items()}
+        for point, n in fired.items():
+            got = int(observed.get(point, 0))
+            if got != n:
+                report["violations"].append(
+                    f"{point}: injected {n} faults but observed {got} "
+                    "recoveries")
+    finally:
+        if own_workdir:
+            shutil.rmtree(workdir, ignore_errors=True)
+    report["passed"] = not report["violations"]
+    return report
+
+
+# ------------------------------------------------------------------ CLI
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m bigdl_tpu.tools.chaos", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--model", choices=("lenet", "tiny"), default="lenet")
+    ap.add_argument("--steps", type=int, default=16,
+                    help="total training iterations of each run")
+    ap.add_argument("--leg-a", type=int, default=8,
+                    help="iterations of the pre-corruption chaos leg")
+    ap.add_argument("--ckpt-every", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--schedule", default=DEFAULT_SCHEDULE,
+                    help="leg-B fault schedule (faults.parse_schedule "
+                         "syntax)")
+    ap.add_argument("--kill-at", type=int, default=None,
+                    help="run leg A as a subprocess SIGKILLed "
+                         "mid-checkpoint-write at this neval")
+    ap.add_argument("--workdir", default=None,
+                    help="keep work files here instead of a temp dir")
+    ap.add_argument("--json", action="store_true")
+    # internal: subprocess leg entry
+    ap.add_argument("--worker", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--ckpt-dir", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--save-params", default=None,
+                    help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    if args.worker:
+        if not args.ckpt_dir:
+            print("--worker needs --ckpt-dir", file=sys.stderr)
+            return 2
+        return _run_worker(args)
+    if args.leg_a >= args.steps:
+        print("--leg-a must be < --steps", file=sys.stderr)
+        return 2
+    if args.kill_at is not None \
+            and (not 0 < args.kill_at <= args.leg_a
+                 or args.kill_at % args.ckpt_every):
+        print("--kill-at must fall inside leg A on a checkpoint step "
+              "(a multiple of --ckpt-every): the SIGKILL fires "
+              "mid-checkpoint-write, so a non-checkpoint neval never "
+              "kills", file=sys.stderr)
+        return 2
+
+    report = run_soak(model=args.model, steps=args.steps,
+                      leg_a=args.leg_a, ckpt_every=args.ckpt_every,
+                      batch_size=args.batch_size, seed=args.seed,
+                      schedule=args.schedule, kill_at=args.kill_at,
+                      workdir=args.workdir)
+    if args.json:
+        print(json.dumps(report, indent=2, default=str))
+    else:
+        print("== chaos soak ==")
+        print(f"model={report['model']} steps={report['steps']} "
+              f"seed={report['seed']} kill_at={report['kill_at']}")
+        print(f"injected:  {report.get('injected')}")
+        print(f"recovered: {report.get('recovered')}")
+        print(f"burst:     {report.get('burst')} "
+              f"{report.get('burst_stats')}")
+        print(f"bit-identical final params: "
+              f"{report.get('bit_identical')}")
+        print(f"quarantined: {report.get('quarantined')}")
+        for v in report["violations"]:
+            print(f"VIOLATION: {v}")
+        print("PASS" if report["passed"] else "FAIL")
+    return 0 if report["passed"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -28,6 +28,7 @@ FAMILIES = {
     "telemetry": ["bigdl_tpu.telemetry", "bigdl_tpu.telemetry.tracer",
                   "bigdl_tpu.telemetry.metrics",
                   "bigdl_tpu.telemetry.export"],
+    "faults": ["bigdl_tpu.faults", "bigdl_tpu.faults.retry"],
     "parallel": ["bigdl_tpu.parallel"],
     "models": ["bigdl_tpu.models"],
     "interop": ["bigdl_tpu.utils.serialization",

@@ -27,6 +27,12 @@ def _fs(path: str):
 
 def open_file(path: str, mode: str = "r"):
     if is_remote(path):
+        if "w" in mode or "a" in mode or "x" in mode:
+            # remote writes are where network blips become torn
+            # checkpoints; the faultpoint lets the chaos harness script
+            # exactly that (lazy import: local IO pays nothing)
+            from bigdl_tpu import faults
+            faults.point("file_io/remote_write", path=path)
         import fsspec
         return fsspec.open(path, mode).open()
     return open(path, mode)
@@ -63,3 +69,32 @@ def join(path: str, *parts: str) -> str:
     if is_remote(path):
         return "/".join([path.rstrip("/")] + [p.strip("/") for p in parts])
     return os.path.join(path, *parts)
+
+
+def rename(src: str, dst: str) -> bool:
+    """Rename ``src`` to ``dst`` (local or remote); returns False when
+    the backing filesystem cannot rename (callers must then handle the
+    original path remaining in place)."""
+    if is_remote(src):
+        try:
+            _fs(src).mv(src, dst, recursive=True)
+            return True
+        except Exception:
+            return False
+    try:
+        os.rename(src, dst)
+        return True
+    except OSError:  # read-only parent, cross-device link, ...
+        return False
+
+
+def file_sha256(path: str) -> str:
+    """Streaming sha256 hex digest of one (local or remote) file — the
+    checkpoint-integrity primitive MANIFEST digests are computed and
+    verified with."""
+    import hashlib
+    h = hashlib.sha256()
+    with open_file(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()

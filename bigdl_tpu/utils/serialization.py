@@ -9,6 +9,7 @@ class and constructor args for zoo models that register themselves.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import re
 import time
@@ -19,6 +20,8 @@ import numpy as np
 
 import bigdl_tpu.telemetry as telemetry
 from bigdl_tpu.utils import file_io
+
+logger = logging.getLogger("bigdl_tpu")
 
 _CKPT_SAVE_S = telemetry.histogram(
     "train/checkpoint/save_s", "wall-clock seconds per checkpoint save")
@@ -120,6 +123,21 @@ MANIFEST = "MANIFEST.json"
 _CKPT_FILES = ("params", "opt_state", "model_state")
 
 
+class CheckpointCorrupt(RuntimeError):
+    """A checkpoint directory failed integrity verification: a file
+    named by its MANIFEST is missing or its content no longer matches
+    the sha256 recorded at write time. Raised by
+    :func:`verify_checkpoint` / :func:`load_checkpoint`; the
+    optimizer's resume path quarantines the directory and walks back
+    to the previous intact checkpoint."""
+
+    # the only way this ESCAPES _try_resume is the quarantine-
+    # impossible path (unrenamable filesystem) — retrying re-hashes
+    # the same corrupt dir forever, so the retry classifier must fail
+    # fast despite the RuntimeError base
+    bigdl_fatal = True
+
+
 def _fsync(f) -> None:
     try:
         f.flush()
@@ -145,40 +163,50 @@ def _fsync_dir(d: str) -> None:
         pass
 
 
-def _write_ckpt_files(d: str, flats) -> None:
-    """Write the three tree parts (pre-materialized host arrays) into
-    ``d``, fsyncing each file."""
+def _part_blobs(flats, host):
+    """Yield each checkpoint file as (filename, bytes, sha256hex), one
+    part at a time — digests hash the exact serialized bytes, so both
+    the local and remote writers get MANIFEST integrity in a single
+    pass (no write-then-re-read). Peak extra memory is one part's
+    serialization, never the whole checkpoint twice."""
+    import hashlib
+    import io
+
+    def blob(fname, data):
+        return fname, data, hashlib.sha256(data).hexdigest()
+
     for name, (arrays, template) in flats.items():
-        _write_json(os.path.join(d, name + ".json"), template)
-        with open(os.path.join(d, name + ".npz"), "wb") as f:
-            np.savez(f, **arrays)
-            _fsync(f)
+        yield blob(name + ".json", json.dumps(template).encode())
+        buf = io.BytesIO()
+        np.savez(buf, **arrays)
+        yield blob(name + ".npz", buf.getvalue())
+    yield blob("host_state.json", json.dumps(host).encode())
 
 
-_SCRIPTED_CRASH_ARMED = False
+def _crash_env_matches(ctx) -> bool:
+    """BIGDL_TEST_CRASH_IN_CHECKPOINT names this save's neval (read at
+    fire time, like the pre-faults hook did — a harness may set the
+    variable after arming)."""
+    at = os.environ.get("BIGDL_TEST_CRASH_IN_CHECKPOINT")
+    return bool(at) and int(at) == ctx.get("neval", -1)
 
 
 def arm_scripted_crash() -> None:
-    """Explicit opt-in for the fault-injection hook below. A test
-    harness must call this IN ADDITION to setting the env var — so a
-    stray BIGDL_TEST_CRASH_IN_CHECKPOINT inherited from a test
-    environment can never SIGKILL a real training run (ADVICE r5)."""
-    global _SCRIPTED_CRASH_ARMED
-    _SCRIPTED_CRASH_ARMED = True
-
-
-def _maybe_scripted_crash(driver_state) -> None:
-    """Test-only fault injection (the reference scripted worker deaths
-    the same way, ExceptionTest / TestUtils.scala:103-131): SIGKILL this
-    process MID-checkpoint-write — after the tree files, before the
-    MANIFEST — when BIGDL_TEST_CRASH_IN_CHECKPOINT names this neval AND
-    the process called :func:`arm_scripted_crash`."""
-    if not _SCRIPTED_CRASH_ARMED:
-        return
-    at = os.environ.get("BIGDL_TEST_CRASH_IN_CHECKPOINT")
-    if at and int(at) == driver_state.get("neval", -1):
-        import signal
-        os.kill(os.getpid(), signal.SIGKILL)
+    """Explicit opt-in for the mid-checkpoint-write SIGKILL (the
+    reference scripted worker deaths the same way, ExceptionTest /
+    TestUtils.scala:103-131). A test harness must call this IN
+    ADDITION to setting BIGDL_TEST_CRASH_IN_CHECKPOINT — so a stray
+    env var inherited from a test environment can never SIGKILL a real
+    training run (ADVICE r5). Implemented as a ``ckpt/write_manifest``
+    SIGKILL schedule on the :mod:`bigdl_tpu.faults` framework: the
+    process dies after the tree files, before the MANIFEST."""
+    from bigdl_tpu import faults
+    rule = faults.FaultRule("ckpt/write_manifest", action="sigkill",
+                            predicate=_crash_env_matches)
+    sched = faults.active_schedule() if faults.is_armed() else None
+    if sched is None:
+        sched = faults.FaultSchedule()
+    faults.arm(sched.add(rule))
 
 
 def save_checkpoint(path: str, *, params, opt_state, model_state,
@@ -230,28 +258,30 @@ def _save_checkpoint_impl(path: str, *, params, opt_state, model_state,
              for k, t in parts.items()}
     if not writer:
         return
+    from bigdl_tpu import faults
     host = {"optim_host_state": optim_host_state,
             "driver_state": driver_state}
-    manifest = {"format": 1,
+    files = [f"{n}.{ext}" for n in _CKPT_FILES
+             for ext in ("json", "npz")] + ["host_state.json"]
+    # format 2: the MANIFEST records each file's sha256 — load verifies
+    # them, so a corrupt-at-rest checkpoint (bit rot, truncation AFTER
+    # the manifest landed) is detected and quarantined instead of
+    # resumed from
+    manifest = {"format": 2,
                 "neval": driver_state.get("neval"),
-                "files": [f"{n}.{ext}" for n in _CKPT_FILES
-                          for ext in ("json", "npz")] +
-                         ["host_state.json"]}
+                "files": files,
+                "sha256": {}}
     if file_io.is_remote(path):
         # no atomic rename on object stores: MANIFEST-last ordering is
-        # the completeness certificate
+        # the completeness certificate; each digest hashes the exact
+        # bytes shipped
         file_io.makedirs(path)
-        for name, (arrays, template) in flats.items():
-            with file_io.open_file(
-                    file_io.join(path, name + ".json"), "w") as f:
-                json.dump(template, f)
-            with file_io.open_file(
-                    file_io.join(path, name + ".npz"), "wb") as f:
-                np.savez(f, **arrays)
-        with file_io.open_file(
-                file_io.join(path, "host_state.json"), "w") as f:
-            json.dump(host, f)
-        _maybe_scripted_crash(driver_state)
+        for fname, data, digest in _part_blobs(flats, host):
+            manifest["sha256"][fname] = digest
+            with file_io.open_file(file_io.join(path, fname), "wb") as f:
+                f.write(data)
+        faults.point("ckpt/write_manifest",
+                     neval=driver_state.get("neval", -1), path=path)
         with file_io.open_file(file_io.join(path, MANIFEST), "w") as f:
             json.dump(manifest, f)
         return
@@ -265,9 +295,13 @@ def _save_checkpoint_impl(path: str, *, params, opt_state, model_state,
     if os.path.exists(tmp):  # our own earlier failed attempt
         shutil.rmtree(tmp)
     os.makedirs(tmp)
-    _write_ckpt_files(tmp, flats)
-    _write_json(os.path.join(tmp, "host_state.json"), host)
-    _maybe_scripted_crash(driver_state)
+    for fname, data, digest in _part_blobs(flats, host):
+        manifest["sha256"][fname] = digest
+        with open(os.path.join(tmp, fname), "wb") as f:
+            f.write(data)
+            _fsync(f)
+    faults.point("ckpt/write_manifest",
+                 neval=driver_state.get("neval", -1), path=path)
     _write_json(os.path.join(tmp, MANIFEST), manifest)
     _fsync_dir(tmp)
     # commit: the destination only ever transitions complete->complete
@@ -286,14 +320,65 @@ def _save_checkpoint_impl(path: str, *, params, opt_state, model_state,
             shutil.rmtree(os.path.join(parent, name), ignore_errors=True)
 
 
-def load_checkpoint(path: str) -> Dict[str, Any]:
+def verify_checkpoint(path: str) -> None:
+    """Integrity-check one checkpoint dir against its MANIFEST: every
+    listed file must exist and (format >= 2) hash to its recorded
+    sha256. Raises :class:`CheckpointCorrupt` naming the first bad
+    file; a format-0/1 dir (no MANIFEST / no digests) passes — its
+    completeness certificate is presence-only, the pre-integrity
+    contract."""
+    mpath = file_io.join(path, MANIFEST)
+    if not file_io.exists(mpath):
+        return  # format-0 back-compat: nothing recorded to verify
+    try:
+        with file_io.open_file(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorrupt(f"{path}: unreadable MANIFEST ({e})")
+    digests = manifest.get("sha256") or {}
+    for fname in manifest.get("files", []):
+        fpath = file_io.join(path, fname)
+        if not file_io.exists(fpath):
+            raise CheckpointCorrupt(
+                f"{path}: MANIFEST names {fname} but it is missing")
+        want = digests.get(fname)
+        if want is None:
+            continue  # format-1: files listed, no digests recorded
+        got = file_io.file_sha256(fpath)
+        if got != want:
+            raise CheckpointCorrupt(
+                f"{path}: {fname} fails its recorded sha256 "
+                f"(got {got[:12]}…, want {want[:12]}…)")
+
+
+def quarantine_checkpoint(path: str) -> Optional[str]:
+    """Move a corrupt checkpoint dir aside to ``<path>.corrupt-<pid>``
+    (kept for post-mortem, never selected by
+    :func:`find_latest_checkpoint`) so resume walks back to the
+    previous intact checkpoint instead of re-raising on the same bad
+    dir every retry. Returns the quarantine path, or None when the
+    backing filesystem cannot rename."""
+    dst = f"{path}.corrupt-{os.getpid()}"
+    if file_io.rename(path, dst):
+        logger.warning("quarantined corrupt checkpoint %s -> %s",
+                       path, dst)
+        return dst
+    return None
+
+
+def load_checkpoint(path: str, verify: bool = True) -> Dict[str, Any]:
     """Read one complete checkpoint dir written by
-    :func:`save_checkpoint`; the wall-clock cost lands in the
+    :func:`save_checkpoint`, integrity-verifying it first (every
+    MANIFEST-listed file present and matching its recorded sha256 —
+    :class:`CheckpointCorrupt` otherwise; ``verify=False`` skips the
+    hash pass). The wall-clock cost lands in the
     ``train/checkpoint/load_s`` telemetry histogram and a
     ``checkpoint/load`` span."""
     t0 = time.perf_counter()
     try:
         with telemetry.span("checkpoint/load", path=path):
+            if verify:
+                verify_checkpoint(path)
             with file_io.open_file(
                     file_io.join(path, "host_state.json")) as f:
                 host = json.load(f)
@@ -326,6 +411,8 @@ def find_latest_checkpoint(directory: str) -> Optional[str]:
         full = file_io.join(directory, name)
         if not name.startswith("checkpoint") or not file_io.isdir(full):
             continue
+        if ".corrupt-" in name:
+            continue  # quarantined by a failed verify: never re-selected
         if not file_io.exists(file_io.join(full, "host_state.json")):
             continue
         proper = re.match(r"checkpoint(\.\d+)?$", name) is not None

@@ -1,0 +1,107 @@
+"""Chaos soak (python -m bigdl_tpu.tools.chaos): the tier-1 smoke runs
+the full in-process soak on the tiny workload — transient step faults,
+serving dispatch failure, worker-thread death, corrupt-checkpoint
+fallback — asserting bit-identical recovery, zero hangs, and exact
+fault/recovery reconciliation. The slow half adds the subprocess
+SIGKILL legs (mid-training and mid-checkpoint-write)."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.tools.chaos import main, run_soak
+
+SMOKE_SCHEDULE = ("train/step=nth:2,raise:RuntimeError;"
+                  "serving/dispatch=nth:2,raise:RuntimeError;"
+                  "serving/take_batch=nth:3,raise:RuntimeError")
+
+
+def test_chaos_smoke_soak_in_process(tmp_path):
+    report = run_soak(model="tiny", steps=8, leg_a=4, ckpt_every=2,
+                      batch_size=8, seed=42, schedule=SMOKE_SCHEDULE,
+                      workdir=str(tmp_path))
+    assert report["passed"], report["violations"]
+    assert report["bit_identical"] is True
+    assert report["burst"]["hung"] == 0
+    assert report["quarantined"], "corrupt checkpoint never quarantined"
+    # counter-for-counter reconciliation across every armed fault kind
+    assert report["injected"] == {"train/step": 1,
+                                  "serving/dispatch": 1,
+                                  "serving/take_batch": 1}
+    for point, n in report["injected"].items():
+        assert report["recovered"][point] == n, (point, report)
+
+
+def test_chaos_cli_usage_errors():
+    assert main(["--leg-a", "20", "--steps", "10"]) == 2
+    assert main(["--kill-at", "9", "--leg-a", "4", "--steps", "8"]) == 2
+
+
+def _worker(args, timeout=300):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "bigdl_tpu.tools.chaos", "--worker",
+         "--model", "tiny", "--batch-size", "8", "--seed", "42", *args],
+        capture_output=True, text=True, timeout=timeout, env=env)
+
+
+@pytest.mark.slow
+def test_resume_exactness_after_midtraining_sigkill(tmp_path):
+    """The satellite contract: a seeded run SIGKILLed mid-training at
+    step k (train/step faultpoint), relaunched and resumed from its
+    checkpoint, must land bit-identically — final params array-equal
+    and final loss float-equal — on an uninterrupted seeded run."""
+    ck_kill = tmp_path / "ck_kill"
+    ck_ref = tmp_path / "ck_ref"
+    p_kill = tmp_path / "killed.npz"
+    p_ref = tmp_path / "ref.npz"
+
+    r = _worker(["--steps", "8", "--ckpt-every", "2",
+                 "--ckpt-dir", str(ck_kill),
+                 "--schedule", "train/step=match:neval=5,sigkill"])
+    assert r.returncode == -9, (r.returncode, r.stderr[-500:])
+    assert (ck_kill / "checkpoint.4").exists()
+
+    r2 = _worker(["--steps", "8", "--ckpt-every", "2",
+                  "--ckpt-dir", str(ck_kill),
+                  "--save-params", str(p_kill)])
+    assert r2.returncode == 0, (r2.returncode, r2.stderr[-500:])
+    res2 = json.loads(r2.stdout.strip().splitlines()[-1])
+
+    r3 = _worker(["--steps", "8", "--ckpt-every", "2",
+                  "--ckpt-dir", str(ck_ref),
+                  "--save-params", str(p_ref)])
+    assert r3.returncode == 0, (r3.returncode, r3.stderr[-500:])
+    res3 = json.loads(r3.stdout.strip().splitlines()[-1])
+
+    assert res2["loss"] == res3["loss"]  # exact float, not approx
+    with np.load(p_kill) as a, np.load(p_ref) as b:
+        assert sorted(a.files) == sorted(b.files)
+        for k in a.files:
+            assert a[k].dtype == b[k].dtype
+            np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+
+
+@pytest.mark.slow
+def test_full_soak_cli_with_sigkill_leg(tmp_path):
+    """The acceptance soak: >= 4 distinct fault kinds (mid-checkpoint
+    SIGKILL, corrupt npz, transient step failures, serving dispatch
+    failure + worker death) through the real CLI; exit 0 == every
+    invariant held."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "bigdl_tpu.tools.chaos", "--model",
+         "tiny", "--steps", "12", "--leg-a", "6", "--ckpt-every", "2",
+         "--kill-at", "4", "--workdir", str(tmp_path), "--json"],
+        capture_output=True, text=True, timeout=560, env=env)
+    assert r.returncode == 0, (r.returncode, r.stdout[-800:],
+                               r.stderr[-500:])
+    report = json.loads(r.stdout)
+    assert report["passed"] and report["bit_identical"]
+    assert report["kill"] == {"injected_sigkills": 1, "resumes": 1}
+    assert report["burst"]["hung"] == 0

@@ -184,7 +184,89 @@ def train(step, params, batches):
         print(float(loss))  # bigdl: disable=sync-in-loop
 """,
     ),
+    "retry-no-backoff": (
+        """
+def run(fn):
+    for attempt in range(5):
+        try:
+            return fn()
+        except Exception:
+            time.sleep(1.0)
+""",
+        """
+def run(fn):
+    for attempt in range(5):
+        try:
+            return fn()
+        except Exception:
+            time.sleep(1.0)  # bigdl: disable=retry-no-backoff
+""",
+    ),
 }
+
+
+def test_retry_no_backoff_flags_fixed_attribute_interval():
+    # the exact shape this rule was written to remove from
+    # optimizer.py: except Exception + sleep(self.retry_interval_s)
+    src = HEADER + """
+class Driver:
+    def optimize(self):
+        while True:
+            try:
+                return self._impl()
+            except Exception:
+                time.sleep(self.retry_interval_s)
+"""
+    findings = lint_source(src, "fixture.py")
+    assert "retry-no-backoff" in names(findings)
+
+
+def test_retry_no_backoff_passes_computed_backoff():
+    # a delay assigned in the handler grows across attempts — the
+    # sanctioned pattern must not be flagged
+    src = HEADER + """
+def run(fn, backoff):
+    for attempt in range(5):
+        try:
+            return fn()
+        except Exception:
+            delay = backoff(attempt)
+            time.sleep(delay)
+"""
+    findings = lint_source(src, "fixture.py")
+    assert "retry-no-backoff" not in names(findings, only_active=False)
+
+
+def test_retry_no_backoff_passes_growing_attribute_backoff():
+    # an attribute the loop rebinds (self.delay *= 2) IS a backoff —
+    # only never-reassigned attributes (config knobs) count as fixed
+    src = HEADER + """
+class Driver:
+    def run(self, fn):
+        while True:
+            try:
+                return fn()
+            except Exception:
+                self.delay *= 2
+                time.sleep(self.delay)
+"""
+    findings = lint_source(src, "fixture.py")
+    assert "retry-no-backoff" not in names(findings, only_active=False)
+
+
+def test_retry_no_backoff_ignores_narrow_excepts():
+    # a narrow except (one concrete error) is a deliberate recovery
+    # path, not a blanket retry — out of scope for this rule
+    src = HEADER + """
+def run(fn):
+    for attempt in range(5):
+        try:
+            return fn()
+        except ConnectionResetError:
+            time.sleep(1.0)
+"""
+    findings = lint_source(src, "fixture.py")
+    assert "retry-no-backoff" not in names(findings, only_active=False)
 
 
 def test_sync_in_loop_skips_files_without_jax():

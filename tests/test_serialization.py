@@ -315,3 +315,107 @@ def test_checkpoint_roundtrip_via_memory_filesystem():
     ck = load_checkpoint(latest)
     np.testing.assert_array_equal(ck["params"]["w"], params["w"])
     assert ck["driver_state"]["epoch"] == 2
+
+
+# ---------------------------------------------- integrity (PR5 faults)
+
+def test_manifest_records_sha256_and_verify_passes(tmp_path):
+    """Format-2 checkpoints carry per-file digests; a clean dir
+    verifies and loads."""
+    import json
+
+    from bigdl_tpu.utils.serialization import (MANIFEST, load_checkpoint,
+                                               verify_checkpoint)
+    _save_ck(tmp_path / "checkpoint.2", 2, 1.0)
+    with open(tmp_path / "checkpoint.2" / MANIFEST) as f:
+        manifest = json.load(f)
+    assert manifest["format"] == 2
+    assert sorted(manifest["sha256"]) == sorted(manifest["files"])
+    verify_checkpoint(str(tmp_path / "checkpoint.2"))
+    assert load_checkpoint(
+        str(tmp_path / "checkpoint.2"))["params"]["w"][0] == 1.0
+
+
+def test_corrupt_npz_behind_manifest_raises_and_skips_verify_off(tmp_path):
+    """Bit rot AFTER the MANIFEST landed: completeness says done, the
+    bytes say otherwise — only the digest check can catch it."""
+    import os
+
+    from bigdl_tpu.utils.serialization import (CheckpointCorrupt,
+                                               load_checkpoint)
+    _save_ck(tmp_path / "checkpoint.2", 2, 1.0)
+    npz = tmp_path / "checkpoint.2" / "params.npz"
+    with open(npz, "r+b") as f:
+        f.truncate(os.path.getsize(npz) // 2)
+    with pytest.raises(CheckpointCorrupt, match="params.npz"):
+        load_checkpoint(str(tmp_path / "checkpoint.2"))
+
+
+def test_missing_manifest_file_raises_corrupt(tmp_path):
+    from bigdl_tpu.utils.serialization import (CheckpointCorrupt,
+                                               verify_checkpoint)
+    _save_ck(tmp_path / "checkpoint.2", 2)
+    (tmp_path / "checkpoint.2" / "opt_state.npz").unlink()
+    with pytest.raises(CheckpointCorrupt, match="opt_state.npz"):
+        verify_checkpoint(str(tmp_path / "checkpoint.2"))
+
+
+def test_format1_manifest_without_digests_still_loads(tmp_path):
+    """Back-compat: a MANIFEST written before digests existed (format
+    1: files listed, no sha256 map) passes verification on presence
+    alone."""
+    import json
+
+    from bigdl_tpu.utils.serialization import (MANIFEST, load_checkpoint,
+                                               verify_checkpoint)
+    _save_ck(tmp_path / "checkpoint.2", 2, 3.0)
+    mpath = tmp_path / "checkpoint.2" / MANIFEST
+    with open(mpath) as f:
+        manifest = json.load(f)
+    del manifest["sha256"]
+    manifest["format"] = 1
+    with open(mpath, "w") as f:
+        json.dump(manifest, f)
+    verify_checkpoint(str(tmp_path / "checkpoint.2"))
+    assert load_checkpoint(
+        str(tmp_path / "checkpoint.2"))["params"]["w"][0] == 3.0
+
+
+def test_quarantined_dirs_are_never_selected(tmp_path):
+    from bigdl_tpu.utils.serialization import (find_latest_checkpoint,
+                                               quarantine_checkpoint)
+    _save_ck(tmp_path / "checkpoint.2", 2, 1.0)
+    _save_ck(tmp_path / "checkpoint.4", 4, 2.0)
+    q = quarantine_checkpoint(str(tmp_path / "checkpoint.4"))
+    assert q is not None and ".corrupt-" in q
+    latest = find_latest_checkpoint(str(tmp_path))
+    assert latest.endswith("checkpoint.2")
+
+
+def test_try_resume_quarantines_corrupt_latest_and_walks_back(tmp_path):
+    """The recovery contract the retry loop depends on: a corrupt
+    LATEST checkpoint is quarantined and resume lands on the previous
+    intact one — instead of re-raising on the same bad dir every
+    retry (the satellite's truncate-params.npz-after-MANIFEST case)."""
+    import os
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.dataset import DataSet, Sample
+    from bigdl_tpu.optim.optimizer import Optimizer
+
+    _save_ck(tmp_path / "checkpoint.2", 2, 1.0)
+    _save_ck(tmp_path / "checkpoint.4", 4, 2.0)
+    npz = tmp_path / "checkpoint.4" / "params.npz"
+    with open(npz, "r+b") as f:
+        f.truncate(os.path.getsize(npz) // 2)
+
+    samples = [Sample(np.zeros(4, np.float32), np.float32(1.0))]
+    opt = Optimizer(nn.Linear(4, 2), DataSet.array(samples),
+                    nn.ClassNLLCriterion())
+    opt.checkpoint_path = str(tmp_path)
+    resumed = opt._try_resume()
+    assert resumed is not None
+    assert resumed["driver_state"]["neval"] == 2
+    assert resumed["params"]["w"][0] == 1.0
+    quarantined = [n for n in os.listdir(tmp_path) if ".corrupt-" in n]
+    assert len(quarantined) == 1 and "checkpoint.4" in quarantined[0]

@@ -610,3 +610,153 @@ def test_registry_activate_false_stages_even_first_version():
         reg.current("m")
     reg.swap("m", 1)
     assert reg.current("m").version == 1
+
+
+# ------------------------------------- supervision + breaker (PR5 faults)
+
+def test_worker_death_fails_pending_futures_typed_not_hang():
+    """The silent-hang regression: a crash in _take_batch_locked (i.e.
+    in the batching machinery, OUTSIDE _dispatch's error handling) used
+    to kill the daemon thread and leave every queued future pending
+    forever. Supervision must fail them with WorkerDied within the
+    deadline — and restart the loop so the batcher keeps serving."""
+    from concurrent.futures import TimeoutError as FutTimeout
+
+    from bigdl_tpu import faults
+    from bigdl_tpu.serving import WorkerDied
+
+    b = MicroBatcher(lambda x: x, BucketLadder(8), max_wait_ms=20.0,
+                     name="sup")
+    try:
+        with faults.armed("serving/take_batch=nth:1,raise:RuntimeError"):
+            futs = [b.submit(np.ones((1, 4), np.float32))
+                    for _ in range(3)]
+            died = 0
+            for f in futs:
+                try:
+                    f.result(timeout=5)  # a post-restart round may
+                    # legitimately serve a late-queued submitter
+                except WorkerDied as e:
+                    assert "sup" in str(e)
+                    died += 1
+                except FutTimeout:
+                    raise AssertionError(
+                        "future hung past deadline — supervision failed")
+            # the crashing round's submitters fail typed, never hang
+            assert died >= 1
+        assert b.stats.worker_restarts == 1
+        assert b.stats.worker_failed == died
+        # the restarted loop serves new traffic
+        out = b.submit(np.ones((2, 4), np.float32)).result(timeout=5)
+        assert out.shape == (2, 4)
+    finally:
+        faults.disarm()
+        b.shutdown(drain=False)
+
+
+def test_circuit_breaker_state_machine_with_fake_clock():
+    from bigdl_tpu.serving import CircuitBreaker
+
+    now = [0.0]
+    br = CircuitBreaker(failures=3, cooldown_ms=1000.0,
+                        clock=lambda: now[0])
+    assert br.state == "closed" and br.allow()
+    br.on_failure()
+    br.on_failure()
+    assert br.state == "closed"  # 2 < 3: still closed
+    br.on_success()
+    br.on_failure()
+    br.on_failure()
+    br.on_failure()  # 3 consecutive -> open
+    assert br.state == "open"
+    assert not br.allow()
+    now[0] += 0.5
+    assert not br.allow()  # cooldown not elapsed
+    now[0] += 0.6
+    assert br.allow()  # the half-open probe
+    assert br.state == "half-open"
+    assert not br.allow()  # one probe at a time
+    br.on_failure()  # probe failed -> re-open
+    assert br.state == "open"
+    now[0] += 1.1
+    assert br.allow()
+    br.on_success()  # probe succeeded -> closed, counters reset
+    assert br.state == "closed"
+    assert br.allow()
+
+
+def test_circuit_breaker_rearms_probe_when_outcome_never_arrives():
+    """A half-open probe can die before dispatch (queue-full, deadline
+    expiry, worker death clearing the queue) — neither on_success nor
+    on_failure ever fires. The breaker must admit a fresh probe after
+    a cooldown instead of shedding forever."""
+    from bigdl_tpu.serving import CircuitBreaker
+
+    now = [0.0]
+    br = CircuitBreaker(failures=1, cooldown_ms=1000.0,
+                        clock=lambda: now[0])
+    br.on_failure()
+    assert br.state == "open"
+    now[0] += 1.1
+    assert br.allow()  # probe admitted... and then vanishes
+    assert not br.allow()
+    now[0] += 1.1  # a full cooldown with no probe outcome
+    assert br.allow()  # re-armed, not permanently Degraded
+    br.on_success()
+    assert br.state == "closed"
+
+
+def test_service_sheds_load_when_breaker_opens_and_recovers():
+    """End to end: K consecutive dispatch failures open the breaker,
+    submits fast-reject with Degraded (counted as shed), and a healthy
+    dispatch after the cooldown closes it again."""
+    from bigdl_tpu import faults
+    from bigdl_tpu.serving import Degraded
+
+    svc = InferenceService(config=ServingConfig(
+        max_batch_size=8, max_wait_ms=1.0, buckets=(8,),
+        breaker_failures=2, breaker_cooldown_ms=80.0))
+    try:
+        svc.load("brk", _const_model(1.0))
+        x = np.ones((2, 4), np.float32)
+        with faults.armed("serving/dispatch=nth:1-2,raise:RuntimeError"):
+            # two serial failing batches (submit->resolve each so they
+            # cannot coalesce) trip the breaker
+            for _ in range(2):
+                with pytest.raises(RuntimeError):
+                    svc.predict_batch("brk", x, timeout_ms=2000)
+            assert svc.breaker_state("brk") == "open"
+            with pytest.raises(Degraded):
+                svc.predict_batch("brk", x)
+        m = svc.metrics("brk")
+        assert m["failed_batches"] == 2
+        assert m["shed"] == 1
+        time.sleep(0.1)  # past the cooldown: half-open probe admitted
+        out = svc.predict_batch("brk", x, timeout_ms=2000)
+        np.testing.assert_allclose(np.asarray(out), 1.0)
+        assert svc.breaker_state("brk") == "closed"
+    finally:
+        faults.disarm()
+        svc.shutdown(drain=False)
+
+
+def test_swap_faultpoint_failure_leaves_old_version_serving():
+    from bigdl_tpu import faults
+
+    svc = InferenceService(config=ServingConfig(max_batch_size=8,
+                                                buckets=(8,)))
+    try:
+        svc.load("m", _const_model(1.0))
+        svc.load("m", _const_model(2.0), activate=False)
+        x = np.ones((1, 4), np.float32)
+        with faults.armed("serving/swap=nth:1,raise:RuntimeError"):
+            with pytest.raises(RuntimeError):
+                svc.swap("m", 2)
+        np.testing.assert_allclose(
+            np.asarray(svc.predict_batch("m", x, timeout_ms=2000)), 1.0)
+        svc.swap("m", 2)  # disarmed: the swap completes
+        np.testing.assert_allclose(
+            np.asarray(svc.predict_batch("m", x, timeout_ms=2000)), 2.0)
+    finally:
+        faults.disarm()
+        svc.shutdown(drain=False)
