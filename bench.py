@@ -436,6 +436,14 @@ def main():
         # the live values ride the final carry
         result["resnet50_inference_imgs_per_sec_per_chip"] = round(
             _bench_inference(model, carry[0], carry[2], batch), 1)
+    # fourth tracked row: GENERATION — TransformerLM autoregressive
+    # serving through the KV-cache decode engine (tokens/sec plus
+    # TTFT / per-token latency percentiles from the service's own
+    # histograms). Skipped on CPU smoke runs unless forced — the 2K
+    # program warmup would dominate CI.
+    gen_flag = os.environ.get("BENCH_GEN", "")
+    if gen_flag != "0" and (platform != "cpu" or gen_flag == "1"):
+        result.update(_bench_generation())
     print(json.dumps(result))
     _maybe_metrics_snapshot(result)
 
@@ -473,6 +481,62 @@ def _bench_inference(model, params, mstate, batch):
             jax.random.fold_in(root, i), scan))
     float(carry)
     return batch * scan * iters / (time.time() - t0)
+
+
+def _bench_generation():
+    """TransformerLM generation serving: a burst of seeded ragged
+    prompts through the bucketed KV-cache decode engine with
+    continuous batching (``bigdl_tpu.generation``). Returns the
+    GENERATION row: tokens/sec/chip plus p50/p99 time-to-first-token
+    and p50/p99 per-token latency, read from the GenerationService's
+    own telemetry histograms so the scoreboard and the service agree
+    by construction."""
+    import numpy as np
+
+    from bigdl_tpu.generation import GenerationConfig, GenerationService
+    from bigdl_tpu.models import TransformerLM
+    from bigdl_tpu.tools.synthetic import seeded_rng
+    from bigdl_tpu.utils.random import RandomGenerator
+
+    vocab = int(os.environ.get("BENCH_GEN_VOCAB", 8192))
+    hidden = int(os.environ.get("BENCH_GEN_HIDDEN", 512))
+    layers = int(os.environ.get("BENCH_GEN_LAYERS", 6))
+    max_len = int(os.environ.get("BENCH_GEN_LEN", 512))
+    slots = int(os.environ.get("BENCH_GEN_SLOTS", 16))
+    n_reqs = int(os.environ.get("BENCH_GEN_REQS", 32))
+    max_new = int(os.environ.get("BENCH_GEN_NEW", 32))
+
+    RandomGenerator.set_seed(11)
+    model = TransformerLM(vocab_size=vocab, hidden_size=hidden,
+                          num_layers=layers, num_heads=8,
+                          max_len=max_len).evaluate()
+    model.ensure_initialized()
+    svc = GenerationService(config=GenerationConfig(
+        slots=slots, max_len=max_len, prefill_rows=min(4, slots),
+        max_queue=max(n_reqs, 256)))
+    svc.load("lm", model)  # warmup: compiles stay out of the timing
+
+    r = seeded_rng(12)
+    prompts = [r.randint(1, vocab, r.randint(4, max_len - max_new))
+               .astype(np.int32) for _ in range(n_reqs)]
+    t0 = time.time()
+    streams = [svc.generate("lm", p, max_new_tokens=max_new)
+               for p in prompts]
+    total = sum(len(s.result()) for s in streams)
+    dt = time.time() - t0
+    m = svc.metrics("lm")
+    svc.shutdown()
+    row = {
+        "transformerlm_generation_tokens_per_sec_per_chip":
+            round(total / dt, 1),
+        "generation_requests": n_reqs,
+        "generation_compiles": int(m["compile_count"]),
+    }
+    for key in ("ttft_ms_p50", "ttft_ms_p99",
+                "token_ms_p50", "token_ms_p99"):
+        if key in m:
+            row[f"generation_{key}"] = round(float(m[key]), 3)
+    return row
 
 
 def _bench_transformer_lm():

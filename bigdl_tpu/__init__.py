@@ -18,6 +18,10 @@ frankfzw/BigDL, Scala/Spark/MKL) as an idiomatic JAX/XLA framework:
 - ``bigdl_tpu.serving``  — online inference: dynamic micro-batching, a
   shape-bucketed compile cache, and a hot-swappable multi-model registry
   (BigDL's local/distributed predictor serving story, request-level).
+- ``bigdl_tpu.generation`` — autoregressive generation serving: a
+  bucketed KV-cache decode engine (≤ 2K compiled prefill/decode pairs
+  for K length buckets) with continuous batching, streaming token
+  futures, and hot-swap under live decode (docs/serving.md).
 - ``bigdl_tpu.utils``    — Table (the pytree of the system), RandomGenerator,
   DirectedGraph, File I/O, logging.
 - ``bigdl_tpu.ops``      — pallas TPU kernels for ops XLA fusion can't cover
@@ -45,13 +49,13 @@ Design notes (vs the reference, /root/reference):
 from bigdl_tpu.utils.table import Table, T
 from bigdl_tpu.utils.random import RandomGenerator
 from bigdl_tpu.utils.engine import Engine
-from bigdl_tpu import (nn, optim, dataset, faults, parallel, serving,
-                       telemetry, utils, analysis)
+from bigdl_tpu import (nn, optim, dataset, faults, generation, parallel,
+                       serving, telemetry, utils, analysis)
 
 __version__ = "0.1.0"
 
 __all__ = [
     "Table", "T", "RandomGenerator", "Engine",
-    "analysis", "nn", "optim", "dataset", "faults", "parallel",
-    "serving", "telemetry", "utils",
+    "analysis", "nn", "optim", "dataset", "faults", "generation",
+    "parallel", "serving", "telemetry", "utils",
 ]

@@ -71,6 +71,72 @@ def _imports_jax(ctx: FileContext) -> bool:
     return False
 
 
+#: canonical (alias-resolved) names of the array-growing jnp calls
+_GROWING_FNS = frozenset(
+    f"jax.numpy.{fn}" for fn in ("concatenate", "append", "concat",
+                                 "hstack", "vstack"))
+
+
+@rule("growing-concat-in-loop",
+      "growing a jnp array by concatenation every loop iteration")
+def growing_concat_in_loop(ctx: FileContext):
+    """Flags ``x = jnp.concatenate([x, ...])`` / ``jnp.append(x, ...)``
+    (and hstack/vstack/concat) where the target feeds its own
+    concatenation inside a loop — the classic autoregressive-decode
+    pitfall: in traced code every iteration is a NEW shape (one XLA
+    compile per token), and on the host it is O(n²) copying. The
+    sanctioned idiom is a preallocated buffer written in place
+    (``lax.dynamic_update_slice`` — the ``bigdl_tpu.generation`` KV
+    cache), with deliberate exceptions marked
+    ``# bigdl: disable=growing-concat-in-loop``. Each loop is analyzed
+    at its own nesting level; files that never import jax are
+    skipped."""
+    if not _imports_jax(ctx):
+        return
+    for loop in ctx.walk(ast.For, ast.While):
+        body = []
+        # loop.body only: the else: clause runs once, after the loop
+        stack = list(loop.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.For, ast.While)):
+                continue  # other scopes / the inner loop's own finding
+            body.append(node)
+            stack.extend(ast.iter_child_nodes(node))
+        for node in body:
+            if not isinstance(node, (ast.Assign, ast.AugAssign,
+                                     ast.AnnAssign)):
+                continue
+            value = node.value
+            if not (isinstance(value, ast.Call)
+                    and ctx.canon(value.func) in _GROWING_FNS):
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            target_names = {
+                t.id
+                for tgt in targets
+                for t in (tgt.elts if isinstance(tgt, (ast.Tuple,
+                                                       ast.List))
+                          else [tgt])
+                if isinstance(t, ast.Name)}
+            arg_names = {n.id for a in value.args
+                         for n in ast.walk(a)
+                         if isinstance(n, ast.Name)}
+            grown = sorted(target_names & arg_names)
+            if grown:
+                fn = ctx.canon(value.func)
+                yield node, (
+                    f"`{fn}` grows `{grown[0]}` every iteration: in "
+                    "traced code each step is a new shape (one XLA "
+                    "compile per token), on the host it is O(n²) "
+                    "copying; preallocate and write in place "
+                    "(`lax.dynamic_update_slice`, the KV-cache decode "
+                    "idiom) or mark a deliberate small loop with "
+                    "`# bigdl: disable=growing-concat-in-loop`")
+
+
 @rule("sync-in-loop",
       "per-iteration host-device sync inside a host step loop")
 def sync_in_loop(ctx: FileContext):

@@ -1,0 +1,34 @@
+"""Autoregressive generation serving: bucketed KV-cache decode with
+continuous batching (docs/serving.md "Generation").
+
+Batched serving (:mod:`bigdl_tpu.serving`) answers one forward per
+request; this package serves *generation* — the token-at-a-time
+workload — without ever paying XLA's per-shape compile tax: a
+preallocated slot-based :class:`KVCache`, per-length-bucket
+prefill/decode program pairs (K rungs ⇒ ≤ 2K compiles, counted via the
+serving :class:`~bigdl_tpu.serving.compile_cache.CompileCache`), and a
+:class:`DecodeLoop` that admits queued requests into free cache slots
+*every decode step* instead of waiting for the batch to drain::
+
+    from bigdl_tpu.generation import GenerationService, GenerationConfig
+
+    svc = GenerationService(config=GenerationConfig(slots=8,
+                                                    max_len=256))
+    svc.load("lm", transformer_lm)             # warms 2K programs
+    stream = svc.generate("lm", prompt_ids, max_new_tokens=32)
+    print(stream.first())                      # TTFT moment
+    print(stream.result())                     # the full generation
+"""
+from bigdl_tpu.generation.engine import DecodeEngine
+from bigdl_tpu.generation.kv_cache import KVCache, SlotAllocator
+from bigdl_tpu.generation.loop import DecodeLoop
+from bigdl_tpu.generation.sampling import Sampler, SamplingParams
+from bigdl_tpu.generation.service import (GenerationConfig,
+                                          GenerationService)
+from bigdl_tpu.generation.stream import TokenStream
+
+__all__ = [
+    "DecodeEngine", "DecodeLoop", "GenerationConfig",
+    "GenerationService", "KVCache", "Sampler", "SamplingParams",
+    "SlotAllocator", "TokenStream",
+]

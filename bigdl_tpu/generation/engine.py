@@ -1,0 +1,224 @@
+"""Compiled prefill/decode program pairs, bucketed by sequence length.
+
+The XLA serving lesson (TensorFlow paper §4.4) applied to
+autoregression: a naive decode loop re-traces every time the sequence
+grows — one compile *per token*. This engine pins every shape instead:
+
+- **prefill** runs at ``[prefill_rows, S_b]`` for a prompt-length
+  bucket ``S_b`` from the service's :class:`~bigdl_tpu.serving.
+  compile_cache.BucketLadder` — the padded-prompt batch computes the
+  prompt's K/V rows *and* the first-token logits in one program, and
+  scatters the rows straight into the big cache (out-of-bounds slot
+  ids are dropped, which is how padding rows write nothing);
+- **decode** runs at ``[slots]`` — one token per slot per step, the
+  cache donated through — with attention restricted to the first
+  ``T_b`` cache positions for a length bucket ``T_b``, so short
+  sequences never scan the whole preallocated ``max_len``.
+
+K ladder rungs ⇒ at most K prefill + K decode = **2K compiled
+programs** per model version, warmed eagerly as pairs by
+:meth:`DecodeEngine.warmup` and counted — not trusted — through the
+shared :class:`~bigdl_tpu.serving.compile_cache.CompileCache` compile
+counter the serving tests already assert against.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Sequence, Set, Tuple
+
+import numpy as np
+
+from bigdl_tpu.serving.compile_cache import BucketLadder, CompileCache
+from bigdl_tpu.generation.kv_cache import KVCache
+
+
+class DecodeEngine:
+    """Per-servable prefill/decode programs over one length ladder.
+
+    Stateless apart from the program handles it registers in the
+    shared :class:`CompileCache` (keys ``servable.key + ("prefill",
+    S_b)`` / ``+ ("decode", T_b)``); the caller owns the
+    :class:`KVCache` buffers and threads them through."""
+
+    def __init__(self, cache: CompileCache, ladder: BucketLadder,
+                 slots: int, prefill_rows: int):
+        self.cache = cache
+        self.ladder = ladder
+        self.slots = slots
+        self.prefill_rows = prefill_rows
+        # program keys registered per servable key, so unload can drop
+        # exactly the programs this engine created; guarded — the
+        # decode-loop thread registers while metrics readers iterate
+        self._lock = threading.Lock()
+        self._keys: Dict[Tuple, Set[Tuple]] = {}
+
+    # ------------------------------------------------------- programs
+    def _program(self, servable, kind: str, bucket: int, build):
+        key = servable.key + (kind, bucket)
+        prog = self.cache.program_for(key, build)
+        with self._lock:
+            self._keys.setdefault(servable.key, set()).add(key)
+        return prog
+
+    def prefill_program(self, servable, bucket: int):
+        """The compiled prefill for prompt bucket ``bucket``:
+        ``(params, state, k, v, tokens[Bp,S_b], prompt_lens[Bp],
+        slot_ids[Bp]) -> (logits[Bp,V], k', v')`` with the cache
+        donated. Padding rows carry ``slot_ids == slots`` (out of
+        bounds): their K/V scatter is dropped and their logits row is
+        garbage the driver never reads."""
+        import jax
+        import jax.numpy as jnp
+
+        model = servable.model
+
+        def build(on_trace):
+            def fn(params, state, k, v, tokens, prompt_lens, slot_ids):
+                on_trace()
+                bp, sb = tokens.shape
+                layers, _, heads, _, hd = k.shape
+                zero_rows = jnp.zeros((layers, bp, heads, sb, hd),
+                                      k.dtype)
+                # the prompt's cache rows start empty — attention here
+                # is causal among the prompt tokens themselves
+                logits, _, rows = model.apply(
+                    params, state, tokens, training=False,
+                    cache={"k": zero_rows, "v": zero_rows},
+                    positions=jnp.zeros((bp,), jnp.int32),
+                    attend_len=sb)
+                last = jnp.take_along_axis(
+                    logits, (prompt_lens.astype(jnp.int32) - 1)
+                    [:, None, None], axis=1)[:, 0, :]
+                ids = slot_ids.astype(jnp.int32)
+                k = k.at[:, ids, :, :sb, :].set(rows["k"], mode="drop")
+                v = v.at[:, ids, :, :sb, :].set(rows["v"], mode="drop")
+                return last, k, v
+
+            return jax.jit(fn, donate_argnums=(2, 3))
+
+        return self._program(servable, "prefill", bucket, build)
+
+    def decode_program(self, servable, attend_len: int):
+        """The compiled decode step for length bucket ``attend_len``:
+        ``(params, state, k, v, tokens[slots], positions[slots],
+        active[slots]) -> (logits[slots,V], k', v')``, cache donated.
+        Each live slot writes its token's K/V at ``positions[s]`` and
+        attends the first ``attend_len`` cache positions under the
+        length-masked causal mask; inactive slots write into their own
+        (free) row at position 0, which the slot's next prefill
+        re-writes before anything can attend it."""
+        import jax
+        import jax.numpy as jnp
+
+        model = servable.model
+
+        def build(on_trace):
+            def fn(params, state, k, v, tokens, positions, active):
+                on_trace()
+                pos = jnp.where(active, positions.astype(jnp.int32), 0)
+                logits, _, cache = model.apply(
+                    params, state, tokens[:, None], training=False,
+                    cache={"k": k, "v": v}, positions=pos,
+                    attend_len=attend_len)
+                return logits[:, 0, :], cache["k"], cache["v"]
+
+            return jax.jit(fn, donate_argnums=(2, 3))
+
+        return self._program(servable, "decode", attend_len, build)
+
+    # ------------------------------------------------------ execution
+    def prefill(self, servable, kv: KVCache, prompts: Sequence[np.ndarray],
+                slot_ids: Sequence[int]):
+        """Run one padded-prompt prefill batch: writes each prompt's
+        K/V into its slot's cache rows and returns the ``[n, V]``
+        first-token logits (host ndarray) for the ``n`` real rows.
+
+        Prompts pad to the ladder rung of the longest prompt in the
+        batch; rows pad to ``prefill_rows`` with dropped slot ids."""
+        n = len(prompts)
+        if n == 0 or n > self.prefill_rows:
+            raise ValueError(f"prefill batch of {n} rows "
+                             f"(prefill_rows={self.prefill_rows})")
+        lens = [len(p) for p in prompts]
+        bucket = self.ladder.bucket_for(max(lens))
+        tokens = np.zeros((self.prefill_rows, bucket), np.int32)
+        for i, p in enumerate(prompts):
+            tokens[i, :len(p)] = np.asarray(p, np.int32)
+        prompt_lens = np.ones((self.prefill_rows,), np.int32)
+        prompt_lens[:n] = lens
+        ids = np.full((self.prefill_rows,), self.slots, np.int32)  # OOB
+        ids[:n] = np.asarray(slot_ids, np.int32)
+        prog = self.prefill_program(servable, bucket)
+        logits, kv.k, kv.v = prog(servable.params, servable.state,
+                                  kv.k, kv.v, tokens, prompt_lens, ids)
+        for i, slot in enumerate(slot_ids):
+            kv.lengths[slot] = lens[i]
+        return np.asarray(logits[:n]), bucket
+
+    def decode(self, servable, kv: KVCache, tokens: np.ndarray,
+               positions: np.ndarray, active: np.ndarray):
+        """Run one decode step over every slot (one token per live
+        slot); returns the ``[slots, V]`` logits as a host ndarray.
+        ``attend_len`` is re-bucketed from the longest live row each
+        step, so a batch of short sequences runs the small-rung
+        program."""
+        longest = int(positions[active].max()) + 1 if active.any() else 1
+        attend_len = self.ladder.bucket_for(longest)
+        prog = self.decode_program(servable, attend_len)
+        logits, kv.k, kv.v = prog(
+            servable.params, servable.state, kv.k, kv.v,
+            tokens.astype(np.int32), positions.astype(np.int32),
+            active.astype(bool))
+        return np.asarray(logits), attend_len
+
+    # -------------------------------------------------------- warmup
+    def warmup(self, servable, kv: KVCache = None, kv_dtype=None) -> int:
+        """Eagerly compile the prefill+decode program *pair* for every
+        ladder rung (the generation analogue of
+        :meth:`CompileCache.warmup`, which warms one eval program per
+        rung) so no live request ever eats an XLA compile. All writes
+        are dropped/inactive, so the cache stays servable: pass the
+        ``kv`` the decode loop will adopt (the service does — the
+        warmup buffers must not be a second full-size allocation on
+        top of the serving one) or omit it for a throwaway. Returns
+        how many programs this call compiled (≤ 2 × ladder rungs;
+        rungs already compiled cost nothing)."""
+        import jax
+
+        if kv is None:
+            kv = KVCache.for_model(servable.model, self.slots,
+                                   self.ladder.max_batch_size, kv_dtype)
+        before = self.compile_count(servable)
+        drop_ids = np.full((self.prefill_rows,), self.slots, np.int32)
+        lens1 = np.ones((self.prefill_rows,), np.int32)
+        dec_tokens = np.zeros((self.slots,), np.int32)
+        dec_pos = np.zeros((self.slots,), np.int32)
+        inactive = np.zeros((self.slots,), bool)
+        for rung in self.ladder:
+            pre = self.prefill_program(servable, rung)
+            prompts = np.zeros((self.prefill_rows, rung), np.int32)
+            # warmup exists to GATE on both programs of every rung
+            # before the version takes traffic
+            _, kv.k, kv.v = pre(servable.params, servable.state, kv.k,
+                                kv.v, prompts, lens1, drop_ids)
+            dec = self.decode_program(servable, rung)
+            out, kv.k, kv.v = dec(servable.params, servable.state, kv.k,
+                                  kv.v, dec_tokens, dec_pos, inactive)
+            jax.block_until_ready(out)  # bigdl: disable=sync-in-loop
+        return self.compile_count(servable) - before
+
+    # ----------------------------------------------------- accounting
+    def compile_count(self, servable) -> int:
+        """Programs compiled for this servable through this engine."""
+        with self._lock:
+            keys = list(self._keys.get(servable.key, ()))
+        return sum(self.cache.compile_count(k) for k in keys)
+
+    def drop(self, key: Tuple) -> None:
+        """Release every program registered for a servable key (called
+        at unload, mirroring :meth:`CompileCache.drop` for eval
+        steps)."""
+        with self._lock:
+            keys = self._keys.pop(key, ())
+        for k in keys:
+            self.cache.drop(k)

@@ -82,16 +82,26 @@ class TransformerBlock(Module):
     def initial_state(self):
         return {"mlp": adopt_state(self.mlp)}
 
-    def apply(self, params, state, input, *, training=False, rng=None):
+    def apply(self, params, state, input, *, training=False, rng=None,
+              cache=None, positions=None, attend_len=None):
         r1, r2 = (jax.random.split(rng) if rng is not None else (None, None))
         h = self.ln1.forward_fn(params["ln1"], input)
-        h = self.attn.forward_fn(params["attn"], h, training=training,
-                                 rng=r1)
+        if cache is None:
+            h = self.attn.forward_fn(params["attn"], h, training=training,
+                                     rng=r1)
+        else:
+            # incremental decode: the attention writes this block's K/V
+            # rows at `positions` and returns the updated cache
+            h, cache = self.attn.forward_fn(
+                params["attn"], h, training=training, rng=r1,
+                cache=cache, positions=positions, attend_len=attend_len)
         x = input + h
         h = self.ln2.forward_fn(params["ln2"], x)
         h, mlp_state = self.mlp.apply(params["mlp"], state.get("mlp", {}), h,
                                       training=training, rng=r2)
-        return x + h, {"mlp": mlp_state}
+        if cache is None:
+            return x + h, {"mlp": mlp_state}
+        return x + h, {"mlp": mlp_state}, cache
 
 
 class TransformerLM(Module):
@@ -145,24 +155,46 @@ class TransformerLM(Module):
         return {f"block_{i}": adopt_state(blk)
                 for i, blk in enumerate(self.blocks)}
 
-    def apply(self, params, state, input, *, training=False, rng=None):
+    def apply(self, params, state, input, *, training=False, rng=None,
+              cache=None, positions=None, attend_len=None):
         tokens = input.astype(jnp.int32)
         b, s = tokens.shape
-        x = params["embed"][tokens] + params["pos_embed"][:s][None]
+        if cache is None:
+            x = params["embed"][tokens] + params["pos_embed"][:s][None]
+        else:
+            # incremental decode: row b's S tokens sit at absolute
+            # positions positions[b] .. positions[b]+S-1 (clip keeps a
+            # free-slot row's garbage offset from faulting the gather;
+            # its output is never read)
+            idx = jnp.clip(
+                positions.astype(jnp.int32)[:, None] + jnp.arange(s)[None],
+                0, self.max_len - 1)
+            x = params["embed"][tokens] + params["pos_embed"][idx]
         keys = (jax.random.split(rng, self.num_layers)
                 if rng is not None else [None] * self.num_layers)
         new_state = {}
         for i, blk in enumerate(self.blocks):
-            x, st = blk.apply(params[f"block_{i}"],
-                              state.get(f"block_{i}", {}), x,
-                              training=training, rng=keys[i])
+            if cache is None:
+                x, st = blk.apply(params[f"block_{i}"],
+                                  state.get(f"block_{i}", {}), x,
+                                  training=training, rng=keys[i])
+            else:
+                x, st, layer_cache = blk.apply(
+                    params[f"block_{i}"], state.get(f"block_{i}", {}), x,
+                    training=training, rng=keys[i],
+                    cache={"k": cache["k"][i], "v": cache["v"][i]},
+                    positions=positions, attend_len=attend_len)
+                cache = {"k": cache["k"].at[i].set(layer_cache["k"]),
+                         "v": cache["v"].at[i].set(layer_cache["v"])}
             new_state[f"block_{i}"] = st
         x = self.ln_f.forward_fn(params["ln_f"], x)
         if self.tie_embeddings:
             logits = x @ params["embed"].T
         else:
             logits = x @ params["lm_head"]
-        return logits, new_state
+        if cache is None:
+            return logits, new_state
+        return logits, new_state, cache
 
     def aux_loss(self, state) -> jnp.ndarray:
         """Total MoE load-balance loss across blocks."""

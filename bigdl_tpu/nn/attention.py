@@ -148,7 +148,28 @@ class MultiHeadAttention(Module):
             y = y + params[f"b{name}"]
         return y
 
-    def forward_fn(self, params, input, *, training=False, rng=None):
+    def forward_fn(self, params, input, *, training=False, rng=None,
+                   cache=None, positions=None, attend_len=None):
+        """Full-sequence attention, or — with ``cache=`` — one
+        incremental (KV-cached) step.
+
+        ``cache`` is ``{"k": [B,H,T,D], "v": [B,H,T,D]}`` (T the
+        cache's bucketed max length), ``positions`` an int32 ``[B]`` of
+        per-row write offsets: the S new tokens of row ``b`` land at
+        cache slots ``positions[b] .. positions[b]+S-1`` via
+        ``dynamic_update_slice``, and each query at absolute position
+        ``p`` attends the cached keys ``j <= p`` under a length-masked
+        causal mask. ``attend_len`` (static) restricts attention to the
+        first ``attend_len`` cache slots so short sequences never scan
+        the whole preallocated cache — the per-bucket decode programs
+        close over one rung each. Returns ``(out, new_cache)``.
+
+        Without ``cache`` the path below is byte-identical to the
+        pre-cache implementation (weights are shared; generation adds
+        no parameters)."""
+        if cache is not None:
+            return self._forward_cached(params, input, cache, positions,
+                                        attend_len)
         x = input
         b, s, e = x.shape
         h, d = self.num_heads, self.head_dim
@@ -180,6 +201,51 @@ class MultiHeadAttention(Module):
         out = out.transpose(0, 2, 1, 3).reshape(b, s, e)
         return self._proj(params, out, "o")
 
+
+    def _forward_cached(self, params, x, cache, positions, attend_len):
+        """One KV-cached attention step (module ``forward_fn`` doc has
+        the contract). Pure: returns the updated cache, mutates
+        nothing."""
+        b, s, e = x.shape
+        h, d = self.num_heads, self.head_dim
+        if positions is None:
+            raise ValueError("cache= needs positions= (per-row int32 "
+                             "write offsets into the KV cache)")
+
+        def split(t):  # [B,S,E] -> [B,H,S,D]
+            return t.reshape(b, s, h, d).transpose(0, 2, 1, 3)
+
+        q = split(self._proj(params, x, "q"))
+        k = split(self._proj(params, x, "k"))
+        v = split(self._proj(params, x, "v"))
+
+        positions = positions.astype(jnp.int32)
+
+        # write the S new K/V rows at each row's offset (XLA clamps an
+        # out-of-range start into the buffer; the driver only passes
+        # in-range offsets for live rows, and a clamped write into a
+        # FREE slot is re-written by that slot's next prefill before any
+        # mask ever exposes it)
+        def upd(c, u, p):  # c: [H,T,D], u: [H,S,D], p: scalar offset
+            return jax.lax.dynamic_update_slice(c, u, (0, p, 0))
+
+        ck = jax.vmap(upd)(cache["k"], k, positions)
+        cv = jax.vmap(upd)(cache["v"], v, positions)
+
+        t = ck.shape[2]
+        al = t if attend_len is None else int(attend_len)
+        ks, vs = ck[:, :, :al, :], cv[:, :, :al, :]
+        # length-masked causal mask: query i of row b sits at absolute
+        # position positions[b]+i and may see cache slots j <= that —
+        # fed through the ONE attention core above so the cached and
+        # full-sequence paths can never drift numerically
+        jpos = jnp.arange(al)[None, None, None, :]
+        qpos = positions[:, None, None, None] \
+            + jnp.arange(s)[None, None, :, None]
+        out = dot_product_attention(q, ks, vs, mask=jpos <= qpos,
+                                    use_flash=False)
+        out = out.transpose(0, 2, 1, 3).reshape(b, s, e)
+        return self._proj(params, out, "o"), {"k": ck, "v": cv}
 
     def _sp_kernel(self):
         if self.sp_impl == "ulysses":

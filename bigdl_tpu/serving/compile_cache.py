@@ -114,18 +114,24 @@ class CompileCache:
             return key[0]
         return str(key)
 
-    def step_for(self, key, model):
-        """The (cached) jitted eval step for ``key``; builds it on first
-        use with a trace hook wired to this cache's counter. The
-        returned callable times itself: an execution that triggered a
-        trace counts as a cache miss (its wall-clock lands in the
+    def program_for(self, key, build):
+        """The (cached) self-counting program for ``key``; built on
+        first use by ``build(on_trace) -> jitted callable``, where
+        ``on_trace`` must be invoked from inside the traced function
+        body — i.e. exactly once per XLA compilation. The returned
+        callable times itself: an execution that triggered a trace
+        counts as a cache miss (its wall-clock lands in the
         ``serving/compile_cache/compile_s`` histogram), every other
-        execution as a hit."""
+        execution as a hit.
+
+        ``step_for`` (the eval forward every servable gets) and the
+        generation engine's per-bucket prefill/decode program pairs
+        (:mod:`bigdl_tpu.generation`) both build through here, so ONE
+        counter bounds every kind of program a servable compiles."""
         with self._lock:
-            step = self._steps.get(key)
-            if step is not None:
-                return step
-        from bigdl_tpu.optim.predictor import make_eval_step
+            prog = self._steps.get(key)
+            if prog is not None:
+                return prog
 
         label = self._model_label(key)
         # compiles already charged to the miss series; the delta against
@@ -138,11 +144,11 @@ class CompileCache:
             with self._lock:
                 self._compiles[key] = self._compiles.get(key, 0) + 1
 
-        jitted = make_eval_step(model, on_trace=on_trace)
+        jitted = build(on_trace)
 
-        def step(params, state, x):
+        def prog(*args, **kwargs):
             t0 = time.perf_counter()
-            out = jitted(params, state, x)
+            out = jitted(*args, **kwargs)
             dt = time.perf_counter() - t0
             with self._lock:
                 total = self._compiles.get(key, 0)
@@ -156,10 +162,19 @@ class CompileCache:
             return out
 
         with self._lock:
-            # two racing builders: keep the first registered step so
+            # two racing builders: keep the first registered program so
             # the trace counter stays tied to the cached callable
-            cached = self._steps.setdefault(key, step)
+            cached = self._steps.setdefault(key, prog)
         return cached
+
+    def step_for(self, key, model):
+        """The (cached) jitted eval step for ``key`` — ``program_for``
+        over ``optim.predictor.make_eval_step`` (hit/miss timing and
+        the per-key compile counter included)."""
+        from bigdl_tpu.optim.predictor import make_eval_step
+
+        return self.program_for(
+            key, lambda on_trace: make_eval_step(model, on_trace=on_trace))
 
     def compile_count(self, key=None) -> int:
         """Compilations so far — for ``key``, or in total when None."""

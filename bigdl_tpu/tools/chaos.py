@@ -20,15 +20,18 @@ serving burst, and asserts three invariants:
    epoch-exact device cache (every batch a pure function of the
    iteration number), checkpoints capture params + momentum + driver
    state, so recovery must be EXACT, not merely "converges anyway".
-2. **No hangs** — every serving future submitted during the burst
-   resolves (result or *typed* error) within its deadline; a pending
-   future after the run is a supervision bug.
+2. **No hangs** — every serving future AND every generation token
+   stream submitted during the bursts resolves (result or *typed*
+   error) within its deadline; a pending future after the run is a
+   supervision bug. The generation burst drives a tiny TransformerLM
+   through the KV-cache decode engine under ``serving/decode`` faults.
 3. **Reconciliation** — injected faults equal observed recoveries,
    counter for counter: ``train/step`` raises == optimizer
    ``recoveries``, ``serving/dispatch`` raises == batcher
    ``failed_batches``, ``serving/take_batch`` raises == supervised
-   ``worker_restarts``, and (kill mode) the mid-checkpoint SIGKILL ==
-   one successful torn-write resume. Pure-latency rules are excluded
+   ``worker_restarts``, ``serving/decode`` raises == generation
+   decode-loop ``worker_restarts``, and (kill mode) the
+   mid-checkpoint SIGKILL == one successful torn-write resume. Pure-latency rules are excluded
    (they recover nothing by design).
 
 Phases: an undisturbed **reference** run; chaos **leg A** to
@@ -58,6 +61,7 @@ DEFAULT_SCHEDULE = (
     "train/step=nth:6,raise:OSError;"
     "serving/dispatch=nth:4,raise:RuntimeError;"
     "serving/take_batch=nth:6,raise:RuntimeError;"
+    "serving/decode=nth:4,raise:RuntimeError;"
     "serving/dispatch=delay:2,times:2"
 )
 
@@ -217,6 +221,107 @@ class _Burst:
         return m
 
 
+class _GenBurst:
+    """Background *generation* burst against a dedicated
+    GenerationService (tiny TransformerLM, 2 cache slots): token-stream
+    requests submitted continuously so the ``serving/decode`` faults in
+    the schedule land under real continuous-batching traffic. Collects
+    EVERY stream so the no-hang invariant extends to generation — a
+    decode-loop death must fail streams typed, never strand them."""
+
+    def __init__(self, seed: int, threads: int = 2):
+        import numpy as np
+
+        from bigdl_tpu.generation import (GenerationConfig,
+                                          GenerationService)
+        from bigdl_tpu.models import TransformerLM
+        from bigdl_tpu.tools.synthetic import seeded_rng
+        from bigdl_tpu.utils.random import RandomGenerator
+
+        RandomGenerator.set_seed(seed + 2)
+        model = TransformerLM(vocab_size=32, hidden_size=16,
+                              num_layers=1, num_heads=2,
+                              max_len=16).evaluate()
+        model.ensure_initialized()
+        self.svc = GenerationService(config=GenerationConfig(
+            slots=2, max_len=16, length_buckets=(16,), prefill_rows=2,
+            max_queue=8))
+        self.svc.load("chaos-lm", model)
+        self.prompt = seeded_rng(seed + 3).randint(
+            1, 32, 3).astype(np.int32)
+        self.streams: List = []
+        self._lock = threading.Lock()
+        self.stop = threading.Event()
+        self.threads = [threading.Thread(target=self._run, daemon=True,
+                                         name=f"chaos-gen-burst-{i}")
+                        for i in range(threads)]
+
+    def _run(self):
+        from bigdl_tpu.serving import QueueFull
+        while not self.stop.is_set():
+            try:
+                s = self.svc.generate("chaos-lm", self.prompt,
+                                      max_new_tokens=4, seed=7,
+                                      timeout_ms=5000)
+            except QueueFull:
+                time.sleep(0.005)
+                continue
+            except RuntimeError:
+                break  # service shut down under us
+            with self._lock:
+                self.streams.append(s)
+            time.sleep(0.002)
+
+    def start(self):
+        for t in self.threads:
+            t.start()
+
+    def finish(self, deadline_s: float = 30.0) -> Dict[str, int]:
+        """Stop the burst, drain the service, and resolve every
+        stream: {ok, typed_errors, hung}. The drain itself is bounded
+        — a decode loop hung by the very supervision bug this
+        invariant exists to catch must surface as ``hung`` streams,
+        not hang the soak."""
+        from concurrent.futures import TimeoutError as FutTimeout
+        self.stop.set()
+        for t in self.threads:
+            t.join(timeout=10)
+        closer = threading.Thread(
+            target=lambda: self.svc.shutdown(drain=True), daemon=True,
+            name="chaos-gen-burst-drain")
+        closer.start()
+        closer.join(timeout=deadline_s)
+        out = {"ok": 0, "typed_errors": 0, "hung": 0}
+        end = time.monotonic() + deadline_s
+        for s in self.streams:
+            try:
+                s.result(timeout=max(0.0, end - time.monotonic()))
+                out["ok"] += 1
+            except FutTimeout:
+                out["hung"] += 1
+            except Exception:
+                out["typed_errors"] += 1
+        return out
+
+    def stats(self) -> Dict[str, float]:
+        return self.svc.metrics("chaos-lm")
+
+
+def _await_deterministic_rules(sched, points, timeout_s: float) -> None:
+    """Keep the burst window open until every deterministic raise rule
+    on ``points`` has fired (seeded-prob rules may legitimately land on
+    zero) — the training leg can finish before a background burst has
+    taken enough decode steps to reach an nth trigger."""
+    rules = [r for r in sched.rules
+             if r.point in points and r.prob is None
+             and r.action in ("raise", "sigkill")]
+    end = time.monotonic() + timeout_s
+    while time.monotonic() < end:
+        if all(r.fired > 0 for r in rules):
+            return
+        time.sleep(0.02)
+
+
 # ------------------------------------------------------------- worker
 
 def _run_worker(args) -> int:
@@ -329,14 +434,22 @@ def run_soak(model: str = "lenet", steps: int = 16, leg_a: int = 8,
         io_counter = telemetry.counter("io/retry/retries")
         rec0, io0 = rec_counter.value(), io_counter.value()
         burst = _Burst(seed)
+        gen_burst = _GenBurst(seed)
         sched = faults.arm(schedule)
         try:
             burst.start()
+            gen_burst.start()
             leg_b = _train_leg(model, seed, batch_size, steps, ckpt_dir,
                                ckpt_every)
+            # the background bursts may need a little longer than the
+            # training leg to reach their scheduled nth triggers
+            _await_deterministic_rules(
+                sched, ("serving/dispatch", "serving/take_batch",
+                        "serving/decode"), timeout_s=15.0)
         finally:
             faults.disarm()
             futures = burst.finish()
+            gen_streams = gen_burst.finish()
         p_chaos = _final_params(leg_b)
 
         # -- invariant 1: bit-exactness -------------------------------
@@ -363,6 +476,16 @@ def run_soak(model: str = "lenet", steps: int = 16, leg_a: int = 8,
         if futures["hung"]:
             report["violations"].append(
                 f"{futures['hung']} serving futures never resolved")
+        report["gen_burst"] = gen_streams
+        gen_metrics = gen_burst.stats()
+        report["gen_burst_stats"] = {
+            k: gen_metrics[k] for k in ("request_count", "tokens",
+                                        "finished", "worker_restarts",
+                                        "timed_out")}
+        if gen_streams["hung"]:
+            report["violations"].append(
+                f"{gen_streams['hung']} generation token streams never "
+                "resolved")
 
         # -- invariant 4: injected == recovered, counter for counter --
         fired = {}
@@ -382,6 +505,7 @@ def run_soak(model: str = "lenet", steps: int = 16, leg_a: int = 8,
             "train/step": rec_counter.value() - rec0,
             "serving/dispatch": svc_metrics["failed_batches"],
             "serving/take_batch": svc_metrics["worker_restarts"],
+            "serving/decode": gen_metrics["worker_restarts"],
             "fetch/download": io_counter.value() - io0,
         }
         report["injected"] = fired
@@ -462,6 +586,8 @@ def main(argv=None) -> int:
         print(f"recovered: {report.get('recovered')}")
         print(f"burst:     {report.get('burst')} "
               f"{report.get('burst_stats')}")
+        print(f"gen burst: {report.get('gen_burst')} "
+              f"{report.get('gen_burst_stats')}")
         print(f"bit-identical final params: "
               f"{report.get('bit_identical')}")
         print(f"quarantined: {report.get('quarantined')}")

@@ -114,11 +114,13 @@ def collect_instrument_names():
                 "bigdl_tpu.tools.perf", "bigdl_tpu.tools.ceiling"):
         importlib.import_module(mod)
     scratch = telemetry.MetricsRegistry()
+    from bigdl_tpu.generation.loop import register_generation_instruments
     from bigdl_tpu.optim.optimizer import Metrics
     from bigdl_tpu.serving.batcher import BatcherStats
     from bigdl_tpu.serving.compile_cache import CompileCache
     BatcherStats(registry=scratch, model="audit")
     CompileCache(metrics=scratch)
+    register_generation_instruments(scratch)
     m = Metrics(registry=scratch)
     m.add("data time", 0.0)
     m.add("computing time", 0.0)

@@ -23,6 +23,11 @@ FAMILIES = {
                 "bigdl_tpu.dataset.fetch"],
     "optim": ["bigdl_tpu.optim"],
     "serving": ["bigdl_tpu.serving"],
+    "generation": ["bigdl_tpu.generation", "bigdl_tpu.generation.kv_cache",
+                   "bigdl_tpu.generation.engine",
+                   "bigdl_tpu.generation.loop",
+                   "bigdl_tpu.generation.stream",
+                   "bigdl_tpu.generation.sampling"],
     "analysis": ["bigdl_tpu.analysis", "bigdl_tpu.analysis.shapecheck",
                  "bigdl_tpu.analysis.lint"],
     "telemetry": ["bigdl_tpu.telemetry", "bigdl_tpu.telemetry.tracer",

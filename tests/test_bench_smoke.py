@@ -41,3 +41,21 @@ def test_bench_rotate_contract():
     out = _run_bench("rotate", {"BENCH_ROTATE_SHARDS": "4"})
     assert out["value"] > 0
     assert out["pool_images"] == 8 and out["hbm_budget_images"] == 4
+
+
+@pytest.mark.slow
+def test_bench_generation_row_contract():
+    """The GENERATION row: tokens/sec plus p50/p99 TTFT and per-token
+    latency for the TransformerLM decode engine, with the compile
+    count carried for the 2K bound."""
+    out = _run_bench("synthetic", {
+        "BENCH_GEN": "1", "BENCH_GEN_VOCAB": "64",
+        "BENCH_GEN_HIDDEN": "32", "BENCH_GEN_LAYERS": "1",
+        "BENCH_GEN_LEN": "32", "BENCH_GEN_SLOTS": "2",
+        "BENCH_GEN_REQS": "4", "BENCH_GEN_NEW": "4"})
+    assert out["transformerlm_generation_tokens_per_sec_per_chip"] > 0
+    for key in ("generation_ttft_ms_p50", "generation_ttft_ms_p99",
+                "generation_token_ms_p50", "generation_token_ms_p99"):
+        assert out[key] >= 0
+    # K length-buckets (powers of two up to BENCH_GEN_LEN) => <= 2K
+    assert out["generation_compiles"] <= 2 * 6

@@ -16,7 +16,8 @@ from bigdl_tpu.tools.chaos import main, run_soak
 
 SMOKE_SCHEDULE = ("train/step=nth:2,raise:RuntimeError;"
                   "serving/dispatch=nth:2,raise:RuntimeError;"
-                  "serving/take_batch=nth:3,raise:RuntimeError")
+                  "serving/take_batch=nth:3,raise:RuntimeError;"
+                  "serving/decode=nth:3,raise:RuntimeError")
 
 
 def test_chaos_smoke_soak_in_process(tmp_path):
@@ -26,11 +27,14 @@ def test_chaos_smoke_soak_in_process(tmp_path):
     assert report["passed"], report["violations"]
     assert report["bit_identical"] is True
     assert report["burst"]["hung"] == 0
+    assert report["gen_burst"]["hung"] == 0, \
+        "a generation token stream never resolved"
     assert report["quarantined"], "corrupt checkpoint never quarantined"
     # counter-for-counter reconciliation across every armed fault kind
     assert report["injected"] == {"train/step": 1,
                                   "serving/dispatch": 1,
-                                  "serving/take_batch": 1}
+                                  "serving/take_batch": 1,
+                                  "serving/decode": 1}
     for point, n in report["injected"].items():
         assert report["recovered"][point] == n, (point, report)
 

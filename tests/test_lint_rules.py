@@ -80,6 +80,23 @@ def feed(batches):
     return out
 """,
     ),
+    "growing-concat-in-loop": (
+        """
+def decode(step, tok):
+    out = jnp.zeros((1, 4))
+    for t in range(16):
+        out = jnp.concatenate([out, step(tok)])
+    return out
+""",
+        """
+def decode(step, tok):
+    out = jnp.zeros((1, 4))
+    for t in range(16):
+        # bigdl: disable=growing-concat-in-loop
+        out = jnp.concatenate([out, step(tok)])
+    return out
+""",
+    ),
     "jit-static-args": (
         """
 def g(x, mode):
