@@ -444,6 +444,15 @@ def main():
     gen_flag = os.environ.get("BENCH_GEN", "")
     if gen_flag != "0" and (platform != "cpu" or gen_flag == "1"):
         result.update(_bench_generation())
+    # fifth tracked row: DATA — the streaming data plane
+    # (bigdl_tpu.datapipe). Host-feed (reader -> shuffle -> staged
+    # [K,B,...] windows) vs device-feed steps/sec at K=8 for LeNet — the
+    # ROADMAP "within ~10% of device-feed" number — and TransformerLM
+    # packed-vs-padded tokens/sec with the padding-efficiency gauge
+    # values. Skipped on CPU smoke runs unless forced.
+    data_flag = os.environ.get("BENCH_DATA", "")
+    if data_flag != "0" and (platform != "cpu" or data_flag == "1"):
+        result.update(_bench_data())
     print(json.dumps(result))
     _maybe_metrics_snapshot(result)
 
@@ -536,6 +545,191 @@ def _bench_generation():
                 "token_ms_p50", "token_ms_p99"):
         if key in m:
             row[f"generation_{key}"] = round(float(m[key]), 3)
+    return row
+
+
+def _bench_data():
+    """DATA row: how fast the streaming data plane feeds the chip.
+
+    Leg 1 — LeNet at K=8: device-feed (HBM-cached ``batch_fn`` inside
+    the scan, the feed ceiling) vs host-feed (datapipe reader ->
+    seeded shuffle -> SampleToMiniBatch -> ``[K, B, ...]`` staged
+    windows consumed by the same scanned step). Leg 2 — TransformerLM
+    on ragged documents: packed slabs (segment masks) vs pad-to-max
+    rows through the identical train step; tokens/sec counts REAL
+    tokens, so the packed win is the padding it no longer computes.
+    """
+    import functools
+
+    import jax
+    import numpy as np
+    from jax import lax
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu import datapipe as dp
+    from bigdl_tpu.dataset.device_dataset import DeviceCachedArrayDataSet
+    from bigdl_tpu.models import LeNet5, TransformerLM
+    from bigdl_tpu.optim import SGD
+    from bigdl_tpu.optim.optimizer import build_train_step
+    from bigdl_tpu.tools.synthetic import seeded_rng
+    from bigdl_tpu.utils.random import RandomGenerator
+
+    k = int(os.environ.get("BENCH_DATA_K", 8))
+    iters = int(os.environ.get("BENCH_ITERS", 6))
+    batch = int(os.environ.get("BENCH_DATA_BATCH", 128))
+    row = {}
+
+    def window_runner(step):
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+        def run(p, o, m, keys, xs, ys):
+            def body(carry, sl):
+                p, o, m = carry
+                key, x, y = sl
+                p, o, m, loss = step(p, o, m, key, 0.05, x, y)
+                return (p, o, m), loss
+            (p, o, m), losses = lax.scan(body, (p, o, m), (keys, xs, ys))
+            return p, o, m, losses
+        return run
+
+    rng = seeded_rng(6)
+    n_pool = max(4 * batch, 512)
+    imgs = rng.rand(n_pool, 1, 28, 28).astype(np.float32)
+    labels = (rng.randint(0, 10, n_pool) + 1).astype(np.float32)
+
+    def lenet_setup():
+        RandomGenerator.set_seed(5)
+        model = LeNet5(10).training()
+        model.ensure_initialized()
+        optim = SGD(learning_rate=0.05)
+        step = build_train_step(model, nn.ClassNLLCriterion(), optim)
+        return step, (model.get_parameters(),
+                      optim.init_state(model.get_parameters()),
+                      model.get_state())
+
+    def lenet_host_leg() -> float:
+        step, carry = lenet_setup()
+        run = window_runner(step)
+        root = jax.random.PRNGKey(2)
+        pipe = (dp.Pipeline(dp.ArrayRecordReader(imgs, labels, seed=1))
+                .shuffle(buffer_size=4 * batch, seed=2)
+                .batch(batch, drop_remainder=True))
+        staged = pipe.staged(k=k, loop=True)
+        try:
+            done = -1  # one warmup window, then `iters` timed ones
+            t0 = None
+            while done < iters:
+                keys = jax.random.split(jax.random.fold_in(root, done + 1), k)
+                b = next(staged)
+                p, o, m, losses = run(*carry, keys, b.input, b.target)
+                carry = (p, o, m)
+                float(losses.sum())  # window boundary: the host sync
+                done += 1
+                if t0 is None:
+                    t0 = time.time()
+            dt = time.time() - t0
+        finally:
+            staged.close()
+        return k * iters / dt
+
+    def lenet_dev_leg() -> float:
+        import jax.numpy as jnp
+        step, carry = lenet_setup()
+        ds = DeviceCachedArrayDataSet(
+            (imgs * 255).astype(np.uint8), labels, batch,
+            crop=(28, 28), flip=False, mean=(0.0,), std=(255.0,))
+        root = jax.random.PRNGKey(2)
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def run(carry, keys):
+            def body(c, key):
+                p, o, m, ep, pos = c
+                kb, kr = jax.random.split(key)
+                x, y = ds.batch_fn(kb, epoch=ep, pos=pos)
+                p, o, m, loss = step(p, o, m, kr, 0.05, x, y)
+                pos = pos + batch
+                return (p, o, m, ep + pos // ds.n, pos % ds.n), loss
+            return lax.scan(body, carry, keys)
+        carry = carry + (jnp.int32(0), jnp.int32(0))
+        done = -1
+        t0 = None
+        while done < iters:
+            keys = jax.random.split(jax.random.fold_in(root, done + 1), k)
+            carry, losses = run(carry, keys)
+            float(losses.sum())
+            done += 1
+            if t0 is None:
+                t0 = time.time()
+        return k * iters / (time.time() - t0)
+
+    dev = lenet_dev_leg()
+    host = lenet_host_leg()
+    row["data_window_k"] = k
+    row["data_lenet_devfeed_steps_per_sec"] = round(dev, 2)
+    row["data_lenet_hostfeed_steps_per_sec"] = round(host, 2)
+    row["data_hostfeed_fraction_of_devfeed"] = round(host / dev, 3)
+
+    # ---- TransformerLM: packed slabs vs pad-to-max rows ----------------
+    vocab = int(os.environ.get("BENCH_DATA_VOCAB", 4096))
+    seq = int(os.environ.get("BENCH_DATA_SEQ", 256))
+    rows_b = int(os.environ.get("BENCH_DATA_ROWS", 8))
+    r2 = seeded_rng(7)
+    docs = [r2.randint(1, vocab, int(n)).astype(np.int32)
+            for n in r2.randint(8, seq // 2, 256)]
+    lengths = [len(d) - 1 for d in docs]
+    packed_arrays = dp.pack_documents(docs, seq)  # packed once: the
+    # timed leg and the efficiency number must describe the same slabs
+
+    def tlm_leg(packed: bool) -> float:
+        RandomGenerator.set_seed(9)
+        model = TransformerLM(vocab_size=vocab, hidden_size=256,
+                              num_layers=4, num_heads=8,
+                              max_len=seq).training()
+        model.ensure_initialized()
+        optim = SGD(learning_rate=0.1)
+        crit = nn.SequenceCrossEntropyCriterion(ignore_index=-1)
+        step = build_train_step(model, crit, optim)
+        params = model.get_parameters()
+        mstate = model.get_state()
+        opt_state = optim.init_state(params)
+        if packed:
+            toks, segs, pos, tgt = packed_arrays
+        else:
+            packer = dp.LengthBucketBatcher([seq], len(docs))
+            (mb,) = list(packer(iter(docs), 0))
+            toks, segs, pos = mb.input
+            tgt = mb.target
+        n_rows = (len(toks) // rows_b) * rows_b
+        if n_rows == 0:
+            raise ValueError(
+                f"BENCH_DATA_ROWS={rows_b} exceeds the {len(toks)} "
+                f"{'packed' if packed else 'padded'} rows the corpus "
+                "yields; lower BENCH_DATA_ROWS")
+        batches = [([toks[i:i + rows_b], segs[i:i + rows_b],
+                     pos[i:i + rows_b]], tgt[i:i + rows_b],
+                    int((segs[i:i + rows_b] > 0).sum()))
+                   for i in range(0, n_rows, rows_b)]
+        carry = (params, opt_state, mstate)
+        real_tokens = 0
+        t0 = None
+        for it in range(iters + 1):
+            for x, y, n_real in batches:
+                p, o, m, loss = step(*carry, RandomGenerator.next_key(),
+                                     0.1, x, y)
+                carry = (p, o, m)
+                if it > 0:
+                    real_tokens += n_real
+            float(loss)
+            if t0 is None:
+                t0 = time.time()  # first pass was compile+warmup
+        return real_tokens / (time.time() - t0)
+
+    row["data_tlm_packed_tokens_per_sec"] = round(tlm_leg(True), 1)
+    row["data_tlm_padded_tokens_per_sec"] = round(tlm_leg(False), 1)
+    row["data_padding_efficiency_padded"] = round(
+        dp.padding_efficiency(lengths, seq), 4)
+    packed_segs = packed_arrays[1]
+    row["data_padding_efficiency_packed"] = round(
+        float((packed_segs > 0).mean()), 4) if len(packed_segs) else 1.0
     return row
 
 

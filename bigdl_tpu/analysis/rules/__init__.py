@@ -14,8 +14,10 @@ Shipped rules:
 - ``bare-except`` — bare ``except:`` handlers
 - ``sync-in-loop`` — per-iteration host-device sync in host step loops
 - ``retry-no-backoff`` — broad-except retry loops with fixed sleeps
+- ``unseeded-shuffle`` — data-path shuffles without a seeded Generator
 """
-from bigdl_tpu.analysis.rules import (jit_calls, perf, purity, robust,
-                                      style, traced)
+from bigdl_tpu.analysis.rules import (data, jit_calls, perf, purity,
+                                      robust, style, traced)
 
-__all__ = ["jit_calls", "perf", "purity", "robust", "style", "traced"]
+__all__ = ["data", "jit_calls", "perf", "purity", "robust", "style",
+           "traced"]

@@ -1,0 +1,146 @@
+"""Data-path determinism rules.
+
+The framework's exactness guarantees (seeded K=1-vs-K=8 equivalence,
+bit-identical chaos recovery, checkpoint/resume continuation) all
+assume the record order a data pipeline emits is a pure function of its
+seeds. One unseeded shuffle anywhere in the dataset/datapipe path
+silently breaks every one of them — runs stop being reproducible and
+the equivalence harnesses compare different streams. ``unseeded-shuffle``
+makes that a lint failure instead of a debugging session.
+"""
+from __future__ import annotations
+
+import ast
+
+from bigdl_tpu.analysis.lint import FileContext, rule
+
+# reorder/draw entry points whose determinism matters for data feeds
+_SHUFFLE_METHODS = {"shuffle", "permutation", "permuted", "choice"}
+
+# module-level forms that are unseeded BY DEFINITION (process-global RNG)
+_GLOBAL_NP_SHUFFLES = {
+    "numpy.random.shuffle", "numpy.random.permutation",
+    "numpy.random.choice",
+}
+_GLOBAL_STDLIB_SHUFFLES = {"random.shuffle", "random.sample"}
+
+# generator constructors; a call with NO seed argument is a fresh
+# OS-entropy stream — different every run
+_GEN_CTORS = {
+    "numpy.random.RandomState", "numpy.random.default_rng",
+    "numpy.random.Generator", "numpy.random.PCG64",
+    "numpy.random.Philox", "numpy.random.SFC64", "numpy.random.MT19937",
+}
+
+_FIX = ("; seed it explicitly (np.random.default_rng(seed) / "
+        "RandomState(seed)) — record order must be a pure function of "
+        "the seed for the K-window and resume equivalence guarantees "
+        "to hold")
+
+
+def _unseeded_ctor(ctx: FileContext, node) -> bool:
+    """A generator construction carrying no seed: ``RandomState()``,
+    ``default_rng()``, or a wrapper of one (``Generator(PCG64())``)."""
+    if not isinstance(node, ast.Call):
+        return False
+    if ctx.canon(node.func) not in _GEN_CTORS:
+        return False
+    args = list(node.args) + [kw.value for kw in node.keywords]
+    if not args:
+        return True
+    return len(args) == 1 and _unseeded_ctor(ctx, args[0])
+
+
+_FN_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _is_gen_ctor(ctx: FileContext, node) -> bool:
+    return isinstance(node, ast.Call) \
+        and ctx.canon(node.func) in _GEN_CTORS
+
+
+def _fn_scope_chain(ctx: FileContext, node):
+    """Enclosing function-scope ids innermost-first, ending with the
+    module scope (0)."""
+    chain = []
+    cur = node
+    while True:
+        enc = ctx.enclosing(cur, *_FN_SCOPES)
+        if enc is None:
+            break
+        chain.append(id(enc))
+        cur = enc
+    chain.append(0)
+    return chain
+
+
+def _cls_scope(ctx: FileContext, node) -> int:
+    enc = ctx.enclosing(node, ast.ClassDef)
+    return id(enc) if enc is not None else 0
+
+
+@rule("unseeded-shuffle",
+      "shuffle/permutation without a seeded Generator (dataset "
+      "determinism)")
+def unseeded_shuffle(ctx: FileContext):
+    # Generator-constructor bindings, SCOPED: plain names key on their
+    # enclosing function (so an unseeded `rng` in one function never
+    # taints a seeded `rng` in another), attributes on their enclosing
+    # class. Per scope we count seeded and unseeded bindings; a name is
+    # treated as unseeded only when every binding in its scope is —
+    # a seeded rebinding exonerates (order analysis is out of budget
+    # for a linter; when in doubt, stay quiet).
+    names: dict = {}  # (scope_id, name) -> [n_unseeded, n_seeded]
+    attrs: dict = {}  # (class_scope_id, attr) -> [n_unseeded, n_seeded]
+    for node in ctx.walk(ast.Assign):
+        if not _is_gen_ctor(ctx, node.value):
+            continue
+        bad = _unseeded_ctor(ctx, node.value)
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                sid = _fn_scope_chain(ctx, node)[0]  # innermost scope
+                row = names.setdefault((sid, t.id), [0, 0])
+                row[0 if bad else 1] += 1
+            elif isinstance(t, ast.Attribute):
+                row = attrs.setdefault((_cls_scope(ctx, node), t.attr),
+                                       [0, 0])
+                row[0 if bad else 1] += 1
+
+    def name_unseeded(call, ident) -> bool:
+        # nearest scope holding a binding for this name decides
+        for sid in _fn_scope_chain(ctx, call):
+            row = names.get((sid, ident))
+            if row is not None:
+                return row[0] > 0 and row[1] == 0
+        return False
+
+    def attr_unseeded(call, ident) -> bool:
+        row = attrs.get((_cls_scope(ctx, call), ident))
+        return row is not None and row[0] > 0 and row[1] == 0
+
+    for node in ctx.walk(ast.Call):
+        c = ctx.canon(node.func)
+        if c in _GLOBAL_NP_SHUFFLES:
+            yield node, (f"`{c}` shuffles through the process-global "
+                         "numpy RNG" + _FIX)
+            continue
+        if c in _GLOBAL_STDLIB_SHUFFLES and "random" in ctx.aliases \
+                and ctx.aliases["random"] == "random":
+            yield node, (f"`{c}` shuffles through the global stdlib RNG"
+                         + _FIX)
+            continue
+        f = node.func
+        if not isinstance(f, ast.Attribute) \
+                or f.attr not in _SHUFFLE_METHODS:
+            continue
+        base = f.value
+        if _unseeded_ctor(ctx, base):
+            yield node, (f"`.{f.attr}()` on a generator constructed "
+                         "without a seed" + _FIX)
+        elif isinstance(base, ast.Name) and name_unseeded(node, base.id):
+            yield node, (f"`{base.id}.{f.attr}()` draws from a "
+                         "generator constructed without a seed" + _FIX)
+        elif isinstance(base, ast.Attribute) \
+                and attr_unseeded(node, base.attr):
+            yield node, (f"`.{base.attr}.{f.attr}()` draws from a "
+                         "generator constructed without a seed" + _FIX)

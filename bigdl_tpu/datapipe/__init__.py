@@ -1,0 +1,47 @@
+"""bigdl_tpu.datapipe — high-throughput streaming data plane.
+
+The host-feed successor to whole-epoch ``DataSet`` lists, in the
+lineage of the reference's Spark-RDD data plane (partitioned, streamed,
+shuffled per epoch) and tf.data's composable input pipelines — done
+JAX-native with seeded determinism so the framework's K=1-vs-K=8
+windowed-exactness guarantees extend through the data feed:
+
+- **Sharded streaming readers** (``readers``): text / SequenceFile /
+  array sources streamed record by record with serializable per-shard
+  cursors (checkpoint/resume), multi-host shard splitting, per-epoch
+  shard-order permutation.
+- **Windowed global shuffle** (``shuffle``): bounded buffer, seeded and
+  reseeded per epoch — same seed ⇒ bit-identical record order across
+  runs and across the windowed driver's K.
+- **Sequence packing & length bucketing** (``packing``): variable-length
+  token documents into fixed ``[B, S]`` slabs with segment-id masks
+  (packed forward bit-exact per token vs each document alone), or
+  length-bucketed padded batches — both feed the same 3-plane
+  ``TransformerLM`` input convention.
+- **Device staging** (``stage``): batches or ``[K, B, ...]`` stacked
+  windows staged to device ahead of compute, riding the prefetch
+  stager's stop-event/drain semantics.
+- **Pipeline** (``pipeline``): the builder tying them together, plus
+  ``as_dataset()`` — any pipeline as a drop-in Optimizer ``DataSet``
+  with cursor checkpointing through the training loop.
+
+See docs/data.md for the determinism contract and the pack-vs-bucket
+decision math; the ``data/packing/padding_efficiency`` and
+``data/shuffle/buffer_depth`` gauges feed ``tools.diagnose`` and the
+bench DATA row.
+"""
+from bigdl_tpu.datapipe.readers import (ArrayRecordReader, SeqFileImageReader,
+                                        ShardedReader, TextLineReader)
+from bigdl_tpu.datapipe.shuffle import WindowShuffle
+from bigdl_tpu.datapipe.packing import (LengthBucketBatcher, SequencePacker,
+                                        pack_documents, padding_efficiency)
+from bigdl_tpu.datapipe.stage import stage_batches, stage_windows
+from bigdl_tpu.datapipe.pipeline import Pipeline
+from bigdl_tpu.dataset.dataset import PipelineDataSet
+
+__all__ = [
+    "ShardedReader", "TextLineReader", "ArrayRecordReader",
+    "SeqFileImageReader", "WindowShuffle", "SequencePacker",
+    "LengthBucketBatcher", "pack_documents", "padding_efficiency",
+    "stage_batches", "stage_windows", "Pipeline", "PipelineDataSet",
+]

@@ -1,0 +1,274 @@
+"""Sequence packing & length bucketing for variable-length token data.
+
+Padding every sequence to the model's max length wastes the chip: at
+PTB-like length distributions most of a ``[B, S]`` slab is pad tokens
+that burn attention/FFN FLOPs and then get masked out of the loss. Two
+standard remedies, both shape-static (one compiled program):
+
+- **Packing** (:class:`SequencePacker`, :func:`pack_documents`): lay
+  several documents head-to-tail in each row of a fixed ``[B, S]`` slab
+  and carry a ``segment_ids`` plane so attention can refuse to look
+  across document boundaries (the T5/tf.data "pack_dataset" technique).
+  Rows also carry a ``positions`` plane that restarts at 0 per document,
+  so positional embeddings match the unpacked forward exactly —
+  together these make the packed forward **bit-exact** per token
+  against running each document alone (asserted in
+  tests/test_datapipe.py).
+- **Length bucketing** (:class:`LengthBucketBatcher`): group sequences
+  into a small ladder of length buckets and pad only to the bucket
+  bound — lighter-weight (no segment mask needed, one doc per row),
+  costs one compiled program per bucket. Bucket when documents are
+  near-uniform or attention masks are unwelcome; pack when lengths are
+  ragged and throughput matters (see docs/data.md for the math).
+
+Batches come out as ``MiniBatch(input=[tokens, segment_ids, positions],
+target=targets)`` — the 3-plane convention ``TransformerLM`` consumes
+directly; ``targets`` are next-token ids inside each document with
+``ignore_index`` at pad positions (pair with
+``SequenceCrossEntropyCriterion(ignore_index=...)``).
+
+Every emitted slab updates the ``data/packing/padding_efficiency``
+gauge (real tokens / slab capacity, cumulative per stage) — the number
+the DATA bench row and ``tools.diagnose`` report.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import bigdl_tpu.telemetry as telemetry
+from bigdl_tpu.dataset.sample import MiniBatch
+
+_PAD_EFF = telemetry.gauge(
+    "data/packing/padding_efficiency",
+    "real tokens / slab capacity of emitted [B, S] token batches")
+
+
+def padding_efficiency(lengths: Sequence[int], seq_len: int) -> float:
+    """Real-token fraction of the pad-to-``seq_len`` layout: what a
+    plain padded batcher achieves on documents of these lengths (the
+    "before" number; a packer's "after" comes from its emitted slabs)."""
+    lengths = [min(int(l), seq_len) for l in lengths]
+    if not lengths:
+        return 1.0
+    return sum(lengths) / (len(lengths) * seq_len)
+
+
+def _chunk_doc(doc: np.ndarray, max_tokens: int) -> List[np.ndarray]:
+    """Split an over-long document into <= max_tokens pieces (the LM
+    convention: a document longer than the slab trains as consecutive
+    independent windows)."""
+    return [doc[i:i + max_tokens] for i in range(0, len(doc), max_tokens)]
+
+
+class _RowBuilder:
+    """One [S] row being filled with consecutive documents."""
+
+    def __init__(self, seq_len: int, pad_id: int, ignore_index: int):
+        self.seq_len = seq_len
+        self.pad_id = pad_id
+        self.ignore_index = ignore_index
+        self.tokens = np.full(seq_len, pad_id, np.int32)
+        self.segments = np.zeros(seq_len, np.int32)
+        self.positions = np.zeros(seq_len, np.int32)
+        self.targets = np.full(seq_len, ignore_index, np.int32)
+        self.used = 0
+        self.n_docs = 0
+
+    def fits(self, n: int) -> bool:
+        return self.used + n <= self.seq_len
+
+    def add(self, doc: np.ndarray) -> None:
+        # each document of length L contributes x = doc[:-1], y = doc[1:]
+        # (L-1 positions): every real token is predicted from its own
+        # document's prefix, and no target ever crosses a boundary
+        n = len(doc) - 1
+        lo = self.used
+        self.n_docs += 1
+        self.tokens[lo:lo + n] = doc[:-1]
+        self.targets[lo:lo + n] = doc[1:]
+        self.segments[lo:lo + n] = self.n_docs
+        self.positions[lo:lo + n] = np.arange(n, dtype=np.int32)
+        self.used += n
+
+
+def _iter_packed_rows(docs, seq_len: int, pad_id: int,
+                      ignore_index: int):
+    """THE next-fit packing loop (deterministic, order-preserving),
+    shared by :func:`pack_documents` and :class:`SequencePacker` so the
+    boundary rules (chunk at ``seq_len + 1``, drop docs shorter than 2
+    tokens, close a row when the next piece no longer fits) can never
+    drift between them. Yields completed :class:`_RowBuilder` rows."""
+    cur = _RowBuilder(seq_len, pad_id, ignore_index)
+    for doc in docs:
+        doc = np.asarray(doc)
+        for piece in _chunk_doc(doc, seq_len + 1):
+            if len(piece) < 2:
+                continue
+            if not cur.fits(len(piece) - 1):
+                yield cur
+                cur = _RowBuilder(seq_len, pad_id, ignore_index)
+            cur.add(piece)
+    if cur.used:
+        yield cur
+
+
+def pack_documents(docs: Sequence[np.ndarray], seq_len: int, *,
+                   pad_id: int = 0, ignore_index: int = -1
+                   ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                              np.ndarray]:
+    """Pack integer token documents into fixed-shape slabs.
+
+    Greedy next-fit (deterministic, order-preserving): fill the current
+    row until the next document no longer fits, then open a new row.
+    Documents shorter than 2 tokens are dropped (no next-token pair);
+    longer than ``seq_len + 1`` are chunked.
+
+    Returns ``(tokens, segment_ids, positions, targets)``, each
+    ``[rows, seq_len]`` int32 — feed rows in groups of B as the 3-plane
+    ``MiniBatch`` convention (see module doc).
+    """
+    if seq_len < 1:
+        raise ValueError(f"seq_len must be >= 1, got {seq_len}")
+    rows = list(_iter_packed_rows(docs, seq_len, pad_id, ignore_index))
+    if not rows:
+        z = np.zeros((0, seq_len), np.int32)
+        return z, z.copy(), z.copy(), z.copy()
+    _PAD_EFF.set(sum(r.used for r in rows) / (len(rows) * seq_len))
+    return (np.stack([r.tokens for r in rows]),
+            np.stack([r.segments for r in rows]),
+            np.stack([r.positions for r in rows]),
+            np.stack([r.targets for r in rows]))
+
+
+def _emit(rows: List[_RowBuilder], stats, report: bool) -> MiniBatch:
+    tokens = np.stack([r.tokens for r in rows])
+    segs = np.stack([r.segments for r in rows])
+    pos = np.stack([r.positions for r in rows])
+    tgt = np.stack([r.targets for r in rows])
+    stats[0] += sum(r.used for r in rows)
+    stats[1] += len(rows) * rows[0].seq_len
+    if report:
+        _PAD_EFF.set(stats[0] / stats[1])
+    return MiniBatch([tokens, segs, pos], tgt)
+
+
+class SequencePacker:
+    """Pipeline stage: token documents -> packed ``[B, S]`` MiniBatches
+    (see module doc for the slab layout and target rules). Flushes at
+    epoch end so the packing — like the shuffle — is a pure function of
+    the epoch's record stream; a final partial batch is emitted with
+    fully-padded spare rows (static shapes) unless ``drop_remainder``.
+    """
+
+    def __init__(self, seq_len: int, batch_rows: int, *, pad_id: int = 0,
+                 ignore_index: int = -1, drop_remainder: bool = False):
+        if seq_len < 1 or batch_rows < 1:
+            raise ValueError("seq_len and batch_rows must be >= 1")
+        self.seq_len = int(seq_len)
+        self.batch_rows = int(batch_rows)
+        self.pad_id = int(pad_id)
+        self.ignore_index = int(ignore_index)
+        self.drop_remainder = drop_remainder
+        # cumulative [real_tokens, capacity] across the stage's lifetime
+        self._stats = [0, 0]
+        # detached (eval/count) copies clear this so validation slabs
+        # never pollute the training feed's padding_efficiency gauge
+        self.report_gauge = True
+
+    @property
+    def efficiency(self) -> float:
+        """Cumulative real-token fraction of everything emitted so far
+        (the value the ``data/packing/padding_efficiency`` gauge holds)."""
+        return self._stats[0] / self._stats[1] if self._stats[1] else 1.0
+
+    def __call__(self, it: Iterator, epoch: int) -> Iterator[MiniBatch]:
+        done: List[_RowBuilder] = []
+        for row in _iter_packed_rows(it, self.seq_len, self.pad_id,
+                                     self.ignore_index):
+            done.append(row)
+            if len(done) == self.batch_rows:
+                yield _emit(done, self._stats, self.report_gauge)
+                done = []
+        if done and not self.drop_remainder:
+            while len(done) < self.batch_rows:  # static shapes: pad rows
+                done.append(_RowBuilder(self.seq_len, self.pad_id,
+                                        self.ignore_index))
+            yield _emit(done, self._stats, self.report_gauge)
+
+
+class LengthBucketBatcher:
+    """Pipeline stage: token documents -> length-bucketed padded
+    MiniBatches (one document per row, padded to its bucket's bound).
+
+    ``boundaries`` are ascending inclusive upper bounds; documents
+    longer than the last bound are truncated to it. Each bucket fills
+    independently and emits ``[batch_size, bound]`` batches in the
+    3-plane convention (segment id 1 on real tokens, 0 on pad), so the
+    packed and bucketed paths feed the identical model surface. At
+    epoch end, partial buckets flush (in boundary order) unless
+    ``drop_remainder``."""
+
+    def __init__(self, boundaries: Sequence[int], batch_size: int, *,
+                 pad_id: int = 0, ignore_index: int = -1,
+                 drop_remainder: bool = False):
+        bounds = [int(b) for b in boundaries]
+        if not bounds or sorted(bounds) != bounds or bounds[0] < 2:
+            raise ValueError(
+                f"boundaries must be ascending and >= 2, got {bounds}")
+        self.boundaries = bounds
+        self.batch_size = int(batch_size)
+        self.pad_id = int(pad_id)
+        self.ignore_index = int(ignore_index)
+        self.drop_remainder = drop_remainder
+        self._stats = [0, 0]
+        self.report_gauge = True
+
+    @property
+    def efficiency(self) -> float:
+        """Cumulative real-token fraction of emitted batches."""
+        return self._stats[0] / self._stats[1] if self._stats[1] else 1.0
+
+    def _bucket_of(self, n: int) -> int:
+        for i, b in enumerate(self.boundaries):
+            if n <= b:
+                return i
+        return len(self.boundaries) - 1
+
+    def _emit_bucket(self, bound: int, docs: List[np.ndarray]) -> MiniBatch:
+        b = len(docs)
+        tokens = np.full((b, bound), self.pad_id, np.int32)
+        segs = np.zeros((b, bound), np.int32)
+        pos = np.zeros((b, bound), np.int32)
+        tgt = np.full((b, bound), self.ignore_index, np.int32)
+        for i, doc in enumerate(docs):
+            n = len(doc) - 1
+            tokens[i, :n] = doc[:-1]
+            tgt[i, :n] = doc[1:]
+            segs[i, :n] = 1
+            pos[i, :n] = np.arange(n, dtype=np.int32)
+            self._stats[0] += n
+        self._stats[1] += b * bound
+        if self.report_gauge:
+            _PAD_EFF.set(self._stats[0] / self._stats[1])
+        return MiniBatch([tokens, segs, pos], tgt)
+
+    def __call__(self, it: Iterator, epoch: int) -> Iterator[MiniBatch]:
+        buckets: List[List[np.ndarray]] = [[] for _ in self.boundaries]
+        top = self.boundaries[-1]
+        for doc in it:
+            doc = np.asarray(doc)
+            if len(doc) < 2:
+                continue
+            if len(doc) > top + 1:
+                doc = doc[:top + 1]
+            i = self._bucket_of(len(doc) - 1)
+            buckets[i].append(doc)
+            if len(buckets[i]) == self.batch_size:
+                yield self._emit_bucket(self.boundaries[i], buckets[i])
+                buckets[i] = []
+        if not self.drop_remainder:
+            for i, docs in enumerate(buckets):
+                if docs:
+                    yield self._emit_bucket(self.boundaries[i], docs)

@@ -1,0 +1,46 @@
+"""Device staging — render pipeline output into the window layout.
+
+The last pipeline hop: turn the host-side MiniBatch stream into device
+buffers shaped for the consumer. Two renderings:
+
+- :func:`stage_batches` — stage each ``[B, ...]`` MiniBatch to device
+  ``size`` steps ahead (the classic double-buffer; rides
+  ``dataset.prefetch.device_prefetch`` with its stop-event/drain
+  abandonment semantics and the ``prefetch/stage`` faultpoint).
+- :func:`stage_windows` — group ``k`` consecutive equal-shape batches
+  into ONE ``[K, B, ...]`` stacked buffer (``stack_windows``) and stage
+  that: the exact layout a fused ``lax.scan`` over ``k`` train steps
+  consumes in one dispatch (``Optimizer.set_steps_per_sync`` /
+  ``bench.py``'s scanned chunks).
+
+Both return iterators of device-resident MiniBatches. Note the
+Optimizer's own host-feed windowing stacks on the HOST and must see
+host arrays — feed it the un-staged pipeline (``Pipeline.as_dataset``)
+and let it stage; these stages are for external scan/serving consumers
+that own their dispatch loop.
+"""
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+from bigdl_tpu.dataset.prefetch import device_prefetch, stack_windows
+from bigdl_tpu.dataset.sample import MiniBatch
+
+
+def stage_batches(it: Iterator[MiniBatch], *, size: int = 2,
+                  sharding=None) -> Iterator[MiniBatch]:
+    """Stage MiniBatches to device ``size`` steps ahead (see module
+    doc); ``sharding`` lays the batch dim across a mesh."""
+    return device_prefetch(it, size=size, sharding=sharding)
+
+
+def stage_windows(it: Iterator[MiniBatch], k: int, *, size: int = 2,
+                  sharding: Optional[object] = None
+                  ) -> Iterator[MiniBatch]:
+    """Stack ``k``-batch windows into ``[K, B, ...]`` buffers and stage
+    them to device (see module doc). A shape change (e.g. a short final
+    batch) closes a window early, exactly like ``stack_windows``; on a
+    mesh pass the axis-1 batch sharding (the window axis stays
+    unsharded)."""
+    return device_prefetch(stack_windows(it, k), size=size,
+                           sharding=sharding)

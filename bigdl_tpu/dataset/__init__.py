@@ -4,8 +4,8 @@ from bigdl_tpu.dataset.sample import (
 from bigdl_tpu.dataset.transformer import (
     Transformer, ChainedTransformer, SampleToMiniBatch, Lambda)
 from bigdl_tpu.dataset.dataset import (
-    AbstractDataSet, LocalDataSet, TransformedDataSet, ShardedDataSet,
-    DataSet, array_to_samples)
+    AbstractDataSet, LocalDataSet, PipelineDataSet, TransformedDataSet,
+    ShardedDataSet, DataSet, array_to_samples)
 from bigdl_tpu.dataset.native_dataset import NativeArrayDataSet, native_available
 from bigdl_tpu.dataset.imagenet import (
     ImageFolderDataSet, ImageRecordWriter, list_image_folder, decode_image,

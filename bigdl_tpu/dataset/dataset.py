@@ -126,6 +126,62 @@ def array_to_samples(features: np.ndarray, labels: Optional[np.ndarray] = None
     return out
 
 
+class PipelineDataSet(AbstractDataSet):
+    """A streaming :class:`bigdl_tpu.datapipe.Pipeline` as a drop-in
+    DataSet: ``data(train=True)`` is the pipeline's looped stream
+    (per-epoch reseeded shuffle/packing happen inside the pipeline, so
+    ``shuffle()`` is a no-op here), ``size()`` is records per epoch in
+    emitted units (MiniBatch rows when the pipeline batches/packs).
+
+    ``continuous_stream = True``: the optimizer's epoch rollover keeps
+    its overshoot carry and never recreates the iterator — the pipeline
+    itself owns epoch boundaries. The optimizer also checkpoints
+    :meth:`pipeline_state` into ``driver_state`` and restores it on
+    resume, so a recovered run continues from the reader cursor instead
+    of replaying the epoch.
+
+    Epoch-counter contract: the driver divides consumed rows by this
+    fixed ``size``. Stages whose output count varies with record order
+    (packing after a per-epoch reshuffle — next-fit row counts differ
+    slightly epoch to epoch) make that a RATE, so the driver's epoch
+    counter can drift from the source reader's true epochs by a few
+    rows per epoch. Prefer iteration-based triggers for packed
+    streams; the record stream itself remains exactly deterministic
+    either way (see docs/data.md)."""
+
+    continuous_stream = True
+
+    def __init__(self, pipeline, size: int, batch_size: Optional[int] = None):
+        self.pipeline = pipeline
+        self._size = int(size)
+        if batch_size is not None:
+            # emitted rows per MiniBatch, for the windowed driver's plan
+            self.batch_size = int(batch_size)
+
+    def size(self) -> int:
+        return self._size
+
+    def shuffle(self):
+        return self  # seeded per-epoch shuffle lives in the pipeline
+
+    def data(self, train: bool) -> Iterator:
+        if train:
+            return self.pipeline.iterate(loop=True)
+        # eval contract (LocalDataSet.data(False) semantics): a
+        # repeatable, side-effect-free pass — detached from the training
+        # cursor, identical on every call
+        return self.pipeline.iterate_detached()
+
+    # -- cursor checkpointing (see Optimizer._checkpoint) ------------------
+    def pipeline_state(self) -> dict:
+        """Serializable source cursor for the checkpoint driver_state."""
+        return self.pipeline.state()
+
+    def restore_pipeline_state(self, state: dict) -> None:
+        """Resume the source cursor from a checkpointed snapshot."""
+        self.pipeline.restore(state)
+
+
 class DataSet:
     """Factory namespace mirroring ``object DataSet`` (DataSet.scala:319)."""
 

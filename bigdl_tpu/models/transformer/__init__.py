@@ -83,13 +83,17 @@ class TransformerBlock(Module):
         return {"mlp": adopt_state(self.mlp)}
 
     def apply(self, params, state, input, *, training=False, rng=None,
-              cache=None, positions=None, attend_len=None):
+              cache=None, positions=None, attend_len=None, attn_mask=None):
         r1, r2 = (jax.random.split(rng) if rng is not None else (None, None))
         h = self.ln1.forward_fn(params["ln1"], input)
         if cache is None:
             h = self.attn.forward_fn(params["attn"], h, training=training,
-                                     rng=r1)
+                                     rng=r1, mask=attn_mask)
         else:
+            if attn_mask is not None:
+                raise ValueError(
+                    "segment masks are not supported on the KV-cached "
+                    "decode path (pack training slabs, not decode steps)")
             # incremental decode: the attention writes this block's K/V
             # rows at `positions` and returns the updated cache
             h, cache = self.attn.forward_fn(
@@ -105,7 +109,17 @@ class TransformerBlock(Module):
 
 
 class TransformerLM(Module):
-    """Decoder-only LM over int32 token ids [B, S] -> logits [B, S, V]."""
+    """Decoder-only LM over int32 token ids [B, S] -> logits [B, S, V].
+
+    Also accepts the PACKED 3-plane input convention the datapipe
+    produces (``bigdl_tpu.datapipe.packing``): a list/Table of
+    ``[tokens, segment_ids, positions]``, each ``[B, S]`` int — rows
+    hold several documents head-to-tail, attention is restricted to
+    same-segment (and causal) pairs, and positional embeddings gather
+    at the per-document ``positions`` (restarting at 0), so the packed
+    forward is per-token exact against running each document alone.
+    Segment id 0 marks padding; its logits are garbage by design (mask
+    their targets with the criterion's ``ignore_index``)."""
 
     def __init__(self, vocab_size: int, hidden_size: int = 512,
                  num_layers: int = 6, num_heads: int = 8,
@@ -157,10 +171,39 @@ class TransformerLM(Module):
 
     def apply(self, params, state, input, *, training=False, rng=None,
               cache=None, positions=None, attend_len=None):
-        tokens = input.astype(jnp.int32)
+        from bigdl_tpu.utils.table import Table
+        seg_mask = None
+        packed_pos = None
+        if isinstance(input, Table):
+            input = [input[i] for i in range(1, input.length() + 1)]
+        if isinstance(input, (list, tuple)):
+            if len(input) != 3:
+                raise ValueError(
+                    "packed TransformerLM input must be [tokens, "
+                    f"segment_ids, positions]; got {len(input)} planes")
+            if cache is not None:
+                raise ValueError(
+                    "packed 3-plane input is a training/scoring layout; "
+                    "the KV-cached decode path takes plain token ids")
+            tokens, segment_ids, packed_pos = input
+            seg = segment_ids.astype(jnp.int32)
+            # same-document attention only: [B, 1, Sq, Sk]; ANDed with
+            # the causal structure inside dot_product_attention
+            seg_mask = seg[:, None, :, None] == seg[:, None, None, :]
+            tokens = tokens.astype(jnp.int32)
+        else:
+            tokens = input.astype(jnp.int32)
         b, s = tokens.shape
         if cache is None:
-            x = params["embed"][tokens] + params["pos_embed"][:s][None]
+            if packed_pos is None:
+                x = params["embed"][tokens] + params["pos_embed"][:s][None]
+            else:
+                # per-document positions (restart at 0 per segment) so a
+                # packed document sees the same positional embeddings it
+                # would alone in a row
+                idx = jnp.clip(packed_pos.astype(jnp.int32), 0,
+                               self.max_len - 1)
+                x = params["embed"][tokens] + params["pos_embed"][idx]
         else:
             # incremental decode: row b's S tokens sit at absolute
             # positions positions[b] .. positions[b]+S-1 (clip keeps a
@@ -175,9 +218,15 @@ class TransformerLM(Module):
         new_state = {}
         for i, blk in enumerate(self.blocks):
             if cache is None:
+                # attn_mask only rides along for packed inputs: the
+                # plain path keeps the bare apply signature (shapecheck
+                # interceptors and custom blocks see no new kwarg)
+                mask_kw = {} if seg_mask is None \
+                    else {"attn_mask": seg_mask}
                 x, st = blk.apply(params[f"block_{i}"],
                                   state.get(f"block_{i}", {}), x,
-                                  training=training, rng=keys[i])
+                                  training=training, rng=keys[i],
+                                  **mask_kw)
             else:
                 x, st, layer_cache = blk.apply(
                     params[f"block_{i}"], state.get(f"block_{i}", {}), x,

@@ -58,6 +58,54 @@ def build_mesh_for(pp: int, tp: int, sp_size: int):
     return make_mesh(sizes, names, jax.devices()[:n]), names
 
 
+def _split_documents(stream, eos_index):
+    """1-based token stream -> list of 0-based int32 documents split at
+    ``eos_index`` (each document keeps its trailing <eos>) — the
+    variable-length view the packing/bucketing input modes consume."""
+    import numpy as np
+
+    s = np.asarray(stream).astype(np.int64)
+    docs, lo = [], 0
+    ends = np.flatnonzero(s == eos_index)
+    for e in ends:
+        doc = s[lo:e + 1]
+        if len(doc) >= 2:
+            docs.append((doc - 1).astype(np.int32))
+        lo = e + 1
+    tail = s[lo:]
+    if len(tail) >= 2:
+        docs.append((tail - 1).astype(np.int32))
+    return docs
+
+
+def _packed_corpus(args, stream, eos_index):
+    """The packing-path replacement for the contiguous ``ptb_arrays``
+    layout: documents packed into ``[rows, seqLen]`` slabs with segment
+    masks (``--inputMode packed``) or padded one-per-row to the seqLen
+    bound (``--inputMode padded``). Prints the padding efficiency both
+    layouts would achieve, and leaves the gauge at the chosen one."""
+    from bigdl_tpu import datapipe as dp
+
+    docs = _split_documents(stream, eos_index)
+    if not docs:
+        raise SystemExit("corpus has no documents after <eos> splitting")
+    lengths = [min(len(d) - 1, args.seqLen) for d in docs]
+    eff_padded = dp.padding_efficiency(lengths, args.seqLen)
+    if args.inputMode == "padded":
+        batcher = dp.LengthBucketBatcher([args.seqLen], len(docs))
+        (mb,) = list(batcher(iter(docs), 0))
+        toks, segs, pos = mb.input
+        tgt = mb.target
+        eff = batcher.efficiency
+    else:
+        toks, segs, pos, tgt = dp.pack_documents(docs, args.seqLen)
+        eff = float((segs > 0).mean())
+    print(f"input mode {args.inputMode}: padding_efficiency {eff:.3f} "
+          f"(pad-to-max would be {eff_padded:.3f}) over {len(docs)} "
+          f"documents, {len(toks)} rows of {args.seqLen}")
+    return [toks, segs, pos], tgt
+
+
 def _corpus(args):
     """(x, y) int32 0-based token windows [N, seqLen] + vocab size."""
     import numpy as np
@@ -69,6 +117,15 @@ def _corpus(args):
         stream = rng.randint(1, args.vocabSize + 1,
                              args.synthetic).astype(np.float32)
         vocab = args.vocabSize
+        if args.inputMode != "contiguous":
+            # ragged synthetic documents: mark seeded pseudo-<eos>
+            # boundaries so the packed path has real length variance
+            eos = args.vocabSize
+            cuts = rng.randint(8, max(9, args.seqLen // 2),
+                               max(1, args.synthetic // 16))
+            pos = np.minimum(np.cumsum(cuts), args.synthetic - 1)
+            stream[pos] = eos
+            return _packed_corpus(args, stream, eos) + (vocab,)
     else:
         train_txt = args.folder if os.path.isfile(args.folder) else \
             os.path.join(args.folder, "train.txt")
@@ -86,6 +143,9 @@ def _corpus(args):
         if args.checkpoint:
             os.makedirs(args.checkpoint, exist_ok=True)
             d.save(os.path.join(args.checkpoint, "dictionary.json"))
+        if args.inputMode != "contiguous":
+            return _packed_corpus(args, stream,
+                                  d.get_index("<eos>")) + (vocab,)
     bs = args.batchSize or 8
     x, y = ptb_arrays(stream, bs, args.seqLen)
     # ptb_arrays is 1-based (the torch convention); LM criterion wants
@@ -103,6 +163,15 @@ def main(argv=None):
     ap.add_argument("--layers", type=int, default=4)
     ap.add_argument("--heads", type=int, default=8)
     ap.add_argument("--seqLen", type=int, default=128)
+    ap.add_argument("--inputMode",
+                    choices=("contiguous", "packed", "padded"),
+                    default="contiguous",
+                    help="text layout: 'contiguous' = the classic "
+                    "ptb_arrays stream windows; 'packed' = documents "
+                    "packed into [B, seqLen] slabs with segment masks "
+                    "(datapipe.packing — no pad FLOPs); 'padded' = one "
+                    "document per row padded to seqLen (the before "
+                    "number for the padding-efficiency gauge)")
     ap.add_argument("--dropout", type=float, default=0.0)
     ap.add_argument("--moeExperts", type=int, default=0)
     ap.add_argument("--pp", type=int, default=1,
@@ -137,10 +206,27 @@ def main(argv=None):
             "--pp does not support dropout (per-microbatch rng through "
             "the pipeline ring would tie the objective to the stage "
             "count); use the non-pipelined TransformerLM for dropout")
+    if args.inputMode != "contiguous" and (args.pp > 1
+                                           or args.sp != "none"):
+        raise ValueError(
+            "--inputMode packed/padded needs the dense TransformerLM "
+            "(segment masks are unsupported on the pipelined and "
+            "sequence-parallel paths)")
 
     x, y, vocab = _corpus(args)
     bs = args.batchSize or 8
-    ds = arrays_to_dataset(x, y, bs)
+    if isinstance(x, list):
+        # packed/padded 3-plane layout: Samples carry [tokens,
+        # segment_ids, positions]; pad/boundary targets are -1 and the
+        # criterion must ignore them
+        from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+        samples = [Sample([plane[i] for plane in x], y[i])
+                   for i in range(len(x[0]))]
+        ds = DataSet.array(samples).transform(SampleToMiniBatch(bs))
+        criterion = nn.SequenceCrossEntropyCriterion(ignore_index=-1)
+    else:
+        ds = arrays_to_dataset(x, y, bs)
+        criterion = nn.SequenceCrossEntropyCriterion()
 
     mesh, _ = build_mesh_for(args.pp, args.tp,
                              args.spSize if args.sp != "none" else 1)
@@ -182,7 +268,7 @@ def main(argv=None):
 
     optim = SGD(learning_rate=args.learningRate or 0.1,
                 learning_rate_decay=args.learningRateDecay or 0.0)
-    opt = Optimizer(model, ds, nn.SequenceCrossEntropyCriterion(),
+    opt = Optimizer(model, ds, criterion,
                     batch_size=bs, mesh=mesh, sharding_rules=rules)
     wire_optimizer(opt, args, optim, default_epochs=1)
     opt.optimize()

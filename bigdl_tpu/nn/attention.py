@@ -149,9 +149,17 @@ class MultiHeadAttention(Module):
         return y
 
     def forward_fn(self, params, input, *, training=False, rng=None,
-                   cache=None, positions=None, attend_len=None):
+                   cache=None, positions=None, attend_len=None,
+                   mask=None):
         """Full-sequence attention, or — with ``cache=`` — one
         incremental (KV-cached) step.
+
+        ``mask`` is an optional boolean ``[B, 1, S, S]`` (broadcastable)
+        attention mask ANDed with the causal structure — the segment
+        mask the packed-sequence data path supplies so rows holding
+        several documents never attend across document boundaries
+        (``bigdl_tpu.datapipe.packing``). Unsupported on the
+        sequence-parallel and cached paths.
 
         ``cache`` is ``{"k": [B,H,T,D], "v": [B,H,T,D]}`` (T the
         cache's bucketed max length), ``positions`` an int32 ``[B]`` of
@@ -168,8 +176,17 @@ class MultiHeadAttention(Module):
         pre-cache implementation (weights are shared; generation adds
         no parameters)."""
         if cache is not None:
+            if mask is not None:
+                raise ValueError(
+                    "segment masks are not supported on the KV-cached "
+                    "decode path (pack training slabs, not decode steps)")
             return self._forward_cached(params, input, cache, positions,
                                         attend_len)
+        if mask is not None and self.ring_axis is not None:
+            raise ValueError(
+                "segment masks are not supported on the sequence-parallel "
+                "path (ring/ulysses kernels shard the key axis the mask "
+                "indexes); use ring_axis=None for packed inputs")
         x = input
         b, s, e = x.shape
         h, d = self.num_heads, self.head_dim
@@ -196,8 +213,8 @@ class MultiHeadAttention(Module):
                         kern, mesh, self.ring_axis, self.causal)(q, k, v)
         if out is None:
             out = dot_product_attention(
-                q, k, v, causal=self.causal, dropout_rate=self.dropout,
-                rng=rng, training=training)
+                q, k, v, causal=self.causal, mask=mask,
+                dropout_rate=self.dropout, rng=rng, training=training)
         out = out.transpose(0, 2, 1, 3).reshape(b, s, e)
         return self._proj(params, out, "o")
 

@@ -568,11 +568,18 @@ class SequenceCrossEntropyCriterion(Criterion):
     ClassNLLCriterion subtracts 1), targets here are 0-based vocabulary
     ids — the universal LM convention. Out-of-range ids are clamped into
     the vocab rather than silently producing NaN.
+
+    ``ignore_index`` (e.g. -1, the datapipe packing convention) marks
+    positions excluded from the loss — slab padding and spare rows of a
+    packed batch; the mean is over REAL tokens only, so packed and
+    padded feeds of the same documents optimize the same objective.
     """
 
-    def __init__(self, label_smoothing: float = 0.0):
+    def __init__(self, label_smoothing: float = 0.0,
+                 ignore_index: Optional[int] = None):
         super().__init__()
         self.label_smoothing = label_smoothing
+        self.ignore_index = ignore_index
 
     def apply(self, input, target):
         v = input.shape[-1]
@@ -585,4 +592,8 @@ class SequenceCrossEntropyCriterion(Criterion):
             smooth = -jnp.mean(logp, axis=-1)
             nll = ((1.0 - self.label_smoothing) * nll
                    + self.label_smoothing * smooth)
-        return jnp.mean(nll)
+        if self.ignore_index is None:
+            return jnp.mean(nll)
+        keep = t != self.ignore_index
+        count = jnp.maximum(jnp.sum(keep), 1)
+        return jnp.sum(jnp.where(keep, nll, 0.0)) / count

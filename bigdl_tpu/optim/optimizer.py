@@ -708,6 +708,23 @@ class Optimizer:
         return inp, tgt
 
     # -- checkpointing (DistriOptimizer.checkpoint :433-463) ---------------
+    def _cursor_dataset(self):
+        """The dataset (possibly behind ``TransformedDataSet`` wrappers —
+        walk the ``.base`` chain) that carries a streaming-pipeline
+        cursor, or None. Without the unwrap, ``pipe.as_dataset()
+        .transform(...)`` would silently lose cursor checkpointing and a
+        resumed run would replay already-consumed records."""
+        ds = self.dataset
+        seen = 0
+        while ds is not None and seen < 32:  # cycle guard
+            if callable(getattr(ds, "pipeline_state", None)) \
+                    and callable(getattr(ds, "restore_pipeline_state",
+                                         None)):
+                return ds
+            ds = getattr(ds, "base", None)
+            seen += 1
+        return None
+
     def _checkpoint(self, params, opt_state, model_state):
         from bigdl_tpu.utils.serialization import save_checkpoint
         neval = self.driver_state["neval"]
@@ -719,11 +736,22 @@ class Optimizer:
         # save_checkpoint, but only process 0 touches the (shared)
         # checkpoint storage — no N× duplicated IO
         writer = not self._multiprocess() or jax.process_index() == 0
+        driver_state = {k: v for k, v in self.driver_state.items()}
+        # streaming pipelines (datapipe PipelineDataSet) carry a read
+        # cursor: checkpoint it alongside the driver counters so resume
+        # continues the stream instead of replaying the epoch
+        cursor_ds = self._cursor_dataset()
+        if cursor_ds is not None and not self._multiprocess():
+            # single-process only: the cursor is PROCESS-LOCAL (each
+            # process reads its own shard split), but only process 0
+            # writes the checkpoint — restoring its cursor onto every
+            # process would desync the per-process streams. Multi-host
+            # runs keep the pre-cursor resume semantics (epoch replay).
+            driver_state["datapipe"] = cursor_ds.pipeline_state()
         save_checkpoint(path, params=params, opt_state=opt_state,
                         model_state=model_state,
                         optim_host_state=self.optim_method.get_state(),
-                        driver_state={k: v for k, v in
-                                      self.driver_state.items()},
+                        driver_state=driver_state,
                         writer=writer)
         if writer:
             logger.info("checkpointed to %s", path)
@@ -921,6 +949,18 @@ class Optimizer:
             model_state = resumed["model_state"]
             self.optim_method.load_state(resumed["optim_host_state"])
             self.driver_state.update(resumed["driver_state"])
+            # a checkpointed streaming-pipeline cursor restores the data
+            # position (see _checkpoint); popped so the driver counters
+            # stay plain ints and a later dataset swap can't reuse it.
+            # Multi-process mirrors the _checkpoint guard: the cursor is
+            # process-0's PROCESS-LOCAL position — applying it to every
+            # process's different shard split would desync the streams,
+            # so multi-host resume keeps the epoch-replay fallback.
+            cursor = self.driver_state.pop("datapipe", None)
+            cursor_ds = self._cursor_dataset()
+            if cursor is not None and cursor_ds is not None \
+                    and not self._multiprocess():
+                cursor_ds.restore_pipeline_state(cursor)
         # epoch/iteration-driven lr schedules read the OptimMethod's
         # state: sync the driver counters in (covers set_state called
         # before set_optim_method, and keeps both views consistent)

@@ -111,7 +111,9 @@ def collect_instrument_names():
 
     for mod in ("bigdl_tpu.optim.optimizer", "bigdl_tpu.dataset.prefetch",
                 "bigdl_tpu.utils.serialization", "bigdl_tpu.parallel.tp",
-                "bigdl_tpu.tools.perf", "bigdl_tpu.tools.ceiling"):
+                "bigdl_tpu.tools.perf", "bigdl_tpu.tools.ceiling",
+                "bigdl_tpu.datapipe.readers", "bigdl_tpu.datapipe.shuffle",
+                "bigdl_tpu.datapipe.packing"):
         importlib.import_module(mod)
     scratch = telemetry.MetricsRegistry()
     from bigdl_tpu.generation.loop import register_generation_instruments

@@ -87,7 +87,8 @@ def attribution(agg: Dict[str, Dict[str, float]]) -> List[dict]:
 
 
 def _fmt_report(rows: List[dict], metrics_lines: List[str],
-                summary: Optional[str]) -> str:
+                summary: Optional[str],
+                feed_lines: Optional[List[str]] = None) -> str:
     lines = ["== where did the time go =="]
     group = None
     for r in rows:
@@ -96,12 +97,74 @@ def _fmt_report(rows: List[dict], metrics_lines: List[str],
             lines.append(f"{group}:")
         lines.append(f"  {r['name']:<34s} {r['total_s']:9.4f} s "
                      f"({100 * r['share']:5.1f}%)  x{r['count']}")
+    if feed_lines:
+        lines.append("data feed:")
+        lines.extend(f"  {m}" for m in feed_lines)
     if metrics_lines:
         lines.append("metrics:")
         lines.extend(f"  {m}" for m in metrics_lines)
     if summary:
         lines.append(f"optimizer Metrics.summary(): {summary}")
     return "\n".join(lines)
+
+
+def feed_summary(snapshot: List[dict]) -> Dict[str, float]:
+    """Host-feed health numbers from a registry snapshot: how well the
+    datapipe fills slabs (``padding_efficiency``), how deep the shuffle
+    window runs (``shuffle_buffer_depth``), and the host-feed stall the
+    trainer actually paid (``data_wait_s`` vs ``compute_s``, plus the
+    prefetch consumer's ``fetch_wait_s``) — the numbers that separate
+    "the chip is starved" from "the chip is slow"."""
+    by_name = {row["name"]: row for row in snapshot}
+
+    def gauge(name):
+        row = by_name.get(name)
+        return float(row["series"][0]["value"]) if row and row["series"] \
+            else None
+
+    def hist_sum(name):
+        row = by_name.get(name)
+        return float(row["series"][0]["sum"]) if row and row["series"] \
+            else None
+
+    out: Dict[str, float] = {}
+    eff = gauge("data/packing/padding_efficiency")
+    if eff is not None:
+        out["padding_efficiency"] = eff
+    depth = gauge("data/shuffle/buffer_depth")
+    if depth is not None:
+        out["shuffle_buffer_depth"] = depth
+    wait = hist_sum("train/optimizer/data_time")
+    comp = hist_sum("train/optimizer/computing_time")
+    if wait is not None:
+        out["data_wait_s"] = wait
+    if comp is not None:
+        out["compute_s"] = comp
+    if wait is not None and comp is not None and wait + comp > 0:
+        out["feed_stall_share"] = wait / (wait + comp)
+    fetch = hist_sum("data/prefetch/fetch_wait_s")
+    if fetch is not None:
+        out["prefetch_fetch_wait_s"] = fetch
+    return out
+
+
+def _feed_lines(feed: Dict[str, float]) -> List[str]:
+    out = []
+    if "padding_efficiency" in feed:
+        out.append(f"padding_efficiency: {feed['padding_efficiency']:.3f}"
+                   " (real tokens / slab capacity)")
+    if "shuffle_buffer_depth" in feed:
+        out.append("shuffle_buffer_depth: "
+                   f"{feed['shuffle_buffer_depth']:g} records")
+    if "feed_stall_share" in feed:
+        out.append(
+            f"host-feed stall: {feed['data_wait_s']:.4f} s waiting on "
+            f"data vs {feed['compute_s']:.4f} s compute "
+            f"({100 * feed['feed_stall_share']:.1f}% of step time)")
+    if "prefetch_fetch_wait_s" in feed:
+        out.append("prefetch fetch_wait: "
+                   f"{feed['prefetch_fetch_wait_s']:.4f} s")
+    return out
 
 
 def _metrics_lines(snapshot: List[dict]) -> List[str]:
@@ -260,12 +323,15 @@ def main(argv=None) -> int:
 
     agg = aggregate_spans(events)
     rows = attribution(agg)
+    feed = feed_summary(snapshot)
     if args.json:
         print(json.dumps({"spans": rows,
                           "metrics": snapshot,
+                          "data_feed": feed,
                           "optimizer_summary": summary}, indent=2))
     else:
-        print(_fmt_report(rows, _metrics_lines(snapshot), summary))
+        print(_fmt_report(rows, _metrics_lines(snapshot), summary,
+                          _feed_lines(feed)))
         if wrote_trace:
             print(f"chrome trace written to {args.out_trace} "
                   "(load in Perfetto / chrome://tracing)")

@@ -21,6 +21,11 @@ FAMILIES = {
            "bigdl_tpu.nn.sparse", "bigdl_tpu.nn.quantized"],
     "dataset": ["bigdl_tpu.dataset", "bigdl_tpu.dataset.device_dataset",
                 "bigdl_tpu.dataset.fetch"],
+    "datapipe": ["bigdl_tpu.datapipe", "bigdl_tpu.datapipe.readers",
+                 "bigdl_tpu.datapipe.shuffle",
+                 "bigdl_tpu.datapipe.packing",
+                 "bigdl_tpu.datapipe.stage",
+                 "bigdl_tpu.datapipe.pipeline"],
     "optim": ["bigdl_tpu.optim"],
     "serving": ["bigdl_tpu.serving"],
     "generation": ["bigdl_tpu.generation", "bigdl_tpu.generation.kv_cache",

@@ -59,3 +59,23 @@ def test_bench_generation_row_contract():
         assert out[key] >= 0
     # K length-buckets (powers of two up to BENCH_GEN_LEN) => <= 2K
     assert out["generation_compiles"] <= 2 * 6
+
+
+@pytest.mark.slow
+def test_bench_data_row_contract():
+    """The DATA row: host-feed vs device-feed steps/sec through the
+    datapipe staged windows, TransformerLM packed-vs-padded real
+    tokens/sec, and the padding-efficiency pair."""
+    out = _run_bench("synthetic", {
+        "BENCH_DATA": "1", "BENCH_DATA_K": "2",
+        "BENCH_DATA_BATCH": "16", "BENCH_DATA_SEQ": "32",
+        "BENCH_DATA_VOCAB": "64", "BENCH_DATA_ROWS": "4"})
+    assert out["data_window_k"] == 2
+    assert out["data_lenet_devfeed_steps_per_sec"] > 0
+    assert out["data_lenet_hostfeed_steps_per_sec"] > 0
+    assert out["data_hostfeed_fraction_of_devfeed"] > 0
+    assert out["data_tlm_packed_tokens_per_sec"] > 0
+    assert out["data_tlm_padded_tokens_per_sec"] > 0
+    # packing must beat pad-to-max on slab utilization
+    assert out["data_padding_efficiency_packed"] > \
+        out["data_padding_efficiency_padded"]

@@ -219,6 +219,20 @@ def run(fn):
             time.sleep(1.0)  # bigdl: disable=retry-no-backoff
 """,
     ),
+    "unseeded-shuffle": (
+        """
+def epoch_order(records):
+    rng = np.random.default_rng()
+    rng.shuffle(records)
+    return records
+""",
+        """
+def epoch_order(records):
+    rng = np.random.default_rng()
+    rng.shuffle(records)  # bigdl: disable=unseeded-shuffle
+    return records
+""",
+    ),
 }
 
 
@@ -284,6 +298,78 @@ def run(fn):
 """
     findings = lint_source(src, "fixture.py")
     assert "retry-no-backoff" not in names(findings, only_active=False)
+
+
+def test_unseeded_shuffle_passes_seeded_generators():
+    # the sanctioned pattern: an explicit seed (any expression) makes
+    # the order a pure function of it — nothing to flag
+    src = HEADER + """
+def epoch_order(records, seed, epoch):
+    rng = np.random.default_rng((seed, epoch))
+    rng.shuffle(records)
+    old = np.random.RandomState(seed)
+    return old.permutation(len(records))
+"""
+    findings = lint_source(src, "fixture.py")
+    assert "unseeded-shuffle" not in names(findings, only_active=False)
+
+
+def test_unseeded_shuffle_flags_self_attribute_and_wrapper():
+    # self._rng bound to an unseeded wrapper (Generator(PCG64())) is the
+    # sneaky form: construction and use sit in different methods
+    src = HEADER + """
+class Feed:
+    def __init__(self):
+        self._rng = np.random.Generator(np.random.PCG64())
+
+    def shuffle(self, xs):
+        self._rng.shuffle(xs)
+"""
+    findings = lint_source(src, "fixture.py")
+    assert "unseeded-shuffle" in names(findings)
+
+
+def test_unseeded_shuffle_scoping_no_cross_function_taint():
+    # an unseeded `rng` in one function (used for non-shuffle draws)
+    # must not taint a seeded `rng` in a DIFFERENT function; and a
+    # seeded rebinding in the same scope exonerates
+    src = HEADER + """
+def jitter(xs):
+    rng = np.random.default_rng()
+    return xs + rng.normal()
+
+def epoch_order(xs, seed):
+    rng = np.random.default_rng(seed)
+    rng.shuffle(xs)
+    return xs
+
+def rebound(xs, seed):
+    rng = np.random.default_rng()
+    rng = np.random.default_rng(seed)
+    rng.shuffle(xs)
+"""
+    findings = lint_source(src, "fixture.py")
+    assert "unseeded-shuffle" not in names(findings, only_active=False)
+
+
+def test_unseeded_shuffle_module_level_binding_reaches_functions():
+    src = HEADER + """
+rng = np.random.default_rng()
+
+def epoch_order(xs):
+    rng.shuffle(xs)
+"""
+    findings = lint_source(src, "fixture.py")
+    assert "unseeded-shuffle" in names(findings)
+
+
+def test_unseeded_shuffle_flags_global_numpy_permutation():
+    src = HEADER + """
+def order(n):
+    return np.random.permutation(n)
+"""
+    findings = lint_source(src, "fixture.py")
+    assert "unseeded-shuffle" in names(findings)
 
 
 def test_sync_in_loop_skips_files_without_jax():
