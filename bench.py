@@ -118,6 +118,14 @@ def _fed_minibatch_chunks(batch, scan):
     return chunks(), loader, decode_rate
 
 
+def _row_enabled(flag_name: str, platform: str) -> bool:
+    """One gate for every optional bench row: the env flag "0" disables
+    it everywhere, "1" forces it on, and otherwise it runs only off-CPU
+    (on CPU smoke runs the extra compiles would dominate CI)."""
+    flag = os.environ.get(flag_name, "")
+    return flag != "0" and (platform != "cpu" or flag == "1")
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -400,8 +408,7 @@ def main():
     # async dispatch buys over per-step host sync (the Optimizer's
     # set_steps_per_sync knob). Skipped on CPU smoke runs unless forced
     # — two extra compiles would dominate CI.
-    cmp_flag = os.environ.get("BENCH_SYNC_COMPARE", "")
-    if cmp_flag != "0" and (platform != "cpu" or cmp_flag == "1"):
+    if _row_enabled("BENCH_SYNC_COMPARE", platform):
         from bigdl_tpu.tools.sync_compare import measure_sync_compare
 
         def build(k):
@@ -423,15 +430,13 @@ def main():
     # net-new flagship family; a regression here must be visible to the
     # driver's scoreboard, not just ResNet-50). Skipped on CPU smoke
     # runs unless forced — the compile alone would dominate CI.
-    lm_flag = os.environ.get("BENCH_LM", "")
-    if lm_flag != "0" and (platform != "cpu" or lm_flag == "1"):
+    if _row_enabled("BENCH_LM", platform):
         result["transformerlm_tokens_per_sec_per_chip"] = round(
             _bench_transformer_lm(), 1)
     # third tracked scalar: forward-only (serving) throughput — the
     # reference's Predictor half of the product (Predictor.scala:35);
     # the full bf16-vs-int8 inference table lives in BASELINE.md
-    inf_flag = os.environ.get("BENCH_INFER", "")
-    if inf_flag != "0" and (platform != "cpu" or inf_flag == "1"):
+    if _row_enabled("BENCH_INFER", platform):
         # the original params buffers were DONATED to the train chunk;
         # the live values ride the final carry
         result["resnet50_inference_imgs_per_sec_per_chip"] = round(
@@ -441,8 +446,7 @@ def main():
     # TTFT / per-token latency percentiles from the service's own
     # histograms). Skipped on CPU smoke runs unless forced — the 2K
     # program warmup would dominate CI.
-    gen_flag = os.environ.get("BENCH_GEN", "")
-    if gen_flag != "0" and (platform != "cpu" or gen_flag == "1"):
+    if _row_enabled("BENCH_GEN", platform):
         result.update(_bench_generation())
     # fifth tracked row: DATA — the streaming data plane
     # (bigdl_tpu.datapipe). Host-feed (reader -> shuffle -> staged
@@ -450,9 +454,16 @@ def main():
     # ROADMAP "within ~10% of device-feed" number — and TransformerLM
     # packed-vs-padded tokens/sec with the padding-efficiency gauge
     # values. Skipped on CPU smoke runs unless forced.
-    data_flag = os.environ.get("BENCH_DATA", "")
-    if data_flag != "0" and (platform != "cpu" or data_flag == "1"):
+    if _row_enabled("BENCH_DATA", platform):
         result.update(_bench_data())
+    # sixth tracked row: ZERO — weight-update sharding
+    # (bigdl_tpu.parallel.zero). Stage 0 vs 2 vs 3 imgs/sec at K=8
+    # scanned windows over a data mesh of all devices, plus
+    # opt_state_bytes_per_chip per stage — the n-fold memory reduction
+    # and its throughput cost/benefit as scoreboard numbers. Skipped on
+    # CPU smoke runs unless forced.
+    if _row_enabled("BENCH_ZERO", platform):
+        result.update(_bench_zero())
     print(json.dumps(result))
     _maybe_metrics_snapshot(result)
 
@@ -730,6 +741,95 @@ def _bench_data():
     packed_segs = packed_arrays[1]
     row["data_padding_efficiency_packed"] = round(
         float((packed_segs > 0).mean()), 4) if len(packed_segs) else 1.0
+    return row
+
+
+def _bench_zero():
+    """ZERO row: ResNet training at ZeRO stage 0 vs 2 vs 3 over a
+    data-parallel mesh of every available device, K scanned steps per
+    dispatch (the windowed-driver regime where the collectives overlap
+    the neighbouring steps' compute). Reports imgs/sec and the per-chip
+    optimizer-state bytes each stage leaves resident — the measured
+    form of the ZeRO memory math in docs/performance.md."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.models import ResNet
+    from bigdl_tpu.optim import SGD
+    from bigdl_tpu.optim.optimizer import build_train_step
+    from bigdl_tpu.parallel import (ZeroConfig, data_parallel_mesh,
+                                    place_zero_state, tree_bytes_per_chip)
+    from bigdl_tpu.utils.random import RandomGenerator
+
+    scan = int(os.environ.get("BENCH_SCAN", 8))
+    iters = int(os.environ.get("BENCH_ITERS", 6))
+    mesh = data_parallel_mesh()
+    ndev = mesh.shape["data"]
+    batch = int(os.environ.get("BENCH_ZERO_BATCH", 16 * ndev))
+    batch = max(ndev, batch - batch % ndev)
+    depth = int(os.environ.get("BENCH_ZERO_DEPTH", 20))
+    repl = NamedSharding(mesh, P())
+    bsh = NamedSharding(mesh, P("data"))
+    row = {"zero_window_k": scan, "zero_devices": ndev,
+           "zero_batch": batch}
+
+    def leg(stage):
+        RandomGenerator.set_seed(13)
+        model = ResNet(10, depth=depth, dataset="CIFAR10").training()
+        model.ensure_initialized()
+        optim = SGD(learning_rate=0.1, momentum=0.9)
+        cfg = ZeroConfig(stage=stage) if stage else None
+        params = model.get_parameters()
+        opt_state = optim.init_state(params)
+        params, opt_state = place_zero_state(params, opt_state, mesh,
+                                             cfg)
+        mstate = jax.device_put(model.get_state(), repl)
+        step = build_train_step(model, nn.CrossEntropyCriterion(), optim,
+                                zero=cfg, mesh=mesh)
+
+        def scan_body(carry, key):
+            params, opt_state, mstate = carry
+            kx, ky, kr = jax.random.split(key, 3)
+            x = jax.lax.with_sharding_constraint(
+                jax.random.uniform(kx, (batch, 3, 32, 32), jnp.float32),
+                bsh)
+            y = jax.lax.with_sharding_constraint(
+                jax.random.randint(ky, (batch,), 1, 11)
+                .astype(jnp.float32), bsh)
+            params, opt_state, mstate, loss = step(
+                params, opt_state, mstate, kr, 0.1, x, y)
+            return (params, opt_state, mstate), loss
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def run_chunk(carry, keys):
+            return lax.scan(scan_body, carry, keys)
+
+        opt_bytes = tree_bytes_per_chip(opt_state)
+        root = jax.random.PRNGKey(3)
+        carry = (params, opt_state, mstate)
+        carry, losses = run_chunk(carry, jax.random.split(root, scan))
+        float(losses.sum())  # compile + warmup outside the clock
+        t0 = time.time()
+        for i in range(iters):
+            carry, losses = run_chunk(
+                carry, jax.random.split(jax.random.fold_in(root, i + 1),
+                                        scan))
+        float(losses.sum())
+        return batch * scan * iters / (time.time() - t0), opt_bytes
+
+    for stage in (0, 2, 3):
+        rate, opt_bytes = leg(stage)
+        row[f"zero_stage{stage}_imgs_per_sec"] = round(rate, 2)
+        row[f"zero_stage{stage}_opt_state_bytes_per_chip"] = opt_bytes
+    row["zero_opt_state_reduction_stage2"] = round(
+        row["zero_stage0_opt_state_bytes_per_chip"]
+        / max(1, row["zero_stage2_opt_state_bytes_per_chip"]), 2)
     return row
 
 

@@ -13,6 +13,7 @@ Shipped rules:
 - ``global-rng`` — module-global ``np.random``/``random`` state
 - ``bare-except`` — bare ``except:`` handlers
 - ``sync-in-loop`` — per-iteration host-device sync in host step loops
+- ``gather-in-step-loop`` — loop-invariant collectives in host step loops
 - ``retry-no-backoff`` — broad-except retry loops with fixed sleeps
 - ``unseeded-shuffle`` — data-path shuffles without a seeded Generator
 """

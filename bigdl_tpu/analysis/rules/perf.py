@@ -137,6 +137,59 @@ def growing_concat_in_loop(ctx: FileContext):
                     "`# bigdl: disable=growing-concat-in-loop`")
 
 
+#: collectives whose per-step re-execution over an UNCHANGED tree is
+#: the gather-every-step-instead-of-once pitfall (ZeRO's inverse: the
+#: sanctioned placement is inside the compiled window, or once before
+#: the loop)
+_GATHER_FNS = frozenset({"jax.lax.all_gather", "jax.lax.psum"})
+
+
+@rule("gather-in-step-loop",
+      "collective over a loop-invariant tree inside a host step loop")
+def gather_in_step_loop(ctx: FileContext):
+    """Flags ``jax.lax.all_gather`` / ``jax.lax.psum`` whose gathered
+    operand never changes across iterations of a HOST-level loop — the
+    classic ZeRO pitfall of re-gathering the full (loop-invariant)
+    params every step instead of once before the loop, or instead of
+    letting the compiled step place the collective inside the program
+    where XLA overlaps it with compute (``parallel/zero.py``'s
+    contract). Per-iteration operands (the updated params of a real
+    train loop) are intentional and pass; traced loops are XLA's to
+    schedule and are skipped; files that never import jax hold no
+    collectives and are skipped. Mark a deliberate host-side gather
+    with ``# bigdl: disable=gather-in-step-loop``."""
+    from bigdl_tpu.analysis.rules.jit_calls import _loop_bound_names
+    if not _imports_jax(ctx):
+        return
+    for loop in ctx.walk(ast.For, ast.While):
+        if ctx.in_traced(loop):
+            continue
+        bound = _loop_bound_names(loop)
+        stack = list(ast.iter_child_nodes(loop))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.For, ast.While)):
+                continue  # other scopes / the inner loop's own finding
+            if isinstance(node, ast.Call):
+                c = ctx.canon(node.func)
+                if c in _GATHER_FNS and node.args:
+                    arg_names = {
+                        n.id for a in node.args[:1]
+                        for n in ast.walk(a) if isinstance(n, ast.Name)}
+                    if arg_names and not (arg_names & bound):
+                        yield node, (
+                            f"`{c}` of a loop-invariant tree runs the "
+                            "full collective every iteration; gather "
+                            "once before the loop, or move the loop "
+                            "into the compiled step (lax.scan / "
+                            "steps_per_sync) so XLA overlaps the "
+                            "collective with compute — or mark a "
+                            "deliberate host-side gather with "
+                            "`# bigdl: disable=gather-in-step-loop`")
+            stack.extend(ast.iter_child_nodes(node))
+
+
 @rule("sync-in-loop",
       "per-iteration host-device sync inside a host step loop")
 def sync_in_loop(ctx: FileContext):

@@ -188,7 +188,8 @@ def _local_rows(x) -> np.ndarray:
 def build_train_step(module: Module, criterion: Criterion,
                      optim_method: OptimMethod,
                      aux_loss_weight: float = 0.01,
-                     gradient_clip=None):
+                     gradient_clip=None, zero=None, mesh=None,
+                     sharding_rules=None):
     """The compiled hot path: loss + grad + update in one jit.
 
     Gradient normalization matches the reference (grads averaged over the
@@ -202,12 +203,25 @@ def build_train_step(module: Module, criterion: Criterion,
     setGradientClippingByl2Norm) to the aggregated gradients before the
     update — the global-L2 form is what keeps edge-of-stability recipes
     (classic PTB LSTM at lr 1.0) convergent.
+
+    ``zero`` (a ``parallel.zero.ZeroConfig`` with ``mesh``, and the
+    TP ``sharding_rules`` when params are rule-sharded) turns the
+    update into its weight-update-sharded form: stage >= 2 constrains
+    the fresh gradients to the 1/n data-axis layout (XLA lowers the
+    gradient all-reduce to a reduce-scatter), the optimizer math then
+    runs on shards, and the new params are constrained back to the
+    at-rest layout — replicated/TP for stage <= 2 (the single
+    all-gather), still sharded for stage 3 (forward/backward gather
+    each layer just in time). Every new optimizer-state leaf is pinned
+    to an explicit sharding so donated-jit out-shardings can never
+    silently re-replicate a shard after the first update.
     """
     if gradient_clip is not None and gradient_clip[0] not in (
             "constant", "l2norm"):
         raise ValueError(
             f"gradient_clip kind must be 'constant' or 'l2norm', got "
             f"{gradient_clip[0]!r}")
+    zero_active = zero is not None and zero.active_on(mesh)
 
     def step(params, opt_state, model_state, rng, lr, inputs, targets):
         cdtype = Engine.compute_dtype()
@@ -237,6 +251,12 @@ def build_train_step(module: Module, criterion: Criterion,
 
         grads, (new_mstate, data_loss) = jax.grad(
             loss_fn, has_aux=True)(params)
+        if zero_active and zero.stage >= 2:
+            # the reduce-scatter point (arXiv:2004.13336): constrained
+            # HERE, everything downstream — scaling, clipping, the
+            # optimizer math — runs on 1/n shards
+            from bigdl_tpu.parallel.zero import constrain_zero
+            grads = constrain_zero(grads, mesh, zero, sharding_rules)
         scales = module.param_scales(params)
         if any(s != 1.0 for s in jax.tree.leaves(scales)):
             grads = jax.tree.map(lambda g, s: g * s, grads, scales)
@@ -255,6 +275,22 @@ def build_train_step(module: Module, criterion: Criterion,
                     lambda g: g * scale.astype(g.dtype), grads)
         new_params, new_opt = optim_method.update(grads, opt_state, params,
                                                   lr)
+        if zero_active:
+            from bigdl_tpu.parallel.zero import (constrain_base,
+                                                 constrain_zero)
+            # pin EVERY fresh opt-state leaf (moments AND step
+            # counters) to its explicit sharded layout
+            new_opt = constrain_zero(new_opt, mesh, zero, sharding_rules)
+            if zero.stage == 3:
+                # params stay sharded at rest; each layer all-gathers
+                # just-in-time at its use inside the next fwd/bwd
+                new_params = constrain_zero(new_params, mesh, zero,
+                                            sharding_rules)
+            else:
+                # THE one params all-gather of the classic partitioned
+                # parameter server (AllReduceParameter.scala:214-303)
+                new_params = constrain_base(new_params, mesh,
+                                            sharding_rules)
         return new_params, new_opt, new_mstate, data_loss
 
     return jax.jit(step, donate_argnums=(0, 1, 2))
@@ -296,8 +332,14 @@ class Optimizer:
         self.sharding_rules = sharding_rules
         # ZeRO-1: optimizer state sharded over the data axis — the direct
         # analogue of the reference's per-node OWNED weight shard running
-        # the OptimMethod (AllReduceParameter.scala:214-303)
+        # the OptimMethod (AllReduceParameter.scala:214-303). The bool is
+        # the original knob; stages 2/3 (gradient reduce-scatter /
+        # params-sharded-at-rest) arrive through set_zero(ZeroConfig).
         self.zero1 = zero1
+        self.zero_config = None
+        if zero1:
+            from bigdl_tpu.parallel.zero import ZeroConfig
+            self.zero_config = ZeroConfig(stage=1, data_axis=data_axis)
         self.optim_method: OptimMethod = SGD()
         self.end_when: Trigger = None
         # validation
@@ -466,6 +508,39 @@ class Optimizer:
         self.steps_per_sync = k
         return self
 
+    def set_zero(self, config) -> "Optimizer":
+        """Weight-update sharding policy (``parallel.zero.ZeroConfig``):
+        stage 1 shards optimizer state over the data axis, stage 2
+        additionally reduce-scatters gradients so each replica updates
+        only its 1/n shard before a single params all-gather, stage 3
+        keeps params sharded at rest with just-in-time per-layer
+        gathers inside forward/backward. Composes with
+        ``set_steps_per_sync(K)`` — the donated scan carry holds the
+        sharded state and XLA overlaps the collectives with the
+        neighbouring steps' compute — and with TP ``sharding_rules``
+        (ZeRO shards the dims the rules leave free). A no-op off-mesh
+        or when the data axis does not split; pass None (or stage 0)
+        to disable. Checkpoints save the gathered, unsharded-equivalent
+        state, so a run may resume onto a different stage or mesh
+        width. The config's ``data_axis`` is reconciled with this
+        Optimizer's own (a mismatched axis would silently deactivate
+        the policy — ZeRO only makes sense over the axis the batch and
+        gradient reduction shard on)."""
+        import dataclasses as _dc
+
+        from bigdl_tpu.parallel.zero import ZeroConfig
+        if config is not None and not isinstance(config, ZeroConfig):
+            raise TypeError(
+                f"set_zero expects a parallel.ZeroConfig or None, got "
+                f"{type(config).__name__}")
+        if config is not None and config.data_axis != self.data_axis:
+            config = _dc.replace(config, data_axis=self.data_axis)
+        self.zero_config = config if config is not None \
+            and config.stage > 0 else None
+        self.zero1 = self.zero_config is not None \
+            and self.zero_config.stage == 1
+        return self
+
     def set_preflight_spec(self, input_spec) -> "Optimizer":
         """Opt-in pre-flight: before any compilation, ``optimize()``
         shape/dtype-checks the model against ``input_spec`` (see
@@ -571,28 +646,51 @@ class Optimizer:
             return jax.device_put(tree, sh)
         return tree
 
+    def _active_zero(self):
+        """The ZeroConfig in force for THIS run, or None: configured,
+        stage > 0, and the mesh's data axis actually splits (LocalOptimizer
+        and pure-TP meshes fall back to the dense layout)."""
+        cfg = self.zero_config
+        return cfg if cfg is not None and cfg.active_on(self.mesh) else None
+
     def _put_params(self, tree):
-        """Params: TP/EP-sharded when rules are given, else replicated."""
+        """Params: TP/EP-sharded when rules are given, else replicated —
+        except ZeRO stage 3, where params live SHARDED at rest over the
+        data axis (composed with any TP rules) and each layer is
+        all-gathered just-in-time inside the compiled forward/backward."""
+        cfg = self._active_zero()
         if self.mesh is not None and self.sharding_rules is not None:
             from bigdl_tpu.parallel.tp import shard_params, validate_rules
             problems = validate_rules(tree, self.mesh, self.sharding_rules)
             if problems:
                 raise ValueError("bad sharding rules:\n" +
                                  "\n".join(problems))
+            if cfg is not None and cfg.stage == 3:
+                from bigdl_tpu.parallel.zero import shard_zero_tree
+                return shard_zero_tree(tree, self.mesh, cfg,
+                                       self.sharding_rules)
             return shard_params(tree, self.mesh, self.sharding_rules)
+        if cfg is not None and cfg.stage == 3:
+            from bigdl_tpu.parallel.zero import shard_zero_tree
+            return shard_zero_tree(tree, self.mesh, cfg)
         return self._put_replicated(tree)
 
     def _put_opt_state(self, tree):
         """Optimizer state (momentum/variance buffers mirror the params
         tree, so the TP rules match their paths too — re.search ignores the
-        'momentum/' prefix). With zero1, moment buffers instead shard dim 0
-        over the data axis (the reference's per-node owned shard running
-        the OptimMethod, AllReduceParameter.scala:214-303 ≈ ZeRO-1)."""
+        'momentum/' prefix). Under ZeRO (any stage), every buffer shards
+        its first free divisible dim over the data axis — the reference's
+        per-node owned shard running the OptimMethod
+        (AllReduceParameter.scala:214-303) — with an EXPLICIT sharding on
+        every leaf, matching the in-step constraints exactly so donated
+        updates never re-lay-out."""
         if self.mesh is None:
             return tree
-        if self.zero1:
-            from bigdl_tpu.parallel.tp import shard_opt_state_zero1
-            return shard_opt_state_zero1(tree, self.mesh, self.data_axis)
+        cfg = self._active_zero()
+        if cfg is not None:
+            from bigdl_tpu.parallel.zero import place_zero_opt_state
+            return place_zero_opt_state(tree, self.mesh, cfg,
+                                        self.sharding_rules)
         if self.sharding_rules is not None:
             from bigdl_tpu.parallel.tp import shard_params
             return shard_params(tree, self.mesh, self.sharding_rules)
@@ -971,9 +1069,17 @@ class Optimizer:
         params = self._put_params(params)
         opt_state = self._put_opt_state(opt_state)
         model_state = self._put_replicated(model_state)
+        if self.mesh is not None:
+            # per-chip memory proof: gauges read the PLACED shard sizes,
+            # so the n-fold ZeRO reduction is an exported number, not a
+            # claim (train/memory/*_bytes_per_chip)
+            from bigdl_tpu.parallel.zero import record_memory_gauges
+            record_memory_gauges(params, opt_state)
 
         step = build_train_step(model, self.criterion, self.optim_method,
-                                gradient_clip=self._gradient_clip)
+                                gradient_clip=self._gradient_clip,
+                                zero=self._active_zero(), mesh=self.mesh,
+                                sharding_rules=self.sharding_rules)
         ev_sh = self._batch_sharding() if self.mesh is not None else None
         eval_step = build_eval_step(model, ev_sh)
 

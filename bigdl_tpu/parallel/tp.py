@@ -103,21 +103,20 @@ def validate_rules(params, mesh: Mesh, rules: Rules) -> List[str]:
 
 
 def shard_opt_state_zero1(tree, mesh: Mesh, data_axis: str = "data"):
-    """ZeRO-1 optimizer-state layout: each moment buffer's dim 0 sharded
-    over the data axis when divisible, else replicated — the analogue of
-    the reference's per-node owned weight shard running the OptimMethod
-    (AllReduceParameter.scala:214-303)."""
-    ndev = mesh.shape.get(data_axis, 1)
-
-    def put(leaf):
-        if (hasattr(leaf, "ndim") and leaf.ndim >= 1
-                and leaf.shape[0] % ndev == 0):
-            spec = P(data_axis, *([None] * (leaf.ndim - 1)))
-            return put_global(leaf, NamedSharding(mesh, spec))
-        return put_global(leaf, NamedSharding(mesh, P()))
+    """ZeRO-1 optimizer-state layout: each moment buffer's first
+    divisibly-sized dim sharded over the data axis, else replicated —
+    the analogue of the reference's per-node owned weight shard running
+    the OptimMethod (AllReduceParameter.scala:214-303). EVERY leaf —
+    including non-float step counters — gets an explicit NamedSharding,
+    so a donated ``jax.jit`` update's inferred out-shardings can never
+    silently re-replicate a shard after the first step (the full
+    stage-1/2/3 policy lives in ``parallel/zero.py``; this keeps the
+    original one-call helper)."""
+    from bigdl_tpu.parallel.zero import ZeroConfig, shard_zero_tree
 
     t0 = time.perf_counter()
     with telemetry.span("parallel/shard_opt_state_zero1"):
-        out = jax.tree.map(put, tree)
+        out = shard_zero_tree(tree, mesh,
+                              ZeroConfig(stage=1, data_axis=data_axis))
     _SHARD_OPT_S.observe(time.perf_counter() - t0)
     return out

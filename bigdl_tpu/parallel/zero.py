@@ -1,0 +1,301 @@
+"""ZeRO-2/3 weight-update sharding over the data axis (GSPMD-native).
+
+The reference's partitioned parameter server (AllReduceParameter.scala:
+214-303: each node owns 1/n of the flattened parameter space, aggregates
+its slice, runs the OptimMethod on it, and all-gathers the updated
+weights) is exactly what "Automatic Cross-Replica Sharding of Weight
+Update in Data-Parallel Training" (arXiv:2004.13336) gives TPUs through
+sharding annotations alone — and the RDMA thesis (arXiv:1805.08430)
+says the win only lands when the collectives hide behind compute. Both
+are honored here without a single hand-written collective:
+
+- **stage 1** — optimizer state sharded at rest; gradients stay
+  all-reduced (the repo's original ``zero1`` flag).
+- **stage 2** — gradients are *constrained* to the sharded layout right
+  where ``jax.grad`` produces them, so XLA turns the gradient all-reduce
+  into a reduce-scatter (on CPU: all-reduce + dynamic-slice — same
+  math, same bytes-per-chip); each replica updates only its 1/n
+  gradient + optimizer-state shard and ONE params all-gather follows
+  the update.
+- **stage 3** — additionally keeps params sharded at rest; every
+  layer's weights are all-gathered just-in-time at their use site
+  inside the forward/backward (XLA places the gather next to the
+  consuming op, so peak live memory is one layer's worth, and the
+  gathered copy is discarded — the ``jax.remat``-friendly
+  gather-discard regime).
+
+Inside the windowed step driver (``Optimizer.set_steps_per_sync``) the
+donated ``lax.scan`` carry holds the *sharded* optimizer state, and the
+constraints sit inside the scan body — XLA is free to overlap step
+N+1's backward with step N's reduce-scatter, and no per-layer gather
+escapes to the host boundary (asserted via :func:`collective_counts`).
+
+Exactness is the contract: the update math is elementwise over shards,
+so stage-0 vs stage-1/2/3 differ only by collective reduction order
+(float tolerance, bounded in the multichip dryrun), never semantics.
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import bigdl_tpu.telemetry as telemetry
+from bigdl_tpu.parallel.tp import Rules, _path_str, put_global, spec_for
+
+# per-chip resident bytes, set whenever a training run lays out its
+# state (Optimizer._optimize_impl / tools.perf --zero / bench ZERO row):
+# the observable proof of the n-fold ZeRO memory reduction that makes
+# larger-than-chip models a supported scenario
+_OPT_BYTES = telemetry.gauge(
+    "train/memory/opt_state_bytes_per_chip",
+    "bytes of optimizer state resident per chip after sharding")
+_PARAM_BYTES = telemetry.gauge(
+    "train/memory/params_bytes_per_chip",
+    "bytes of parameters resident per chip after sharding")
+
+
+@dataclasses.dataclass(frozen=True)
+class ZeroConfig:
+    """Weight-update sharding policy over the ``data`` mesh axis.
+
+    ``stage`` — 0: off (pure DP replication); 1: optimizer state
+    sharded; 2: + gradients reduce-scattered and updated per-shard,
+    one params all-gather per step; 3: + params sharded at rest,
+    per-layer just-in-time gathers inside forward/backward.
+    ``data_axis`` — the mesh axis to shard over (the batch axis).
+    """
+
+    stage: int = 2
+    data_axis: str = "data"
+
+    def __post_init__(self):
+        if self.stage not in (0, 1, 2, 3):
+            raise ValueError(
+                f"ZeroConfig.stage must be 0, 1, 2 or 3, got {self.stage}")
+
+    def active_on(self, mesh: Optional[Mesh]) -> bool:
+        """True when the policy does anything on ``mesh``: a real mesh
+        whose data axis actually splits, and a stage above 0."""
+        return (self.stage > 0 and mesh is not None
+                and mesh.shape.get(self.data_axis, 1) > 1)
+
+
+def extend_spec(base: P, shape, ndev: int, data_axis: str) -> P:
+    """``base`` (the TP/EP rule spec, or ``P()``) with the FIRST free,
+    divisibly-sized dim additionally sharded over ``data_axis`` — the
+    FSDP composition rule: ZeRO takes whatever dims tensor parallelism
+    left unsharded. Leaves with no qualifying dim (scalars, tiny
+    biases) keep ``base`` — still an explicit spec, never unannotated.
+    """
+    if ndev <= 1 or not shape:
+        return base
+    entries = list(base) + [None] * (len(shape) - len(base))
+    used = set()
+    for e in entries:
+        if e is not None:
+            used.update((e,) if isinstance(e, str) else tuple(e))
+    if data_axis in used:
+        return base  # rules already consume the axis for this leaf
+    for d, e in enumerate(entries):
+        if e is None and shape[d] > 0 and shape[d] % ndev == 0:
+            entries[d] = data_axis
+            return P(*entries)
+    return base
+
+
+def tree_zero_specs(tree, mesh: Mesh, config: ZeroConfig,
+                    rules: Optional[Rules] = None):
+    """Pytree of PartitionSpecs for a params-shaped (or optimizer-state)
+    tree under ``config``: every leaf gets an EXPLICIT spec — sharded
+    where a dim divides the data axis, the TP-rule (or replicated) base
+    otherwise. Shape-only: works on live arrays, tracers and
+    ``jax.eval_shape`` structs alike."""
+    ndev = mesh.shape.get(config.data_axis, 1)
+
+    def leaf_spec(path, leaf):
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        base = spec_for(_path_str(path), len(shape), rules) if rules \
+            else P()
+        return extend_spec(base, shape, ndev, config.data_axis)
+
+    return jax.tree_util.tree_map_with_path(leaf_spec, tree)
+
+
+def tree_base_specs(tree, mesh: Mesh, rules: Optional[Rules] = None):
+    """The stage-0 layout: TP-rule specs where rules match, replicated
+    everywhere else — what stage-2 gathers params back to after the
+    sharded update."""
+
+    def leaf_spec(path, leaf):
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        return spec_for(_path_str(path), len(shape), rules) if rules \
+            else P()
+
+    return jax.tree_util.tree_map_with_path(leaf_spec, tree)
+
+
+def shard_zero_tree(tree, mesh: Mesh, config: ZeroConfig,
+                    rules: Optional[Rules] = None):
+    """Place a host tree on ``mesh`` in its ZeRO layout (multi-host
+    safe). Used for the at-rest state: optimizer state at stage >= 1,
+    params at stage 3."""
+    specs = tree_zero_specs(tree, mesh, config, rules)
+    return jax.tree.map(
+        lambda leaf, spec: put_global(leaf, NamedSharding(mesh, spec)),
+        tree, specs)
+
+
+def place_zero_params(tree, mesh: Mesh, config: Optional[ZeroConfig],
+                      rules: Optional[Rules] = None):
+    """Params' at-rest placement under ``config``: sharded over the
+    data axis only at stage 3, else the TP-rule layout when ``rules``
+    are given, else replicated."""
+    if config is not None and config.stage == 3:
+        return shard_zero_tree(tree, mesh, config, rules)
+    if rules is not None:
+        from bigdl_tpu.parallel.tp import shard_params
+        return shard_params(tree, mesh, rules)
+    return jax.tree.map(
+        lambda leaf: put_global(leaf, NamedSharding(mesh, P())), tree)
+
+
+def place_zero_opt_state(tree, mesh: Mesh, config: Optional[ZeroConfig],
+                         rules: Optional[Rules] = None):
+    """Optimizer state's at-rest placement under ``config``: sharded at
+    any stage >= 1, else the TP-rule layout, else replicated. The
+    sharded leg is timed into ``parallel/tp/shard_opt_state_s`` under a
+    ``parallel/shard_opt_state`` span — the one instrumented entry
+    point for every harness that lays ZeRO state out."""
+    if config is not None and config.stage >= 1:
+        import time as _time
+        t0 = _time.perf_counter()
+        with telemetry.span("parallel/shard_opt_state",
+                            stage=config.stage):
+            out = shard_zero_tree(tree, mesh, config, rules)
+        telemetry.histogram("parallel/tp/shard_opt_state_s").observe(
+            _time.perf_counter() - t0)
+        return out
+    if rules is not None:
+        from bigdl_tpu.parallel.tp import shard_params
+        return shard_params(tree, mesh, rules)
+    return jax.tree.map(
+        lambda leaf: put_global(leaf, NamedSharding(mesh, P())), tree)
+
+
+def place_zero_state(params, opt_state, mesh: Mesh,
+                     config: Optional[ZeroConfig],
+                     rules: Optional[Rules] = None):
+    """Both halves of the at-rest layout in one call — the placement
+    dance every training harness (Optimizer, bench, perf, the dryrun)
+    otherwise re-implements."""
+    return (place_zero_params(params, mesh, config, rules),
+            place_zero_opt_state(opt_state, mesh, config, rules))
+
+
+def constrain_zero(tree, mesh: Mesh, config: ZeroConfig,
+                   rules: Optional[Rules] = None):
+    """``with_sharding_constraint`` every leaf to its ZeRO spec, INSIDE
+    a jitted computation. On gradients this is the reduce-scatter
+    point; on fresh optimizer state it pins the sharded layout so
+    inferred jit out-shardings can never silently re-replicate a shard
+    after the first donated update."""
+    specs = tree_zero_specs(tree, mesh, config, rules)
+    return jax.tree.map(
+        lambda leaf, spec: jax.lax.with_sharding_constraint(
+            leaf, NamedSharding(mesh, spec)), tree, specs)
+
+
+def constrain_base(tree, mesh: Mesh, rules: Optional[Rules] = None):
+    """Constrain every leaf back to the stage-0 layout (replicated, or
+    the TP rules) — the single params all-gather stage 2 performs after
+    its sharded update."""
+    specs = tree_base_specs(tree, mesh, rules)
+    return jax.tree.map(
+        lambda leaf, spec: jax.lax.with_sharding_constraint(
+            leaf, NamedSharding(mesh, spec)), tree, specs)
+
+
+def tree_bytes_per_chip(tree) -> int:
+    """Resident bytes per chip for a (possibly sharded) pytree: each
+    leaf contributes its per-device shard size — ``sharding.shard_shape``
+    when the leaf carries one (live arrays and sharded
+    ``jax.eval_shape`` structs), its full shape otherwise. This is what
+    the ``train/memory/*_bytes_per_chip`` gauges report."""
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        dtype = np.dtype(getattr(leaf, "dtype", np.float32))
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None and hasattr(sharding, "shard_shape"):
+            shape = sharding.shard_shape(shape)
+        total += int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+    return total
+
+
+def record_memory_gauges(params, opt_state) -> Dict[str, int]:
+    """Set the per-chip memory gauges from the placed training state
+    and return the two byte counts (``params``, ``opt_state``)."""
+    pb = tree_bytes_per_chip(params)
+    ob = tree_bytes_per_chip(opt_state)
+    _PARAM_BYTES.set(pb)
+    _OPT_BYTES.set(ob)
+    return {"params_bytes_per_chip": pb, "opt_state_bytes_per_chip": ob}
+
+
+# HLO instruction form: "%name = TYPE op(operands)"; -start covers the
+# async variants real TPU schedules emit (their TYPE is a tuple with
+# spaces — "(f32[2,4]{1,0}, f32[16,4]{1,0})" — so the type is matched
+# lazily, not as one token). -done twins never match (the char after
+# the op name is "-", not "("), so each async pair counts once.
+_COLLECTIVES = ("all-gather", "reduce-scatter", "all-reduce",
+                "collective-permute", "all-to-all", "dynamic-slice")
+
+
+def collective_counts(hlo_text: str) -> Dict[str, Dict[str, int]]:
+    """Count collective ops in compiled-HLO text, split into the ENTRY
+    computation vs everything else (scan/while bodies, fusions).
+
+    ``{"all-gather": {"total": n, "entry": m}, ...}`` — the windowed
+    ZeRO contract is ``entry == 0`` for the gather/reduce collectives:
+    they live INSIDE the scanned window where XLA can overlap them with
+    the neighbouring steps' compute, never at the host dispatch
+    boundary. ``dynamic-slice`` (not itself a collective — it also
+    serves ordinary indexing) is counted because XLA CPU lowers
+    reduce-scatter to all-reduce + dynamic-slice — on that backend the
+    scatter evidence is the pair, not the fused op."""
+    counts = {op: {"total": 0, "entry": 0} for op in _COLLECTIVES}
+    in_entry = False
+    for line in hlo_text.splitlines():
+        if line.startswith("ENTRY"):
+            in_entry = True
+            continue
+        if in_entry and line.startswith("}"):
+            in_entry = False
+            continue
+        for op in _COLLECTIVES:
+            if re.search(rf"= .+? {op}(?:-start)?\(", line):
+                counts[op]["total"] += 1
+                if in_entry:
+                    counts[op]["entry"] += 1
+    return counts
+
+
+def window_collectives(compiled) -> Dict[str, Dict[str, int]]:
+    """:func:`collective_counts` over a compiled jit program (the
+    object ``jax.jit(f).lower(...).compile()`` returns)."""
+    return collective_counts(compiled.as_text())
+
+
+def reduce_scatter_evidence(counts: Dict[str, Dict[str, int]]) -> bool:
+    """True when the program reduce-scatters gradients: a literal
+    ``reduce-scatter`` op (TPU), or the CPU lowering's
+    all-reduce + dynamic-slice pair."""
+    if counts["reduce-scatter"]["total"] > 0:
+        return True
+    return (counts["all-reduce"]["total"] > 0
+            and counts["dynamic-slice"]["total"] > 0)

@@ -39,7 +39,7 @@ FAMILIES = {
                   "bigdl_tpu.telemetry.metrics",
                   "bigdl_tpu.telemetry.export"],
     "faults": ["bigdl_tpu.faults", "bigdl_tpu.faults.retry"],
-    "parallel": ["bigdl_tpu.parallel"],
+    "parallel": ["bigdl_tpu.parallel", "bigdl_tpu.parallel.zero"],
     "models": ["bigdl_tpu.models"],
     "interop": ["bigdl_tpu.utils.serialization",
                 "bigdl_tpu.utils.tf_loader", "bigdl_tpu.utils.tf_fusion",

@@ -82,6 +82,13 @@ def main(argv=None):
                     help="train mode: additionally measure steps/sec at "
                     "K=1 vs K=8 fused windows and report both in the "
                     "JSON tail line")
+    ap.add_argument("--zero", type=int, choices=(0, 1, 2, 3), default=0,
+                    metavar="STAGE",
+                    help="train mode: ZeRO weight-update sharding stage "
+                    "over a data-parallel mesh of ALL devices (parallel/"
+                    "zero.py — 1: sharded opt state, 2: + gradient "
+                    "reduce-scatter, 3: + params sharded at rest); the "
+                    "JSON tail reports opt_state/params bytes per chip")
     args = ap.parse_args(argv)
     if args.steps_per_sync < 1:
         raise SystemExit("--steps-per-sync must be >= 1")
@@ -129,13 +136,39 @@ def main(argv=None):
     # whole program a second time just to read the flop count)
     compiled_for_cost = None
     sync_k = args.steps_per_sync if args.mode == "train" else 1
+    zero_meta = {}
     if args.mode == "train":
         import functools
         from jax import lax
 
         optim = SGD(learning_rate=0.01, momentum=0.9)
         opt_state = optim.init_state(params)
-        jit_step = build_train_step(model, criterion, optim)
+        zero_cfg, zero_mesh = None, None
+        if args.zero:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from bigdl_tpu.parallel import (ZeroConfig,
+                                            data_parallel_mesh,
+                                            place_zero_state,
+                                            record_memory_gauges)
+            zero_mesh = data_parallel_mesh()
+            ndev = zero_mesh.shape["data"]
+            if args.batch_size % ndev:
+                raise SystemExit(
+                    f"--zero needs --batch-size divisible by the "
+                    f"{ndev}-device data mesh, got {args.batch_size}")
+            zero_cfg = ZeroConfig(stage=args.zero)
+            repl = NamedSharding(zero_mesh, P())
+            bsh = NamedSharding(zero_mesh, P("data"))
+            params, opt_state = place_zero_state(params, opt_state,
+                                                 zero_mesh, zero_cfg)
+            mstate = jax.device_put(mstate, repl)
+            x = jax.device_put(x, bsh)
+            y = jax.device_put(y, bsh)
+            zero_meta = dict(record_memory_gauges(params, opt_state),
+                             zero_stage=args.zero, zero_devices=ndev)
+        jit_step = build_train_step(model, criterion, optim,
+                                    zero=zero_cfg, mesh=zero_mesh)
         key = jax.random.PRNGKey(0)
 
         def make_chunk(k):
@@ -256,6 +289,7 @@ def main(argv=None):
             "batch_size": args.batch_size, "dtype": args.dtype,
             "backend": jax.default_backend(), "median_s": med,
             "rate": rate, "steps_per_sync": sync_k}
+    tail.update(zero_meta)
     if args.mode == "train":
         tail["steps_per_sec"] = sync_k / med
         if args.sync_compare:

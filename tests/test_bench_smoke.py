@@ -79,3 +79,23 @@ def test_bench_data_row_contract():
     # packing must beat pad-to-max on slab utilization
     assert out["data_padding_efficiency_packed"] > \
         out["data_padding_efficiency_padded"]
+
+
+@pytest.mark.slow
+def test_bench_zero_row_contract():
+    """The ZERO row: imgs/sec and opt_state_bytes_per_chip at ZeRO
+    stage 0 vs 2 vs 3 over the data mesh of every device — the stage-2
+    bytes must show a real reduction whenever the mesh has more than
+    one device (trivially 1.0 on a single-device smoke host)."""
+    out = _run_bench("synthetic", {
+        "BENCH_ZERO": "1", "BENCH_ZERO_BATCH": "8",
+        "BENCH_ZERO_DEPTH": "8",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8"})
+    assert out["zero_devices"] >= 1
+    for stage in (0, 2, 3):
+        assert out[f"zero_stage{stage}_imgs_per_sec"] > 0
+        assert out[f"zero_stage{stage}_opt_state_bytes_per_chip"] > 0
+    if out["zero_devices"] >= 8:
+        assert out["zero_opt_state_reduction_stage2"] >= 4
+        assert out["zero_stage3_opt_state_bytes_per_chip"] <= \
+            out["zero_stage0_opt_state_bytes_per_chip"] // 4

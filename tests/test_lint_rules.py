@@ -97,6 +97,25 @@ def decode(step, tok):
     return out
 """,
     ),
+    "gather-in-step-loop": (
+        """
+def train(ref_params, step):
+    state = 0
+    for i in range(100):
+        full = lax.all_gather(ref_params, "data")
+        state = step(state, full)
+    return state
+""",
+        """
+def train(ref_params, step):
+    state = 0
+    for i in range(100):
+        # bigdl: disable=gather-in-step-loop
+        full = lax.all_gather(ref_params, "data")
+        state = step(state, full)
+    return state
+""",
+    ),
     "jit-static-args": (
         """
 def g(x, mode):
@@ -453,6 +472,43 @@ for i in range(1000):
 """
     findings = lint_source(src, "fixture.py")
     assert "sync-in-loop" in names(findings)
+
+
+def test_gather_in_step_loop_allows_loop_variant_tree():
+    # a REAL train loop re-gathers the params it just updated — the
+    # operand changes per iteration, so this is not the pitfall
+    body = """
+def train(params, step):
+    for i in range(100):
+        full = lax.all_gather(params, "data")
+        params = step(full)
+    return params
+"""
+    assert "gather-in-step-loop" not in names(run(body))
+
+
+def test_gather_in_step_loop_flags_psum():
+    body = """
+def train(ref_grads, apply):
+    out = []
+    for i in range(10):
+        g = jax.lax.psum(ref_grads, "data")
+        out.append(apply(g))
+    return out
+"""
+    assert "gather-in-step-loop" in names(run(body))
+
+
+def test_gather_in_step_loop_skips_traced_loops():
+    # inside jit, loop-invariant collectives are XLA's to hoist
+    body = """
+@jax.jit
+def f(x):
+    for i in range(4):
+        y = lax.all_gather(x, "data")
+    return y
+"""
+    assert "gather-in-step-loop" not in names(run(body))
 
 
 def test_case_table_covers_every_shipped_rule():
