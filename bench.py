@@ -464,6 +464,15 @@ def main():
     # CPU smoke runs unless forced.
     if _row_enabled("BENCH_ZERO", platform):
         result.update(_bench_zero())
+    # seventh tracked row: PRECISION — mixed precision as a policy
+    # (bigdl_tpu.precision). ResNet f32 vs bf16_mixed train imgs/sec at
+    # K scanned steps, TransformerLM tokens/sec both regimes, and f32
+    # vs calibrated-int8 serving imgs/sec with the accuracy delta the
+    # serving gate would enforce. Skipped on CPU smoke runs unless
+    # forced — bf16 emulates (slowly) on CPU, so the CPU number reports
+    # the measured delta, not a win.
+    if _row_enabled("BENCH_PRECISION", platform):
+        result.update(_bench_precision())
     print(json.dumps(result))
     _maybe_metrics_snapshot(result)
 
@@ -830,6 +839,190 @@ def _bench_zero():
     row["zero_opt_state_reduction_stage2"] = round(
         row["zero_stage0_opt_state_bytes_per_chip"]
         / max(1, row["zero_stage2_opt_state_bytes_per_chip"]), 2)
+    return row
+
+
+def _bench_precision():
+    """PRECISION row: what the precision policy buys, as scoreboard
+    numbers.
+
+    Leg 1 — ResNet training (depth BENCH_PREC_DEPTH; 50 = the ImageNet
+    north-star, smoke tests shrink it) under ``f32`` vs ``bf16_mixed``
+    at K scanned steps per dispatch: identical program, identical data
+    keys, only the policy differs — the ratio is the bf16 win. Leg 2 —
+    TransformerLM train tokens/sec under both regimes. Leg 3 — serving:
+    f32 forward vs CALIBRATED int8 (activation scales from
+    ``precision.calibrate`` over real calibration batches), imgs/sec
+    plus the top-1 agreement delta measured by the same ``AccuracyGate``
+    the registry's quantized loads enforce — the delta in this row is
+    the number the gate would compare against its bound."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.models import ResNet, TransformerLM
+    from bigdl_tpu.optim import SGD
+    from bigdl_tpu.optim.optimizer import build_train_step
+    from bigdl_tpu.precision import AccuracyGate, PrecisionPolicy
+    from bigdl_tpu.utils.random import RandomGenerator
+
+    scan = int(os.environ.get("BENCH_SCAN", 8))
+    iters = int(os.environ.get("BENCH_ITERS", 6))
+    depth = int(os.environ.get("BENCH_PREC_DEPTH", 50))
+    batch = int(os.environ.get("BENCH_PREC_BATCH", 64))
+    dataset = "ImageNet" if depth >= 50 else "CIFAR10"
+    classes = 1000 if depth >= 50 else 10
+    hw = 224 if depth >= 50 else 32
+    row = {"precision_window_k": scan, "precision_resnet_depth": depth,
+           "precision_batch": batch}
+
+    def resnet_leg(policy) -> float:
+        RandomGenerator.set_seed(17)
+        model = ResNet(classes, depth=depth, dataset=dataset).training()
+        model.ensure_initialized()
+        optim = SGD(learning_rate=0.1, momentum=0.9)
+        params = model.get_parameters()
+        opt_state = optim.init_state(params)
+        step = build_train_step(model, nn.CrossEntropyCriterion(), optim,
+                                precision=policy)
+
+        def scan_body(carry, key):
+            params, opt_state, mstate = carry
+            kx, ky, kr = jax.random.split(key, 3)
+            x = jax.random.uniform(kx, (batch, 3, hw, hw), jnp.float32)
+            y = jax.random.randint(ky, (batch,), 1, classes + 1) \
+                .astype(jnp.float32)
+            params, opt_state, mstate, loss = step(
+                params, opt_state, mstate, kr, 0.1, x, y)
+            return (params, opt_state, mstate), loss
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def run_chunk(carry, keys):
+            return lax.scan(scan_body, carry, keys)
+
+        root = jax.random.PRNGKey(4)
+        carry = (params, opt_state, model.get_state())
+        carry, losses = run_chunk(carry, jax.random.split(root, scan))
+        float(losses.sum())  # compile + warmup outside the clock
+        t0 = time.time()
+        for i in range(iters):
+            carry, losses = run_chunk(
+                carry, jax.random.split(jax.random.fold_in(root, i + 1),
+                                        scan))
+        float(losses.sum())
+        return batch * scan * iters / (time.time() - t0)
+
+    f32 = resnet_leg(PrecisionPolicy.f32())
+    bf16 = resnet_leg(PrecisionPolicy.bf16_mixed())
+    row["precision_resnet_f32_imgs_per_sec"] = round(f32, 2)
+    row["precision_resnet_bf16_imgs_per_sec"] = round(bf16, 2)
+    row["precision_resnet_bf16_speedup"] = round(bf16 / f32, 3)
+
+    # ---- TransformerLM tokens/sec, both regimes ------------------------
+    vocab = int(os.environ.get("BENCH_PREC_VOCAB", 4096))
+    hidden = int(os.environ.get("BENCH_PREC_HIDDEN", 256))
+    layers = int(os.environ.get("BENCH_PREC_LAYERS", 4))
+    seq = int(os.environ.get("BENCH_PREC_SEQ", 256))
+    lm_batch = int(os.environ.get("BENCH_PREC_LM_BATCH", 8))
+
+    def tlm_leg(policy) -> float:
+        RandomGenerator.set_seed(19)
+        model = TransformerLM(vocab_size=vocab, hidden_size=hidden,
+                              num_layers=layers, num_heads=8,
+                              max_len=seq).training()
+        model.ensure_initialized()
+        optim = SGD(learning_rate=0.1)
+        crit = nn.SequenceCrossEntropyCriterion(ignore_index=-1)
+        step = build_train_step(model, crit, optim, precision=policy)
+        params = model.get_parameters()
+        opt_state = optim.init_state(params)
+
+        def scan_body(carry, key):
+            params, opt_state, mstate = carry
+            kx, kr = jax.random.split(key)
+            toks = jax.random.randint(kx, (lm_batch, seq), 1, vocab)
+            tgt = jnp.roll(toks, -1, axis=1)
+            params, opt_state, mstate, loss = step(
+                params, opt_state, mstate, kr, 0.1, toks, tgt)
+            return (params, opt_state, mstate), loss
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def run_chunk(carry, keys):
+            return lax.scan(scan_body, carry, keys)
+
+        root = jax.random.PRNGKey(5)
+        carry = (params, opt_state, model.get_state())
+        carry, losses = run_chunk(carry, jax.random.split(root, scan))
+        float(losses.sum())
+        t0 = time.time()
+        for i in range(iters):
+            carry, losses = run_chunk(
+                carry, jax.random.split(jax.random.fold_in(root, i + 1),
+                                        scan))
+        float(losses.sum())
+        return lm_batch * seq * scan * iters / (time.time() - t0)
+
+    tf32 = tlm_leg(PrecisionPolicy.f32())
+    tbf16 = tlm_leg(PrecisionPolicy.bf16_mixed())
+    row["precision_tlm_f32_tokens_per_sec"] = round(tf32, 1)
+    row["precision_tlm_bf16_tokens_per_sec"] = round(tbf16, 1)
+    row["precision_tlm_bf16_speedup"] = round(tbf16 / tf32, 3)
+
+    # ---- serving: f32 vs calibrated int8 -------------------------------
+    from bigdl_tpu.nn.quantized import quantize
+    from bigdl_tpu.precision.calibrate import collect_activation_scales
+    from bigdl_tpu.tools.synthetic import seeded_rng
+
+    RandomGenerator.set_seed(23)
+    fmodel = ResNet(classes, depth=depth, dataset=dataset).evaluate()
+    fmodel.ensure_initialized()
+    r = seeded_rng(24)
+    calib = [r.rand(min(batch, 16), 3, hw, hw).astype(np.float32)
+             for _ in range(2)]
+    scales = collect_activation_scales(fmodel, calib)
+    qmodel = quantize(fmodel, act_scales=scales)
+
+    def serve_leg(model) -> float:
+        params, mstate = model.get_parameters(), model.get_state()
+
+        def scan_body(carry, key):
+            x = jax.random.uniform(key, (batch, 3, hw, hw), jnp.float32)
+            out, _ = model.apply(params, mstate, x, training=False)
+            return carry + out[0, 0].astype(jnp.float32), None
+
+        @jax.jit
+        def run_chunk(carry, keys):
+            return lax.scan(scan_body, carry, keys)
+
+        root = jax.random.PRNGKey(6)
+        carry = jnp.zeros((), jnp.float32)
+        carry, _ = run_chunk(carry, jax.random.split(root, scan))
+        float(carry)
+        t0 = time.time()
+        for i in range(iters):
+            carry, _ = run_chunk(carry, jax.random.split(
+                jax.random.fold_in(root, i + 1), scan))
+        float(carry)
+        return batch * scan * iters / (time.time() - t0)
+
+    sf32 = serve_leg(fmodel)
+    sint8 = serve_leg(qmodel)
+    # the SAME gate the registry's quantized loads enforce; agreement
+    # mode (no labels) — delta is the top-1 disagreement rate
+    gate = AccuracyGate(
+        inputs=r.rand(int(os.environ.get("BENCH_PREC_GATE_N", 64)),
+                      3, hw, hw).astype(np.float32),
+        max_delta=float(os.environ.get("BENCH_PREC_GATE", 0.02)))
+    delta = gate.evaluate(fmodel, qmodel)
+    row["precision_serving_f32_imgs_per_sec"] = round(sf32, 2)
+    row["precision_serving_int8_imgs_per_sec"] = round(sint8, 2)
+    row["precision_serving_int8_speedup"] = round(sint8 / sf32, 3)
+    row["precision_int8_accuracy_delta"] = round(delta, 4)
+    row["precision_int8_gate_max_delta"] = gate.max_delta
     return row
 
 

@@ -190,6 +190,97 @@ def gather_in_step_loop(ctx: FileContext):
             stack.extend(ast.iter_child_nodes(node))
 
 
+#: spellings of an explicit float32 target in astype()/asarray(dtype=)
+_F32_NAMES = frozenset({"jax.numpy.float32", "numpy.float32"})
+
+
+def _is_f32_target(ctx: FileContext, node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return node.value in ("float32", "f32")
+    return ctx.canon(node) in _F32_NAMES
+
+
+def _precision_surface(ctx: FileContext) -> bool:
+    """True when the file participates in the precision-policy surface:
+    it imports ``bigdl_tpu.precision`` (policy consumers — the
+    optimizer, serving loads) or defines Module-ish classes whose
+    apply/forward_fn run under the policy's compute dtype (the nn
+    layers and models)."""
+    for node in ctx.walk(ast.Import):
+        if any(a.name.startswith("bigdl_tpu.precision")
+               for a in node.names):
+            return True
+    for node in ctx.walk(ast.ImportFrom):
+        if node.module and node.module.startswith("bigdl_tpu.precision"):
+            return True
+    return bool(ctx._moduleish_classes())
+
+
+@rule("implicit-upcast-in-trace",
+      "silent float32 upcast of a traced value under a precision policy")
+def implicit_upcast_in_trace(ctx: FileContext):
+    """Flags ``x.astype(jnp.float32)`` / ``x.astype("float32")``,
+    ``jnp.float32(x)`` and dtype-less ``jnp.asarray(x)`` over traced
+    values inside traced code of files on the precision-policy surface
+    (they import ``bigdl_tpu.precision`` or define Module-ish layers).
+
+    Under a ``bf16_mixed``/``f16_mixed`` policy these quietly promote
+    the whole downstream graph back to f32 — the matmuls run full-width
+    again and the policy's 2x is gone, with no error anywhere. The
+    SANCTIONED f32 islands (norm statistics, softmax, the loss, the
+    gradient-norm accumulator, the loss scaler) stay f32 by design and
+    carry ``# bigdl: disable=implicit-upcast-in-trace`` so every one is
+    auditable. A dtype-less ``jnp.asarray`` is flagged only when a
+    traced value flows in: over host constants it is trace-time
+    folding, not an upcast."""
+    if not _imports_jax(ctx) or not _precision_surface(ctx):
+        return
+    for node in ctx.walk(ast.Call):
+        if not ctx.in_traced(node):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "astype" \
+                and node.args and _is_f32_target(ctx, node.args[0]):
+            yield node, (
+                "`.astype(float32)` in traced layer code upcasts the "
+                "value — and everything computed from it — out of the "
+                "policy's compute dtype; keep the compute dtype "
+                "(`x.dtype`), or mark a sanctioned f32 island "
+                "(norm stats / softmax / loss / scaler) with "
+                "`# bigdl: disable=implicit-upcast-in-trace`")
+            continue
+        c = ctx.canon(f)
+        if c in _F32_NAMES and node.args:
+            # jnp.float32(x) over a TRACED value upcasts it; over a
+            # host literal (eps constants, scan carry inits) it is
+            # trace-time constant folding — same exemption dtype-less
+            # asarray gets below
+            fn = ctx.enclosing(node, ast.FunctionDef,
+                               ast.AsyncFunctionDef, ast.Lambda)
+            known = ctx.traced_vars(fn) if fn is not None else set()
+            if ctx._is_arrayish(node.args[0], known):
+                yield node, (
+                    f"`{c}(...)` upcasts a traced value to float32; "
+                    "derive the dtype from the operand (`x.dtype`) so "
+                    "the precision policy's compute dtype survives, or "
+                    "mark a sanctioned f32 island with "
+                    "`# bigdl: disable=implicit-upcast-in-trace`")
+            continue
+        if c == "jax.numpy.asarray" and node.args \
+                and len(node.args) < 2 \
+                and not any(kw.arg == "dtype" for kw in node.keywords):
+            fn = ctx.enclosing(node, ast.FunctionDef,
+                               ast.AsyncFunctionDef, ast.Lambda)
+            known = ctx.traced_vars(fn) if fn is not None else set()
+            if ctx._is_arrayish(node.args[0], known):
+                yield node, (
+                    "dtype-less `jnp.asarray` on a traced value "
+                    "defaults weakly-typed operands to float32 and "
+                    "silently widens the policy's compute dtype; pass "
+                    "`dtype=x.dtype` (or mark a sanctioned island with "
+                    "`# bigdl: disable=implicit-upcast-in-trace`)")
+
+
 @rule("sync-in-loop",
       "per-iteration host-device sync inside a host step loop")
 def sync_in_loop(ctx: FileContext):

@@ -50,12 +50,15 @@ class Diagnostic:
     message: str             # first line of the underlying error
     severity: str = "error"  # "error" fails the check; "warning" does not
     input_shapes: Optional[str] = None
+    policy: Optional[str] = None  # the precision regime checked under
 
     def __str__(self) -> str:
         loc = f"`{self.path}` ({self.layer})"
         msg = f"{loc}: {self.message}"
         if self.input_shapes:
             msg += f" [input: {self.input_shapes}]"
+        if self.policy:
+            msg += f" [policy: {self.policy}]"
         return msg
 
 
@@ -298,7 +301,8 @@ class _Interceptor:
 
 # ------------------------------------------------------------------- driver
 
-def _run_abstract(module: Module, structs, training: bool) -> ShapeReport:
+def _run_abstract(module: Module, structs, training: bool,
+                  policy=None) -> ShapeReport:
     # the PRNG key enters as an abstract spec too, so nothing — params,
     # state, key, forward — ever materializes or compiles
     key_spec = jax.eval_shape(jax.random.PRNGKey,
@@ -310,6 +314,13 @@ def _run_abstract(module: Module, structs, training: bool) -> ShapeReport:
         ki, kr = jax.random.split(key)
         params = module.init(ki)
         state = module.initial_state()
+        if policy is not None and not policy.is_noop:
+            # trace the graph exactly as the policy's train/eval step
+            # would run it: params and inputs cast to compute dtype on
+            # entry, output cast on exit — so the abstract dtypes the
+            # diagnostics print are the dtypes the compile would see
+            return policy.apply_module(module, params, state, x,
+                                       training=training, rng=kr)
         return module.apply(params, state, x, training=training, rng=kr)
 
     with _Interceptor(module):
@@ -325,7 +336,7 @@ def _run_abstract(module: Module, structs, training: bool) -> ShapeReport:
 
 
 def check_module(module: Module, input_spec, *, training: bool = False,
-                 probe_batch: int = 4) -> ShapeReport:
+                 probe_batch: int = 4, policy=None) -> ShapeReport:
     """Shape/dtype-check ``module`` against ``input_spec`` without any
     compilation or FLOPs.
 
@@ -334,16 +345,38 @@ def check_module(module: Module, input_spec, *, training: bool = False,
     Symbolic dims (strings / None) prove the graph for every batch size;
     if a layer cannot trace symbolically the checker retries with
     ``probe_batch`` and downgrades the symbolic failure to a warning.
+
+    ``policy`` (a ``precision.PrecisionPolicy``) traces the graph under
+    that mixed-precision regime: floating input specs are re-dtyped to
+    ``compute_dtype``, params cast on entry exactly like the compiled
+    step, and every diagnostic carries the policy's dtypes — so layer
+    paths in the report show the bf16/f16 dtypes the real compile
+    would see.
     """
     pairs = _normalize(input_spec)
+    if policy is not None and not policy.is_noop:
+        pairs = [(shape,
+                  policy.compute_dtype
+                  if jnp.issubdtype(dt, jnp.floating) else dt)
+                 for shape, dt in pairs]
+
+    def tag(report: ShapeReport) -> ShapeReport:
+        if policy is not None and not policy.is_noop:
+            note = (f"{policy.name}: param={policy.param_dtype.name} "
+                    f"compute={policy.compute_dtype.name} "
+                    f"accum={policy.accum_dtype.name}")
+            for d in report.diagnostics:
+                d.policy = note
+        return report
+
     structs, had_symbolic = _build_structs(pairs, concrete_batch=None)
-    report = _run_abstract(module, structs, training)
+    report = _run_abstract(module, structs, training, policy)
     report.symbolic = had_symbolic
     if report.ok or not had_symbolic:
-        return report
+        return tag(report)
     # disambiguate "mis-wired model" from "layer can't trace symbolically"
     concrete, _ = _build_structs(pairs, concrete_batch=probe_batch)
-    retry = _run_abstract(module, concrete, training)
+    retry = _run_abstract(module, concrete, training, policy)
     if retry.ok:
         first = report.diagnostics[0]
         retry.diagnostics.append(Diagnostic(
@@ -351,5 +384,4 @@ def check_module(module: Module, input_spec, *, training: bool = False,
             message="traces with a concrete batch but not with a "
                     f"symbolic batch dim ({first.message})"))
         retry.symbolic = False
-        return retry
-    return retry
+    return tag(retry)

@@ -222,7 +222,8 @@ class PipelinedTransformerLM(Module):
             frac = jax.lax.pmean(frac, self.ring_axis)
             meanp = jax.lax.pmean(meanp, self.ring_axis)
         aux = X * jnp.sum(frac * meanp)
-        return out, aux.astype(jnp.float32)
+        # aux loss is a sanctioned f32 island (summed into the loss)
+        return out, aux.astype(jnp.float32)  # bigdl: disable=implicit-upcast-in-trace
 
     def _block_aux(self, lp, h):
         """One pre-norm transformer block returning (h, aux). lp: this

@@ -314,8 +314,9 @@ class GaussianSampler(Module):
 
     def forward_fn(self, params, input, *, training=False, rng=None):
         mean, log_var = list(input)[:2]  # Table (1-based) or plain list
-        mean = jnp.asarray(mean)
-        log_var = jnp.asarray(log_var)
+        # Table normalization — dtype-preserving for array inputs
+        mean = jnp.asarray(mean)  # bigdl: disable=implicit-upcast-in-trace
+        log_var = jnp.asarray(log_var)  # bigdl: disable=implicit-upcast-in-trace
         if rng is None:
             raise ValueError("GaussianSampler requires an rng")
         eps = jax.random.normal(rng, mean.shape, mean.dtype)

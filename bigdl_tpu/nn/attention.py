@@ -75,14 +75,20 @@ def dot_product_attention(q, k, v, *, causal: bool = False, mask=None,
         out = _flash_attention_tpu(q, k, v, causal)
         if out is not None:
             return out
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(d)
+    # softmax is a sanctioned f32 island under every precision policy:
+    # the QK contraction accumulates f32 on the MXU
+    # (preferred_element_type costs nothing) and the exp/normalize run
+    # in f32 — bf16 softmax saturates long-context score rows; the
+    # weights return to v.dtype so the PV matmul stays in compute dtype
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) / math.sqrt(d)
     if causal:
         sq, sk = scores.shape[-2], scores.shape[-1]
         cmask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
         scores = jnp.where(cmask, scores, jnp.finfo(scores.dtype).min)
     if mask is not None:
         scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
-    weights = jax.nn.softmax(scores, axis=-1)
+    weights = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
     if training and dropout_rate > 0.0 and rng is not None:
         keep = jax.random.bernoulli(rng, 1.0 - dropout_rate, weights.shape)
         weights = weights * keep / (1.0 - dropout_rate)

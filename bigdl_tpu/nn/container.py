@@ -229,7 +229,8 @@ class MixtureTable(Module):
 
     def forward_fn(self, params, input, *, training=False, rng=None):
         gater, experts = list(input)[:2]  # Table (1-based) or plain list
-        gater = jnp.asarray(gater)
+        # Table normalization — dtype-preserving for array inputs
+        gater = jnp.asarray(gater)  # bigdl: disable=implicit-upcast-in-trace
         if isinstance(experts, (Table, list, tuple)):
             stacked = jnp.stack([jnp.asarray(e) for e in experts],
                                 axis=1)  # [B, E, ...]

@@ -59,7 +59,8 @@ class MergeOps(Module):
 
     @staticmethod
     def select(pred, true_v, false_v):
-        pred = jnp.asarray(pred)
+        # predicate normalization (cast to bool below — never an upcast)
+        pred = jnp.asarray(pred)  # bigdl: disable=implicit-upcast-in-trace
         return lax.select(
             jnp.broadcast_to(pred.astype(bool), jnp.shape(true_v)),
             jnp.asarray(true_v), jnp.asarray(false_v))

@@ -361,8 +361,9 @@ class GaussianCriterion(Criterion):
     (nn/GaussianCriterion.scala)."""
 
     def apply(self, input, target):
-        mean, log_var = (jnp.asarray(v) for v in list(input)[:2])
-        target = jnp.asarray(target)
+        # loss math is a sanctioned f32 island
+        mean, log_var = (jnp.asarray(v) for v in list(input)[:2])  # bigdl: disable=implicit-upcast-in-trace
+        target = jnp.asarray(target)  # bigdl: disable=implicit-upcast-in-trace
         nll = 0.5 * (jnp.log(2 * jnp.pi) + log_var
                      + (target - mean) ** 2 / jnp.exp(log_var))
         return jnp.sum(nll)

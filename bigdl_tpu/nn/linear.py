@@ -13,6 +13,7 @@ import jax.numpy as jnp
 
 from bigdl_tpu.nn.initialization import InitializationMethod, RandomUniform, Zeros
 from bigdl_tpu.nn.module import Module
+from bigdl_tpu.precision.policy import matmul_accum_dtype
 from bigdl_tpu.utils.engine import Engine
 
 
@@ -59,8 +60,13 @@ class Linear(Module):
         squeeze = x.ndim == 1
         if squeeze:
             x = x[None, :]
+        # low-precision inputs ask the MXU for its native f32
+        # accumulator (matmul_accum_dtype) and round once at the end —
+        # f32 inputs keep the exact pre-policy program
         y = jnp.dot(x, params["weight"].T,
-                    preferred_element_type=x.dtype)
+                    preferred_element_type=matmul_accum_dtype(x.dtype))
+        if y.dtype != x.dtype:
+            y = y.astype(x.dtype)
         if self.with_bias:
             y = y + params["bias"]
         return y[0] if squeeze else y

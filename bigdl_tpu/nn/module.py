@@ -253,7 +253,7 @@ class Module:
 
     # ---- pre-compile checking -------------------------------------------
     def check(self, input_spec, *, training: bool = False,
-              raise_on_error: bool = True):
+              raise_on_error: bool = True, policy=None):
         """Shape/dtype-check this module against ``input_spec`` BEFORE any
         XLA compilation: the whole graph is walked under ``jax.eval_shape``
         (zero FLOPs, milliseconds) and a mis-wiring is rejected with a
@@ -263,12 +263,17 @@ class Module:
         ``input_spec`` is ``analysis.spec(shape, dtype)``, a bare shape
         tuple (float32), or a list of those for multi-input modules;
         string/None dims are symbolic (checked for every batch size).
-        Returns an ``analysis.ShapeReport``; raises ``ShapeCheckError``
-        on failure unless ``raise_on_error=False``.
+        ``policy`` (a ``precision.PrecisionPolicy``) checks the graph
+        under that mixed-precision regime: params/inputs trace in
+        ``compute_dtype`` and layer-path diagnostics report the
+        policy's dtypes, so a bf16 wiring problem surfaces before the
+        bf16 compile. Returns an ``analysis.ShapeReport``; raises
+        ``ShapeCheckError`` on failure unless ``raise_on_error=False``.
         """
         from bigdl_tpu.analysis.shapecheck import (ShapeCheckError,
                                                    check_module)
-        report = check_module(self, input_spec, training=training)
+        report = check_module(self, input_spec, training=training,
+                              policy=policy)
         if raise_on_error and not report.ok:
             raise ShapeCheckError(report.diagnostics)
         return report

@@ -76,8 +76,11 @@ class BatchNormalization(Module):
             # One-pass stats, f32-accumulated: E[x²]-E[x]² instead of the
             # two-pass mean-then-squared-diff — halves the serial reduce
             # stages and the activation reads (matters doubly in bf16).
-            x32 = x.astype(jnp.float32)  # fuses into the reduces: converts
-            # in-register, so squares are exact-f32 before accumulation
+            # norm stats are a sanctioned f32 island under every
+            # precision policy; the cast fuses into the reduces
+            # (converts in-register, squares exact-f32 before
+            # accumulation)
+            x32 = x.astype(jnp.float32)  # bigdl: disable=implicit-upcast-in-trace
             mean32 = jnp.mean(x32, axis=axes)
             ex2 = jnp.mean(jnp.square(x32), axis=axes)
             var32 = jnp.maximum(ex2 - jnp.square(mean32), 0.0)
@@ -273,9 +276,14 @@ class LayerNorm(Module):
 
     def forward_fn(self, params, input, *, training=False, rng=None):
         x = input
-        mu = jnp.mean(x, axis=-1, keepdims=True)
-        var = jnp.var(x, axis=-1, keepdims=True)
-        y = (x - mu) * jax.lax.rsqrt(var + self.eps)
+        # sanctioned f32 island: LayerNorm statistics accumulate in f32
+        # under every precision policy (bf16 mean/var drift visibly at
+        # transformer widths); the normalized value returns to x.dtype
+        # before the affine so activations stay in compute dtype
+        x32 = x.astype(jnp.float32)  # bigdl: disable=implicit-upcast-in-trace
+        mu = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.var(x32, axis=-1, keepdims=True)
+        y = ((x32 - mu) * jax.lax.rsqrt(var + self.eps)).astype(x.dtype)
         if self.elementwise_affine:
             y = y * params["weight"] + params["bias"]
         return y
@@ -296,5 +304,8 @@ class RMSNorm(Module):
 
     def forward_fn(self, params, input, *, training=False, rng=None):
         x = input
-        ms = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
-        return x * jax.lax.rsqrt(ms + self.eps) * params["weight"]
+        # sanctioned f32 island: the mean-square accumulates in f32
+        x32 = x.astype(jnp.float32)  # bigdl: disable=implicit-upcast-in-trace
+        ms = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+        inv = jax.lax.rsqrt(ms + self.eps).astype(x.dtype)
+        return x * inv * params["weight"]

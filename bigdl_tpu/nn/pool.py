@@ -177,7 +177,8 @@ class RoiPooling(Module):
 
     def forward_fn(self, params, input, *, training=False, rng=None):
         import jax
-        data, rois = (jnp.asarray(v) for v in list(input)[:2])
+        # Table normalization — dtype-preserving for array inputs
+        data, rois = (jnp.asarray(v) for v in list(input)[:2])  # bigdl: disable=implicit-upcast-in-trace
         N, C, H, W = data.shape
 
         def pool_one(roi):

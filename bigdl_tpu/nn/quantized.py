@@ -38,12 +38,19 @@ class QuantizedLinear(Module):
         self._qparams = None  # set by from_float
 
     @classmethod
-    def from_float(cls, linear, params) -> "QuantizedLinear":
+    def from_float(cls, linear, params,
+                   act_scale: Optional[float] = None) -> "QuantizedLinear":
+        """``act_scale`` (a calibrated per-tensor activation scale from
+        ``precision/calibrate.py``) switches the layer from dynamic
+        per-batch activation quantization to the static calibrated
+        path — no amax reduce on the serving hot path."""
         m = cls(linear.input_size, linear.output_size, linear.with_bias)
         w = np.asarray(params["weight"], np.float32)
         q, scale = quantize_symmetric(w, axis=0)
         m._qparams = {"weight_q": np.asarray(q),
                       "w_scale": np.asarray(scale).reshape(-1)}
+        if act_scale is not None:
+            m._qparams["act_scale"] = np.float32(act_scale)
         if linear.with_bias and "bias" in params:
             m._qparams["bias"] = np.asarray(params["bias"], np.float32)
         if linear._name:
@@ -74,17 +81,28 @@ class QuantizedLinear(Module):
 
     def _dispatch(self, x2, params):
         bias = params.get("bias")
+        act_scale = params.get("act_scale")
         m, k = x2.shape
         n = self.output_size
         if (jax.default_backend() == "tpu" and m % 256 == 0
                 and n % 256 == 0 and k % 512 == 0):
             from bigdl_tpu.ops.pallas_kernels import pallas_quantized_matmul
-            x_q, x_scale = quantize_symmetric(x2.astype(jnp.float32), axis=0)
+            from bigdl_tpu.ops.quant import quantize_with_scale
+            # int8 dequant math is f32 by contract (BigQuant rescale)
+            x32 = x2.astype(jnp.float32)  # bigdl: disable=implicit-upcast-in-trace
+            if act_scale is None:
+                x_q, x_scale = quantize_symmetric(x32, axis=0)
+                x_scale = x_scale.reshape(-1)
+            else:
+                # int8 dequant math is f32 by contract
+                x_scale = jnp.broadcast_to(
+                    act_scale.astype(jnp.float32), (m,))  # bigdl: disable=implicit-upcast-in-trace
+                x_q = quantize_with_scale(x32, x_scale.reshape(-1, 1))
             return pallas_quantized_matmul(
-                x_q, params["weight_q"], x_scale.reshape(-1),
+                x_q, params["weight_q"], x_scale,
                 params["w_scale"], bias)
         return quantized_linear(x2, params["weight_q"], params["w_scale"],
-                                bias)
+                                bias, x_scale=act_scale)
 
 
 class QuantizedSpatialConvolution(Module):
@@ -108,7 +126,9 @@ class QuantizedSpatialConvolution(Module):
         self._qparams = None
 
     @classmethod
-    def from_float(cls, conv, params) -> "QuantizedSpatialConvolution":
+    def from_float(cls, conv, params,
+                   act_scale: Optional[float] = None
+                   ) -> "QuantizedSpatialConvolution":
         m = cls(conv.n_input_plane, conv.n_output_plane, conv.kernel_w,
                 conv.kernel_h, conv.stride_w, conv.stride_h, conv.pad_w,
                 conv.pad_h, conv.n_group,
@@ -118,6 +138,8 @@ class QuantizedSpatialConvolution(Module):
         q, scale = quantize_symmetric(w, axis=0)      # per-out-channel
         m._qparams = {"weight_q": np.asarray(q),
                       "w_scale": np.asarray(scale).reshape(-1)}
+        if act_scale is not None:
+            m._qparams["act_scale"] = np.float32(act_scale)
         if conv.with_bias and "bias" in params:
             m._qparams["bias"] = np.asarray(params["bias"], np.float32)
         if conv._name:
@@ -139,11 +161,12 @@ class QuantizedSpatialConvolution(Module):
         if squeeze:
             x = x[None]
         if self.dilation_w != 1 or self.dilation_h != 1:
-            # dilated path: fall back to float conv on dequantized weight
-            w = (params["weight_q"].astype(jnp.float32)
+            # dilated path: fall back to float conv on dequantized
+            # weight — int8 dequant math is f32 by contract
+            w = (params["weight_q"].astype(jnp.float32)  # bigdl: disable=implicit-upcast-in-trace
                  * params["w_scale"].reshape(-1, 1, 1, 1))
             out = jax.lax.conv_general_dilated(
-                x.astype(jnp.float32), w,
+                x.astype(jnp.float32), w,  # bigdl: disable=implicit-upcast-in-trace
                 window_strides=(self.stride_h, self.stride_w),
                 padding=[(self.pad_h, self.pad_h), (self.pad_w, self.pad_w)],
                 rhs_dilation=(self.dilation_h, self.dilation_w),
@@ -157,29 +180,39 @@ class QuantizedSpatialConvolution(Module):
                 params.get("bias"),
                 stride=(self.stride_h, self.stride_w),
                 padding=[(self.pad_h, self.pad_h), (self.pad_w, self.pad_w)],
-                n_group=self.n_group)
+                n_group=self.n_group,
+                x_scale=params.get("act_scale"))
         return out[0] if squeeze else out
 
 
-def quantize(model: Module) -> Module:
+def quantize(model: Module, act_scales=None) -> Module:
     """Rewrite a trained model for int8 inference
     (Quantization.scala:168). Returns a NEW module tree; the original is
-    untouched. Only inference makes sense afterwards."""
+    untouched. Only inference makes sense afterwards.
+
+    ``act_scales`` — optional ``{id(module): activation_scale}`` from
+    ``precision.calibrate.collect_activation_scales``: calibrated layers
+    bake their static activation scale in (the registry's
+    ``load(quantize=True, calibration=...)`` path); absent layers keep
+    the dynamic per-batch estimate."""
     from bigdl_tpu.nn.container import Container
     from bigdl_tpu.nn.conv import SpatialConvolution
     from bigdl_tpu.nn.graph import Graph
     from bigdl_tpu.nn.linear import Linear
 
     model.ensure_initialized()
+    act_scales = act_scales or {}
 
     def convert(m: Module, params, state):
         """Returns (new_module, new_params, new_state) — trained float
         params/state carry over unchanged for layers that stay float."""
         if isinstance(m, Linear):
-            qm = QuantizedLinear.from_float(m, params)
+            qm = QuantizedLinear.from_float(m, params,
+                                            act_scales.get(id(m)))
             return qm, qm.init(None), {}
         if isinstance(m, SpatialConvolution) and m.n_group == 1:
-            qm = QuantizedSpatialConvolution.from_float(m, params)
+            qm = QuantizedSpatialConvolution.from_float(
+                m, params, act_scales.get(id(m)))
             return qm, qm.init(None), {}
         if isinstance(m, Graph):
             # rebuild nodes/edges so the original graph stays untouched

@@ -80,7 +80,8 @@ class BinaryTreeLSTM(Module):
 
     def forward_fn(self, params, input, *, training=False, rng=None):
         emb, children = list(input)[:2]
-        emb = jnp.asarray(emb)
+        # Table normalization — dtype-preserving for array inputs
+        emb = jnp.asarray(emb)  # bigdl: disable=implicit-upcast-in-trace
         children = jnp.asarray(children).astype(jnp.int32)  # [n, 2]
         n = emb.shape[0]
         H = self.hidden_size

@@ -21,6 +21,21 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def scale_from_amax(amax, eps=1e-12):
+    """The ONE symmetric int8 scale rule: ``scale = max(|x|) / 127``
+    (BigQuant's max-abs scheme). Weight quantization, dynamic
+    activation quantization and offline calibration
+    (``precision/calibrate.py``) all derive their scales here, so a
+    change to the rule changes every consumer at once."""
+    return jnp.maximum(amax, eps) / 127.0
+
+
+def quantize_with_scale(x, scale):
+    """Quantize ``x`` to int8 with a precomputed ``scale`` (dynamic or
+    calibrated — the scale's provenance is the caller's choice)."""
+    return jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+
+
 def quantize_symmetric(x, axis, eps=1e-12):
     """Symmetric max-abs int8 quantization along all dims except `axis`.
 
@@ -30,8 +45,8 @@ def quantize_symmetric(x, axis, eps=1e-12):
     x = jnp.asarray(x)
     reduce_axes = tuple(i for i in range(x.ndim) if i != axis)
     amax = jnp.max(jnp.abs(x), axis=reduce_axes, keepdims=True)
-    scale = jnp.maximum(amax, eps) / 127.0
-    q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    scale = scale_from_amax(amax, eps)
+    q = quantize_with_scale(x, scale)
     return q, scale
 
 
@@ -42,11 +57,22 @@ def int8_matmul(x_q, w_q, out_dtype=jnp.int32):
         preferred_element_type=out_dtype)
 
 
-def quantized_linear(x, w_q, w_scale, bias=None, out_dtype=jnp.float32):
-    """Full mixed-precision FC: dynamic per-row activation quantization,
-    int8 GEMM, fp rescale (BigQuant MixPrecisionGEMM semantics)."""
+def quantized_linear(x, w_q, w_scale, bias=None, out_dtype=jnp.float32,
+                     x_scale=None):
+    """Full mixed-precision FC: per-row activation quantization, int8
+    GEMM, fp rescale (BigQuant MixPrecisionGEMM semantics).
+
+    ``x_scale=None`` estimates the activation scale dynamically per
+    batch (the original mix-precision behavior); a CALIBRATED scalar
+    ``x_scale`` (``precision/calibrate.py``) skips the per-request amax
+    reduce entirely — the serving hot path the accuracy gate certifies.
+    """
     x = x.astype(jnp.float32)
-    x_q, x_scale = quantize_symmetric(x, axis=0)  # per-sample rows
+    if x_scale is None:
+        x_q, x_scale = quantize_symmetric(x, axis=0)  # per-sample rows
+    else:
+        x_scale = jnp.asarray(x_scale, jnp.float32).reshape(1, 1)
+        x_q = quantize_with_scale(x, x_scale)
     acc = int8_matmul(x_q, w_q)                   # [M,N] int32
     out = acc.astype(jnp.float32) * x_scale * w_scale.reshape(1, -1)
     if bias is not None:
@@ -55,16 +81,21 @@ def quantized_linear(x, w_q, w_scale, bias=None, out_dtype=jnp.float32):
 
 
 def quantized_conv2d(x, w_q, w_scale, bias=None, *, stride, padding,
-                     n_group=1, out_dtype=jnp.float32):
+                     n_group=1, out_dtype=jnp.float32, x_scale=None):
     """Quantized NCHW conv: per-sample activation quantization, int8 conv
     with int32 accumulation, per-output-channel rescale.
 
     x [B,Cin,H,W] float; w_q [Cout,Cin/g,kh,kw] int8; w_scale [Cout].
+    A calibrated scalar ``x_scale`` replaces the per-sample dynamic
+    estimate (see :func:`quantized_linear`).
     """
     x = x.astype(jnp.float32)
-    amax = jnp.max(jnp.abs(x), axis=(1, 2, 3), keepdims=True)
-    x_scale = jnp.maximum(amax, 1e-12) / 127.0
-    x_q = jnp.clip(jnp.round(x / x_scale), -127, 127).astype(jnp.int8)
+    if x_scale is None:
+        amax = jnp.max(jnp.abs(x), axis=(1, 2, 3), keepdims=True)
+        x_scale = scale_from_amax(amax)
+    else:
+        x_scale = jnp.asarray(x_scale, jnp.float32).reshape(1, 1, 1, 1)
+    x_q = quantize_with_scale(x, x_scale)
     acc = jax.lax.conv_general_dilated(
         x_q, w_q, window_strides=stride, padding=padding,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),

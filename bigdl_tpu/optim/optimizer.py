@@ -52,6 +52,27 @@ _RECORD_COUNT = telemetry.counter("train/optimizer/records",
 _RECOVERIES = telemetry.counter(
     "train/optimizer/recoveries",
     "retry-from-checkpoint recoveries performed by optimize()")
+# mixed-precision observability (Optimizer.set_precision): the loss
+# scale and cumulative skipped steps are read off the (already-fetched)
+# scaler state once per host sync; the policy/bytes gauges are set once
+# at state layout
+_LOSS_SCALE = telemetry.gauge(
+    "train/precision/loss_scale",
+    "current dynamic loss scale (1.0 when the policy does not scale)")
+_SKIPPED_STEPS = telemetry.gauge(
+    "train/precision/skipped_steps",
+    "cumulative optimizer steps skipped on non-finite gradients")
+_POLICY_INFO = telemetry.gauge(
+    "train/precision/policy_info",
+    "active precision policy (labels carry the dtypes); value is 1")
+_PARAMS_F32_BYTES = telemetry.gauge(
+    "train/precision/params_f32_bytes_per_chip",
+    "per-chip param bytes the same layout would cost at float32 — the "
+    "'before' against train/memory/params_bytes_per_chip")
+_OPT_F32_BYTES = telemetry.gauge(
+    "train/precision/opt_state_f32_bytes_per_chip",
+    "per-chip optimizer-state bytes at float32 — the 'before' against "
+    "train/memory/opt_state_bytes_per_chip")
 
 
 class Metrics:
@@ -130,6 +151,18 @@ def _losses_list(losses, k: int):
     return [float(v) for v in _fetch_replicated(losses).reshape(-1)[:k]]
 
 
+def _record_scaler_gauges(opt_state):
+    """Refresh the loss-scale/skipped-steps gauges from the (already
+    synchronized) scaler state riding the optimizer-state tree — one
+    cheap host read per sync, no extra device fetch ordering."""
+    from bigdl_tpu.precision import SCALER_KEY
+    ss = opt_state.get(SCALER_KEY) if isinstance(opt_state, dict) else None
+    if ss is None:
+        return
+    _LOSS_SCALE.set(float(_fetch_replicated(ss["scale"])))
+    _SKIPPED_STEPS.set(float(_fetch_replicated(ss["skipped"])))
+
+
 def _window_stackable(batch: MiniBatch) -> bool:
     """True when every leaf of the MiniBatch is a dense HOST array —
     the only thing ``np.stack`` window stacking supports. Sparse COO
@@ -189,7 +222,8 @@ def build_train_step(module: Module, criterion: Criterion,
                      optim_method: OptimMethod,
                      aux_loss_weight: float = 0.01,
                      gradient_clip=None, zero=None, mesh=None,
-                     sharding_rules=None):
+                     sharding_rules=None, precision=None,
+                     loss_scaler=None):
     """The compiled hot path: loss + grad + update in one jit.
 
     Gradient normalization matches the reference (grads averaged over the
@@ -215,6 +249,20 @@ def build_train_step(module: Module, criterion: Criterion,
     each layer just in time). Every new optimizer-state leaf is pinned
     to an explicit sharding so donated-jit out-shardings can never
     silently re-replicate a shard after the first update.
+
+    ``precision`` (a ``precision.PrecisionPolicy``; None reads the
+    legacy ``Engine`` dtype knobs) compiles the mixed-precision casts
+    into the step: params/inputs cast to ``compute_dtype`` on entry,
+    gradients come back in compute dtype (so a ZeRO reduce-scatter
+    moves low-precision bytes), are cast to ``accum_dtype`` (f32) and
+    unscaled, and the update runs on the f32 weights — the params tree
+    itself when ``param_dtype`` is f32, else the f32 MASTER COPY kept
+    in the optimizer state under ``precision.MASTER_KEY``. With
+    ``loss_scaler`` (auto-created for f16 policies) the loss is scaled
+    before ``jax.grad`` and a step with non-finite gradients is
+    SKIPPED: params/optimizer state keep their previous values and the
+    scaler backs off — all inside the compiled step, so the state
+    machine rides the windowed scan carry bit-consistently.
     """
     if gradient_clip is not None and gradient_clip[0] not in (
             "constant", "l2norm"):
@@ -222,41 +270,74 @@ def build_train_step(module: Module, criterion: Criterion,
             f"gradient_clip kind must be 'constant' or 'l2norm', got "
             f"{gradient_clip[0]!r}")
     zero_active = zero is not None and zero.active_on(mesh)
+    from bigdl_tpu.precision import (MASTER_KEY, SCALER_KEY,
+                                     DynamicLossScaler, PrecisionPolicy)
+    policy = precision if precision is not None \
+        else PrecisionPolicy.from_engine()
+    scaler = None
+    if policy.needs_loss_scaling:
+        scaler = loss_scaler if loss_scaler is not None \
+            else DynamicLossScaler()
 
     def step(params, opt_state, model_state, rng, lr, inputs, targets):
-        cdtype = Engine.compute_dtype()
-        ddtype = Engine.default_dtype()
+        scaler_state = opt_state.get(SCALER_KEY) \
+            if isinstance(opt_state, dict) else None
+        master = opt_state.get(MASTER_KEY) \
+            if isinstance(opt_state, dict) else None
+        inner_opt = {k: v for k, v in opt_state.items()
+                     if k not in (SCALER_KEY, MASTER_KEY)} \
+            if isinstance(opt_state, dict) else opt_state
+        if scaler is not None and scaler_state is None:
+            raise ValueError(
+                "loss-scaling policy needs the scaler state in "
+                "opt_state[precision.SCALER_KEY]; seed it with "
+                "scaler.init_state() (Optimizer.set_precision does "
+                "this automatically)")
+        if policy.needs_master and master is None:
+            raise ValueError(
+                "low-precision param_dtype needs the f32 master copy "
+                "in opt_state[precision.MASTER_KEY] "
+                "(Optimizer.set_precision seeds it automatically)")
 
-        def maybe_cast(tree, dtype):
-            if cdtype == ddtype:
-                return tree
-            return jax.tree.map(
-                lambda a: a.astype(dtype)
-                if jnp.issubdtype(a.dtype, jnp.floating) else a, tree)
-
-        def loss_fn(p):
-            # mixed precision: compute fwd/bwd in compute_dtype (bf16 on
-            # TPU — the analogue of the reference's fp16 gradient
-            # compression, FP16CompressedTensor.scala), master params and
-            # the update stay in default_dtype.
-            p_c = maybe_cast(p, cdtype)
-            x_c = maybe_cast(inputs, cdtype)
+        def loss_fn(p_c):
+            # cast-on-entry at the step boundary: fwd/bwd run in
+            # compute_dtype (bf16 on TPU — the analogue of the
+            # reference's fp16 gradient compression,
+            # FP16CompressedTensor.scala); norm stats/softmax/loss stay
+            # f32 inside the layers; cast-on-exit hands the loss an
+            # output_dtype (f32) tensor.
+            x_c = policy.cast_to_compute(inputs)
             out, new_mstate = module.apply(p_c, model_state, x_c,
                                            training=True, rng=rng)
-            out = maybe_cast(out, ddtype)
+            out = policy.cast_output(out)
             loss = criterion.apply(out, targets)
-            reg = module.regularization_loss(p)
+            reg = module.regularization_loss(p_c)
             aux = _collect_aux_losses(new_mstate)
-            return loss + reg + aux_loss_weight * aux, (new_mstate, loss)
+            total = loss + reg + aux_loss_weight * aux
+            if scaler is not None:
+                total = scaler.scale_loss(total, scaler_state)
+            return total, (new_mstate, loss)
 
+        # grads are taken wrt the COMPUTE-dtype params, so they arrive
+        # in compute dtype — under ZeRO >= 2 the reduce-scatter below
+        # therefore moves bf16/f16 bytes, half the f32 wire traffic
+        p_c = policy.cast_to_compute(params)
         grads, (new_mstate, data_loss) = jax.grad(
-            loss_fn, has_aux=True)(params)
+            loss_fn, has_aux=True)(p_c)
         if zero_active and zero.stage >= 2:
             # the reduce-scatter point (arXiv:2004.13336): constrained
             # HERE, everything downstream — scaling, clipping, the
             # optimizer math — runs on 1/n shards
             from bigdl_tpu.parallel.zero import constrain_zero
             grads = constrain_zero(grads, mesh, zero, sharding_rules)
+        grads = policy.cast_to_accum(grads)
+        finite = None
+        if scaler is not None:
+            grads = scaler.unscale(grads, scaler_state)
+            # the skip-step probe: checked AFTER unscaling so an
+            # overflowed-scale inf is caught even when the raw f16
+            # grads were finite
+            finite = scaler.all_finite(grads)
         scales = module.param_scales(params)
         if any(s != 1.0 for s in jax.tree.leaves(scales)):
             grads = jax.tree.map(lambda g, s: g * s, grads, scales)
@@ -265,21 +346,56 @@ def build_train_step(module: Module, criterion: Criterion,
                 lo, hi = gradient_clip[1], gradient_clip[2]
                 grads = jax.tree.map(lambda g: jnp.clip(g, lo, hi),
                                      grads)
-            else:  # global L2 norm
+            else:  # global L2 norm accumulates f32 (sanctioned island)
                 nrm = jnp.sqrt(sum(
-                    jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    jnp.sum(jnp.square(g.astype(jnp.float32)))  # bigdl: disable=implicit-upcast-in-trace
                     for g in jax.tree.leaves(grads)))
                 scale = jnp.minimum(
                     1.0, gradient_clip[1] / jnp.maximum(nrm, 1e-12))
                 grads = jax.tree.map(
                     lambda g: g * scale.astype(g.dtype), grads)
-        new_params, new_opt = optim_method.update(grads, opt_state, params,
-                                                  lr)
+        # master-copy update: the f32 weights are the params tree when
+        # param_dtype is f32, else the MASTER_KEY copy; low-precision
+        # at-rest params are the master cast down after the update
+        update_base = master if master is not None else params
+        if master is None and policy.param_dtype != policy.accum_dtype:
+            # no-master low-precision policy (the legacy Engine
+            # default-dtype path): the update runs in param dtype,
+            # exactly the pre-policy program
+            from bigdl_tpu.precision import cast_floating
+            grads = cast_floating(grads, policy.param_dtype)
+        new_base, new_inner = optim_method.update(grads, inner_opt,
+                                                  update_base, lr)
+        if master is not None:
+            new_master = new_base
+            new_params = policy.cast_to_param(new_master)
+        else:
+            new_master = None
+            new_params = new_base
+        if finite is not None:
+            # skip-step select: a non-finite gradient leaves params,
+            # master and EVERY optimizer buffer (moments, Adam's t) at
+            # their previous values; only the scaler state advances
+            def keep_old(new, old):
+                return jax.tree.map(
+                    lambda n, o: jnp.where(finite, n, o), new, old)
+            new_params = keep_old(new_params, params)
+            new_inner = keep_old(new_inner, inner_opt)
+            if new_master is not None:
+                new_master = keep_old(new_master, master)
+        new_opt = dict(new_inner) if isinstance(new_inner, dict) \
+            else new_inner
+        if new_master is not None:
+            new_opt[MASTER_KEY] = new_master
+        if scaler is not None:
+            new_opt[SCALER_KEY] = scaler.next_state(scaler_state, finite)
         if zero_active:
             from bigdl_tpu.parallel.zero import (constrain_base,
                                                  constrain_zero)
             # pin EVERY fresh opt-state leaf (moments AND step
-            # counters) to its explicit sharded layout
+            # counters — and the f32 master copy, which shards exactly
+            # like the optimizer state it lives in) to its explicit
+            # sharded layout
             new_opt = constrain_zero(new_opt, mesh, zero, sharding_rules)
             if zero.stage == 3:
                 # params stay sharded at rest; each layer all-gathers
@@ -296,14 +412,24 @@ def build_train_step(module: Module, criterion: Criterion,
     return jax.jit(step, donate_argnums=(0, 1, 2))
 
 
-def build_eval_step(module: Module, out_sharding=None):
+def build_eval_step(module: Module, out_sharding=None, precision=None):
     """``out_sharding`` pins the output layout (batch-sharded over the
     data axis on a mesh): GSPMD is otherwise free to replicate the
     output, and multi-host scoring slices each process's LOCAL rows —
-    those must be the rows that process fed."""
-    def eval_step(params, model_state, inputs):
-        out, _ = module.apply(params, model_state, inputs, training=False)
-        return out
+    those must be the rows that process fed. ``precision`` (a non-noop
+    ``PrecisionPolicy``) runs the forward in compute dtype with the
+    output cast back — validation scores the precision that actually
+    trains/serves."""
+    if precision is not None and not precision.is_noop:
+        def eval_step(params, model_state, inputs):
+            out, _ = precision.apply_module(module, params, model_state,
+                                            inputs, training=False)
+            return out
+    else:
+        def eval_step(params, model_state, inputs):
+            out, _ = module.apply(params, model_state, inputs,
+                                  training=False)
+            return out
 
     return jax.jit(eval_step, out_shardings=out_sharding)
 
@@ -371,6 +497,10 @@ class Optimizer:
         # into one lax.scan dispatch, host syncs only at window
         # boundaries. 1 = the classic per-step loop.
         self.steps_per_sync = 1
+        # mixed-precision policy (set_precision); None = the legacy
+        # Engine dtype knobs (f32 unless configured)
+        self._precision = None
+        self._loss_scaler = None
         # gradient clipping (Optimizer.scala setConstantGradientClipping
         # / setGradientClippingByl2Norm); None = off
         self._gradient_clip = None
@@ -539,6 +669,39 @@ class Optimizer:
             and config.stage > 0 else None
         self.zero1 = self.zero_config is not None \
             and self.zero_config.stage == 1
+        return self
+
+    def set_precision(self, policy, scaler=None) -> "Optimizer":
+        """Mixed-precision policy for this run
+        (``precision.PrecisionPolicy``, a preset name like
+        ``"bf16_mixed"``, or None to revert to f32/Engine defaults).
+
+        The policy threads the whole stack: forward/backward compile in
+        ``compute_dtype``, gradients reduce(-scatter) in compute dtype
+        under ZeRO, the update runs on f32 weights (the f32 master copy
+        when ``param_dtype`` is low-precision), and f16 policies get a
+        ``DynamicLossScaler`` (pass ``scaler`` to tune it) whose state
+        rides the optimizer-state tree — so ``set_steps_per_sync(K)``
+        windows and ZeRO stages 1-3 compose with no further
+        configuration, and seeded K=1 vs K=8 runs stay bit-identical
+        with the scaler in the scan carry."""
+        from bigdl_tpu.precision import DynamicLossScaler, PrecisionPolicy
+        if isinstance(policy, str):
+            policy = PrecisionPolicy.named(policy)
+        if policy is not None and not isinstance(policy, PrecisionPolicy):
+            raise TypeError(
+                f"set_precision expects a PrecisionPolicy, a preset "
+                f"name or None, got {type(policy).__name__}")
+        if scaler is not None and not isinstance(scaler,
+                                                 DynamicLossScaler):
+            raise TypeError(
+                f"scaler must be a DynamicLossScaler, got "
+                f"{type(scaler).__name__}")
+        self._precision = policy
+        self._loss_scaler = scaler
+        # the compiled validation slot closed over the previous
+        # precision regime — drop it like set_model does
+        self._dc_eval = None
         return self
 
     def set_preflight_spec(self, input_spec) -> "Optimizer":
@@ -1066,22 +1229,76 @@ class Optimizer:
             if k in self.driver_state:
                 self.optim_method.state[k] = self.driver_state[k]
 
+        from bigdl_tpu.precision import (MASTER_KEY, SCALER_KEY,
+                                         DynamicLossScaler,
+                                         PrecisionPolicy)
+        policy = self._precision if self._precision is not None \
+            else PrecisionPolicy.from_engine()
+        scaler = None
+        if policy.needs_loss_scaling:
+            scaler = self._loss_scaler if self._loss_scaler is not None \
+                else DynamicLossScaler()
+        if not isinstance(opt_state, dict):  # exotic OptimMethod state
+            if policy.needs_master or scaler is not None:
+                raise ValueError(
+                    "set_precision with master weights / loss scaling "
+                    "needs a dict-shaped optimizer state (every shipped "
+                    "OptimMethod qualifies)")
+        else:
+            # a resumed checkpoint already carries these keys; a fresh
+            # run (or one resumed from a pre-policy checkpoint, whose
+            # params are f32) inserts them here
+            if policy.needs_master and MASTER_KEY not in opt_state:
+                # the f32 master copy (cast up if the module was built
+                # under a low-precision Engine default dtype)
+                opt_state[MASTER_KEY] = policy.cast_to_accum(params)
+                params = policy.cast_to_param(params)
+            if scaler is not None and SCALER_KEY not in opt_state:
+                opt_state[SCALER_KEY] = scaler.init_state()
+        if not policy.is_noop:
+            logger.info("precision policy: %s", policy.describe())
+            # value 1 marks the ACTIVE policy; series from earlier runs
+            # in this process drop to 0 so diagnose can tell them apart
+            for key in _POLICY_INFO._series():
+                _POLICY_INFO.set(0.0, **dict(key))
+            _POLICY_INFO.set(
+                1.0, policy=policy.name,
+                param=policy.param_dtype.name,
+                compute=policy.compute_dtype.name,
+                accum=policy.accum_dtype.name)
+            _LOSS_SCALE.set(float(scaler.init_scale) if scaler else 1.0)
+            _SKIPPED_STEPS.set(0.0)
+
         params = self._put_params(params)
         opt_state = self._put_opt_state(opt_state)
         model_state = self._put_replicated(model_state)
-        if self.mesh is not None:
+        if self.mesh is not None or not policy.is_noop:
             # per-chip memory proof: gauges read the PLACED shard sizes,
-            # so the n-fold ZeRO reduction is an exported number, not a
-            # claim (train/memory/*_bytes_per_chip)
-            from bigdl_tpu.parallel.zero import record_memory_gauges
+            # so the n-fold ZeRO reduction — and the low-precision
+            # params/grads shrink — are exported numbers, not claims
+            # (train/memory/*_bytes_per_chip; the f32-equivalent
+            # "before" lands in train/precision/*_f32_bytes_per_chip)
+            from bigdl_tpu.parallel.zero import (record_memory_gauges,
+                                                 tree_bytes_per_chip)
             record_memory_gauges(params, opt_state)
+            if not policy.is_noop:
+                _PARAMS_F32_BYTES.set(tree_bytes_per_chip(
+                    params, floating_as=jnp.float32))
+                _OPT_F32_BYTES.set(tree_bytes_per_chip(
+                    opt_state, floating_as=jnp.float32))
 
         step = build_train_step(model, self.criterion, self.optim_method,
                                 gradient_clip=self._gradient_clip,
                                 zero=self._active_zero(), mesh=self.mesh,
-                                sharding_rules=self.sharding_rules)
+                                sharding_rules=self.sharding_rules,
+                                precision=policy, loss_scaler=scaler)
         ev_sh = self._batch_sharding() if self.mesh is not None else None
-        eval_step = build_eval_step(model, ev_sh)
+        # validation runs under the policy only when the user OPTED IN
+        # via set_precision — the legacy Engine dtype knobs never cast
+        # eval (pre-policy validation always scored the f32 forward)
+        eval_step = build_eval_step(model, ev_sh,
+                                    precision=self._precision)
+        track_scaler = scaler is not None
 
         ds_size = self.dataset.size()
         state = self.driver_state
@@ -1445,6 +1662,8 @@ class Optimizer:
                 jax.block_until_ready((params, opt_state, model_state))  # bigdl: disable=sync-in-loop
                 loss_vals = _losses_list(losses, k_now)
                 t_compute = time.time() - t1
+                if track_scaler and telemetry.enabled():
+                    _record_scaler_gauges(opt_state)
                 if telemetry.enabled():
                     # per-WINDOW records (amortized granularity — see
                     # docs/performance.md); phase SUMS still equal the
@@ -1500,6 +1719,8 @@ class Optimizer:
             jax.block_until_ready((params, opt_state, model_state))  # bigdl: disable=sync-in-loop
             loss_f = _to_scalar(loss)
             t_compute = time.time() - t1
+            if track_scaler and telemetry.enabled():
+                _record_scaler_gauges(opt_state)
             if telemetry.enabled():
                 telemetry.record("optimizer/compute", t_compute,
                                  step=state["neval"])
@@ -1514,9 +1735,16 @@ class Optimizer:
                     self.metrics.summary())
         # write trained params back to the stateful module (multi-host
         # safe: ZeRO-1 can leave updated params data-sharded, and a
-        # spanning shard is not plain-readable — host_value reshards)
+        # spanning shard is not plain-readable — host_value reshards).
+        # Under a master-weights policy the f32 MASTER copy is the
+        # canonical result — the at-rest low-precision params are its
+        # rounding, and downstream consumers (export, further finetunes)
+        # want the full-precision weights.
         from bigdl_tpu.utils.serialization import host_value
-        model.set_parameters(jax.tree.map(host_value, params))
+        final_params = opt_state[MASTER_KEY] \
+            if isinstance(opt_state, dict) and MASTER_KEY in opt_state \
+            else params
+        model.set_parameters(jax.tree.map(host_value, final_params))
         model.set_state(jax.tree.map(host_value, model_state))
         return model
 

@@ -220,16 +220,22 @@ def constrain_base(tree, mesh: Mesh, rules: Optional[Rules] = None):
             leaf, NamedSharding(mesh, spec)), tree, specs)
 
 
-def tree_bytes_per_chip(tree) -> int:
+def tree_bytes_per_chip(tree, floating_as=None) -> int:
     """Resident bytes per chip for a (possibly sharded) pytree: each
     leaf contributes its per-device shard size — ``sharding.shard_shape``
     when the leaf carries one (live arrays and sharded
     ``jax.eval_shape`` structs), its full shape otherwise. This is what
-    the ``train/memory/*_bytes_per_chip`` gauges report."""
+    the ``train/memory/*_bytes_per_chip`` gauges report.
+
+    ``floating_as`` prices every floating leaf at that dtype instead of
+    its own — the "what would this layout cost at f32" counterfactual
+    the precision gauges report as the before number."""
     total = 0
     for leaf in jax.tree.leaves(tree):
         shape = tuple(getattr(leaf, "shape", ()) or ())
         dtype = np.dtype(getattr(leaf, "dtype", np.float32))
+        if floating_as is not None and np.issubdtype(dtype, np.floating):
+            dtype = np.dtype(floating_as)
         sharding = getattr(leaf, "sharding", None)
         if sharding is not None and hasattr(sharding, "shard_shape"):
             shape = sharding.shard_shape(shape)

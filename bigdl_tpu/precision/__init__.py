@@ -1,0 +1,42 @@
+"""Mixed-precision as a *policy* — bf16/fp16 training with dynamic loss
+scaling and calibrated int8 serving, end to end.
+
+The source system shipped a native int8 inference engine (BigQuant) and
+an fp16 gradient-compression path (FP16CompressedTensor.scala); here
+precision is one declarative object instead of scattered one-offs:
+
+- :class:`PrecisionPolicy` — the four dtypes that define a regime
+  (``param``/``compute``/``output``/``accum``) with presets ``f32``,
+  ``bf16_mixed`` and ``f16_mixed``; threaded through ``Module.apply``
+  (cast-on-entry / cast-on-exit at the step boundary, norm stats /
+  softmax / loss pinned to f32 accumulation inside the layers) and
+  ``Optimizer.set_precision`` (f32 master-copy update, low-precision
+  gradients reduce-scattered in compute dtype under ZeRO).
+- :class:`DynamicLossScaler` — the fp16 overflow state machine; its
+  state rides the donated scan carry so ``set_steps_per_sync(K)`` stays
+  bit-consistent across K.
+- :mod:`~bigdl_tpu.precision.calibrate` — the ONE scale-estimation path
+  for int8: weight scales and activation-calibration scales both derive
+  from ``ops/quant``'s symmetric max-abs rule.
+- :class:`AccuracyGate` — calibrated int8 serving loads refuse the swap
+  when the quantized model's accuracy delta exceeds the bound
+  (``serving/precision/accuracy_delta``).
+
+See ``docs/precision.md`` for the policy table and interaction rules
+with ``steps_per_sync``/ZeRO/TP.
+"""
+from bigdl_tpu.precision.calibrate import (calibrate_weight,
+                                           collect_activation_scales,
+                                           scale_from_amax)
+from bigdl_tpu.precision.gate import AccuracyGate, AccuracyGateError
+from bigdl_tpu.precision.policy import (MASTER_KEY, SCALER_KEY,
+                                        PrecisionPolicy, cast_floating,
+                                        matmul_accum_dtype)
+from bigdl_tpu.precision.scaler import DynamicLossScaler
+
+__all__ = [
+    "AccuracyGate", "AccuracyGateError", "DynamicLossScaler",
+    "MASTER_KEY", "PrecisionPolicy", "SCALER_KEY", "calibrate_weight",
+    "cast_floating", "collect_activation_scales", "matmul_accum_dtype",
+    "scale_from_amax",
+]

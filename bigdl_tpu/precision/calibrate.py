@@ -1,0 +1,128 @@
+"""Int8 calibration — the ONE scale-estimation path.
+
+Every int8 scale in the system derives from the same symmetric max-abs
+rule (``ops/quant.scale_from_amax``: ``scale = max(|x|) / 127``, the
+BigQuant scheme):
+
+- **weight scales** — :func:`calibrate_weight` (per-output-channel,
+  exactly ``ops/quant.quantize_symmetric``).
+- **activation scales** — :func:`collect_activation_scales` runs
+  calibration batches through the FLOAT model once, recording the
+  running max-abs of every quantizable layer's input; the resulting
+  per-layer scale is baked into the quantized twin, replacing the
+  per-batch dynamic estimate. Static scales are both cheaper (no amax
+  reduce + divide per request on the hot path) and the thing an
+  accuracy gate can actually certify — a dynamic scale changes with
+  every batch, so "calibrated accuracy" would be meaningless.
+
+``tools/int8_sweep`` and ``ModelRegistry.load(quantize=True,
+calibration=...)`` both go through here.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional
+
+import numpy as np
+
+from bigdl_tpu.ops.quant import quantize_symmetric, scale_from_amax
+
+__all__ = ["calibrate_weight", "collect_activation_scales",
+           "scale_from_amax"]
+
+
+def calibrate_weight(w, axis: int = 0):
+    """Per-channel symmetric int8 weight quantization along ``axis``
+    (delegates to the one ``ops/quant`` path). Returns ``(q, scale)``."""
+    return quantize_symmetric(w, axis=axis)
+
+
+def _quantizable(m) -> bool:
+    from bigdl_tpu.nn.conv import SpatialConvolution
+    from bigdl_tpu.nn.linear import Linear
+    return isinstance(m, Linear) or (
+        isinstance(m, SpatialConvolution) and m.n_group == 1)
+
+
+def _walk(m, out):
+    from bigdl_tpu.nn.container import Container
+    from bigdl_tpu.nn.graph import Graph
+    if _quantizable(m):
+        out.append(m)
+    if isinstance(m, Graph):
+        for n in m.exec_order:
+            _walk(n.element, out)
+    elif isinstance(m, Container):
+        for c in m.modules:
+            _walk(c, out)
+    else:
+        for v in vars(m).values():
+            from bigdl_tpu.nn.module import Module
+            if isinstance(v, Module):
+                _walk(v, out)
+            elif isinstance(v, (list, tuple)):
+                for e in v:
+                    if isinstance(e, Module):
+                        _walk(e, out)
+
+
+def collect_activation_scales(model,
+                              batches: Iterable) -> Dict[int, float]:
+    """Run ``batches`` through the float ``model`` (inference mode) and
+    return ``{id(module): activation_scale}`` for every quantizable
+    layer (Linear, ungrouped SpatialConvolution) — the per-tensor
+    symmetric scale of the layer's OBSERVED input range, via the shared
+    max-abs rule.
+
+    Interception mirrors ``analysis/shapecheck``: each target module's
+    bound ``apply`` is temporarily shadowed with a recording wrapper and
+    restored afterwards; the model itself is never mutated beyond the
+    transient wrapper. Keys are module identities so
+    ``nn/quantized.quantize`` can look its conversion targets up while
+    rebuilding the tree.
+    """
+    from bigdl_tpu.utils.random import RandomGenerator
+
+    targets: list = []
+    _walk(model, targets)
+    if not targets:
+        raise ValueError(
+            "model has no quantizable layers (Linear / ungrouped "
+            "SpatialConvolution); nothing to calibrate")
+    amax: Dict[int, float] = {}
+
+    def wrap(m):
+        orig = type(m).apply.__get__(m)
+
+        def recording(params, state, input, *, training=False, rng=None):
+            x = np.asarray(input)
+            peak = float(np.max(np.abs(x))) if x.size else 0.0
+            amax[id(m)] = max(amax.get(id(m), 0.0), peak)
+            return orig(params, state, input, training=training, rng=rng)
+
+        m.__dict__["apply"] = recording
+
+    model.ensure_initialized()
+    params, state = model.get_parameters(), model.get_state()
+    for m in targets:
+        wrap(m)
+    try:
+        saw_batch = False
+        for batch in batches:
+            saw_batch = True
+            model.apply(params, state, np.asarray(batch),
+                        training=False, rng=RandomGenerator.next_key())
+    finally:
+        for m in targets:
+            m.__dict__.pop("apply", None)
+    if not saw_batch:
+        raise ValueError("calibration needs at least one batch")
+    return {mid: float(np.asarray(scale_from_amax(peak)))
+            for mid, peak in amax.items()}
+
+
+def maybe_collect(model, calibration: Optional[Iterable]):
+    """``collect_activation_scales`` when ``calibration`` is given,
+    else None — the registry/quantize entry point's one-liner."""
+    if calibration is None:
+        return None
+    return collect_activation_scales(model, calibration)

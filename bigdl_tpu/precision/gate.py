@@ -1,0 +1,94 @@
+"""Accuracy-delta gate for quantized serving loads.
+
+``ModelRegistry.load(quantize=True, calibration=..., accuracy_gate=
+AccuracyGate(...))`` evaluates the candidate (quantized) model against
+the float reference on held-out batches BEFORE anything is staged: if
+the accuracy delta exceeds the configured bound the load raises
+:class:`AccuracyGateError` and the registry is untouched — no version
+registered, no program compiled, no traffic can resolve it. The
+measured delta lands in the ``serving/precision/accuracy_delta`` gauge
+either way, so dashboards see near-misses too.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+import bigdl_tpu.telemetry as telemetry
+
+_ACC_DELTA = telemetry.gauge(
+    "serving/precision/accuracy_delta",
+    "accuracy delta (reference minus candidate) measured by the last "
+    "quantized-load gate evaluation, by model label")
+
+
+class AccuracyGateError(ValueError):
+    """A quantized load's accuracy delta exceeded the gate bound; the
+    candidate was refused before staging."""
+
+
+@dataclasses.dataclass
+class AccuracyGate:
+    """Eval-batch gate for quantized loads.
+
+    ``inputs`` — held-out eval rows ``[N, features...]``.
+    ``targets`` — optional 1-based class labels ``[N]``; with targets
+    the metric is top-1 accuracy of each model and the delta is
+    ``acc_reference - acc_candidate``; without targets the metric is
+    top-1 AGREEMENT with the reference (delta = disagreement rate) —
+    no labels needed, which is the common serving case.
+    ``max_delta`` — the refusal bound (default 2 points).
+    ``batch_size`` — evaluation chunking (eager forwards).
+    """
+
+    inputs: np.ndarray
+    targets: Optional[np.ndarray] = None
+    max_delta: float = 0.02
+    batch_size: int = 64
+
+    @staticmethod
+    def _top1(model, params, state, x) -> np.ndarray:
+        out = np.asarray(model.apply(params, state, x,
+                                     training=False)[0])
+        return np.argmax(out.reshape(out.shape[0], -1), axis=1)
+
+    def evaluate(self, reference, candidate) -> float:
+        """The accuracy delta of ``candidate`` vs ``reference`` on the
+        gate's eval rows (positive = the candidate is worse)."""
+        x = np.asarray(self.inputs)
+        # one module-tree walk per model, not one per eval chunk
+        ref_ps = (reference.get_parameters(), reference.get_state())
+        cand_ps = (candidate.get_parameters(), candidate.get_state())
+        ref_hits = cand_hits = agree = 0
+        for start in range(0, x.shape[0], self.batch_size):
+            chunk = x[start:start + self.batch_size]
+            ref = self._top1(reference, *ref_ps, chunk)
+            cand = self._top1(candidate, *cand_ps, chunk)
+            if self.targets is not None:
+                t = np.asarray(self.targets).reshape(-1)[
+                    start:start + chunk.shape[0]].astype(np.int64) - 1
+                ref_hits += int((ref == t).sum())
+                cand_hits += int((cand == t).sum())
+            else:
+                agree += int((ref == cand).sum())
+        n = x.shape[0]
+        if self.targets is not None:
+            return (ref_hits - cand_hits) / n
+        return 1.0 - agree / n
+
+    def check(self, reference, candidate, *, label: str = "") -> float:
+        """Evaluate, record the gauge, and raise
+        :class:`AccuracyGateError` when the delta exceeds
+        ``max_delta``. Returns the delta on success."""
+        delta = self.evaluate(reference, candidate)
+        _ACC_DELTA.set(delta, **({"model": label} if label else {}))
+        if delta > self.max_delta:
+            raise AccuracyGateError(
+                f"quantized model refused: accuracy delta {delta:.4f} "
+                f"exceeds the gate bound {self.max_delta:.4f}"
+                + (f" for {label!r}" if label else "")
+                + " (recalibrate with representative batches, or raise "
+                  "the bound if the regression is acceptable)")
+        return delta

@@ -1,0 +1,212 @@
+"""The precision policy: four dtypes that define a training/serving
+regime.
+
+``param_dtype`` is what the weights are stored in at rest;
+``compute_dtype`` is what forward/backward matmuls run in (the MXU's
+bf16 sweet spot); ``output_dtype`` is what the model hands the loss;
+``accum_dtype`` is where reductions and the weight update accumulate —
+pinned to f32 in every preset, because that is the part low-precision
+training cannot cheapen without diverging (norm statistics, softmax,
+the loss, and the optimizer's master-copy update are the sanctioned f32
+islands).
+
+The policy is *declarative*: ``build_train_step`` reads it once and
+compiles the casts into the step, so switching ``f32`` ->
+``bf16_mixed`` is one ``Optimizer.set_precision`` call, not a model
+rewrite. When ``param_dtype`` is lower than ``accum_dtype`` the
+optimizer keeps an f32 **master copy** of the weights in its state tree
+(the classic mixed-precision recipe, and the reference's
+FP16CompressedTensor idea taken to its conclusion): gradients arrive in
+compute dtype, the update runs on the f32 master, and the served
+params are the master cast down.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+# Reserved optimizer-state keys. The dunder namespace guarantees a real
+# OptimMethod buffer can never collide: the loss-scaler state and the
+# f32 master params ride the SAME opt-state tree as the moments, so
+# they are donated into the scan carry, sharded by ZeRO's spec engine,
+# and checkpointed/resumed with zero extra plumbing.
+SCALER_KEY = "__bigdl_loss_scale__"
+MASTER_KEY = "__bigdl_master_params__"
+
+_LOW_PRECISION = (jnp.dtype(jnp.bfloat16), jnp.dtype(jnp.float16))
+
+
+def cast_floating(tree, dtype):
+    """Cast every floating leaf of ``tree`` to ``dtype``; integer/bool
+    leaves (labels, step counters, int8 weights) pass through."""
+    dtype = jnp.dtype(dtype)
+    return jax.tree.map(
+        lambda a: a.astype(dtype)
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+        and a.dtype != dtype else a, tree)
+
+
+def matmul_accum_dtype(operand_dtype):
+    """The ``preferred_element_type`` a layer should request for a
+    matmul over ``operand_dtype`` operands: f32 for bf16/f16 inputs (the
+    MXU accumulates in f32 natively — asking for it costs nothing and
+    keeps long contractions exact), the operand dtype otherwise."""
+    if jnp.dtype(operand_dtype) in _LOW_PRECISION:
+        return jnp.float32
+    return jnp.dtype(operand_dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class PrecisionPolicy:
+    """Declarative mixed-precision regime (module docstring has the
+    semantics of the four dtypes).
+
+    Presets: :meth:`f32` (everything f32 — the no-op policy),
+    :meth:`bf16_mixed` (f32 params, bf16 compute — the TPU default win:
+    bf16's 8 exponent bits need no loss scaling), :meth:`f16_mixed`
+    (f32 master params, f16 compute, dynamic loss scaling on). The
+    serving-side int8 path is not a training policy — it goes through
+    ``ModelRegistry.load(quantize=True, calibration=...)``.
+    """
+
+    param_dtype: jnp.dtype = jnp.float32
+    compute_dtype: jnp.dtype = jnp.float32
+    output_dtype: jnp.dtype = jnp.float32
+    accum_dtype: jnp.dtype = jnp.float32
+    #: None = decide from compute_dtype (f16 scales, bf16/f32 do not)
+    loss_scaling: Optional[bool] = None
+    #: None = decide from param_dtype (below accum -> keep an f32
+    #: master). False trains DIRECTLY on low-precision params — the
+    #: pre-policy Engine behavior ``from_engine`` preserves bitwise.
+    master_weights: Optional[bool] = None
+
+    def __post_init__(self):
+        for f in ("param_dtype", "compute_dtype", "output_dtype",
+                  "accum_dtype"):
+            object.__setattr__(self, f, jnp.dtype(getattr(self, f)))
+        if self.accum_dtype != jnp.dtype(jnp.float32):
+            raise ValueError(
+                "accum_dtype must stay float32: reductions, norm stats "
+                "and the master-copy update are the f32 islands that "
+                "keep low-precision training convergent")
+
+    # ---- presets ---------------------------------------------------------
+    @classmethod
+    def f32(cls) -> "PrecisionPolicy":
+        """Everything float32 — the exact pre-policy behavior."""
+        return cls()
+
+    @classmethod
+    def bf16_mixed(cls) -> "PrecisionPolicy":
+        """f32 params at rest, bf16 forward/backward, f32 accumulation.
+        bf16 shares f32's exponent range, so no loss scaling."""
+        return cls(compute_dtype=jnp.bfloat16)
+
+    @classmethod
+    def f16_mixed(cls) -> "PrecisionPolicy":
+        """f16 params at rest + f32 master copy, f16 compute, dynamic
+        loss scaling (f16's 5 exponent bits underflow small gradients
+        without it)."""
+        return cls(param_dtype=jnp.float16, compute_dtype=jnp.float16,
+                   loss_scaling=True)
+
+    @classmethod
+    def named(cls, name: str) -> "PrecisionPolicy":
+        """Preset by name: ``"f32"`` | ``"bf16_mixed"`` | ``"f16_mixed"``."""
+        try:
+            return {"f32": cls.f32, "bf16_mixed": cls.bf16_mixed,
+                    "f16_mixed": cls.f16_mixed}[name]()
+        except KeyError:
+            raise ValueError(
+                f"unknown precision preset {name!r}; pick one of "
+                "f32 | bf16_mixed | f16_mixed") from None
+
+    @classmethod
+    def from_engine(cls) -> "PrecisionPolicy":
+        """The policy ``Engine.set_default_dtype``/``set_compute_dtype``
+        imply — the pre-policy configuration surface, kept working so
+        existing recipes change behavior not one bit. That surface had
+        no loss scaler and no master copy (a low-precision default
+        dtype trained directly on the low-precision params), so both
+        are pinned OFF here; the presets are the opt-in for the full
+        mixed-precision recipe."""
+        from bigdl_tpu.utils.engine import Engine
+        return cls(param_dtype=Engine.default_dtype(),
+                   compute_dtype=Engine.compute_dtype(),
+                   output_dtype=Engine.default_dtype(),
+                   loss_scaling=False, master_weights=False)
+
+    # ---- derived properties ----------------------------------------------
+    @property
+    def is_noop(self) -> bool:
+        """True when the policy changes nothing vs plain f32 training."""
+        return (self.param_dtype == self.compute_dtype
+                == self.output_dtype and not self.needs_loss_scaling
+                and not self.needs_master)
+
+    @property
+    def needs_master(self) -> bool:
+        """Params stored below accum precision -> the optimizer keeps an
+        f32 master copy in its state tree (``MASTER_KEY``). Explicit
+        ``master_weights`` wins (``from_engine`` pins it False: the
+        legacy path updates low-precision params directly)."""
+        if self.master_weights is not None:
+            return self.master_weights
+        return self.param_dtype != self.accum_dtype
+
+    @property
+    def needs_loss_scaling(self) -> bool:
+        """Explicit ``loss_scaling`` wins; otherwise f16 compute scales."""
+        if self.loss_scaling is not None:
+            return self.loss_scaling
+        return self.compute_dtype == jnp.dtype(jnp.float16)
+
+    @property
+    def name(self) -> str:
+        """The preset name when this policy matches one, else "custom"."""
+        for n in ("f32", "bf16_mixed", "f16_mixed"):
+            if self == PrecisionPolicy.named(n):
+                return n
+        return "custom"
+
+    # ---- casting ---------------------------------------------------------
+    def cast_to_compute(self, tree):
+        """Cast-on-entry: floating leaves -> ``compute_dtype``."""
+        return cast_floating(tree, self.compute_dtype)
+
+    def cast_output(self, tree):
+        """Cast-on-exit: floating leaves -> ``output_dtype`` (what the
+        loss consumes — its log/exp run in f32)."""
+        return cast_floating(tree, self.output_dtype)
+
+    def cast_to_param(self, tree):
+        """Floating leaves -> ``param_dtype`` (the at-rest weights)."""
+        return cast_floating(tree, self.param_dtype)
+
+    def cast_to_accum(self, tree):
+        """Floating leaves -> ``accum_dtype`` (gradients entering the
+        update, after any unscaling)."""
+        return cast_floating(tree, self.accum_dtype)
+
+    def apply_module(self, module, params, state, x, *, training=False,
+                     rng=None):
+        """``module.apply`` under this policy: params and inputs cast to
+        ``compute_dtype`` on entry, the output cast to ``output_dtype``
+        on exit — the one cast boundary every consumer (train step,
+        eval step, shape checker) shares. Layer-internal f32 islands
+        (norm stats, softmax) are the layers' own responsibility."""
+        out, new_state = module.apply(self.cast_to_compute(params), state,
+                                      self.cast_to_compute(x),
+                                      training=training, rng=rng)
+        return self.cast_output(out), new_state
+
+    def describe(self) -> str:
+        """One-line human form for logs/diagnose."""
+        return (f"{self.name}(param={self.param_dtype.name}, "
+                f"compute={self.compute_dtype.name}, "
+                f"output={self.output_dtype.name}, "
+                f"accum={self.accum_dtype.name}, "
+                f"loss_scaling={self.needs_loss_scaling})")

@@ -1,0 +1,97 @@
+"""Dynamic loss scaling for fp16-style training.
+
+f16's 5 exponent bits underflow gradients around 6e-8, so the loss is
+multiplied by a large scale before ``jax.grad`` (shifting the whole
+gradient distribution into range) and the gradients divided back in f32
+before the update. The scale adapts online with the classic overflow
+state machine:
+
+- **non-finite gradients** (overflow): the step is SKIPPED (params and
+  optimizer state keep their previous values), the scale halves
+  (``backoff_factor``), and the growth counter resets.
+- **finite gradients**: the update applies; after ``growth_interval``
+  consecutive finite steps the scale doubles (``growth_factor``) and
+  the counter resets.
+
+The state is a tiny jittable pytree ``{scale, good_steps, skipped}``
+that lives in the optimizer-state tree under ``precision.SCALER_KEY``,
+so it rides the donated ``lax.scan`` carry of
+``Optimizer.set_steps_per_sync(K)`` — a window that overflows at step 3
+backs off INSIDE the scan and step 4 already retries at the halved
+scale, bit-identically to the per-step loop. ``skipped`` counts
+cumulative skipped steps for the ``train/precision/skipped_steps``
+gauge.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class DynamicLossScaler:
+    """Config of the overflow state machine (module docstring). The
+    mutable part is the state pytree from :meth:`init_state`; every
+    method is pure/jittable."""
+
+    init_scale: float = 2.0 ** 15
+    growth_factor: float = 2.0
+    backoff_factor: float = 0.5
+    growth_interval: int = 200
+    min_scale: float = 1.0
+    max_scale: float = 2.0 ** 24
+
+    def __post_init__(self):
+        if not (self.growth_factor > 1.0 and 0.0 < self.backoff_factor
+                < 1.0 and self.growth_interval >= 1
+                and self.min_scale > 0.0):
+            raise ValueError(
+                "DynamicLossScaler needs growth_factor > 1, "
+                "0 < backoff_factor < 1, growth_interval >= 1 and "
+                "min_scale > 0")
+
+    def init_state(self):
+        """Fresh scaler state: ``{scale, good_steps, skipped}``."""
+        return {"scale": jnp.float32(self.init_scale),
+                "good_steps": jnp.int32(0),
+                "skipped": jnp.int32(0)}
+
+    def scale_loss(self, loss, state):
+        """The loss actually differentiated: ``loss * scale`` (cast to
+        the loss's own dtype so f16 compute stays f16)."""
+        return loss * state["scale"].astype(loss.dtype)
+
+    def unscale(self, grads, state):
+        """Divide the scale back out — call AFTER casting gradients to
+        accum dtype, so the division is exact f32."""
+        inv = 1.0 / state["scale"]
+        return jax.tree.map(lambda g: g * inv.astype(g.dtype), grads)
+
+    @staticmethod
+    def all_finite(grads):
+        """Scalar bool: every gradient element is finite. The overflow
+        probe the skip-step decision keys on."""
+        leaves = [jnp.all(jnp.isfinite(g)) for g in jax.tree.leaves(grads)
+                  if jnp.issubdtype(jnp.asarray(g).dtype, jnp.floating)]
+        if not leaves:
+            return jnp.bool_(True)
+        return jnp.stack(leaves).all()
+
+    def next_state(self, state, finite):
+        """One state-machine transition (module docstring has the
+        rules); ``finite`` is :meth:`all_finite`'s scalar."""
+        good = state["good_steps"] + 1
+        grow = good >= self.growth_interval
+        grown = jnp.minimum(state["scale"] * self.growth_factor,
+                            self.max_scale)
+        backed = jnp.maximum(state["scale"] * self.backoff_factor,
+                             self.min_scale)
+        scale = jnp.where(finite, jnp.where(grow, grown, state["scale"]),
+                          backed)
+        good_steps = jnp.where(finite, jnp.where(grow, 0, good), 0)
+        skipped = state["skipped"] + jnp.where(finite, 0, 1)
+        return {"scale": scale.astype(jnp.float32),
+                "good_steps": good_steps.astype(jnp.int32),
+                "skipped": skipped.astype(jnp.int32)}

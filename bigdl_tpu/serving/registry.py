@@ -61,6 +61,7 @@ class ModelRegistry:
     # ---------------------------------------------------------- load
     def load(self, name: str, model=None, *, path: Optional[str] = None,
              version: Optional[int] = None, quantize: bool = False,
+             calibration=None, accuracy_gate=None,
              activate: bool = True, input_spec=None) -> Servable:
         """Register a model version under ``name``.
 
@@ -73,6 +74,16 @@ class ModelRegistry:
         fresh name (that is what lets a caller warm it up before any
         traffic can resolve it): ``swap`` makes it current.
 
+        ``calibration`` (an iterable of activation batches, quantize
+        loads only) runs the FLOAT model once over the batches and
+        bakes per-layer static activation scales into the int8 twin
+        (``precision/calibrate.py`` — one scale-estimation path).
+        ``accuracy_gate`` (a ``precision.AccuracyGate``) evaluates the
+        quantized candidate against the float reference BEFORE
+        registration: a delta above the gate bound raises
+        ``AccuracyGateError`` and stages nothing — the previous
+        version keeps serving, exactly like a failed swap.
+
         ``input_spec`` (``analysis.spec`` / shape tuple / list of them)
         opts into a pre-flight shape check: the servable-to-be is walked
         under ``jax.eval_shape`` and a mis-wired model is rejected with a
@@ -81,6 +92,11 @@ class ModelRegistry:
         """
         if (model is None) == (path is None):
             raise ValueError("pass exactly one of model= or path=")
+        if (calibration is not None or accuracy_gate is not None) \
+                and not quantize:
+            raise ValueError(
+                "calibration=/accuracy_gate= only apply to quantize=True "
+                "loads (they calibrate and certify the int8 rewrite)")
         user_live_module = path is None
         if path is not None:
             from bigdl_tpu.utils.serialization import load_module
@@ -89,9 +105,19 @@ class ModelRegistry:
         model.ensure_initialized()
         if quantize:
             from bigdl_tpu.nn.quantized import quantize as _quantize
-            model = _quantize(model)  # a rewrite, original untouched
+            from bigdl_tpu.precision.calibrate import maybe_collect
+            float_reference = model
+            scales = maybe_collect(model, calibration)
+            # a rewrite, original untouched
+            model = _quantize(model, act_scales=scales)
             model.evaluate()
             user_live_module = False
+            if accuracy_gate is not None:
+                # raises AccuracyGateError above the bound — before any
+                # registration, so no traffic can ever resolve a
+                # candidate that failed its accuracy budget; the delta
+                # lands in serving/precision/accuracy_delta either way
+                accuracy_gate.check(float_reference, model, label=name)
         if input_spec is not None:
             # checks the model that will actually SERVE (post-quantize
             # rewrite), in inference mode; raises ShapeCheckError.

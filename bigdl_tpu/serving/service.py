@@ -86,6 +86,7 @@ class InferenceService:
     # ------------------------------------------------------- lifecycle
     def load(self, name: str, model=None, *, path: Optional[str] = None,
              version: Optional[int] = None, quantize: bool = False,
+             calibration=None, accuracy_gate=None,
              activate: bool = True,
              warmup_shape: Optional[Sequence[int]] = None,
              warmup_dtype=np.float32) -> Servable:
@@ -96,9 +97,15 @@ class InferenceService:
         traffic — the version is registered inactive, warmed, and only
         THEN swapped in, so a hot-swap under live traffic never serves
         a cold bucket (and the first real request never eats a
-        compile)."""
+        compile). ``calibration``/``accuracy_gate`` ride through to
+        ``ModelRegistry.load`` for quantized loads: calibrated int8
+        weights stage through this cache's warmed programs ONLY after
+        the accuracy gate passes — a refused candidate compiles
+        nothing and the old version keeps serving."""
         servable = self.registry.load(name, model, path=path,
                                       version=version, quantize=quantize,
+                                      calibration=calibration,
+                                      accuracy_gate=accuracy_gate,
                                       activate=False)
         if warmup_shape is not None:
             self.cache.warmup(servable.key, servable.model,

@@ -111,7 +111,7 @@ def collect_instrument_names():
 
     for mod in ("bigdl_tpu.optim.optimizer", "bigdl_tpu.dataset.prefetch",
                 "bigdl_tpu.utils.serialization", "bigdl_tpu.parallel.tp",
-                "bigdl_tpu.parallel.zero",
+                "bigdl_tpu.parallel.zero", "bigdl_tpu.precision.gate",
                 "bigdl_tpu.tools.perf", "bigdl_tpu.tools.ceiling",
                 "bigdl_tpu.datapipe.readers", "bigdl_tpu.datapipe.shuffle",
                 "bigdl_tpu.datapipe.packing"):

@@ -12,7 +12,11 @@ Default mode runs a short INSTRUMENTED workload — a LeNet training run
 serving burst hammering an ``InferenceService`` — with telemetry
 enabled, then prints the attribution report: how much wall-clock went
 to data staging vs compiled compute vs validation/checkpoint vs serving
-batches, with queue-wait percentiles from the metrics registry. The
+batches, with queue-wait percentiles from the metrics registry. Runs
+under a mixed-precision policy additionally get a precision section:
+the active policy's dtypes, the loss-scale value (trajectory across
+snapshots in ``--jsonl`` mode), the cumulative skipped-step count, and
+per-chip params/opt-state bytes against their f32-equivalent "before". The
 span trace is written as ONE Chrome-trace JSON (``--out-trace``,
 loadable in Perfetto / ``chrome://tracing``) and the report's phase
 sums are consistent with the optimizer's ``Metrics.summary()`` numbers
@@ -88,7 +92,8 @@ def attribution(agg: Dict[str, Dict[str, float]]) -> List[dict]:
 
 def _fmt_report(rows: List[dict], metrics_lines: List[str],
                 summary: Optional[str],
-                feed_lines: Optional[List[str]] = None) -> str:
+                feed_lines: Optional[List[str]] = None,
+                precision_lines: Optional[List[str]] = None) -> str:
     lines = ["== where did the time go =="]
     group = None
     for r in rows:
@@ -100,6 +105,9 @@ def _fmt_report(rows: List[dict], metrics_lines: List[str],
     if feed_lines:
         lines.append("data feed:")
         lines.extend(f"  {m}" for m in feed_lines)
+    if precision_lines:
+        lines.append("precision:")
+        lines.extend(f"  {m}" for m in precision_lines)
     if metrics_lines:
         lines.append("metrics:")
         lines.extend(f"  {m}" for m in metrics_lines)
@@ -164,6 +172,94 @@ def _feed_lines(feed: Dict[str, float]) -> List[str]:
     if "prefetch_fetch_wait_s" in feed:
         out.append("prefetch fetch_wait: "
                    f"{feed['prefetch_fetch_wait_s']:.4f} s")
+    return out
+
+
+def precision_summary(snapshot: List[dict],
+                      history: Optional[List[List[dict]]] = None
+                      ) -> Dict[str, object]:
+    """Mixed-precision health from a registry snapshot: the active
+    policy (dtypes from the ``train/precision/policy_info`` labels),
+    the current loss scale and cumulative skipped-step count, and the
+    per-chip params/opt-state bytes AFTER the policy against the
+    f32-equivalent BEFORE (``tree_bytes_per_chip`` priced both at state
+    layout). ``history`` (earlier snapshots, JSONL ingest) contributes
+    the loss-scale trajectory — one point per recorded sync."""
+    by_name = {row["name"]: row for row in snapshot}
+
+    def series(name):
+        row = by_name.get(name)
+        return row["series"][0] if row and row["series"] else None
+
+    def gauge(name):
+        s = series(name)
+        return float(s["value"]) if s else None
+
+    out: Dict[str, object] = {}
+    info_row = by_name.get("train/precision/policy_info")
+    if info_row and info_row["series"]:
+        # one series per policy this process ran; the ACTIVE one holds
+        # value 1, earlier runs' series are zeroed at policy setup
+        active = [dict(s.get("labels") or {}) for s in info_row["series"]
+                  if s.get("value")]
+        earlier = [dict(s.get("labels") or {}) for s in info_row["series"]
+                   if not s.get("value")]
+        if active:
+            out["policy"] = active[-1]
+            if earlier:
+                out["earlier_policies"] = earlier
+    scale = gauge("train/precision/loss_scale")
+    if scale is not None:
+        out["loss_scale"] = scale
+        trajectory = []
+        for snap in (history or []):
+            for row in snap:
+                if row["name"] == "train/precision/loss_scale" \
+                        and row["series"]:
+                    trajectory.append(float(row["series"][0]["value"]))
+        out["loss_scale_trajectory"] = trajectory + [scale]
+    skipped = gauge("train/precision/skipped_steps")
+    if skipped is not None:
+        out["skipped_steps"] = int(skipped)
+    for kind in ("params", "opt_state"):
+        after = gauge(f"train/memory/{kind}_bytes_per_chip")
+        before = gauge(f"train/precision/{kind}_f32_bytes_per_chip")
+        if after is not None and before:
+            out[f"{kind}_bytes_per_chip"] = int(after)
+            out[f"{kind}_f32_bytes_per_chip"] = int(before)
+            out[f"{kind}_bytes_ratio_vs_f32"] = after / before
+    return out
+
+
+def _precision_lines(prec: Dict[str, object]) -> List[str]:
+    out = []
+    pol = prec.get("policy")
+    if pol:
+        dts = " ".join(f"{k}={v}" for k, v in sorted(pol.items())
+                       if k != "policy")
+        line = f"policy: {pol.get('policy', '?')} ({dts})"
+        earlier = prec.get("earlier_policies")
+        if earlier:
+            line += " [earlier this process: " + ", ".join(
+                p.get("policy", "?") for p in earlier) + "]"
+        out.append(line)
+    if "loss_scale" in prec:
+        traj = prec.get("loss_scale_trajectory") or []
+        line = f"loss_scale: {prec['loss_scale']:g}"
+        if len(traj) > 1:
+            line += " (trajectory: " + " -> ".join(
+                f"{v:g}" for v in traj) + ")"
+        out.append(line)
+    if "skipped_steps" in prec:
+        out.append(f"skipped_steps: {prec['skipped_steps']} "
+                   "(non-finite gradients, step retried at backed-off "
+                   "scale)")
+    for kind in ("params", "opt_state"):
+        if f"{kind}_bytes_per_chip" in prec:
+            out.append(
+                f"{kind} bytes/chip: {prec[f'{kind}_bytes_per_chip']:,}"
+                f" vs {prec[f'{kind}_f32_bytes_per_chip']:,} at f32 "
+                f"({prec[f'{kind}_bytes_ratio_vs_f32']:.2f}x)")
     return out
 
 
@@ -292,6 +388,7 @@ def main(argv=None) -> int:
 
     summary = None
     snapshot: List[dict] = []
+    history: Optional[List[List[dict]]] = None
     wrote_trace = False
     if args.trace:
         try:
@@ -314,6 +411,7 @@ def main(argv=None) -> int:
             return 2
         events = []
         snapshot = records[-1]["metrics"]
+        history = [r["metrics"] for r in records[:-1]]
     else:
         opt, events, snapshot = run_workload(
             steps=args.steps, batch_size=args.batch_size,
@@ -324,14 +422,16 @@ def main(argv=None) -> int:
     agg = aggregate_spans(events)
     rows = attribution(agg)
     feed = feed_summary(snapshot)
+    prec = precision_summary(snapshot, history)
     if args.json:
         print(json.dumps({"spans": rows,
                           "metrics": snapshot,
                           "data_feed": feed,
+                          "precision": prec,
                           "optimizer_summary": summary}, indent=2))
     else:
         print(_fmt_report(rows, _metrics_lines(snapshot), summary,
-                          _feed_lines(feed)))
+                          _feed_lines(feed), _precision_lines(prec)))
         if wrote_trace:
             print(f"chrome trace written to {args.out_trace} "
                   "(load in Perfetto / chrome://tracing)")

@@ -40,6 +40,10 @@ FAMILIES = {
                   "bigdl_tpu.telemetry.export"],
     "faults": ["bigdl_tpu.faults", "bigdl_tpu.faults.retry"],
     "parallel": ["bigdl_tpu.parallel", "bigdl_tpu.parallel.zero"],
+    "precision": ["bigdl_tpu.precision", "bigdl_tpu.precision.policy",
+                  "bigdl_tpu.precision.scaler",
+                  "bigdl_tpu.precision.calibrate",
+                  "bigdl_tpu.precision.gate"],
     "models": ["bigdl_tpu.models"],
     "interop": ["bigdl_tpu.utils.serialization",
                 "bigdl_tpu.utils.tf_loader", "bigdl_tpu.utils.tf_fusion",

@@ -9,6 +9,16 @@ Each timing is a scanned chunk with a value fetch (honest-sync on the
 tunnel).
 
     python -m bigdl_tpu.tools.int8_sweep [iters]
+
+.. deprecated:: PR 9
+    Scale estimation moved to ``bigdl_tpu/precision/calibrate.py`` —
+    the ONE int8 calibration path (weights via ``calibrate_weight``,
+    activations via ``collect_activation_scales``; both derive from
+    ``ops/quant.scale_from_amax``). This tool now delegates its weight
+    scales there and remains CLI-compatible, but new code should
+    calibrate through ``precision.calibrate`` / ``ModelRegistry.load(
+    quantize=True, calibration=...)`` rather than calling
+    ``quantize_symmetric`` directly.
 """
 import json
 import sys
@@ -31,7 +41,9 @@ def _time_chunk(fn, args, scan: int, iters: int):
             # hoisted by XLA and the scan would time nothing but adds
             a0 = a[0] + jnp.asarray(carry, a[0].dtype)
             r = fn(a0, *a[1:])
-            return r.astype(jnp.float32).sum() * 1e-30, None
+            # the timing carry is a deliberate f32 scalar reduction —
+            # it measures the kernel, it is not on a policy's hot path
+            return r.astype(jnp.float32).sum() * 1e-30, None  # bigdl: disable=implicit-upcast-in-trace
         out, _ = lax.scan(body, jnp.float32(0.0), None, length=scan)
         return out
 
@@ -49,6 +61,9 @@ def main(argv=None):
 
     from bigdl_tpu.ops.pallas_kernels import pallas_quantized_matmul
     from bigdl_tpu.ops.quant import quantize_symmetric, quantized_linear
+    # the one scale-estimation path (precision/calibrate.py delegates to
+    # ops/quant's max-abs rule): weight scales below come from here
+    from bigdl_tpu.precision.calibrate import calibrate_weight
 
     import os
     args = argv if argv is not None else sys.argv[1:]
@@ -76,7 +91,7 @@ def main(argv=None):
     for b, cin, cout in shapes:
         x = jnp.asarray(gaussian_matrix((b, cin)))
         w = jnp.asarray(gaussian_matrix((cout, cin), scale=0.05, seed=1))
-        w_q, w_s = quantize_symmetric(w, axis=0)  # per-out-channel
+        w_q, w_s = calibrate_weight(w, axis=0)  # per-out-channel
         x16 = x.astype(jnp.bfloat16)
         w16 = w.T.astype(jnp.bfloat16)
 
@@ -117,7 +132,7 @@ def main(argv=None):
     from bigdl_tpu.ops.quant import quantized_conv2d
     x = jnp.asarray(gaussian_matrix((64, 256, 28, 28)))
     w = jnp.asarray(gaussian_matrix((256, 256, 3, 3), scale=0.05, seed=1))
-    w_q, w_s = quantize_symmetric(w, axis=0)  # per-out-channel
+    w_q, w_s = calibrate_weight(w, axis=0)  # per-out-channel
 
     def bf16_conv(x, w):
         from jax import lax

@@ -63,7 +63,18 @@ def main(argv=None):
     ap.add_argument("--warmup", type=int, default=3)
     ap.add_argument("--mode", choices=["train", "inference"],
                     default="train")
-    ap.add_argument("--dtype", choices=["f32", "bf16"], default="bf16")
+    ap.add_argument("--dtype", choices=["f32", "bf16"], default="bf16",
+                    help="legacy Engine compute-dtype knob; ignored "
+                    "when --precision names a full policy")
+    ap.add_argument("--precision", default=None,
+                    choices=["f32", "bf16_mixed", "f16_mixed"],
+                    metavar="POLICY",
+                    help="explicit precision policy "
+                    "(bigdl_tpu.precision.PrecisionPolicy preset): "
+                    "param/compute/output/accum dtypes compiled into "
+                    "the step, f32 master copy + dynamic loss scaling "
+                    "for f16_mixed — the policy twin of "
+                    "Optimizer.set_precision")
     ap.add_argument("--quantize", action="store_true",
                     help="int8 inference rewrite (inference mode only — "
                     "the reference's quantized serving story, "
@@ -105,6 +116,10 @@ def main(argv=None):
     Engine.init()
     if args.dtype == "bf16":
         Engine.set_compute_dtype(jnp.bfloat16)
+    policy = None
+    if args.precision is not None:
+        from bigdl_tpu.precision import PrecisionPolicy
+        policy = PrecisionPolicy.named(args.precision)
     RandomGenerator.set_seed(42)
 
     from bigdl_tpu.tools import synthetic
@@ -143,6 +158,16 @@ def main(argv=None):
 
         optim = SGD(learning_rate=0.01, momentum=0.9)
         opt_state = optim.init_state(params)
+        if policy is not None:
+            # seed the policy's opt-state keys the way
+            # Optimizer.set_precision does (master copy, scaler state)
+            from bigdl_tpu.precision import (MASTER_KEY, SCALER_KEY,
+                                             DynamicLossScaler)
+            if policy.needs_master:
+                opt_state[MASTER_KEY] = params
+                params = policy.cast_to_param(params)
+            if policy.needs_loss_scaling:
+                opt_state[SCALER_KEY] = DynamicLossScaler().init_state()
         zero_cfg, zero_mesh = None, None
         if args.zero:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -168,7 +193,8 @@ def main(argv=None):
             zero_meta = dict(record_memory_gauges(params, opt_state),
                              zero_stage=args.zero, zero_devices=ndev)
         jit_step = build_train_step(model, criterion, optim,
-                                    zero=zero_cfg, mesh=zero_mesh)
+                                    zero=zero_cfg, mesh=zero_mesh,
+                                    precision=policy)
         key = jax.random.PRNGKey(0)
 
         def make_chunk(k):
@@ -222,7 +248,7 @@ def main(argv=None):
                 jax.block_until_ready(params)
                 return loss
     else:
-        eval_step = build_eval_step(model)
+        eval_step = build_eval_step(model, precision=policy)
         try:
             eval_step = eval_step.lower(params, mstate, x).compile()
             compiled_for_cost = eval_step
@@ -241,8 +267,9 @@ def main(argv=None):
 
     recs_per_iter = (args.batch_size * sync_k
                      * (in_shape[0] if is_lm else 1))
+    prec_tag = args.precision if args.precision else args.dtype
     print(f"# {args.model} {args.mode} batch={args.batch_size} "
-          f"dtype={args.dtype} steps_per_sync={sync_k} "
+          f"dtype={prec_tag} steps_per_sync={sync_k} "
           f"backend={jax.default_backend()}")
     for i in range(args.warmup):
         t0 = time.perf_counter()
@@ -286,7 +313,7 @@ def main(argv=None):
     # run's steps/sec at its window size, plus the K=1-vs-K=8 dispatch
     # comparison when requested
     tail = {"tool": "perf", "model": args.model, "mode": args.mode,
-            "batch_size": args.batch_size, "dtype": args.dtype,
+            "batch_size": args.batch_size, "dtype": prec_tag,
             "backend": jax.default_backend(), "median_s": med,
             "rate": rate, "steps_per_sync": sync_k}
     tail.update(zero_meta)

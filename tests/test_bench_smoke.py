@@ -82,6 +82,33 @@ def test_bench_data_row_contract():
 
 
 @pytest.mark.slow
+def test_bench_precision_row_contract():
+    """The PRECISION row: ResNet f32 vs bf16_mixed train imgs/sec,
+    TransformerLM tokens/sec both regimes, and f32 vs calibrated-int8
+    serving with the accuracy delta the registry gate would enforce.
+    On CPU the bf16 ratio is reported, not asserted (bf16 emulates
+    slowly off-accelerator); the int8 delta must sit under its gate."""
+    out = _run_bench("synthetic", {
+        "BENCH_PRECISION": "1", "BENCH_PREC_DEPTH": "8",
+        "BENCH_PREC_BATCH": "8", "BENCH_PREC_VOCAB": "64",
+        "BENCH_PREC_HIDDEN": "32", "BENCH_PREC_LAYERS": "1",
+        "BENCH_PREC_SEQ": "16", "BENCH_PREC_LM_BATCH": "2",
+        "BENCH_PREC_GATE_N": "16"})
+    for key in ("precision_resnet_f32_imgs_per_sec",
+                "precision_resnet_bf16_imgs_per_sec",
+                "precision_tlm_f32_tokens_per_sec",
+                "precision_tlm_bf16_tokens_per_sec",
+                "precision_serving_f32_imgs_per_sec",
+                "precision_serving_int8_imgs_per_sec"):
+        assert out[key] > 0
+    assert out["precision_resnet_bf16_speedup"] > 0
+    # the asserted accuracy contract: calibrated int8 top-1 agreement
+    # with the float reference stays under the serving gate's bound
+    assert out["precision_int8_accuracy_delta"] <= \
+        out["precision_int8_gate_max_delta"]
+
+
+@pytest.mark.slow
 def test_bench_zero_row_contract():
     """The ZERO row: imgs/sec and opt_state_bytes_per_chip at ZeRO
     stage 0 vs 2 vs 3 over the data mesh of every device — the stage-2

@@ -238,6 +238,20 @@ def run(fn):
             time.sleep(1.0)  # bigdl: disable=retry-no-backoff
 """,
     ),
+    "implicit-upcast-in-trace": (
+        """
+class Layer(Module):
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        h = input * params["w"]
+        return h.astype(jnp.float32)
+""",
+        """
+class Layer(Module):
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        h = input * params["w"]
+        return h.astype(jnp.float32)  # bigdl: disable=implicit-upcast-in-trace
+""",
+    ),
     "unseeded-shuffle": (
         """
 def epoch_order(records):
@@ -389,6 +403,71 @@ def order(n):
 """
     findings = lint_source(src, "fixture.py")
     assert "unseeded-shuffle" in names(findings)
+
+
+def test_implicit_upcast_skips_files_off_the_precision_surface():
+    # a plain jax utility file (no Module-ish class, no
+    # bigdl_tpu.precision import) never runs under a policy's compute
+    # dtype — its f32 casts are its own business
+    src = HEADER + """
+@jax.jit
+def f(x):
+    return x.astype(jnp.float32)
+"""
+    findings = lint_source(src, "fixture.py")
+    assert "implicit-upcast-in-trace" not in names(findings,
+                                                   only_active=False)
+
+
+def test_implicit_upcast_fires_via_precision_import():
+    # importing bigdl_tpu.precision marks the file as a policy consumer
+    # even without a Module class (e.g. the optimizer's step builder)
+    src = HEADER + """
+from bigdl_tpu.precision import PrecisionPolicy
+
+@jax.jit
+def step(g):
+    h = jnp.tanh(g)
+    eps = jnp.float32(1e-6)   # host literal: trace-time folding, fine
+    return jnp.float32(h) + eps
+"""
+    findings = lint_source(src, "fixture.py")
+    hits = [f for f in findings if f.rule == "implicit-upcast-in-trace"]
+    assert len(hits) == 1 and hits[0].line == HEADER.count("\n") + 8
+
+
+def test_implicit_upcast_ignores_host_side_code_in_layer_files():
+    # a host-side helper (not apply/forward_fn, not jitted) in a Module
+    # file quantizes weights AT REST — no trace, no finding
+    src = HEADER + """
+class Layer(Module):
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        return input * params["w"]
+
+    def export_weights(self):
+        return np.asarray(self.w).astype(np.float32)
+"""
+    findings = lint_source(src, "fixture.py")
+    assert "implicit-upcast-in-trace" not in names(findings,
+                                                   only_active=False)
+
+
+def test_implicit_upcast_asarray_traced_vs_host_constant():
+    # dtype-less asarray is flagged only over traced values; a host
+    # constant folds at trace time, and dtype= is always sanctioned
+    src = HEADER + """
+class Layer(Module):
+    def forward_fn(self, params, input, *, training=False, rng=None):
+        table = jnp.asarray([0.5, 1.5])          # host constant: fine
+        h = jnp.tanh(input)
+        h = jnp.asarray(h)                       # traced: flagged
+        y = jnp.asarray(h, dtype=h.dtype)        # explicit: fine
+        return h * y * table[0]
+"""
+    findings = lint_source(src, "fixture.py")
+    hits = [f for f in findings
+            if f.rule == "implicit-upcast-in-trace" and not f.suppressed]
+    assert len(hits) == 1
 
 
 def test_sync_in_loop_skips_files_without_jax():

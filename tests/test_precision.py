@@ -1,0 +1,613 @@
+"""Mixed precision as a policy (bigdl_tpu/precision): preset semantics,
+the loss-scaler overflow state machine, bf16_mixed short-run loss parity
+vs f32, f16 skip-step + master-weights behavior inside the compiled
+step, K=1 vs K=8 bit-consistency with the scaler riding the scan carry,
+ZeRO stage-2 + bf16 within the documented bound of f32 stage-0, the ONE
+int8 calibration path, the registry accuracy gate actually refusing a
+bad quantized swap, and shapecheck diagnostics carrying the policy's
+dtypes."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import bigdl_tpu.nn as nn
+import bigdl_tpu.telemetry as telemetry
+from bigdl_tpu.dataset import DataSet, Sample, SampleToMiniBatch
+from bigdl_tpu.optim import SGD, Optimizer, max_iteration
+from bigdl_tpu.optim.optimizer import build_eval_step, build_train_step
+from bigdl_tpu.precision import (MASTER_KEY, SCALER_KEY, AccuracyGate,
+                                 AccuracyGateError, DynamicLossScaler,
+                                 PrecisionPolicy, cast_floating,
+                                 matmul_accum_dtype)
+from bigdl_tpu.utils.random import RandomGenerator
+
+
+@pytest.fixture(scope="module")
+def devices8():
+    d = jax.devices()
+    if len(d) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return d[:8]
+
+
+# ------------------------------------------------------------- helpers
+
+def _mlp(d_in=8, hidden=16, classes=2):
+    return nn.Sequential().add(nn.Linear(d_in, hidden)).add(nn.Tanh()) \
+        .add(nn.Linear(hidden, classes)).add(nn.LogSoftMax())
+
+
+def _batch(n=16, d=8, classes=2, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, d).astype(np.float32)
+    y = (rng.randint(0, classes, n) + 1).astype(np.float32)
+    return x, y
+
+
+def _setup_step(policy, scaler=None, seed=3):
+    """build_train_step under ``policy`` with the optimizer-state keys
+    seeded the way Optimizer.set_precision does it."""
+    RandomGenerator.set_seed(seed)
+    model = _mlp().training()
+    model.ensure_initialized()
+    optim = SGD(learning_rate=0.1, momentum=0.9)
+    params = model.get_parameters()
+    opt_state = optim.init_state(params)
+    if policy.needs_master:
+        opt_state[MASTER_KEY] = params
+        params = policy.cast_to_param(params)
+    if scaler is None and policy.needs_loss_scaling:
+        scaler = DynamicLossScaler()
+    if scaler is not None and policy.needs_loss_scaling:
+        opt_state[SCALER_KEY] = scaler.init_state()
+    step = build_train_step(model, nn.ClassNLLCriterion(), optim,
+                            precision=policy, loss_scaler=scaler)
+    return model, step, params, opt_state, model.get_state()
+
+
+def _run_steps(policy, steps=12, scaler=None):
+    _, step, params, opt, ms = _setup_step(policy, scaler)
+    x, y = _batch()
+    losses = []
+    for i in range(steps):
+        params, opt, ms, loss = step(params, opt, ms,
+                                     jax.random.PRNGKey(i), 0.1, x, y)
+        losses.append(float(loss))
+    return losses, params, opt
+
+
+def _leaves_equal(a, b):
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)))
+
+
+# ------------------------------------------------------ policy object
+
+def test_presets_and_named():
+    assert PrecisionPolicy.f32().is_noop
+    bf16 = PrecisionPolicy.named("bf16_mixed")
+    assert bf16 == PrecisionPolicy.bf16_mixed()
+    assert bf16.compute_dtype == jnp.dtype(jnp.bfloat16)
+    assert bf16.param_dtype == jnp.dtype(jnp.float32)
+    assert not bf16.needs_master and not bf16.needs_loss_scaling
+    f16 = PrecisionPolicy.named("f16_mixed")
+    assert f16.needs_master and f16.needs_loss_scaling
+    assert f16.name == "f16_mixed" and bf16.name == "bf16_mixed"
+    with pytest.raises(ValueError, match="unknown precision preset"):
+        PrecisionPolicy.named("int4_wishful")
+
+
+def test_accum_dtype_pinned_to_f32():
+    with pytest.raises(ValueError, match="accum_dtype must stay float32"):
+        PrecisionPolicy(accum_dtype=jnp.bfloat16)
+
+
+def test_explicit_loss_scaling_flag_wins():
+    assert PrecisionPolicy(compute_dtype=jnp.bfloat16,
+                           loss_scaling=True).needs_loss_scaling
+    assert not PrecisionPolicy(param_dtype=jnp.float16,
+                               compute_dtype=jnp.float16,
+                               loss_scaling=False).needs_loss_scaling
+
+
+def test_cast_floating_skips_non_float_leaves():
+    tree = {"w": jnp.ones((2,), jnp.float32),
+            "ids": jnp.ones((2,), jnp.int32),
+            "flag": jnp.ones((2,), bool)}
+    out = cast_floating(tree, jnp.bfloat16)
+    assert out["w"].dtype == jnp.bfloat16
+    assert out["ids"].dtype == jnp.int32
+    assert out["flag"].dtype == jnp.dtype(bool)
+
+
+def test_matmul_accum_dtype():
+    assert matmul_accum_dtype(jnp.bfloat16) == jnp.float32
+    assert matmul_accum_dtype(jnp.float16) == jnp.float32
+    assert matmul_accum_dtype(jnp.float32) == jnp.dtype(jnp.float32)
+    assert matmul_accum_dtype(jnp.float64) == jnp.dtype(jnp.float64)
+
+
+def test_apply_module_casts_entry_and_exit():
+    RandomGenerator.set_seed(1)
+    model = nn.Linear(4, 3)
+    model.ensure_initialized()
+    policy = PrecisionPolicy.bf16_mixed()
+    x = jnp.ones((2, 4), jnp.float32)
+    out, _ = policy.apply_module(model, model.get_parameters(),
+                                 model.get_state(), x)
+    # cast-on-exit hands the loss output_dtype (f32) activations
+    assert out.dtype == jnp.float32
+
+
+# ------------------------------------------------- loss-scaler machine
+
+def test_scaler_validates_config():
+    with pytest.raises(ValueError):
+        DynamicLossScaler(growth_factor=1.0)
+    with pytest.raises(ValueError):
+        DynamicLossScaler(backoff_factor=1.5)
+    with pytest.raises(ValueError):
+        DynamicLossScaler(growth_interval=0)
+
+
+def test_scaler_grows_after_interval_and_resets_counter():
+    sc = DynamicLossScaler(init_scale=1024.0, growth_interval=2)
+    s = sc.init_state()
+    s = sc.next_state(s, jnp.bool_(True))
+    assert float(s["scale"]) == 1024.0 and int(s["good_steps"]) == 1
+    s = sc.next_state(s, jnp.bool_(True))   # hits the interval: doubles
+    assert float(s["scale"]) == 2048.0 and int(s["good_steps"]) == 0
+    assert int(s["skipped"]) == 0
+
+
+def test_scaler_backoff_resets_counter_and_counts_skip():
+    sc = DynamicLossScaler(init_scale=1024.0, growth_interval=4)
+    s = sc.init_state()
+    s = sc.next_state(s, jnp.bool_(True))
+    s = sc.next_state(s, jnp.bool_(False))  # overflow: halve, reset
+    assert float(s["scale"]) == 512.0
+    assert int(s["good_steps"]) == 0
+    assert int(s["skipped"]) == 1
+
+
+def test_scaler_clamps_to_min_and_max():
+    sc = DynamicLossScaler(init_scale=2.0, growth_interval=1,
+                           min_scale=1.0, max_scale=4.0)
+    s = sc.init_state()
+    s = sc.next_state(s, jnp.bool_(True))
+    s = sc.next_state(s, jnp.bool_(True))
+    assert float(s["scale"]) == 4.0      # max clamp
+    for _ in range(4):
+        s = sc.next_state(s, jnp.bool_(False))
+    assert float(s["scale"]) == 1.0      # min clamp
+
+
+def test_all_finite_probe():
+    good = {"a": jnp.ones((3,)), "b": jnp.zeros((2,), jnp.int32)}
+    assert bool(DynamicLossScaler.all_finite(good))
+    bad = {"a": jnp.asarray([1.0, np.inf]), "b": jnp.ones((2,))}
+    assert not bool(DynamicLossScaler.all_finite(bad))
+    nan = {"a": jnp.asarray([np.nan])}
+    assert not bool(DynamicLossScaler.all_finite(nan))
+    assert bool(DynamicLossScaler.all_finite({"i": jnp.ones((2,),
+                                                       jnp.int32)}))
+
+
+def test_scale_and_unscale_roundtrip():
+    sc = DynamicLossScaler(init_scale=512.0)
+    s = sc.init_state()
+    loss = jnp.float32(3.0)
+    assert float(sc.scale_loss(loss, s)) == 3.0 * 512.0
+    grads = {"w": jnp.full((2,), 512.0 * 0.25)}
+    un = sc.unscale(grads, s)
+    np.testing.assert_allclose(np.asarray(un["w"]), 0.25)
+
+
+# -------------------------------------------- compiled-step integration
+
+def test_bf16_mixed_short_run_loss_parity_vs_f32():
+    """Seeded 12-step run: bf16_mixed tracks the f32 loss trajectory
+    within rounding noise (bf16 shares f32's exponent; the f32 islands
+    keep the reductions exact)."""
+    l32, p32, _ = _run_steps(PrecisionPolicy.f32())
+    lbf, pbf, _ = _run_steps(PrecisionPolicy.bf16_mixed())
+    assert abs(l32[-1] - lbf[-1]) < 2e-2
+    assert np.mean([abs(a - b) for a, b in zip(l32, lbf)]) < 2e-2
+    # params stay f32 at rest under bf16_mixed (no master copy)
+    assert all(l.dtype == jnp.float32 for l in jax.tree.leaves(pbf))
+
+
+def test_f32_policy_matches_engine_default_bitwise():
+    """PrecisionPolicy.f32() compiles the exact pre-policy program: a
+    step built with precision=None (the legacy Engine dtype knobs, f32
+    in tests) is bit-identical to one built with the explicit f32
+    policy."""
+    RandomGenerator.set_seed(3)
+    model = _mlp().training()
+    model.ensure_initialized()
+    optim = SGD(learning_rate=0.1, momentum=0.9)
+    params = model.get_parameters()
+    x, y = _batch()
+
+    def run(precision):
+        # fresh copies: the compiled step DONATES its carry buffers
+        p = jax.tree.map(jnp.array, params)
+        opt = optim.init_state(p)
+        ms = jax.tree.map(jnp.array, model.get_state())
+        step = build_train_step(model, nn.ClassNLLCriterion(), optim,
+                                precision=precision)
+        losses = []
+        for i in range(4):
+            p, opt, ms, loss = step(p, opt, ms, jax.random.PRNGKey(i),
+                                    0.1, x, y)
+            losses.append(float(loss))
+        return losses, p
+
+    l_legacy, p_legacy = run(None)
+    l_f32, p_f32 = run(PrecisionPolicy.f32())
+    assert l_legacy == l_f32
+    assert _leaves_equal(p_legacy, p_f32)
+
+
+def test_legacy_engine_low_precision_path_needs_no_master_or_scaler():
+    """Regression (review finding): Engine.set_default_dtype(bf16) is
+    the PRE-policy configuration surface — precision=None must keep
+    training directly on the low-precision params, with no master copy,
+    no scaler, and the update running in param dtype."""
+    from bigdl_tpu.utils.engine import Engine
+    old_d, old_c = Engine.default_dtype(), Engine.compute_dtype()
+    try:
+        Engine.set_default_dtype(jnp.bfloat16)
+        Engine.set_compute_dtype(jnp.bfloat16)
+        legacy = PrecisionPolicy.from_engine()
+        assert not legacy.needs_master and not legacy.needs_loss_scaling
+        RandomGenerator.set_seed(3)
+        model = _mlp().training()
+        model.ensure_initialized()
+        optim = SGD(learning_rate=0.1, momentum=0.9)
+        params = model.get_parameters()
+        opt_state = optim.init_state(params)  # no dunder keys seeded
+        step = build_train_step(model, nn.ClassNLLCriterion(), optim)
+        x, y = _batch()
+        params, opt_state, ms, loss = step(params, opt_state,
+                                           model.get_state(),
+                                           jax.random.PRNGKey(0), 0.1,
+                                           x, y)
+        assert np.isfinite(float(loss))
+        for leaf in jax.tree.leaves(params):
+            assert leaf.dtype == jnp.bfloat16   # updated in place,
+        assert MASTER_KEY not in opt_state      # no f32 master grew
+        assert SCALER_KEY not in opt_state
+    finally:
+        Engine.set_default_dtype(old_d)
+        Engine.set_compute_dtype(old_c)
+
+
+def test_f16_skip_step_on_overflow_backs_off_inside_step():
+    """A step with non-finite gradients is SKIPPED inside the compiled
+    step: params/opt buffers keep their previous values, the scale
+    halves, the growth counter resets, skipped increments."""
+    sc = DynamicLossScaler(init_scale=2.0 ** 24, growth_interval=3)
+    _, step, params, opt, ms = _setup_step(PrecisionPolicy.f16_mixed(),
+                                           sc)
+    x, y = _batch()
+    before = jax.tree.map(np.asarray, params)
+    master_before = jax.tree.map(np.asarray, opt[MASTER_KEY])
+    v_before = jax.tree.map(np.asarray, opt["v"])
+    params, opt, ms, _ = step(params, opt, ms, jax.random.PRNGKey(0),
+                              0.1, x, y)
+    ss = opt[SCALER_KEY]
+    assert float(ss["scale"]) == 2.0 ** 23        # halved
+    assert int(ss["good_steps"]) == 0             # counter reset
+    assert int(ss["skipped"]) == 1
+    assert _leaves_equal(before, params)          # step skipped
+    assert _leaves_equal(master_before, opt[MASTER_KEY])
+    assert _leaves_equal(v_before, opt["v"])      # moments skipped too
+
+
+def test_f16_master_copy_updates_and_casts_down():
+    """Finite f16 steps: the f32 master copy advances and the at-rest
+    f16 params are exactly the master cast down."""
+    sc = DynamicLossScaler(init_scale=128.0, growth_interval=50)
+    _, step, params, opt, ms = _setup_step(PrecisionPolicy.f16_mixed(),
+                                           sc)
+    x, y = _batch()
+    before = jax.tree.map(np.asarray, opt[MASTER_KEY])
+    for i in range(3):
+        params, opt, ms, loss = step(params, opt, ms,
+                                     jax.random.PRNGKey(i), 0.1, x, y)
+    assert np.isfinite(float(loss))
+    assert int(opt[SCALER_KEY]["skipped"]) == 0
+    assert not _leaves_equal(before, opt[MASTER_KEY])
+    for p, m in zip(jax.tree.leaves(params),
+                    jax.tree.leaves(opt[MASTER_KEY])):
+        assert p.dtype == jnp.float16
+        assert m.dtype == jnp.float32
+        np.testing.assert_array_equal(np.asarray(p),
+                                      np.asarray(m, np.float16))
+
+
+def test_missing_scaler_or_master_state_raises():
+    """Direct build_train_step users get a clear trace-time error when
+    the policy needs state they did not seed."""
+    RandomGenerator.set_seed(3)
+    model = _mlp().training()
+    model.ensure_initialized()
+    optim = SGD(learning_rate=0.1)
+    params = model.get_parameters()
+    opt_state = optim.init_state(params)  # no SCALER_KEY / MASTER_KEY
+    step = build_train_step(model, nn.ClassNLLCriterion(), optim,
+                            precision=PrecisionPolicy.f16_mixed())
+    x, y = _batch()
+    with pytest.raises(ValueError, match="scaler state"):
+        step(params, opt_state, model.get_state(),
+             jax.random.PRNGKey(0), 0.1, x, y)
+
+
+def test_eval_step_runs_compute_dtype_casts_output():
+    RandomGenerator.set_seed(3)
+    model = _mlp().evaluate()
+    model.ensure_initialized()
+    ev = build_eval_step(model, precision=PrecisionPolicy.bf16_mixed())
+    x, _ = _batch()
+    out = ev(model.get_parameters(), model.get_state(), x)
+    assert out.dtype == jnp.float32   # output_dtype — what scoring sees
+
+
+# -------------------------------------------------- Optimizer surface
+
+def _toy_ds(n=256, d=16, classes=4, batch=32, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(classes, d).astype(np.float32) * 3
+    X = np.stack([centers[i % classes]
+                  + rng.randn(d).astype(np.float32) * 0.5
+                  for i in range(n)])
+    y = np.array([i % classes + 1 for i in range(n)], np.float32)
+    return DataSet.array([Sample(X[i], y[i]) for i in range(n)]) \
+        .transform(SampleToMiniBatch(batch))
+
+
+def _mlp16():
+    return nn.Sequential().add(nn.Linear(16, 32)).add(nn.Tanh()) \
+        .add(nn.Linear(32, 4)).add(nn.LogSoftMax())
+
+
+def _run_optimizer(k=1, precision=None, scaler=None, zero=None,
+                   mesh=None, iters=8, seed=7):
+    RandomGenerator.set_seed(seed)
+    opt = Optimizer(_mlp16(), _toy_ds(), nn.ClassNLLCriterion(),
+                    batch_size=32, mesh=mesh)
+    opt.set_optim_method(SGD(learning_rate=0.1, momentum=0.9))
+    opt.set_end_when(max_iteration(iters))
+    opt.set_steps_per_sync(k)
+    if precision is not None:
+        opt.set_precision(precision, scaler)
+    if zero is not None:
+        from bigdl_tpu.parallel import ZeroConfig
+        opt.set_zero(ZeroConfig(stage=zero))
+    model = opt.optimize()
+    return [np.asarray(l)
+            for l in jax.tree_util.tree_leaves(model.get_parameters())]
+
+
+def test_set_precision_validates_inputs():
+    opt = Optimizer(_mlp16(), _toy_ds(), nn.ClassNLLCriterion())
+    with pytest.raises(ValueError, match="unknown precision preset"):
+        opt.set_precision("fp4")
+    with pytest.raises(TypeError, match="PrecisionPolicy"):
+        opt.set_precision(16)
+    with pytest.raises(TypeError, match="DynamicLossScaler"):
+        opt.set_precision("f16_mixed", scaler="big")
+    assert opt.set_precision("bf16_mixed") is opt     # fluent
+    assert opt.set_precision(None) is opt             # revert
+
+
+def test_k1_vs_k8_bit_identical_with_scaler_in_carry():
+    """set_precision composes with set_steps_per_sync: the f16 loss
+    scaler's state rides the donated scan carry, and the K=8 fused
+    window is bit-identical to the per-step loop — overflow/backoff
+    transitions included."""
+    sc = DynamicLossScaler(init_scale=256.0, growth_interval=4)
+    p1 = _run_optimizer(k=1, precision="f16_mixed", scaler=sc)
+    p8 = _run_optimizer(k=8, precision="f16_mixed", scaler=sc)
+    for a, b in zip(p1, p8):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_zero2_bf16_within_bound_of_f32_stage0(devices8):
+    """set_precision composes with set_zero: stage-2 bf16 gradients
+    reduce-scatter in bf16 and the f32-accumulated update lands within
+    the documented 5e-3 short-run bound of the f32 stage-0 reference
+    (docs/precision.md — measured ~2e-4 at this scale)."""
+    from bigdl_tpu.parallel import make_mesh
+    mesh = make_mesh([8], ["data"], devices8)
+    p0 = _run_optimizer(mesh=mesh)
+    pz = _run_optimizer(mesh=mesh, precision="bf16_mixed", zero=2)
+    err = max(float(np.abs(a - b).max()) for a, b in zip(p0, pz))
+    assert err < 5e-3, f"zero2+bf16 err {err}"
+
+
+def test_precision_gauges_exported():
+    """train/precision/* gauges carry the policy, the scale and the
+    skip count after an f16 run (loss-scale trajectory is host-visible
+    at every sync)."""
+    sc = DynamicLossScaler(init_scale=256.0, growth_interval=4)
+    _run_optimizer(k=2, precision="f16_mixed", scaler=sc, iters=4)
+    g = telemetry.gauge("train/precision/policy_info")
+    assert g.value(policy="f16_mixed", param="float16",
+                   compute="float16", accum="float32") == 1.0
+    assert telemetry.gauge("train/precision/loss_scale").value() > 0
+    assert telemetry.gauge("train/precision/skipped_steps").value() >= 0
+    # the f32-equivalent "before" bytes: params are f16 at rest, so the
+    # counterfactual f32 layout must cost ~2x the measured one
+    f32b = telemetry.gauge(
+        "train/precision/params_f32_bytes_per_chip").value()
+    realb = telemetry.gauge(
+        "train/memory/params_bytes_per_chip").value()
+    assert f32b > realb
+
+
+# ------------------------------------- calibration + serving int8 gate
+
+def test_scale_estimation_single_path():
+    """ops/quant.quantize_symmetric == scale_from_amax +
+    quantize_with_scale — the ONE max-abs rule every consumer shares."""
+    from bigdl_tpu.ops.quant import (quantize_symmetric,
+                                     quantize_with_scale, scale_from_amax)
+    w = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+    q, scale = quantize_symmetric(w, axis=0)
+    amax = np.max(np.abs(w), axis=1, keepdims=True)
+    np.testing.assert_allclose(np.asarray(scale),
+                               np.asarray(scale_from_amax(amax)),
+                               rtol=1e-6)
+    np.testing.assert_array_equal(
+        np.asarray(q), np.asarray(quantize_with_scale(w, scale)))
+
+
+def test_collect_activation_scales_records_input_peaks():
+    from bigdl_tpu.ops.quant import scale_from_amax
+    from bigdl_tpu.precision.calibrate import collect_activation_scales
+    RandomGenerator.set_seed(5)
+    lin = nn.Linear(4, 3)
+    model = nn.Sequential().add(lin)
+    model.evaluate()
+    model.ensure_initialized()
+    b1 = np.full((2, 4), 2.0, np.float32)
+    b2 = np.full((2, 4), -5.0, np.float32)
+    scales = collect_activation_scales(model, [b1, b2])
+    assert set(scales) == {id(lin)}
+    np.testing.assert_allclose(scales[id(lin)],
+                               float(np.asarray(scale_from_amax(5.0))),
+                               rtol=1e-6)
+    # the transient recording wrapper must be gone afterwards
+    assert "apply" not in lin.__dict__
+
+
+def test_collect_activation_scales_validates():
+    from bigdl_tpu.precision.calibrate import collect_activation_scales
+    model = nn.Sequential().add(nn.Tanh())
+    with pytest.raises(ValueError, match="no quantizable layers"):
+        collect_activation_scales(model, [np.ones((1, 4), np.float32)])
+    lin_model = nn.Sequential().add(nn.Linear(4, 3))
+    lin_model.ensure_initialized()
+    with pytest.raises(ValueError, match="at least one batch"):
+        collect_activation_scales(lin_model, [])
+
+
+def test_quantized_linear_calibrated_close_to_dynamic():
+    """A representative static activation scale reproduces the dynamic
+    per-batch estimate within quantization noise — and skips the amax
+    reduce on the hot path."""
+    from bigdl_tpu.ops.quant import quantized_linear, quantize_symmetric
+    rng = np.random.RandomState(2)
+    x = rng.randn(8, 16).astype(np.float32)
+    w = rng.randn(4, 16).astype(np.float32) * 0.1
+    w_q, w_s = quantize_symmetric(w, axis=0)
+    dyn = np.asarray(quantized_linear(x, w_q, w_s))
+    static_scale = float(np.max(np.abs(x))) / 127.0
+    cal = np.asarray(quantized_linear(x, w_q, w_s, x_scale=static_scale))
+    ref = x @ w.T
+    assert np.abs(cal - ref).max() < 0.1
+    assert np.abs(cal - dyn).max() < 0.1
+
+
+def test_registry_calibrated_gated_load_passes_and_records_delta():
+    from bigdl_tpu.serving.registry import ModelRegistry
+    RandomGenerator.set_seed(5)
+    model = nn.Sequential().add(nn.Linear(8, 32)).add(nn.ReLU()) \
+        .add(nn.Linear(32, 4))
+    model.evaluate()
+    model.ensure_initialized()
+    rng = np.random.RandomState(1)
+    calib = [rng.randn(16, 8).astype(np.float32) for _ in range(2)]
+    gate = AccuracyGate(inputs=rng.randn(64, 8).astype(np.float32),
+                        max_delta=0.05)
+    reg = ModelRegistry()
+    sv = reg.load("prec_ok", model, quantize=True, calibration=calib,
+                  accuracy_gate=gate)
+    assert reg.current("prec_ok").version == sv.version
+    # delta gauge recorded (near-misses visible on dashboards too)
+    assert telemetry.gauge("serving/precision/accuracy_delta") \
+        .value(model="prec_ok") <= 0.05
+
+
+def test_registry_refuses_swap_when_gate_trips():
+    """The acceptance-criteria path: a quantized candidate calibrated on
+    unrepresentative batches (activations clip hard at serve range)
+    exceeds the gate bound — the load raises, nothing is registered,
+    the old state keeps serving."""
+    from bigdl_tpu.serving.registry import ModelRegistry
+    RandomGenerator.set_seed(5)
+    model = nn.Sequential().add(nn.Linear(8, 32)).add(nn.ReLU()) \
+        .add(nn.Linear(32, 4))
+    model.evaluate()
+    model.ensure_initialized()
+    rng = np.random.RandomState(1)
+    bad_calib = [rng.randn(16, 8).astype(np.float32) * 1e-4
+                 for _ in range(2)]
+    gate = AccuracyGate(inputs=rng.randn(64, 8).astype(np.float32) * 50,
+                        max_delta=0.02)
+    reg = ModelRegistry()
+    with pytest.raises(AccuracyGateError, match="exceeds the gate"):
+        reg.load("prec_bad", model, quantize=True,
+                 calibration=bad_calib, accuracy_gate=gate)
+    assert "prec_bad" not in reg.names()     # nothing staged
+    # the near-miss delta still lands in the gauge
+    assert telemetry.gauge("serving/precision/accuracy_delta") \
+        .value(model="prec_bad") > 0.02
+
+
+def test_registry_gate_requires_quantize():
+    from bigdl_tpu.serving.registry import ModelRegistry
+    model = nn.Linear(4, 2)
+    with pytest.raises(ValueError, match="quantize=True"):
+        ModelRegistry().load("f", model, calibration=[np.ones((1, 4))])
+
+
+def test_diagnose_precision_section():
+    """tools/diagnose renders the precision section from the registry
+    snapshot: policy dtypes, loss-scale (with trajectory from snapshot
+    history), skipped steps, and the params/opt bytes before/after."""
+    from bigdl_tpu.tools.diagnose import (_precision_lines,
+                                          precision_summary)
+    sc = DynamicLossScaler(init_scale=256.0, growth_interval=4)
+    _run_optimizer(k=2, precision="f16_mixed", scaler=sc, iters=4)
+    snap = telemetry.registry().snapshot()
+    prec = precision_summary(snap, history=[snap])
+    assert prec["policy"]["policy"] == "f16_mixed"
+    assert prec["policy"]["compute"] == "float16"
+    assert prec["loss_scale"] > 0
+    assert len(prec["loss_scale_trajectory"]) == 2
+    assert prec["skipped_steps"] >= 0
+    assert prec["params_bytes_ratio_vs_f32"] < 1.0  # f16 at rest
+    lines = "\n".join(_precision_lines(prec))
+    assert "policy: f16_mixed" in lines
+    assert "loss_scale:" in lines and "trajectory" in lines
+    assert "bytes/chip" in lines
+
+
+# ------------------------------------------------- shapecheck surface
+
+def test_shapecheck_diagnostics_carry_policy_dtypes():
+    from bigdl_tpu.analysis import spec
+    bad = nn.Sequential().add(nn.Linear(16, 32)).add(nn.Linear(8, 4))
+    report = bad.check(spec((None, 16), np.float32),
+                       raise_on_error=False,
+                       policy=PrecisionPolicy.bf16_mixed())
+    assert not report.ok
+    d = report.diagnostics[0]
+    assert d.policy and "bf16_mixed" in d.policy
+    assert "compute=bfloat16" in d.policy
+    assert "[policy:" in str(d)
+    # the traced input really was compute dtype
+    assert "bfloat16" in (d.input_shapes or "")
+
+
+def test_shapecheck_ok_model_traces_under_policy():
+    from bigdl_tpu.analysis import spec
+    ok = nn.Sequential().add(nn.Linear(16, 32)).add(nn.Tanh()) \
+        .add(nn.Linear(32, 4))
+    report = ok.check(spec((None, 16), np.float32),
+                      policy=PrecisionPolicy.bf16_mixed())
+    assert report.ok
