@@ -25,6 +25,15 @@ import time
 # BASELINE.md "working baseline" — see §North star.
 REFERENCE_BASELINE_IMGS_PER_SEC = 56.0
 
+# The JSON line's schema version, checked by the regression sentinel
+# (python -m bigdl_tpu.tools.regress): bump it whenever a tracked key
+# is RENAMED or changes meaning (adding keys is compatible — the
+# sentinel reports unknown-to-it keys as "new" and ignores config
+# echo). Version 2 = the documented stable key set: "metric"/"value"/
+# "unit"/"vs_baseline" plus the optional per-row keys (steps_per_sync,
+# *_per_sec*, *_ms_p*, PROGRAMS' programs_*_mfu/_hbm_bytes, ...).
+BENCH_SCHEMA_VERSION = 2
+
 
 def _maybe_metrics_snapshot(result):
     """One flag, default off (BIGDL_METRICS_JSONL=path): append a
@@ -210,6 +219,7 @@ def main():
         dt = time.time() - t0
         imgs_per_sec = batch * scan * iters / dt
         result = {
+            "schema_version": BENCH_SCHEMA_VERSION,
             "metric":
                 "resnet50_imagenet_train_devcached_imgs_per_sec_per_chip",
             "value": round(imgs_per_sec, 2),
@@ -297,6 +307,7 @@ def main():
         dt = t_end - t0
         imgs_per_sec = batch * done / dt
         result = {
+            "schema_version": BENCH_SCHEMA_VERSION,
             "metric":
                 "resnet50_imagenet_train_shardrotate_imgs_per_sec_per_chip",
             "value": round(imgs_per_sec, 2),
@@ -355,6 +366,7 @@ def main():
             loader.close()
         imgs_per_sec = batch * scan * iters / dt
         result = {
+            "schema_version": BENCH_SCHEMA_VERSION,
             "metric": "resnet50_imagenet_train_fed_imgs_per_sec_per_chip",
             "value": round(imgs_per_sec, 2),
             "unit": "images/sec",
@@ -397,6 +409,7 @@ def main():
 
     imgs_per_sec = batch * scan * iters / dt
     result = {
+        "schema_version": BENCH_SCHEMA_VERSION,
         "metric": "resnet50_imagenet_train_imgs_per_sec_per_chip",
         "value": round(imgs_per_sec, 2),
         "unit": "images/sec",
@@ -473,6 +486,19 @@ def main():
     # the measured delta, not a win.
     if _row_enabled("BENCH_PRECISION", platform):
         result.update(_bench_precision())
+    # eighth tracked row: PROGRAMS — per-model device-side program
+    # profiles (bigdl_tpu.telemetry.programs): analytic MFU + HBM
+    # bytes + compile time for the resnet50 train window and the
+    # eval forward, from XLA's own cost/memory analysis combined with
+    # the rates this run just measured. The regression sentinel
+    # (tools/regress) tracks these keys. Skipped on CPU smoke runs
+    # unless forced — each profile pays one extra AOT compile.
+    if _row_enabled("BENCH_PROGRAMS", platform):
+        result.update(_bench_programs(
+            model, run_chunk, carry,
+            jax.random.split(jax.random.fold_in(root, 999), scan),
+            batch, scan, imgs_per_sec,
+            result.get("resnet50_inference_imgs_per_sec_per_chip")))
     print(json.dumps(result))
     _maybe_metrics_snapshot(result)
 
@@ -1023,6 +1049,57 @@ def _bench_precision():
     row["precision_serving_int8_speedup"] = round(sint8 / sf32, 3)
     row["precision_int8_accuracy_delta"] = round(delta, 4)
     row["precision_int8_gate_max_delta"] = gate.max_delta
+    return row
+
+
+def _bench_programs(model, run_chunk, carry, keys, batch, scan,
+                    train_rate, infer_rate):
+    """PROGRAMS row: register the resnet50 train window (and eval
+    forward) in the program-profile registry and combine the analytic
+    FLOPs/HBM numbers with the rates the earlier rows measured —
+    per-model MFU + HBM bytes as sentinel-tracked scoreboard keys."""
+    import time as _time
+
+    import jax
+
+    from bigdl_tpu.optim.optimizer import build_eval_step
+    from bigdl_tpu.telemetry import programs
+
+    reg = programs.registry()
+    row = {}
+
+    t0 = _time.perf_counter()
+    compiled = run_chunk.lower(carry, keys).compile()
+    compile_s = _time.perf_counter() - t0
+    reg.register("bench/resnet50/train_window", "train",
+                 compiled=compiled, compile_s=compile_s,
+                 scan_length=scan, items_per_call=batch * scan,
+                 donation="carry")
+    prof = reg.record_rate("bench/resnet50/train_window", train_rate)
+    row["programs_resnet50_train_hbm_bytes"] = int(prof.hbm_bytes)
+    row["programs_resnet50_train_flops_per_img"] = round(
+        prof.flops / (batch * scan), 1)
+    row["programs_resnet50_train_compile_s"] = round(compile_s, 3)
+    if prof.mfu is not None:
+        row["programs_resnet50_train_mfu"] = round(prof.mfu, 4)
+        row["programs_resnet50_train_achieved_tfs"] = round(
+            prof.achieved_tfs, 3)
+
+    # eval forward at the same batch (params/state ride the final carry
+    # — the originals were donated into the train chunk)
+    eval_step = build_eval_step(model)
+    x = jax.numpy.zeros((batch, 3, 224, 224), jax.numpy.float32)
+    t0 = _time.perf_counter()
+    compiled = eval_step.lower(carry[0], carry[2], x).compile()
+    compile_s = _time.perf_counter() - t0
+    reg.register("bench/resnet50/eval", "train", compiled=compiled,
+                 compile_s=compile_s, items_per_call=batch)
+    row["programs_resnet50_eval_hbm_bytes"] = int(
+        reg.get("bench/resnet50/eval").hbm_bytes)
+    if infer_rate:
+        prof = reg.record_rate("bench/resnet50/eval", infer_rate)
+        if prof is not None and prof.mfu is not None:
+            row["programs_resnet50_eval_mfu"] = round(prof.mfu, 4)
     return row
 
 

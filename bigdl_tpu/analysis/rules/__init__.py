@@ -16,9 +16,10 @@ Shipped rules:
 - ``gather-in-step-loop`` — loop-invariant collectives in host step loops
 - ``retry-no-backoff`` — broad-except retry loops with fixed sleeps
 - ``unseeded-shuffle`` — data-path shuffles without a seeded Generator
+- ``metric-label-cardinality`` — metric labels from loop vars / request ids
 """
 from bigdl_tpu.analysis.rules import (data, jit_calls, perf, purity,
-                                      robust, style, traced)
+                                      robust, style, telemetry, traced)
 
 __all__ = ["data", "jit_calls", "perf", "purity", "robust", "style",
-           "traced"]
+           "telemetry", "traced"]

@@ -1,0 +1,180 @@
+"""Telemetry-hygiene rules: metric series that grow without bound.
+
+A Counter/Gauge/Histogram label set IS a time series: every distinct
+label value allocates an independent series in the registry and in
+every exporter downstream (Prometheus explicitly documents this as the
+cardinality-explosion failure mode). A label value built from a loop
+variable or a per-request id — ``reqs.inc(req=f"req-{i}")``,
+``lat.observe(ms, trace=str(trace_id))`` — therefore leaks memory at
+traffic rate and renders dashboards unreadable. Bounded identity
+(model name, phase, fault point) belongs in labels; per-request
+identity (``trace_id``) belongs in **span args**, where the ring
+buffer bounds it by construction.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from bigdl_tpu.analysis.lint import FileContext, rule
+
+#: instrument update methods whose kwargs are label values
+_UPDATE_METHODS = {"inc", "set", "add", "observe"}
+
+#: constructors whose result is an instrument (dotted-canon suffixes):
+#: telemetry.counter(...), registry.gauge(...), r.histogram(...)
+_INSTRUMENT_SUFFIXES = ("counter", "gauge", "histogram")
+
+#: per-request identity names — these go in span args, never labels
+_ID_NAME = re.compile(r"^(trace|request|req|span|stream|gen)_?id$")
+
+
+def _imports_telemetry(ctx: FileContext) -> bool:
+    return any(v.startswith("bigdl_tpu.telemetry") or v == "telemetry"
+               for v in ctx.aliases.values())
+
+
+def _is_instrument_ctor(ctx: FileContext, node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    c = ctx.canon(node.func)
+    return c is not None and c.split(".")[-1] in _INSTRUMENT_SUFFIXES
+
+
+def _dotted(node: ast.AST):
+    """``self._c_reqs`` -> "self._c_reqs" (None for non-name chains)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _instrument_bindings(ctx: FileContext) -> set:
+    """Names (and ``self.attr`` chains) assigned from an instrument
+    constructor anywhere in the file — the receivers whose update
+    calls this rule inspects."""
+    bound = set()
+    for node in ctx.walk(ast.Assign):
+        if not _is_instrument_ctor(ctx, node.value):
+            continue
+        for t in node.targets:
+            d = _dotted(t)
+            if d is not None:
+                bound.add(d)
+    return bound
+
+
+def _loop_bound_names(ctx: FileContext, node: ast.AST) -> set:
+    """Names bound by loops enclosing ``node`` (for targets,
+    comprehension targets, while-body assignments)."""
+    bound = set()
+    cur = ctx.parent(node)
+    while cur is not None:
+        if isinstance(cur, (ast.For, ast.While, ast.comprehension,
+                            ast.GeneratorExp, ast.ListComp,
+                            ast.SetComp, ast.DictComp)):
+            for sub in ast.walk(cur):
+                if isinstance(sub, (ast.For, ast.comprehension)):
+                    for e in ast.walk(sub.target):
+                        if isinstance(e, ast.Name):
+                            bound.add(e.id)
+                elif isinstance(cur, ast.While) \
+                        and isinstance(sub, (ast.Assign, ast.AugAssign)):
+                    targets = sub.targets if isinstance(sub, ast.Assign) \
+                        else [sub.target]
+                    for t in targets:
+                        for e in ast.walk(t):
+                            if isinstance(e, ast.Name):
+                                bound.add(e.id)
+        cur = ctx.parent(cur)
+    return bound
+
+
+def _names_in(node: ast.AST) -> set:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _unbounded_reason(ctx: FileContext, value: ast.AST,
+                      loop_bound: set):
+    """Why this label value grows without bound, or None.
+
+    Flags f-strings and ``str()``/``repr()``/``format()`` of loop
+    variables or id-like names, and bare id-like names/attributes
+    (``trace_id`` itself is already one series per request)."""
+    if isinstance(value, ast.JoinedStr):
+        inner = set()
+        for part in value.values:
+            if isinstance(part, ast.FormattedValue):
+                inner |= _names_in(part.value)
+        if inner & loop_bound:
+            return "an f-string of a loop variable"
+        if any(_ID_NAME.match(n) for n in inner):
+            return "an f-string of a per-request id"
+        return None
+    if isinstance(value, ast.Call) and isinstance(value.func, ast.Name) \
+            and value.func.id in ("str", "repr", "format") and value.args:
+        inner = _names_in(value.args[0])
+        if inner & loop_bound:
+            return f"{value.func.id}() of a loop variable"
+        if any(_ID_NAME.match(n) for n in inner):
+            return f"{value.func.id}() of a per-request id"
+        return None
+    name = None
+    if isinstance(value, ast.Name):
+        name = value.id
+    elif isinstance(value, ast.Attribute):
+        name = value.attr
+    if name is not None and _ID_NAME.match(name):
+        return "a per-request id"
+    return None
+
+
+@rule("metric-label-cardinality",
+      "metric label values built from loop variables / request ids")
+def metric_label_cardinality(ctx: FileContext):
+    """Flags ``inc``/``set``/``add``/``observe`` calls on telemetry
+    instruments whose label kwargs are built from f-strings/``str()``
+    of loop variables or per-request ids (``trace_id`` & co.): each
+    distinct value is a new series, so the registry and every exporter
+    grow at traffic rate. Receivers are tracked from instrument
+    constructor assignments (``x = telemetry.counter(...)``,
+    ``self._c = r.gauge(...)``) or direct constructor chains, so
+    ``set.add``/dict ``.set`` calls never false-positive."""
+    if not _imports_telemetry(ctx):
+        return
+    instruments = None
+    for call in ctx.walk(ast.Call):
+        if not isinstance(call.func, ast.Attribute) \
+                or call.func.attr not in _UPDATE_METHODS \
+                or not call.keywords:
+            continue
+        recv = call.func.value
+        if _is_instrument_ctor(ctx, recv):
+            pass  # telemetry.counter("...").inc(...)
+        else:
+            if instruments is None:
+                instruments = _instrument_bindings(ctx)
+            d = _dotted(recv)
+            if d is None or d not in instruments:
+                continue
+        loop_bound = None
+        for kw in call.keywords:
+            if kw.arg is None:
+                continue  # **labels forwarding: values not visible here
+            if loop_bound is None:
+                loop_bound = _loop_bound_names(ctx, call)
+            reason = _unbounded_reason(ctx, kw.value, loop_bound)
+            if reason:
+                yield kw.value, (
+                    f"label {kw.arg!r} is {reason}: every distinct "
+                    "value allocates a new metric series (unbounded "
+                    "cardinality at traffic rate) — per-request "
+                    "identity belongs in span args "
+                    "(telemetry.span(..., trace_id=...)), labels in a "
+                    "small fixed vocabulary; a deliberate bounded use "
+                    "can carry `# bigdl: "
+                    "disable=metric-label-cardinality`")

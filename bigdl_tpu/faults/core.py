@@ -310,9 +310,14 @@ def point(name: str, /, **ctx) -> None:
     if hit is None:
         return
     _INJECTED.inc(point=name)
+    from bigdl_tpu.telemetry import flight
+    flight.note("fault", point=name, action=hit.action)
     if hit.delay_ms:
         time.sleep(hit.delay_ms / 1000.0)
     if hit.action == "sigkill":
+        # the sigkill-adjacent flight dump: the bundle on disk is the
+        # only thing that survives the next line
+        flight.on_fatal(f"faults/{name}")
         import signal
         os.kill(os.getpid(), signal.SIGKILL)
     if hit.action == "raise":

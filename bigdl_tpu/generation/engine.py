@@ -53,9 +53,19 @@ class DecodeEngine:
         self._keys: Dict[Tuple, Set[Tuple]] = {}
 
     # ------------------------------------------------------- programs
+    # items one program call processes (program-profile MFU basis):
+    # prefill computes rows x bucket prompt tokens, decode one token
+    # per slot — both read the tokens operand (positional arg 4)
+    _PROFILE_ITEMS = {
+        "prefill": lambda args, kwargs: (args[4].shape[0]
+                                         * args[4].shape[1]),
+        "decode": lambda args, kwargs: args[4].shape[0],
+    }
+
     def _program(self, servable, kind: str, bucket: int, build):
         key = servable.key + (kind, bucket)
-        prog = self.cache.program_for(key, build)
+        prog = self.cache.program_for(
+            key, build, profile_items=self._PROFILE_ITEMS.get(kind))
         with self._lock:
             self._keys.setdefault(servable.key, set()).add(key)
         return prog

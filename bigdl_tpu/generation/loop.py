@@ -23,6 +23,7 @@ caches exist only during the overlap).
 """
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from collections import OrderedDict, deque
@@ -159,6 +160,7 @@ class DecodeLoop:
         self._h_prefill_fill = inst["prefill_fill"]
 
         self._cond = threading.Condition()
+        self._seq = itertools.count(1)  # trace_id suffixes
         self._queue: Deque[_Gen] = deque()
         self._groups: "OrderedDict[tuple, _Group]" = OrderedDict()
         self._stopping = False
@@ -197,7 +199,9 @@ class DecodeLoop:
             else self._timeout_ms
         deadline = (time.monotonic() + timeout_ms / 1000.0
                     if timeout_ms is not None else None)
-        stream = TokenStream(prompt.shape[0], max_new)
+        stream = TokenStream(prompt.shape[0], max_new,
+                             trace_id=f"{self._name}/gen-"
+                                      f"{next(self._seq)}")
         gen = _Gen(prompt, stream, Sampler(sampling), max_new, deadline)
         with self._cond:
             if self._stopping:
@@ -259,6 +263,11 @@ class DecodeLoop:
                     self._g_depth.set(0, **self._labels)
                     self._g_occupancy.set(0.0, **self._labels)
                     self._cond.notify_all()
+                # post-mortem bundle BEFORE failing streams: the last
+                # decode spans + generation gauges are the evidence
+                from bigdl_tpu.telemetry import flight
+                flight.on_fatal("serving/decode", e,
+                                metrics=self.registry_metrics)
                 err = WorkerDied(
                     f"decode loop {self._name!r} died: "
                     f"{type(e).__name__}: {e}")
@@ -348,15 +357,46 @@ class DecodeLoop:
             for g in gens:
                 g.slot = group.kv.allocator.alloc()
                 group.gens[g.slot] = g
+        t0 = time.monotonic()
         with telemetry.span("serving/prefill", model=self._name, rows=n):
             logits, _ = self._engine.prefill(
                 servable, group.kv, [g.prompt for g in gens],
                 [g.slot for g in gens])
+        t1 = time.monotonic()
         self._h_prefill_fill.observe(n / self._engine.prefill_rows,
                                      **self._labels)
         for i, g in enumerate(gens):
             self._emit(group, g, g.sampler.sample(logits[i]))
+        if telemetry.enabled():
+            self._request_tracks_prefill(gens, t0, t1,
+                                         time.monotonic())
         self._g_occupancy.set(group.kv.occupancy(), **self._labels)
+
+    def _request_tracks_prefill(self, gens: List[_Gen], t0: float,
+                                t1: float, t2: float) -> None:
+        """Per-request trace spans for one admission: queue wait
+        (submit -> prefill dispatch), the prefill itself (flow-linked
+        back to this decode thread's ``serving/prefill`` span), and
+        the first token — which the prefill program computed — so a
+        request's token count equals its ``serving/request/decode``
+        span count in the export."""
+        tr = telemetry.tracer()
+        tok_dur = (t2 - t1) / max(len(gens), 1)
+        for i, g in enumerate(gens):
+            tid = tr.track(f"req {g.stream.trace_id}")
+            args = {"trace_id": g.stream.trace_id, "model": self._name}
+            tr.record_span("serving/request/queue_wait",
+                           g.stream._t_submit, t0 - g.stream._t_submit,
+                           tid=tid, args=args)
+            tr.record_span("serving/request/prefill", t0, t1 - t0,
+                           tid=tid,
+                           args=dict(args, slot=g.slot,
+                                     prompt_len=int(g.prompt.shape[0])),
+                           flow=g.stream.trace_id)
+            tr.record_span("serving/request/decode",
+                           t1 + i * tok_dur, tok_dur, tid=tid,
+                           args=dict(args, token=0, phase="prefill",
+                                     ttft_ms=g.stream.ttft_ms))
 
     # ---------------------------------------------------- decode step
     def _decode_step(self) -> None:
@@ -393,6 +433,18 @@ class DecodeLoop:
             real = int(kv.lengths[live].sum()) + len(live)
             self._g_padding.set(real / (len(live) * attend_len),
                                 **self._labels)
+            if telemetry.enabled():
+                # one token span per live request on its own track —
+                # the per-token decode cadence of a single trace_id
+                tr = telemetry.tracer()
+                for slot in live:
+                    g = group.gens[slot]
+                    tr.record_span(
+                        "serving/request/decode", t0, now - t0,
+                        tid=tr.track(f"req {g.stream.trace_id}"),
+                        args={"trace_id": g.stream.trace_id,
+                              "model": self._name, "token": g.produced,
+                              "attend_len": attend_len})
             for slot in live:
                 g = group.gens[slot]
                 kv.lengths[slot] += 1  # g.last's K/V landed this step

@@ -33,9 +33,14 @@ class TokenStream:
     after a clean finish. Write side (`_push`/`_finish`/`_fail`) is
     driver-only."""
 
-    def __init__(self, prompt_len: int, max_new_tokens: int):
+    def __init__(self, prompt_len: int, max_new_tokens: int,
+                 trace_id: Optional[str] = None):
         self.prompt_len = prompt_len
         self.max_new_tokens = max_new_tokens
+        #: per-request trace id assigned at submit; with span tracing
+        #: on, the Chrome-trace export renders this request's queue
+        #: wait, prefill and per-token decode cadence on its own track
+        self.trace_id = trace_id
         self.finish_reason: Optional[str] = None
         #: resolves to the np.int32 array of generated tokens, or to
         #: the stream's typed error

@@ -218,6 +218,23 @@ def _local_rows(x) -> np.ndarray:
         return np.concatenate(parts)
 
 
+def train_program_name(module: Module, suffix: str = "step") -> str:
+    """The program-profile name a module's compiled train/eval/window
+    program registers under (``telemetry.programs``) — ONE naming rule
+    so the build sites and the rate-recording sync points agree. Uses
+    the module's explicit ``set_name`` when given (stable across
+    processes), else its class name."""
+    name = getattr(module, "_name", None) or type(module).__name__
+    return f"train/{name}/{suffix}"
+
+
+def _batch_rows(inputs) -> int:
+    """Leading-dim row count of a step's inputs (first leaf of a
+    Table/list input) — the item basis program-profile MFU uses."""
+    leaves = jax.tree_util.tree_leaves(inputs)
+    return int(leaves[0].shape[0]) if leaves else 1
+
+
 def build_train_step(module: Module, criterion: Criterion,
                      optim_method: OptimMethod,
                      aux_loss_weight: float = 0.01,
@@ -409,7 +426,14 @@ def build_train_step(module: Module, criterion: Criterion,
                                             sharding_rules)
         return new_params, new_opt, new_mstate, data_loss
 
-    return jax.jit(step, donate_argnums=(0, 1, 2))
+    jitted = jax.jit(step, donate_argnums=(0, 1, 2))
+    # program-profile hook (telemetry.programs; one flag check when
+    # profiling is off): the standalone step registers its XLA
+    # cost/memory analysis under train/program/* on first execution
+    return telemetry.programs.maybe_wrap_jitted(
+        train_program_name(module), "train", jitted,
+        donation="params,opt_state,model_state",
+        items_for=lambda args, kwargs: _batch_rows(args[5]))
 
 
 def build_eval_step(module: Module, out_sharding=None, precision=None):
@@ -431,7 +455,10 @@ def build_eval_step(module: Module, out_sharding=None, precision=None):
                                   training=False)
             return out
 
-    return jax.jit(eval_step, out_shardings=out_sharding)
+    return telemetry.programs.maybe_wrap_jitted(
+        train_program_name(module, "eval"), "train",
+        jax.jit(eval_step, out_shardings=out_sharding),
+        items_for=lambda args, kwargs: _batch_rows(args[2]))
 
 
 class Optimizer:
@@ -1186,6 +1213,9 @@ class Optimizer:
                 retries += 1
                 if classify(e) == "fatal" or retries > self.retry_times \
                         or self.checkpoint_path is None:
+                    # the error is about to escape the process: dump a
+                    # post-mortem bundle (no-op unless flight is armed)
+                    telemetry.flight.on_fatal("train/optimizer", e)
                     raise
                 _RECOVERIES.inc()
                 delay = backoff_delay(retries - 1, self.retry_interval_s,
@@ -1418,7 +1448,12 @@ class Optimizer:
                     body, (p, o, m, ep0, pos0), (keys, lrs))
                 return p, o, m, losses
 
-            window_fn = jax.jit(_window_dev, donate_argnums=(0, 1, 2))
+            window_fn = telemetry.programs.maybe_wrap_jitted(
+                train_program_name(model, "window"), "train",
+                jax.jit(_window_dev, donate_argnums=(0, 1, 2)),
+                donation="params,opt_state,model_state",
+                scan_length_for=lambda a, kw: int(a[3].shape[0]),
+                items_for=lambda a, kw: int(a[3].shape[0]) * plan_bsz)
         elif k_cap > 1:
             def _window_host(p, o, m, keys, lrs, xs, ys):
                 # scan over the [K, B, ...] stacked device buffer
@@ -1432,7 +1467,17 @@ class Optimizer:
                     body, (p, o, m), (keys, lrs, xs, ys))
                 return p, o, m, losses
 
-            host_window_fn = jax.jit(_window_host, donate_argnums=(0, 1, 2))
+            def _host_window_items(a, kw):
+                # xs is the [K, B, ...] stacked window: K*B records
+                leaf = jax.tree_util.tree_leaves(a[5])[0]
+                return int(leaf.shape[0]) * int(leaf.shape[1])
+
+            host_window_fn = telemetry.programs.maybe_wrap_jitted(
+                train_program_name(model, "window"), "train",
+                jax.jit(_window_host, donate_argnums=(0, 1, 2)),
+                donation="params,opt_state,model_state",
+                scan_length_for=lambda a, kw: int(a[3].shape[0]),
+                items_for=_host_window_items)
 
         def device_cursor_args():
             """Step arguments for the device-resident feeds at the
@@ -1676,6 +1721,13 @@ class Optimizer:
                 _RECORD_COUNT.inc(sum(sizes))
                 self.metrics.add("data time", t_data)
                 self.metrics.add("computing time", t_compute)
+                if telemetry.programs.enabled() and t_compute > 0:
+                    # the measured window rate turns the registered
+                    # analytic FLOPs into achieved-TFLOPs/MFU gauges
+                    telemetry.programs.record_rate(
+                        train_program_name(model, "window"),
+                        sum(sizes) / t_compute)
+                telemetry.flight.note_metrics({"step": state["neval"]})
                 rate = sum(sizes) / max(1e-9, t_data + t_compute)
                 for i in range(k_now):
                     post_step(loss_vals[i], lr_list[i], sizes[i], rate)
@@ -1728,6 +1780,10 @@ class Optimizer:
             _RECORD_COUNT.inc(bsz)
             self.metrics.add("data time", t_data)
             self.metrics.add("computing time", t_compute)
+            if telemetry.programs.enabled() and t_compute > 0:
+                telemetry.programs.record_rate(
+                    train_program_name(model), bsz / t_compute)
+            telemetry.flight.note_metrics({"step": state["neval"]})
             post_step(loss_f, lr, bsz,
                       bsz / max(1e-9, t_data + t_compute))
 

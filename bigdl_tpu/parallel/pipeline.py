@@ -237,12 +237,18 @@ def _pipeline_callable(block_fn: Callable, mesh: Mesh, axis_name: str,
                                with_aux=with_aux)
     xs = x_spec if x_spec is not None else P()
     out_specs = (xs, P()) if with_aux else xs
-    return jax.jit(jax.shard_map(
+    jitted = jax.jit(jax.shard_map(
         fn, mesh=mesh,
         in_specs=(P(axis_name), xs),
         out_specs=out_specs,
         axis_names=frozenset({axis_name}) | extra_axes,
         check_vma=False))
+    # program-profile hook (one flag check when profiling is off):
+    # eagerly-dispatched pipeline programs register their cost/memory
+    # analysis; under an outer jit the wrapper is tracer-transparent
+    from bigdl_tpu.telemetry import programs
+    return programs.maybe_wrap_jitted(
+        f"train/pipeline/{schedule}x{n_stages}", "train", jitted)
 
 
 def pipeline_forward(block_fn: Callable, stacked_params, x, mesh: Mesh, *,

@@ -27,6 +27,7 @@ slow pure-python runner to exercise the rejection/timeout paths.
 """
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from collections import deque
@@ -57,14 +58,21 @@ class WorkerDied(RuntimeError):
 
 
 class _Request:
-    __slots__ = ("x", "n_rows", "future", "deadline", "t_enqueue")
+    __slots__ = ("x", "n_rows", "future", "deadline", "t_enqueue",
+                 "trace_id")
 
-    def __init__(self, x: np.ndarray, deadline: Optional[float]):
+    def __init__(self, x: np.ndarray, deadline: Optional[float],
+                 trace_id: str = ""):
         self.x = x
         self.n_rows = x.shape[0]
         self.future: Future = Future()
         self.deadline = deadline
         self.t_enqueue = time.monotonic()
+        #: per-request trace id, assigned at submit and carried through
+        #: queue -> batch -> response (``future.trace_id``); when span
+        #: tracing is on, the Chrome-trace export renders this
+        #: request's queue wait and the batch it rode on its own track
+        self.trace_id = trace_id
 
 
 class BatcherStats:
@@ -280,6 +288,7 @@ class MicroBatcher:
         #: a malformed lone first request fails its own forward without
         #: permanently bricking the name.
         self._sig = None
+        self._seq = itertools.count(1)  # trace_id suffixes
         self._queue: Deque[_Request] = deque()
         self._cond = threading.Condition()
         self._stopping = False
@@ -322,7 +331,9 @@ class MicroBatcher:
                 f"{self.max_batch_size}; split it upstream")
         deadline = (time.monotonic() + timeout_ms / 1000.0
                     if timeout_ms is not None else None)
-        req = _Request(x, deadline)
+        req = _Request(x, deadline,
+                       f"{self._name}/req-{next(self._seq)}")
+        req.future.trace_id = req.trace_id  # response carries the id
         sig = (x.shape[1:], x.dtype)
         with self._cond:
             if self._stopping:
@@ -417,6 +428,11 @@ class MicroBatcher:
                     self.stats.on_worker_death(len(died))
                     self.stats.on_depth(0)
                     self._cond.notify_all()
+                # post-mortem bundle BEFORE failing futures: the
+                # flight recorder's whole reason to exist is this path
+                from bigdl_tpu.telemetry import flight
+                flight.on_fatal("serving/dispatch", e,
+                                metrics=self.stats.registry)
                 err = WorkerDied(
                     f"batcher {self._name!r} dispatch worker died: "
                     f"{type(e).__name__}: {e}")
@@ -458,6 +474,22 @@ class MicroBatcher:
                 self._dispatch(batch, rows)
             self._inflight = []
 
+    def _request_tracks(self, batch: List[_Request], t_dispatch: float,
+                        t_done: float, rows: int, bucket: int) -> None:
+        """Per-request trace spans on each request's virtual track:
+        its queue wait and the batch it rode (flow-linked back to this
+        dispatch thread's ``serving/batch`` span)."""
+        tr = telemetry.tracer()
+        for r in batch:
+            tid = tr.track(f"req {r.trace_id}")
+            args = {"trace_id": r.trace_id, "model": self._name}
+            tr.record_span("serving/request/queue_wait", r.t_enqueue,
+                           t_dispatch - r.t_enqueue, tid=tid, args=args)
+            tr.record_span("serving/request/batch", t_dispatch,
+                           t_done - t_dispatch, tid=tid,
+                           args=dict(args, rows=rows, bucket=bucket),
+                           flow=r.trace_id)
+
     def _dispatch(self, batch: List[_Request], rows: int) -> None:
         bucket = self._ladder.bucket_for(rows)
         from bigdl_tpu.optim.predictor import pad_rows
@@ -489,6 +521,8 @@ class MicroBatcher:
                 # name serves exactly this signature
                 self._sig = (x.shape[1:], x.dtype)
         t_done = time.monotonic()
+        if telemetry.enabled():
+            self._request_tracks(batch, t_dispatch, t_done, rows, bucket)
         self.stats.on_batch(rows, bucket)
         for r in batch:
             self.stats.on_latency((t_done - r.t_enqueue) * 1000.0)

@@ -114,7 +114,16 @@ class CompileCache:
             return key[0]
         return str(key)
 
-    def program_for(self, key, build):
+    @staticmethod
+    def _program_name(key) -> str:
+        # registry keys are (name, version[, kind, bucket]) tuples:
+        # render as a slash path ("lenet/1/prefill/64") — the program
+        # label every */program/* gauge series carries
+        if isinstance(key, tuple):
+            return "/".join(str(p) for p in key)
+        return str(key)
+
+    def program_for(self, key, build, profile_items=None):
         """The (cached) self-counting program for ``key``; built on
         first use by ``build(on_trace) -> jitted callable``, where
         ``on_trace`` must be invoked from inside the traced function
@@ -127,7 +136,13 @@ class CompileCache:
         ``step_for`` (the eval forward every servable gets) and the
         generation engine's per-bucket prefill/decode program pairs
         (:mod:`bigdl_tpu.generation`) both build through here, so ONE
-        counter bounds every kind of program a servable compiles."""
+        counter bounds every kind of program a servable compiles.
+
+        With program profiling on (``telemetry.programs.enable()``),
+        each compiled program additionally registers its cost/memory
+        profile under ``serving/program/*``; ``profile_items(args,
+        kwargs)`` counts the rows/tokens one call processes so measured
+        rates become MFU gauges."""
         with self._lock:
             prog = self._steps.get(key)
             if prog is not None:
@@ -145,6 +160,11 @@ class CompileCache:
                 self._compiles[key] = self._compiles.get(key, 0) + 1
 
         jitted = build(on_trace)
+        from bigdl_tpu.telemetry import programs as _programs
+        jitted = _programs.maybe_wrap_jitted(
+            self._program_name(key), "serving", jitted,
+            items_for=profile_items,
+            auto_rate=profile_items is not None)
 
         def prog(*args, **kwargs):
             t0 = time.perf_counter()
@@ -174,7 +194,9 @@ class CompileCache:
         from bigdl_tpu.optim.predictor import make_eval_step
 
         return self.program_for(
-            key, lambda on_trace: make_eval_step(model, on_trace=on_trace))
+            key, lambda on_trace: make_eval_step(model, on_trace=on_trace),
+            # (params, state, x): the padded batch's rows are the items
+            profile_items=lambda args, kwargs: args[2].shape[0])
 
     def compile_count(self, key=None) -> int:
         """Compilations so far — for ``key``, or in total when None."""

@@ -169,3 +169,13 @@ def snapshot_to_jsonl(path: str, step: Optional[int] = None,
 # without code changes (BIGDL_TELEMETRY=1 python -m bigdl_tpu.tools.perf)
 if os.environ.get("BIGDL_TELEMETRY", "").strip() not in ("", "0"):
     enable()
+
+# sibling subsystems, imported LAST so their module-level instrument
+# registrations find counter()/registry() already defined:
+# - telemetry.programs — XLA program profile registry (cost/memory
+#   analysis, MFU math; BIGDL_PROGRAM_PROFILES=1 arms compile sites)
+# - telemetry.flight — crash flight recorder (post-mortem bundles;
+#   BIGDL_FLIGHT_DIR=/path arms it)
+from bigdl_tpu.telemetry import flight, programs  # noqa: E402,F401
+
+__all__ += ["flight", "programs"]

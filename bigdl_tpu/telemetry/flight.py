@@ -1,0 +1,178 @@
+"""Crash flight recorder: a bounded ring of recent events that dumps a
+post-mortem bundle when something dies.
+
+Production failures are diagnosed from what the process *was doing*
+right before it died — but the span trace and metric snapshots live in
+process memory, which is exactly what a crash destroys. The flight
+recorder keeps an always-cheap bounded ring of notes (fault events,
+fatal classifications, metric snapshots) and, on a fatal path, writes a
+**post-mortem bundle** to disk: the ring as JSONL, the span tracer's
+Chrome trace, the metrics registry snapshot and the program-profile
+registry — everything ``python -m bigdl_tpu.tools.diagnose
+--postmortem <dir>`` needs to reconstruct the last seconds.
+
+Armed fatal paths (all no-ops while disarmed):
+
+- the :class:`~bigdl_tpu.optim.optimizer.Optimizer` retry loop, when it
+  classifies an error fatal (or exhausts its budget) and re-raises;
+- the serving :class:`~bigdl_tpu.serving.batcher.MicroBatcher` and
+  generation :class:`~bigdl_tpu.generation.loop.DecodeLoop`
+  supervisors, when the worker thread dies (``WorkerDied``);
+- :func:`bigdl_tpu.faults.point`'s SIGKILL action, immediately before
+  the process kills itself (the bundle is the only survivor).
+
+Disarmed is the default and costs **one module-flag check** per
+:func:`note` — the ``telemetry.span`` discipline, asserted by a
+micro-benchmark test. Arm with :func:`arm` (or ``BIGDL_FLIGHT_DIR=
+/path``); the per-process dump count is capped so a crash loop cannot
+fill a disk.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+import bigdl_tpu.telemetry as telemetry
+
+__all__ = ["arm", "disarm", "armed", "note", "note_metrics", "on_fatal",
+           "dump", "events", "MANIFEST_FORMAT"]
+
+#: bundle format tag the diagnose ingester checks
+MANIFEST_FORMAT = "bigdl-flight-1"
+
+_DUMPS = telemetry.counter(
+    "telemetry/flight/dumps", "post-mortem bundles written")
+_NOTES = telemetry.counter(
+    "telemetry/flight/notes", "events recorded into the armed ring")
+
+# the ONE flag the disarmed note() fast path reads
+_ARMED = False
+_DIR: Optional[str] = None
+_RING: deque = deque(maxlen=4096)
+_LOCK = threading.Lock()
+_SEQ = [0]
+_MAX_DUMPS = int(os.environ.get("BIGDL_FLIGHT_MAX_DUMPS", 8))
+
+
+def armed() -> bool:
+    """Whether the flight recorder is currently armed."""
+    return _ARMED
+
+
+def arm(directory: Optional[str] = None, capacity: int = 4096) -> str:
+    """Arm the recorder: ring notes accumulate and fatal paths dump
+    bundles under ``directory`` (default ``./flight``; created
+    lazily). Returns the bundle base directory."""
+    global _ARMED, _DIR, _RING
+    with _LOCK:
+        _DIR = directory or _DIR or "flight"
+        if capacity != _RING.maxlen:
+            _RING = deque(_RING, maxlen=capacity)
+        _ARMED = True
+        return _DIR
+
+
+def disarm() -> None:
+    """Disarm the recorder; the ring stays readable via
+    :func:`events` until re-armed or the process exits."""
+    global _ARMED
+    _ARMED = False
+
+
+def note(kind: str, **data) -> None:
+    """Append one event to the ring (no-op while disarmed: one flag
+    check, no clock, no lock)."""
+    if not _ARMED:
+        return
+    rec = {"t": time.time(), "kind": kind}
+    rec.update(data)
+    with _LOCK:
+        _RING.append(rec)
+    _NOTES.inc(kind=kind)
+
+
+def note_metrics(meta: Optional[dict] = None) -> None:
+    """Ring-record a scalarized snapshot of the default metrics
+    registry (call at sync cadence points; no-op while disarmed)."""
+    if not _ARMED:
+        return
+    scalars = telemetry.scalarize(telemetry.registry().snapshot())
+    note("metrics", meta=meta or {}, scalars=scalars)
+
+
+def events() -> list:
+    """Snapshot of the ring (oldest first)."""
+    with _LOCK:
+        return list(_RING)
+
+
+def _error_payload(error: Optional[BaseException]) -> Optional[dict]:
+    if error is None:
+        return None
+    return {"type": type(error).__name__, "message": str(error)}
+
+
+def dump(reason: str, error: Optional[BaseException] = None,
+         metrics=None) -> Optional[str]:
+    """Write one post-mortem bundle directory and return its path
+    (None while disarmed or past the per-process dump cap).
+
+    Bundle contents: ``MANIFEST.json`` (format tag, reason, error,
+    wall time, pid), ``events.jsonl`` (the ring), ``trace.json`` (the
+    span tracer's Chrome trace — empty but well-formed when tracing
+    was off), ``metrics.json`` (default-registry snapshot plus the
+    optional ``metrics`` registry, e.g. a service's private one) and
+    ``programs.json`` (the program-profile registry)."""
+    if not _ARMED:
+        return None
+    with _LOCK:
+        if _SEQ[0] >= _MAX_DUMPS:
+            return None
+        _SEQ[0] += 1
+        seq = _SEQ[0]
+        base = _DIR or "flight"
+        ring = list(_RING)
+    path = os.path.join(base, f"postmortem-{os.getpid()}-{seq:03d}")
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "events.jsonl"), "w") as f:
+        for rec in ring:
+            f.write(json.dumps(rec, default=str) + "\n")
+    telemetry.tracer().export_chrome_trace(
+        os.path.join(path, "trace.json"))
+    snapshots = {"default": telemetry.registry().snapshot()}
+    if metrics is not None and metrics is not telemetry.registry():
+        snapshots["local"] = metrics.snapshot()
+    with open(os.path.join(path, "metrics.json"), "w") as f:
+        json.dump(snapshots, f, default=str)
+    from bigdl_tpu.telemetry import programs
+    with open(os.path.join(path, "programs.json"), "w") as f:
+        json.dump(programs.registry().to_dict(), f, default=str)
+    manifest = {"format": MANIFEST_FORMAT, "reason": reason,
+                "error": _error_payload(error),
+                "wall_time": time.time(), "pid": os.getpid(),
+                "events": len(ring)}
+    with open(os.path.join(path, "MANIFEST.json"), "w") as f:
+        json.dump(manifest, f)
+    _DUMPS.inc(reason=reason)
+    return path
+
+
+def on_fatal(source: str, error: Optional[BaseException] = None,
+             metrics=None) -> Optional[str]:
+    """The fatal-path hook: ring-note the death and dump a bundle
+    (no-op while disarmed — one flag check). ``source`` names the
+    dying subsystem (``train/optimizer``, ``serving/dispatch``,
+    ``serving/decode``, ``faults/<point>``)."""
+    if not _ARMED:
+        return None
+    note("fatal", source=source,
+         error=_error_payload(error))
+    return dump(source, error=error, metrics=metrics)
+
+
+if os.environ.get("BIGDL_FLIGHT_DIR", "").strip():
+    arm(os.environ["BIGDL_FLIGHT_DIR"])

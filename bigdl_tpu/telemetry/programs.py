@@ -1,0 +1,486 @@
+"""Program profile registry: what each compiled XLA program costs.
+
+The device-side half of observability. The span tracer and metrics
+registry answer "where did the *host* wall-clock go"; this module
+answers "which compiled program burned the FLOPs and what HBM it
+holds": every program compiled through ``optim.build_train_step`` /
+``build_eval_step``, the serving :class:`~bigdl_tpu.serving.
+compile_cache.CompileCache` and the generation
+:class:`~bigdl_tpu.generation.engine.DecodeEngine` can register its
+``compiled.cost_analysis()`` FLOPs / bytes-accessed, its
+``memory_analysis()`` HBM footprint (arguments / outputs / temps),
+its compile time and its donation summary — and, combined with a
+measured rate, its achieved TFLOP/s and MFU against the device peak.
+
+Profiling is **opt-in** (``enable()`` or ``BIGDL_PROGRAM_PROFILES=1``)
+because the compile-site hooks pay one extra ahead-of-time compile per
+program to obtain the analyses; disabled (the default), every hook is
+one module-flag check and the jitted callables pass through untouched.
+
+This module is also the ONE home of the cost-analysis → MFU math that
+``tools/ceiling`` pioneered — including the scan-body-counted-once
+caveat (:func:`resolve_per_item_flops`): XLA's ``cost_analysis`` counts
+a ``lax.scan`` body once, not times its trip count, on the backends we
+measured, but that is backend/version-dependent, so the disambiguation
+against a hand estimate lives HERE and ``tools/ceiling``,
+``tools/perf`` and ``bench.py`` all consume it.
+
+Profiles land as gauges (``train/program/*`` / ``serving/program/*``,
+labelled ``program=<name>``) in the default telemetry registry, so the
+TensorBoard / Prometheus / JSONL exporters and ``tools.diagnose``'s
+"device:" section see them like any other series.
+"""
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["ProgramProfile", "ProgramRegistry", "registry", "enable",
+           "disable", "enabled", "analyze_compiled",
+           "resolve_per_item_flops", "mfu_fields", "record_rate",
+           "maybe_wrap_jitted", "register_program_instruments",
+           "DEVICE_TFS"]
+
+#: MFU denominator: device peak TFLOP/s (v5e bf16 peak by default;
+#: override with BIGDL_DEVICE_TFS — the same knob tools/ceiling and
+#: tools/perf always honored)
+DEVICE_TFS = float(os.environ.get("BIGDL_DEVICE_TFS", 197.0))
+
+# the ONE flag the disabled compile-site hooks read (telemetry.span
+# discipline: profiling off must cost a flag check, nothing else)
+_ENABLED = False
+
+#: gauge metrics each registered profile publishes, per family
+_PROFILE_GAUGES = {
+    "flops": "analytic FLOPs per program execution (cost_analysis)",
+    "bytes_accessed": "analytic bytes accessed per execution",
+    "hbm_bytes": "HBM footprint: arguments + outputs + temps bytes",
+    "compile_s": "seconds to compile the program",
+    "arithmetic_intensity": "analytic FLOPs / bytes accessed",
+}
+_RATE_GAUGES = {
+    "achieved_tfs": "measured-rate x analytic-flops TFLOP/s",
+    "mfu": "achieved TFLOP/s / device peak (BIGDL_DEVICE_TFS)",
+}
+
+
+def enabled() -> bool:
+    """Whether program profiling (the extra AOT compile per program)
+    is on."""
+    return _ENABLED
+
+
+def enable() -> None:
+    """Turn program profiling on: compile sites built AFTER this call
+    register cost/memory profiles (idempotent)."""
+    global _ENABLED
+    _ENABLED = True
+
+
+def disable() -> None:
+    """Turn program profiling off; registered profiles stay
+    readable."""
+    global _ENABLED
+    _ENABLED = False
+
+
+def register_program_instruments(r) -> Dict[str, object]:
+    """Get-or-create every ``*/program/*`` gauge in registry ``r`` —
+    the profile registry's whole metric surface, factored out so
+    ``tools.check --telemetry-audit`` audits the real registration
+    calls."""
+    out = {}
+    for family in ("train", "serving"):
+        for metric, desc in {**_PROFILE_GAUGES, **_RATE_GAUGES}.items():
+            name = f"{family}/program/{metric}"
+            out[name] = r.gauge(name, desc)
+    return out
+
+
+def analyze_compiled(compiled) -> Dict[str, float]:
+    """Cost + memory analysis of an AOT-compiled program
+    (``jax.jit(f).lower(...).compile()``), robust to backends that
+    support neither: absent quantities report 0.0.
+
+    Returns flops, bytes_accessed, arg/out/temp/alias bytes and their
+    ``hbm_bytes`` total (arguments + outputs + temps — what the
+    program pins while it runs)."""
+    out = {"flops": 0.0, "bytes_accessed": 0.0, "arg_bytes": 0.0,
+           "out_bytes": 0.0, "temp_bytes": 0.0, "alias_bytes": 0.0,
+           "hbm_bytes": 0.0}
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        if cost:
+            out["flops"] = float(cost.get("flops", 0.0))
+            out["bytes_accessed"] = float(cost.get("bytes accessed", 0.0))
+    except Exception:
+        pass
+    try:
+        mem = compiled.memory_analysis()
+        out["arg_bytes"] = float(mem.argument_size_in_bytes)
+        out["out_bytes"] = float(mem.output_size_in_bytes)
+        out["temp_bytes"] = float(mem.temp_size_in_bytes)
+        out["alias_bytes"] = float(mem.alias_size_in_bytes)
+        out["hbm_bytes"] = (out["arg_bytes"] + out["out_bytes"]
+                            + out["temp_bytes"])
+    except Exception:
+        pass
+    return out
+
+
+def resolve_per_item_flops(flops_per_call: float, items_per_call: float,
+                           scan_length: int = 1,
+                           per_item_estimate: Optional[float] = None
+                           ) -> float:
+    """Per-item FLOPs from a compiled call's analytic total — THE home
+    of the scan-body caveat.
+
+    XLA's ``cost_analysis`` counts a ``lax.scan`` body once, not times
+    its trip count (verified on this backend) — but that is backend/
+    version-dependent, so when the caller supplies a hand-computed
+    ``per_item_estimate`` we pick the interpretation (body-once vs
+    body x ``scan_length``) closest to it, and fall back to the
+    estimate outright when neither is within 4x (a silently-wrong
+    convention would inflate MFU by ``scan_length`` x)."""
+    per_item = flops_per_call / items_per_call  # body counted once
+    if per_item_estimate:
+        cands = (per_item,
+                 flops_per_call / (items_per_call * scan_length))
+        per_item = min(cands, key=lambda c:
+                       abs(math.log(c / per_item_estimate)))
+        if not 0.25 < per_item / per_item_estimate < 4.0:
+            per_item = per_item_estimate
+    return per_item
+
+
+def mfu_fields(rate_per_sec: float, *, flops_per_call: float = None,
+               items_per_call: float = 1.0, scan_length: int = 1,
+               per_item_estimate: Optional[float] = None,
+               peak_tfs: Optional[float] = None) -> Dict[str, float]:
+    """``{achieved_tfs, mfu_vs_peak, peak_tfs}`` from a measured item
+    rate and the compiled call's analytic FLOPs (fallback: the
+    caller-supplied per-item estimate) — byte-compatible with the
+    fields ``tools/ceiling`` always printed; empty when neither FLOPs
+    source is available."""
+    peak = DEVICE_TFS if peak_tfs is None else peak_tfs
+    if flops_per_call is not None and flops_per_call > 0:
+        per_item = resolve_per_item_flops(
+            flops_per_call, items_per_call, scan_length,
+            per_item_estimate)
+        tfs = per_item * rate_per_sec / 1e12
+    elif per_item_estimate:
+        tfs = per_item_estimate * rate_per_sec / 1e12
+    else:
+        return {}
+    return {"achieved_tfs": round(tfs, 2),
+            "mfu_vs_peak": round(tfs / peak, 3),
+            "peak_tfs": peak}
+
+
+class ProgramProfile:
+    """One compiled program's registered profile: analytic cost
+    (FLOPs, bytes accessed), HBM footprint (argument/output/temp
+    bytes), compile time, scan length and donation summary — plus the
+    measured-rate derived ``achieved_tfs`` / ``mfu`` once
+    :meth:`ProgramRegistry.record_rate` has seen a rate."""
+
+    __slots__ = ("name", "kind", "flops", "bytes_accessed", "arg_bytes",
+                 "out_bytes", "temp_bytes", "alias_bytes", "hbm_bytes",
+                 "compile_s", "scan_length", "items_per_call",
+                 "donation", "extra", "rate_items_per_s", "achieved_tfs",
+                 "mfu")
+
+    def __init__(self, name: str, kind: str, analysis: Dict[str, float],
+                 compile_s: float, scan_length: int = 1,
+                 items_per_call: Optional[float] = None,
+                 donation: str = "", extra: Optional[dict] = None):
+        self.name = name
+        self.kind = kind  # "train" | "serving" — the gauge family
+        self.flops = analysis.get("flops", 0.0)
+        self.bytes_accessed = analysis.get("bytes_accessed", 0.0)
+        self.arg_bytes = analysis.get("arg_bytes", 0.0)
+        self.out_bytes = analysis.get("out_bytes", 0.0)
+        self.temp_bytes = analysis.get("temp_bytes", 0.0)
+        self.alias_bytes = analysis.get("alias_bytes", 0.0)
+        self.hbm_bytes = analysis.get("hbm_bytes", 0.0)
+        self.compile_s = compile_s
+        self.scan_length = scan_length
+        self.items_per_call = items_per_call
+        self.donation = donation
+        self.extra = dict(extra or {})
+        self.rate_items_per_s: Optional[float] = None
+        self.achieved_tfs: Optional[float] = None
+        self.mfu: Optional[float] = None
+
+    def to_dict(self) -> dict:
+        """JSON-ready dump (the ``programs.json`` bundle format and
+        ``diagnose --json``'s device rows)."""
+        return {s: getattr(self, s) for s in self.__slots__}
+
+    def __repr__(self) -> str:
+        return (f"ProgramProfile({self.name!r} kind={self.kind} "
+                f"flops={self.flops:.3g} hbm={self.hbm_bytes:.3g}B "
+                f"compile={self.compile_s:.3f}s)")
+
+
+class ProgramRegistry:
+    """Named :class:`ProgramProfile` store publishing
+    ``<kind>/program/*`` gauges (labelled ``program=<name>``) into a
+    telemetry metrics registry (default: the process-wide one)."""
+
+    def __init__(self, metrics=None):
+        self._lock = threading.Lock()
+        self._profiles: Dict[str, ProgramProfile] = {}
+        self._metrics = metrics
+
+    def _registry(self):
+        if self._metrics is not None:
+            return self._metrics
+        import bigdl_tpu.telemetry as telemetry
+        return telemetry.registry()
+
+    def register(self, name: str, kind: str, *, compiled=None,
+                 analysis: Optional[Dict[str, float]] = None,
+                 compile_s: float = 0.0, scan_length: int = 1,
+                 items_per_call: Optional[float] = None,
+                 donation: str = "",
+                 extra: Optional[dict] = None) -> ProgramProfile:
+        """Register (or replace) one program's profile from either an
+        AOT ``compiled`` object (analyzed here) or a pre-computed
+        ``analysis`` dict; publishes the profile gauges and returns
+        the profile."""
+        if kind not in ("train", "serving"):
+            raise ValueError(f"kind must be train|serving, got {kind!r}")
+        if analysis is None:
+            analysis = analyze_compiled(compiled) if compiled is not None \
+                else {}
+        prof = ProgramProfile(name, kind, analysis, compile_s,
+                              scan_length, items_per_call, donation,
+                              extra)
+        with self._lock:
+            self._profiles[name] = prof
+        r = self._registry()
+        labels = {"program": name}
+        r.gauge(f"{kind}/program/flops",
+                _PROFILE_GAUGES["flops"]).set(prof.flops, **labels)
+        r.gauge(f"{kind}/program/bytes_accessed",
+                _PROFILE_GAUGES["bytes_accessed"]).set(
+            prof.bytes_accessed, **labels)
+        r.gauge(f"{kind}/program/hbm_bytes",
+                _PROFILE_GAUGES["hbm_bytes"]).set(prof.hbm_bytes,
+                                                  **labels)
+        r.gauge(f"{kind}/program/compile_s",
+                _PROFILE_GAUGES["compile_s"]).set(prof.compile_s,
+                                                  **labels)
+        if prof.bytes_accessed > 0:
+            r.gauge(f"{kind}/program/arithmetic_intensity",
+                    _PROFILE_GAUGES["arithmetic_intensity"]).set(
+                prof.flops / prof.bytes_accessed, **labels)
+        return prof
+
+    def record_rate(self, name: str, items_per_s: float,
+                    peak_tfs: Optional[float] = None
+                    ) -> Optional[ProgramProfile]:
+        """Combine a measured item rate with the registered analytic
+        FLOPs into ``achieved_tfs`` / ``mfu`` gauges. Items are the
+        profile's own unit (rows, images, tokens — whatever
+        ``items_per_call`` counted); unknown names are a no-op so
+        callers need not care whether profiling was on."""
+        with self._lock:
+            prof = self._profiles.get(name)
+        if prof is None or items_per_s <= 0:
+            return None
+        if not prof.flops > 0:
+            return prof
+        # unrounded, unlike the display-precision mfu_fields dict —
+        # a gauge must not flatten a small-but-real MFU to 0
+        per_item = resolve_per_item_flops(
+            prof.flops, prof.items_per_call or 1.0, prof.scan_length)
+        peak = DEVICE_TFS if peak_tfs is None else peak_tfs
+        prof.rate_items_per_s = items_per_s
+        prof.achieved_tfs = per_item * items_per_s / 1e12
+        prof.mfu = prof.achieved_tfs / peak
+        r = self._registry()
+        labels = {"program": name}
+        r.gauge(f"{prof.kind}/program/achieved_tfs",
+                _RATE_GAUGES["achieved_tfs"]).set(prof.achieved_tfs,
+                                                  **labels)
+        r.gauge(f"{prof.kind}/program/mfu",
+                _RATE_GAUGES["mfu"]).set(prof.mfu, **labels)
+        return prof
+
+    def get(self, name: str) -> Optional[ProgramProfile]:
+        """The profile registered under ``name``, or None."""
+        with self._lock:
+            return self._profiles.get(name)
+
+    def profiles(self) -> List[ProgramProfile]:
+        """Every registered profile, sorted by name."""
+        with self._lock:
+            return [self._profiles[n] for n in sorted(self._profiles)]
+
+    def clear(self) -> None:
+        """Drop every registered profile (gauge series persist in the
+        metrics registry — they are history, not state)."""
+        with self._lock:
+            self._profiles.clear()
+
+    def to_dict(self) -> List[dict]:
+        """JSON-ready list of every profile (the flight-recorder
+        ``programs.json`` payload)."""
+        return [p.to_dict() for p in self.profiles()]
+
+
+_REGISTRY = ProgramRegistry()
+
+
+def registry() -> ProgramRegistry:
+    """The process-wide program profile registry."""
+    return _REGISTRY
+
+
+def record_rate(name: str, items_per_s: float,
+                peak_tfs: Optional[float] = None):
+    """Record a measured rate against the default registry's profile
+    ``name`` (no-op for unknown names)."""
+    return _REGISTRY.record_rate(name, items_per_s, peak_tfs)
+
+
+def _has_tracer(leaves) -> bool:
+    import jax
+
+    return any(isinstance(leaf, jax.core.Tracer) for leaf in leaves)
+
+
+def _signature(leaves) -> tuple:
+    return tuple(
+        (tuple(getattr(leaf, "shape", ())),
+         str(getattr(leaf, "dtype", type(leaf).__name__)))
+        for leaf in leaves)
+
+
+class _ProfiledProgram:
+    """The enabled-path wrapper ``maybe_wrap_jitted`` returns: on the
+    first call per argument signature it compiles the wrapped jit
+    ahead of time (timing the compile), registers the program's
+    profile, and executes the compiled object from then on. Attribute
+    access (``.lower``, ``.trace``) delegates to the wrapped jit, so
+    AOT-consuming callers keep working."""
+
+    def __init__(self, name: str, kind: str, jitted, *, donation: str,
+                 scan_length_for: Optional[Callable] = None,
+                 items_for: Optional[Callable] = None,
+                 auto_rate: bool = False, prog_registry=None):
+        self._name = name
+        self._kind = kind
+        self._jitted = jitted
+        self._donation = donation
+        self._scan_length_for = scan_length_for
+        self._items_for = items_for
+        self._auto_rate = auto_rate
+        self._registry = prog_registry or _REGISTRY
+        self._lock = threading.Lock()
+        self._compiled: Dict[tuple, Any] = {}
+        self._names: Dict[tuple, str] = {}
+
+    def __getattr__(self, attr):
+        return getattr(self._jitted, attr)
+
+    def _compile_and_register(self, sig, args, kwargs):
+        import jax  # noqa: F401  (jax present whenever programs exist)
+
+        t0 = time.perf_counter()
+        compiled = self._jitted.lower(*args, **kwargs).compile()
+        compile_s = time.perf_counter() - t0
+        with self._lock:
+            # one profile per signature: the first keeps the bare
+            # name, later specializations get a #N suffix
+            n = len(self._names)
+            name = self._name if n == 0 else f"{self._name}#{n + 1}"
+            self._names[sig] = name
+        scan_length = 1
+        if self._scan_length_for is not None:
+            try:
+                scan_length = int(self._scan_length_for(args, kwargs))
+            except Exception:
+                scan_length = 1
+        items = None
+        if self._items_for is not None:
+            try:
+                items = float(self._items_for(args, kwargs))
+            except Exception:
+                items = None
+        self._registry.register(
+            name, self._kind, compiled=compiled, compile_s=compile_s,
+            scan_length=scan_length, items_per_call=items,
+            donation=self._donation)
+        return compiled
+
+    def __call__(self, *args, **kwargs):
+        import jax
+
+        leaves = jax.tree_util.tree_leaves((args, kwargs))
+        if _has_tracer(leaves):
+            # traced through an outer jit/scan: the OUTER program is
+            # the compiled artifact — stay transparent
+            return self._jitted(*args, **kwargs)
+        sig = _signature(leaves)
+        with self._lock:
+            compiled = self._compiled.get(sig)
+        if compiled is None:
+            try:
+                compiled = self._compile_and_register(sig, args, kwargs)
+            except Exception:
+                compiled = self._jitted  # backend without AOT analysis
+            with self._lock:
+                compiled = self._compiled.setdefault(sig, compiled)
+        if not self._auto_rate:
+            return compiled(*args, **kwargs)
+        t0 = time.perf_counter()
+        out = compiled(*args, **kwargs)
+        # close the timing window on EXECUTION, not dispatch: an
+        # accelerator returns array futures immediately, and a
+        # dispatch-only dt would inflate the MFU gauge by orders of
+        # magnitude (profiling-enabled cost only; callers consume the
+        # result synchronously right after anyway)
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        name = self._names.get(sig, self._name)
+        if dt > 0 and self._items_for is not None:
+            try:
+                self._registry.record_rate(
+                    name, float(self._items_for(args, kwargs)) / dt)
+            except Exception:
+                pass
+        return out
+
+
+def maybe_wrap_jitted(name: str, kind: str, jitted, *, donation: str = "",
+                      scan_length_for: Optional[Callable] = None,
+                      items_for: Optional[Callable] = None,
+                      auto_rate: bool = False):
+    """The compile-site hook: when profiling is enabled, wrap a
+    ``jax.jit`` callable so its programs register cost/memory profiles
+    (see :class:`_ProfiledProgram`); disabled — the default — return
+    ``jitted`` untouched (one flag check, zero wrapping).
+
+    ``scan_length_for(args, kwargs)`` supplies the fused-window length
+    for the scan-body FLOPs caveat; ``items_for(args, kwargs)`` counts
+    the items (rows/images/tokens) one call processes; ``auto_rate``
+    additionally records measured item rates per call — only sensible
+    for programs whose callers consume the result synchronously (the
+    serving paths), never for async-dispatched training steps."""
+    if not _ENABLED:
+        return jitted
+    return _ProfiledProgram(name, kind, jitted, donation=donation,
+                            scan_length_for=scan_length_for,
+                            items_for=items_for, auto_rate=auto_rate)
+
+
+if os.environ.get("BIGDL_PROGRAM_PROFILES", "").strip() not in ("", "0"):
+    enable()

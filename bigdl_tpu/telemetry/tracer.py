@@ -30,7 +30,7 @@ import json
 import os
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional
 
 __all__ = ["SpanRecord", "SpanTracer", "NOOP_SPAN"]
@@ -38,18 +38,24 @@ __all__ = ["SpanRecord", "SpanTracer", "NOOP_SPAN"]
 
 class SpanRecord:
     """One finished span: name, monotonic start, duration, thread,
-    nesting depth, and user args (the kwargs passed to ``span()``)."""
+    nesting depth, user args (the kwargs passed to ``span()``), and an
+    optional ``flow`` link ``(flow_id, src_tid)`` — the Chrome-trace
+    flow arrow tying this span's track back to the thread that
+    recorded it (request tracks use it to point at the dispatch
+    thread)."""
 
-    __slots__ = ("name", "ts", "dur", "tid", "depth", "args")
+    __slots__ = ("name", "ts", "dur", "tid", "depth", "args", "flow")
 
     def __init__(self, name: str, ts: float, dur: float, tid: int,
-                 depth: int, args: Optional[Dict[str, Any]]):
+                 depth: int, args: Optional[Dict[str, Any]],
+                 flow: Optional[tuple] = None):
         self.name = name
         self.ts = ts          # seconds, monotonic clock
         self.dur = dur        # seconds
         self.tid = tid
         self.depth = depth
         self.args = args
+        self.flow = flow
 
     def __repr__(self) -> str:
         return (f"SpanRecord({self.name!r} ts={self.ts:.6f} "
@@ -112,6 +118,14 @@ class SpanTracer:
     keeping the two views arithmetically consistent.
     """
 
+    #: virtual-track tids start here — far above any OS thread ident,
+    #: so request tracks can never collide with a real thread's track
+    _TRACK_BASE = 1 << 48
+    #: bound on live virtual tracks: one track per in-flight request is
+    #: plenty, and an unbounded name->tid dict would leak at traffic
+    #: rate (the cardinality failure the ring buffer exists to prevent)
+    _MAX_TRACKS = 4096
+
     def __init__(self, capacity: int = 65536):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
@@ -120,6 +134,8 @@ class SpanTracer:
         self._spans: deque = deque(maxlen=capacity)
         self._local = threading.local()
         self._thread_names: Dict[int, str] = {}
+        self._tracks: "OrderedDict[str, int]" = OrderedDict()
+        self._next_track = self._TRACK_BASE
 
     # ------------------------------------------------------ recording
     def _stack(self) -> List[_Span]:
@@ -152,6 +168,43 @@ class SpanTracer:
         self._record(SpanRecord(name, t1 - duration_s, float(duration_s),
                                 threading.get_ident(),
                                 len(self._stack()), args))
+
+    def track(self, name: str) -> int:
+        """Get-or-create a **virtual track**: a synthetic tid labelled
+        ``name`` in the export, for spans that belong to a logical
+        entity (one request's timeline) rather than a thread.
+
+        The table is bounded (``_MAX_TRACKS``, oldest evicted): request
+        trace_ids arrive at traffic rate, and an unbounded name->tid
+        map would leak exactly the way the span ring is bounded not
+        to. An evicted track's already-recorded spans stay in the ring;
+        only their name-metadata row ages out of the export."""
+        with self._lock:
+            tid = self._tracks.get(name)
+            if tid is None:
+                if len(self._tracks) >= self._MAX_TRACKS:
+                    _, old_tid = self._tracks.popitem(last=False)
+                    self._thread_names.pop(old_tid, None)
+                tid = self._next_track
+                self._next_track += 1
+                self._tracks[name] = tid
+                self._thread_names[tid] = name
+            return tid
+
+    def record_span(self, name: str, start: float, dur: float,
+                    tid: Optional[int] = None,
+                    args: Optional[Dict[str, Any]] = None,
+                    flow: Optional[str] = None) -> None:
+        """Record a span with explicit monotonic ``start``/``dur`` and
+        an explicit (usually virtual) ``tid``. With ``flow``, the
+        export links this span back to the *recording* thread's track
+        via a Chrome-trace flow arrow — how a request track points at
+        the dispatch-thread span that served it."""
+        link = (flow, threading.get_ident()) if flow is not None else None
+        self._record(SpanRecord(
+            name, start, float(dur),
+            threading.get_ident() if tid is None else tid, 0, args,
+            link))
 
     # ------------------------------------------------------ reading
     def spans(self) -> List[SpanRecord]:
@@ -200,6 +253,19 @@ class SpanTracer:
             if s.args:
                 ev["args"] = {k: _jsonable(v) for k, v in s.args.items()}
             events.append(ev)
+            if s.flow is not None:
+                # flow arrow: start ("s") on the recording thread's
+                # track, finish ("f", bind-enclosing) on the span's own
+                # (virtual) track — Perfetto draws the link between
+                # the dispatch thread and the request timeline
+                flow_id, src_tid = s.flow
+                ts = round(s.ts * 1e6, 3)
+                events.append({"ph": "s", "id": str(flow_id),
+                               "pid": pid, "tid": src_tid, "ts": ts,
+                               "name": "request", "cat": "request"})
+                events.append({"ph": "f", "bp": "e", "id": str(flow_id),
+                               "pid": pid, "tid": s.tid, "ts": ts,
+                               "name": "request", "cat": "request"})
         return events
 
     def export_chrome_trace(self, path: str) -> int:

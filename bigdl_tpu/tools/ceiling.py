@@ -26,7 +26,6 @@ Usage: python -m bigdl_tpu.tools.ceiling <mode> [iters]
 """
 import functools
 import json
-import math
 import os
 import sys
 import time
@@ -92,28 +91,16 @@ def mfu_fields(rate_per_sec, per_item_flops=None):
     """{achieved_tfs, mfu} from the measured rate and the compiled
     chunk's analytic flops (fallback: caller-supplied per-item flops).
 
-    XLA's cost_analysis counts a scan BODY once, not times its length
-    (verified on this backend) — but that is backend/version-dependent,
-    so when the caller supplies a hand-computed per-item estimate we use
-    it to pick the interpretation (body-once vs body×SCAN) closest to
-    it, and fall back to the estimate outright when neither is within
-    4× (a silently-wrong convention would inflate MFU by SCAN×)."""
-    if _FLOPS["per_chunk"] is not None and _FLOPS["per_chunk"] > 0:
-        per_item = _FLOPS["per_chunk"] / BATCH  # body counted once
-        if per_item_flops:
-            cands = (per_item, _FLOPS["per_chunk"] / (BATCH * SCAN))
-            per_item = min(cands,
-                           key=lambda c: abs(math.log(c / per_item_flops)))
-            if not 0.25 < per_item / per_item_flops < 4.0:
-                per_item = per_item_flops
-        tfs = per_item * rate_per_sec / 1e12
-    elif per_item_flops:
-        tfs = per_item_flops * rate_per_sec / 1e12
-    else:
-        return {}
-    return {"achieved_tfs": round(tfs, 2),
-            "mfu_vs_peak": round(tfs / DEVICE_TFS, 3),
-            "peak_tfs": DEVICE_TFS}
+    Thin shim over :func:`bigdl_tpu.telemetry.programs.mfu_fields` —
+    the cost-analysis → MFU math (including the scan-body-counted-once
+    disambiguation, ``resolve_per_item_flops``) lives in ONE place
+    there; this keeps the ceiling CLI's JSON fields byte-compatible."""
+    from bigdl_tpu.telemetry import programs
+
+    return programs.mfu_fields(
+        rate_per_sec, flops_per_call=_FLOPS["per_chunk"],
+        items_per_call=BATCH, scan_length=SCAN,
+        per_item_estimate=per_item_flops, peak_tfs=DEVICE_TFS)
 
 
 def framework(mode, iters):

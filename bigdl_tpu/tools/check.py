@@ -114,16 +114,19 @@ def collect_instrument_names():
                 "bigdl_tpu.parallel.zero", "bigdl_tpu.precision.gate",
                 "bigdl_tpu.tools.perf", "bigdl_tpu.tools.ceiling",
                 "bigdl_tpu.datapipe.readers", "bigdl_tpu.datapipe.shuffle",
-                "bigdl_tpu.datapipe.packing"):
+                "bigdl_tpu.datapipe.packing",
+                "bigdl_tpu.telemetry.flight"):
         importlib.import_module(mod)
     scratch = telemetry.MetricsRegistry()
     from bigdl_tpu.generation.loop import register_generation_instruments
     from bigdl_tpu.optim.optimizer import Metrics
     from bigdl_tpu.serving.batcher import BatcherStats
     from bigdl_tpu.serving.compile_cache import CompileCache
+    from bigdl_tpu.telemetry.programs import register_program_instruments
     BatcherStats(registry=scratch, model="audit")
     CompileCache(metrics=scratch)
     register_generation_instruments(scratch)
+    register_program_instruments(scratch)
     m = Metrics(registry=scratch)
     m.add("data time", 0.0)
     m.add("computing time", 0.0)

@@ -5,6 +5,8 @@
         --out-trace PATH                              # Chrome trace out
         --trace FILE                                  # ingest a trace
         --jsonl FILE                                  # ingest snapshots
+        --postmortem DIR                              # ingest a flight-
+                                                      # recorder bundle
         --json                                        # machine output
 
 Default mode runs a short INSTRUMENTED workload — a LeNet training run
@@ -23,11 +25,17 @@ sums are consistent with the optimizer's ``Metrics.summary()`` numbers
 — both views are fed the same measurements (asserted in
 tests/test_telemetry.py).
 
+Runs with registered program profiles (``telemetry.programs``) get a
+"device:" section — per-program analytic MFU, HBM bytes and compile
+time from XLA's own cost/memory analysis.
+
 Ingest modes skip the workload: ``--trace`` aggregates an existing
 Chrome trace (ours or any ``traceEvents`` file with ``ph: "X"``
 events); ``--jsonl`` renders the LAST snapshot of a JSONL metrics file
 (the ones ``tools/perf --metrics-jsonl`` / ``BIGDL_METRICS_JSONL``
-emit).
+emit); ``--postmortem`` ingests a crash flight-recorder bundle
+(``telemetry.flight``) — manifest + trace + metrics + program profiles
++ the last ring events — into the same report.
 
 Exit codes: 0 report printed, 2 usage/ingest error.
 """
@@ -93,8 +101,13 @@ def attribution(agg: Dict[str, Dict[str, float]]) -> List[dict]:
 def _fmt_report(rows: List[dict], metrics_lines: List[str],
                 summary: Optional[str],
                 feed_lines: Optional[List[str]] = None,
-                precision_lines: Optional[List[str]] = None) -> str:
+                precision_lines: Optional[List[str]] = None,
+                device_lines: Optional[List[str]] = None,
+                postmortem_lines: Optional[List[str]] = None) -> str:
     lines = ["== where did the time go =="]
+    if postmortem_lines:
+        lines.append("postmortem:")
+        lines.extend(f"  {m}" for m in postmortem_lines)
     group = None
     for r in rows:
         if r["group"] != group:
@@ -102,6 +115,9 @@ def _fmt_report(rows: List[dict], metrics_lines: List[str],
             lines.append(f"{group}:")
         lines.append(f"  {r['name']:<34s} {r['total_s']:9.4f} s "
                      f"({100 * r['share']:5.1f}%)  x{r['count']}")
+    if device_lines:
+        lines.append("device:")
+        lines.extend(f"  {m}" for m in device_lines)
     if feed_lines:
         lines.append("data feed:")
         lines.extend(f"  {m}" for m in feed_lines)
@@ -263,6 +279,92 @@ def _precision_lines(prec: Dict[str, object]) -> List[str]:
     return out
 
 
+def device_summary(program_rows: List[dict]) -> List[dict]:
+    """Device-side program rows for the report: name, analytic MFU /
+    achieved TFLOP/s, HBM bytes, FLOPs and compile time per registered
+    program (``telemetry.programs`` profiles, live or from a bundle's
+    ``programs.json``)."""
+    out = []
+    for p in sorted(program_rows, key=lambda r: r.get("name", "")):
+        out.append({k: p.get(k) for k in
+                    ("name", "kind", "mfu", "achieved_tfs", "flops",
+                     "hbm_bytes", "compile_s", "scan_length",
+                     "rate_items_per_s")})
+    return out
+
+
+def _device_lines(rows: List[dict]) -> List[str]:
+    out = []
+    for r in rows:
+        line = f"{r['name']}: "
+        if r.get("mfu") is not None:
+            line += (f"MFU {100 * r['mfu']:.1f}% "
+                     f"({r['achieved_tfs']:g} TF/s), ")
+        if r.get("flops"):
+            line += f"{r['flops']:.3g} flops/call, "
+        if r.get("hbm_bytes"):
+            line += f"{int(r['hbm_bytes']):,} HBM bytes, "
+        line += f"compiled in {r.get('compile_s') or 0:.3f}s"
+        out.append(line)
+    return out
+
+
+def load_postmortem(bundle_dir: str) -> dict:
+    """Read a flight-recorder bundle (``telemetry.flight.dump``
+    layout) into ``{manifest, events, snapshot, flight_events,
+    programs}``; raises OSError/ValueError on an unreadable or
+    foreign bundle."""
+    import os
+
+    from bigdl_tpu.telemetry.flight import MANIFEST_FORMAT
+
+    with open(os.path.join(bundle_dir, "MANIFEST.json")) as f:
+        manifest = json.load(f)
+    if manifest.get("format") != MANIFEST_FORMAT:
+        raise ValueError(
+            f"{bundle_dir}: not a flight-recorder bundle "
+            f"(format={manifest.get('format')!r}, "
+            f"want {MANIFEST_FORMAT!r})")
+    out = {"manifest": manifest, "events": [], "snapshot": [],
+           "flight_events": [], "programs": []}
+    trace = os.path.join(bundle_dir, "trace.json")
+    if os.path.exists(trace):
+        with open(trace) as f:
+            out["events"] = json.load(f).get("traceEvents", [])
+    metrics = os.path.join(bundle_dir, "metrics.json")
+    if os.path.exists(metrics):
+        with open(metrics) as f:
+            snaps = json.load(f)
+        for rows in snaps.values():
+            out["snapshot"].extend(rows)
+    programs = os.path.join(bundle_dir, "programs.json")
+    if os.path.exists(programs):
+        with open(programs) as f:
+            out["programs"] = json.load(f)
+    events = os.path.join(bundle_dir, "events.jsonl")
+    if os.path.exists(events):
+        with open(events) as f:
+            out["flight_events"] = [json.loads(ln) for ln in f
+                                    if ln.strip()]
+    return out
+
+
+def _postmortem_lines(pm: dict) -> List[str]:
+    man = pm["manifest"]
+    out = [f"reason: {man.get('reason')}"]
+    err = man.get("error")
+    if err:
+        out.append(f"error: {err.get('type')}: {err.get('message')}")
+    out.append(f"pid {man.get('pid')}, {man.get('events', 0)} ring "
+               "events captured")
+    for ev in pm["flight_events"][-8:]:
+        kind = ev.get("kind")
+        detail = ", ".join(f"{k}={v}" for k, v in sorted(ev.items())
+                           if k not in ("t", "kind", "scalars"))
+        out.append(f"  [{kind}] {detail}" if detail else f"  [{kind}]")
+    return out
+
+
 def _metrics_lines(snapshot: List[dict]) -> List[str]:
     """Human lines for the interesting registry series (queue waits,
     depths, cache hit/miss) — the queue-side attribution spans can't
@@ -378,19 +480,36 @@ def main(argv=None) -> int:
     ap.add_argument("--jsonl", default=None,
                     help="ingest a JSONL metrics file instead of "
                          "running the workload")
+    ap.add_argument("--postmortem", default=None, metavar="DIR",
+                    help="ingest a crash flight-recorder bundle "
+                         "(telemetry.flight.dump directory) instead "
+                         "of running the workload")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
 
-    if args.trace and args.jsonl:
-        print("--trace and --jsonl are mutually exclusive",
-              file=sys.stderr)
+    if sum(bool(m) for m in (args.trace, args.jsonl,
+                             args.postmortem)) > 1:
+        print("--trace, --jsonl and --postmortem are mutually "
+              "exclusive", file=sys.stderr)
         return 2
 
     summary = None
     snapshot: List[dict] = []
     history: Optional[List[List[dict]]] = None
+    program_rows: List[dict] = []
+    postmortem = None
     wrote_trace = False
-    if args.trace:
+    if args.postmortem:
+        try:
+            postmortem = load_postmortem(args.postmortem)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"cannot read postmortem bundle {args.postmortem}: "
+                  f"{e}", file=sys.stderr)
+            return 2
+        events = postmortem["events"]
+        snapshot = postmortem["snapshot"]
+        program_rows = postmortem["programs"]
+    elif args.trace:
         try:
             with open(args.trace) as f:
                 events = json.load(f).get("traceEvents", [])
@@ -419,19 +538,31 @@ def main(argv=None) -> int:
         summary = opt.metrics.summary()
         wrote_trace = args.out_trace is not None
 
+    if not args.postmortem:
+        # live modes read whatever programs this process registered
+        from bigdl_tpu.telemetry import programs as _programs
+        program_rows = _programs.registry().to_dict()
+
     agg = aggregate_spans(events)
     rows = attribution(agg)
     feed = feed_summary(snapshot)
     prec = precision_summary(snapshot, history)
+    device = device_summary(program_rows)
     if args.json:
         print(json.dumps({"spans": rows,
                           "metrics": snapshot,
                           "data_feed": feed,
                           "precision": prec,
+                          "device": device,
+                          "postmortem": postmortem["manifest"]
+                          if postmortem else None,
                           "optimizer_summary": summary}, indent=2))
     else:
         print(_fmt_report(rows, _metrics_lines(snapshot), summary,
-                          _feed_lines(feed), _precision_lines(prec)))
+                          _feed_lines(feed), _precision_lines(prec),
+                          _device_lines(device),
+                          _postmortem_lines(postmortem)
+                          if postmortem else None))
         if wrote_trace:
             print(f"chrome trace written to {args.out_trace} "
                   "(load in Perfetto / chrome://tracing)")

@@ -37,7 +37,10 @@ FAMILIES = {
                  "bigdl_tpu.analysis.lint"],
     "telemetry": ["bigdl_tpu.telemetry", "bigdl_tpu.telemetry.tracer",
                   "bigdl_tpu.telemetry.metrics",
-                  "bigdl_tpu.telemetry.export"],
+                  "bigdl_tpu.telemetry.export",
+                  "bigdl_tpu.telemetry.programs",
+                  "bigdl_tpu.telemetry.flight"],
+    "tools": ["bigdl_tpu.tools.regress"],
     "faults": ["bigdl_tpu.faults", "bigdl_tpu.faults.retry"],
     "parallel": ["bigdl_tpu.parallel", "bigdl_tpu.parallel.zero"],
     "precision": ["bigdl_tpu.precision", "bigdl_tpu.precision.policy",

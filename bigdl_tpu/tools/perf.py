@@ -292,21 +292,32 @@ def main(argv=None):
     line = (f"median: {med*1000:.1f} ms  {rate:.1f} "
             f"{'tok/s' if is_lm else 'img/s'}")
     # analytic MFU vs the measured device envelope (BASELINE.md platform
-    # note; override with BIGDL_DEVICE_TFS) from the one compiled program
+    # note; override with BIGDL_DEVICE_TFS) from the one compiled
+    # program, through the shared telemetry.programs API — the same
+    # math ceiling/bench consume, plus the HBM footprint the cost line
+    # alone never showed
     import os
+    program_fields = {}
     if compiled_for_cost is not None:
-        try:
-            cost = compiled_for_cost.cost_analysis()
-            if isinstance(cost, (list, tuple)):
-                cost = cost[0]
-            tfs = float(cost["flops"]) / med / 1e12
-            # denominator: v5e peak bf16; override via BIGDL_DEVICE_TFS
-            env_tfs = float(os.environ.get("BIGDL_DEVICE_TFS", 197.0))
-            line += (f"  |  {tfs:.2f} TF/s analytic, "
-                     f"MFU {100 * tfs / env_tfs:.1f}% of {env_tfs:.0f} "
-                     "TF/s peak")
-        except Exception as e:
-            line += f"  |  cost-analysis failed: {type(e).__name__}"
+        from bigdl_tpu.telemetry import programs
+        prog_name = f"perf/{args.model}/{args.mode}"
+        prof = programs.registry().register(
+            prog_name, "train" if args.mode == "train" else "serving",
+            compiled=compiled_for_cost, scan_length=sync_k,
+            items_per_call=recs_per_iter)
+        rated = programs.registry().record_rate(prog_name,
+                                                recs_per_iter / med)
+        if rated is not None and rated.achieved_tfs is not None:
+            line += (f"  |  {rated.achieved_tfs:.2f} TF/s analytic, "
+                     f"MFU {100 * rated.mfu:.1f}% of "
+                     f"{programs.DEVICE_TFS:.0f} TF/s peak")
+            program_fields = {"achieved_tfs": rated.achieved_tfs,
+                              "mfu_vs_peak": rated.mfu}
+        else:
+            line += "  |  cost-analysis unavailable on this backend"
+        if prof.hbm_bytes:
+            program_fields["program_hbm_bytes"] = int(prof.hbm_bytes)
+            program_fields["program_flops_per_call"] = prof.flops
     print(line)
 
     # machine-readable JSON tail (the driver's scoreboard hook): the
@@ -317,6 +328,7 @@ def main(argv=None):
             "backend": jax.default_backend(), "median_s": med,
             "rate": rate, "steps_per_sync": sync_k}
     tail.update(zero_meta)
+    tail.update(program_fields)
     if args.mode == "train":
         tail["steps_per_sec"] = sync_k / med
         if args.sync_compare:

@@ -34,6 +34,34 @@ def test_bench_synthetic_contract():
     out = _run_bench("synthetic")
     assert {"metric", "value", "unit", "vs_baseline"} <= set(out)
     assert out["value"] > 0 and out["unit"] == "images/sec"
+    # the regression sentinel's schema handshake: a known version the
+    # sentinel accepts (tools/regress.KNOWN_SCHEMA_VERSIONS)
+    from bigdl_tpu.tools.regress import KNOWN_SCHEMA_VERSIONS
+    assert out["schema_version"] in KNOWN_SCHEMA_VERSIONS
+
+
+@pytest.mark.slow
+def test_bench_programs_row_contract_and_sentinel_accepts_it():
+    """The PROGRAMS row: per-model HBM bytes / flops / compile time
+    (and MFU once a rate exists) from XLA's own analyses — and the
+    regression sentinel must accept the fresh line as a candidate
+    against the checked-in trajectory."""
+    out = _run_bench("synthetic", {"BENCH_PROGRAMS": "1"})
+    assert out["programs_resnet50_train_hbm_bytes"] > 0
+    assert out["programs_resnet50_train_flops_per_img"] > 0
+    assert out["programs_resnet50_train_compile_s"] > 0
+    assert out["programs_resnet50_eval_hbm_bytes"] > 0
+    assert out["programs_resnet50_train_mfu"] >= 0
+    # train holds grads+opt state: strictly more resident bytes than
+    # the eval forward
+    assert out["programs_resnet50_train_hbm_bytes"] > \
+        out["programs_resnet50_eval_hbm_bytes"]
+    # a tiny-shape CPU smoke value regresses hugely vs the banked TPU
+    # trajectory by construction, so only the SCHEMA path is asserted
+    # here: the sentinel must parse the row and not refuse it
+    from bigdl_tpu.tools.regress import extract_metrics
+    metrics = extract_metrics(out, "bench-line")
+    assert "programs_resnet50_train_hbm_bytes" in metrics
 
 
 @pytest.mark.slow

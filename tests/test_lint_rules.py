@@ -266,6 +266,22 @@ def epoch_order(records):
     return records
 """,
     ),
+    "metric-label-cardinality": (
+        """
+import bigdl_tpu.telemetry as telemetry
+reqs = telemetry.counter("serving/x/requests", "d")
+def handle(batch):
+    for i, r in enumerate(batch):
+        reqs.inc(req=f"req-{i}")
+""",
+        """
+import bigdl_tpu.telemetry as telemetry
+reqs = telemetry.counter("serving/x/requests", "d")
+def handle(batch):
+    for i, r in enumerate(batch):
+        reqs.inc(req=f"req-{i}")  # bigdl: disable=metric-label-cardinality
+""",
+    ),
 }
 
 
@@ -331,6 +347,55 @@ def run(fn):
 """
     findings = lint_source(src, "fixture.py")
     assert "retry-no-backoff" not in names(findings, only_active=False)
+
+
+def test_metric_label_cardinality_flags_str_of_request_id():
+    # per-request identity stringified into a label value: one fresh
+    # series per request — the cardinality explosion the rule exists
+    # to catch (trace_id goes in SPAN ARGS, never labels)
+    src = HEADER + """
+import bigdl_tpu.telemetry as telemetry
+lat = telemetry.histogram("serving/x/latency_ms", "d")
+def done(trace_id, ms):
+    lat.observe(ms, trace=str(trace_id))
+"""
+    findings = lint_source(src, "fixture.py")
+    assert "metric-label-cardinality" in names(findings)
+
+
+def test_metric_label_cardinality_flags_bare_request_id_name():
+    # the id itself (no f-string needed) is already one series per
+    # request; instruments tracked through self-attribute bindings too
+    src = HEADER + """
+import bigdl_tpu.telemetry as telemetry
+class Stats:
+    def __init__(self, r):
+        self._g = r.gauge("serving/x/depth", "d")
+    def on_req(self, request_id, d):
+        self._g.set(d, request=request_id)
+"""
+    findings = lint_source(src, "fixture.py")
+    assert "metric-label-cardinality" in names(findings)
+
+
+def test_metric_label_cardinality_passes_bounded_labels_and_spans():
+    # a model-name label is a small fixed vocabulary; trace_id in SPAN
+    # args is the sanctioned home; .add on a plain set is not an
+    # instrument update (receiver tracking, not method-name matching)
+    src = HEADER + """
+import bigdl_tpu.telemetry as telemetry
+reqs = telemetry.counter("serving/x/requests", "d")
+def handle(model_name, trace_id, items):
+    reqs.inc(model=model_name)
+    seen = set()
+    for i in items:
+        seen.add(i)
+    with telemetry.span("serving/request", trace_id=trace_id):
+        pass
+"""
+    findings = lint_source(src, "fixture.py")
+    assert "metric-label-cardinality" not in names(findings,
+                                                  only_active=False)
 
 
 def test_unseeded_shuffle_passes_seeded_generators():

@@ -32,10 +32,14 @@ def _telemetry_off():
 
 def validate_chrome_trace(events):
     """The trace-event schema the acceptance criterion names: every
-    complete event carries ph/ts/dur/pid/tid/name with sane types."""
+    complete event carries ph/ts/dur/pid/tid/name with sane types.
+    Flow events ("s"/"f" — the request-track links) carry an id."""
     assert events, "empty trace"
     for ev in events:
-        assert ev["ph"] in ("X", "M"), ev
+        assert ev["ph"] in ("X", "M", "s", "f"), ev
+        if ev["ph"] in ("s", "f"):
+            assert "id" in ev and "ts" in ev and "tid" in ev
+            continue
         if ev["ph"] == "X":
             for k in ("ts", "dur", "pid", "tid", "name"):
                 assert k in ev, (k, ev)
