@@ -499,6 +499,15 @@ def main():
             jax.random.split(jax.random.fold_in(root, 999), scan),
             batch, scan, imgs_per_sec,
             result.get("resnet50_inference_imgs_per_sec_per_chip")))
+    # ninth tracked row: KERNELS — the pallas kernel layer
+    # (bigdl_tpu.kernels): attention-program MFU with the flash kernel
+    # vs the einsum reference (both registered under kernel= labels in
+    # telemetry.programs, the PR-10 gauges as the success metric) and
+    # generation decode tokens/sec with the ragged kernel on vs off.
+    # Skipped on CPU smoke runs unless forced — the on-leg runs the
+    # pallas interpreter.
+    if _row_enabled("BENCH_KERNELS", platform):
+        result.update(_bench_kernels())
     print(json.dumps(result))
     _maybe_metrics_snapshot(result)
 
@@ -1100,6 +1109,122 @@ def _bench_programs(model, run_chunk, carry, keys, batch, scan,
         prof = reg.record_rate("bench/resnet50/eval", infer_rate)
         if prof is not None and prof.mfu is not None:
             row["programs_resnet50_eval_mfu"] = round(prof.mfu, 4)
+    return row
+
+
+def _bench_kernels():
+    """KERNELS row: what the pallas kernel layer buys, as
+    sentinel-tracked numbers. Leg 1 registers the SAME causal
+    attention forward twice in ``telemetry.programs`` — flash kernel
+    on (``kernel=pallas``) vs einsum reference (``kernel=reference``)
+    — and reports each program's measured rate and MFU, so the gauges
+    and the scoreboard agree by construction. Leg 2 runs the same
+    seeded generation burst through two fresh GenerationServices,
+    ragged decode kernel on vs off, and reports decode tokens/sec both
+    ways plus the speedup. (On CPU the on-legs run the pallas
+    interpreter, so the CPU numbers document equivalence overhead, not
+    a win — the TPU trajectory is the one the sentinel gates.)"""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bigdl_tpu import kernels
+    from bigdl_tpu.generation import GenerationConfig, GenerationService
+    from bigdl_tpu.models import TransformerLM
+    from bigdl_tpu.nn.attention import dot_product_attention
+    from bigdl_tpu.telemetry import programs
+    from bigdl_tpu.tools.synthetic import seeded_rng
+    from bigdl_tpu.utils.random import RandomGenerator
+
+    b = int(os.environ.get("BENCH_KERNELS_BATCH", 4))
+    heads = int(os.environ.get("BENCH_KERNELS_HEADS", 8))
+    seq = int(os.environ.get("BENCH_KERNELS_SEQ", 512))
+    hd = int(os.environ.get("BENCH_KERNELS_HEAD_DIM", 64))
+    iters = int(os.environ.get("BENCH_ITERS", 6))
+    row = {}
+    reg = programs.registry()
+
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(31), 3)
+    q = jax.random.normal(kq, (b, heads, seq, hd), jnp.float32)
+    k = jax.random.normal(kk, (b, heads, seq, hd), jnp.float32)
+    v = jax.random.normal(kv, (b, heads, seq, hd), jnp.float32)
+
+    def attn_leg(tag, cfg):
+        from bigdl_tpu.kernels.dispatch import taken_in_thread
+
+        with kernels.use(cfg):
+            fn = jax.jit(lambda q_, k_, v_: dot_product_attention(
+                q_, k_, v_, causal=True))
+            t0 = time.perf_counter()
+            # label by trace EVIDENCE, like every other register site:
+            # a declined dispatch (shape over the VMEM budget) must
+            # report its leg as reference, not fake a pallas number
+            taken_before = taken_in_thread()
+            compiled = fn.lower(q, k, v).compile()
+            compile_s = time.perf_counter() - t0
+            name = f"bench/attention/{tag}"
+            reg.register(name, "serving", compiled=compiled,
+                         compile_s=compile_s, items_per_call=b * seq,
+                         kernel=("pallas"
+                                 if taken_in_thread() > taken_before
+                                 else "reference"))
+            jax.block_until_ready(compiled(q, k, v))  # warm
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(iters):
+                out = compiled(q, k, v)
+            jax.block_until_ready(out)  # sync once per timed window
+            dt = time.perf_counter() - t0
+            return reg.record_rate(name, b * seq * iters / dt), dt
+
+    p_on, dt_on = attn_leg("pallas", kernels.KernelConfig.all_on())
+    p_off, dt_off = attn_leg("reference", kernels.KernelConfig.off())
+    row["kernels_attention_tokens_per_sec_on"] = round(
+        b * seq * iters / dt_on, 1)
+    row["kernels_attention_tokens_per_sec_off"] = round(
+        b * seq * iters / dt_off, 1)
+    row["kernels_attention_mfu_on"] = round(p_on.mfu or 0.0, 4) \
+        if p_on is not None else 0.0
+    row["kernels_attention_mfu_off"] = round(p_off.mfu or 0.0, 4) \
+        if p_off is not None else 0.0
+
+    vocab = int(os.environ.get("BENCH_KERNELS_VOCAB", 8192))
+    hidden = int(os.environ.get("BENCH_KERNELS_HIDDEN", 512))
+    layers = int(os.environ.get("BENCH_KERNELS_LAYERS", 4))
+    max_len = int(os.environ.get("BENCH_KERNELS_LEN", 512))
+    slots = int(os.environ.get("BENCH_KERNELS_SLOTS", 16))
+    n_reqs = int(os.environ.get("BENCH_KERNELS_REQS", 24))
+    max_new = int(os.environ.get("BENCH_KERNELS_NEW", 32))
+
+    def decode_leg(cfg) -> float:
+        with kernels.use(cfg):
+            RandomGenerator.set_seed(13)
+            model = TransformerLM(vocab_size=vocab, hidden_size=hidden,
+                                  num_layers=layers, num_heads=8,
+                                  max_len=max_len).evaluate()
+            model.ensure_initialized()
+            svc = GenerationService(config=GenerationConfig(
+                slots=slots, max_len=max_len,
+                prefill_rows=min(4, slots),
+                max_queue=max(n_reqs, 256)))
+            svc.load("klm", model)  # warmup compiles outside the timing
+            r = seeded_rng(14)
+            prompts = [r.randint(1, vocab,
+                                 r.randint(4, max_len - max_new))
+                       .astype(np.int32) for _ in range(n_reqs)]
+            t0 = time.time()
+            streams = [svc.generate("klm", p, max_new_tokens=max_new)
+                       for p in prompts]
+            total = sum(len(s.result()) for s in streams)
+            dt = time.time() - t0
+            svc.shutdown()
+            return total / dt
+
+    tps_on = decode_leg(kernels.KernelConfig.all_on())
+    tps_off = decode_leg(kernels.KernelConfig.off())
+    row["kernels_decode_tokens_per_sec_on"] = round(tps_on, 1)
+    row["kernels_decode_tokens_per_sec_off"] = round(tps_off, 1)
+    row["kernels_decode_speedup"] = round(tps_on / tps_off, 3)
     return row
 
 

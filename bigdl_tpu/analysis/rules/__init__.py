@@ -17,6 +17,7 @@ Shipped rules:
 - ``retry-no-backoff`` — broad-except retry loops with fixed sleeps
 - ``unseeded-shuffle`` — data-path shuffles without a seeded Generator
 - ``metric-label-cardinality`` — metric labels from loop vars / request ids
+- ``raw-pallas-call`` — pallas kernels invoked outside bigdl_tpu/kernels/
 """
 from bigdl_tpu.analysis.rules import (data, jit_calls, perf, purity,
                                       robust, style, telemetry, traced)

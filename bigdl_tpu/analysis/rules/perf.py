@@ -281,6 +281,42 @@ def implicit_upcast_in_trace(ctx: FileContext):
                     "`# bigdl: disable=implicit-upcast-in-trace`)")
 
 
+#: the bare-name spelling (a local alias the canonicalizer cannot see
+#: through); every dotted spelling — `pl.pallas_call`,
+#: `jax.experimental.pallas.pallas_call`, `from ... import pallas_call`
+#: — resolves canonically and is caught by the endswith check below
+_PALLAS_CALL_NAMES = frozenset({"pallas_call"})
+
+
+@rule("raw-pallas-call",
+      "direct pl.pallas_call outside the bigdl_tpu/kernels/ dispatch layer")
+def raw_pallas_call(ctx: FileContext):
+    """Flags ``pl.pallas_call(...)`` (any import spelling) in files
+    outside ``bigdl_tpu/kernels/`` — every kernel must enter through
+    the dispatch layer (``kernels.attention`` / ``decode_attention`` /
+    ``int8_matmul``), which is what guarantees the pure-jnp fallback
+    exists, the ``KernelConfig``/``BIGDL_KERNELS`` toggle works, and
+    the interpret-mode equivalence tests cover the kernel body. A raw
+    call site bypasses all three silently. Mark a deliberate
+    exception with ``# bigdl: disable=raw-pallas-call``."""
+    norm = ctx.path.replace("\\", "/")
+    if "bigdl_tpu/kernels/" in norm:
+        return  # the kernel layer itself is the sanctioned home
+    for node in ctx.walk(ast.Call):
+        c = ctx.canon(node.func)
+        if c in _PALLAS_CALL_NAMES or (c is not None
+                                       and c.endswith(".pallas_call")):
+            yield node, (
+                f"`{c}` invoked outside bigdl_tpu/kernels/: raw kernels "
+                "bypass the dispatch layer's jnp fallback, the "
+                "BIGDL_KERNELS toggle and the interpret-mode "
+                "equivalence tests; route through bigdl_tpu.kernels "
+                "(attention/decode_attention/int8_matmul) or add the "
+                "kernel under bigdl_tpu/kernels/ — or mark a "
+                "deliberate exception with "
+                "`# bigdl: disable=raw-pallas-call`")
+
+
 @rule("sync-in-loop",
       "per-iteration host-device sync inside a host step loop")
 def sync_in_loop(ctx: FileContext):

@@ -171,7 +171,17 @@ class DecodeEngine:
         slot); returns the ``[slots, V]`` logits as a host ndarray.
         ``attend_len`` is re-bucketed from the longest live row each
         step, so a batch of short sequences runs the small-rung
-        program."""
+        program.
+
+        ``positions`` is the host per-slot lengths vector
+        (``kv.lengths`` for live slots) — the bucket only fixes the
+        program's *shape*: with the ragged kernel enabled
+        (``bigdl_tpu.kernels``), attention inside the program reads
+        only ``positions[s] + 1`` valid cache rows per slot instead of
+        scanning the whole bucket, and because the vector is already
+        an operand the kernel adds no program keys — the ≤ 2-per-
+        bucket compile bound holds with kernels on (asserted in
+        tests/test_kernels.py)."""
         longest = int(positions[active].max()) + 1 if active.any() else 1
         attend_len = self.ladder.bucket_for(longest)
         prog = self.decode_program(servable, attend_len)

@@ -416,6 +416,10 @@ class DecodeLoop:
             for slot in live:
                 g = group.gens[slot]
                 tokens[slot] = g.last
+                # kv.lengths IS the ragged bound: the decode program's
+                # attention (bigdl_tpu.kernels ragged kernel, when
+                # enabled) reads exactly lengths[slot]+1 cache rows —
+                # the host lengths vector flows through unmodified
                 positions[slot] = kv.lengths[slot]
                 active[slot] = True
             # the decode-machinery death site the chaos harness

@@ -1,0 +1,41 @@
+"""Pallas kernel layer — the hand-tuned L0 the reference built in C++.
+
+The source system's bottom layer was native kernels behind JNI (Intel
+MKL + the BigQuant int8 GEMM, PAPER.md L0); the TPU-native analogue is
+``jax.experimental.pallas``. This package holds the kernels and the
+ONE gate in front of them:
+
+- :mod:`~bigdl_tpu.kernels.flash_attention` — fused flash attention
+  for training: q-tiled, segment-mask aware (packed datapipe slabs run
+  bit-faithfully), custom-VJP backward, no materialized [S, S];
+- :mod:`~bigdl_tpu.kernels.ragged_decode` — ragged decode
+  attention for the generation engine: reads only ``lengths[i]`` valid
+  KV per slot instead of the bucket max;
+- :mod:`~bigdl_tpu.kernels.int8_gemm` — fused dequant-int8-GEMM
+  completing the BigQuant serving story over the calibrated scales;
+- :mod:`~bigdl_tpu.kernels.dispatch` — :func:`attention` /
+  :func:`decode_attention` / :func:`int8_matmul`: config + shape
+  eligibility in, kernel result or None (= run your jnp path) out;
+- :mod:`~bigdl_tpu.kernels.config` — :class:`KernelConfig` and the
+  ``BIGDL_KERNELS`` env toggle; decode + int8 default ON on real TPU
+  (flash stays opt-in until the bench KERNELS trajectory justifies
+  it), everything OFF on CPU, and kernels run under the pallas
+  *interpreter* everywhere but real TPU so tier-1 on CPU executes the
+  real kernel bodies.
+
+Every kernel ships with an interpret-mode equivalence test against the
+pure-jnp fallback (tests/test_kernels.py; bitwise for the int8 core
+and the greedy decode token stream, tolerance-bounded for softmax
+reductions) and registers its programs with a ``kernel=pallas|
+reference`` label in :mod:`bigdl_tpu.telemetry.programs` so MFU/HBM
+gauges compare the two paths side by side. See docs/kernels.md.
+"""
+from bigdl_tpu.kernels.config import (KernelConfig, active_label,
+                                      configure, enabled, get_config,
+                                      interpret_mode, use)
+from bigdl_tpu.kernels.dispatch import (attention, decode_attention,
+                                        int8_matmul)
+
+__all__ = ["KernelConfig", "configure", "get_config", "use", "enabled",
+           "interpret_mode", "active_label", "attention",
+           "decode_attention", "int8_matmul"]

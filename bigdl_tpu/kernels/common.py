@@ -1,0 +1,28 @@
+"""Helpers shared by the kernel implementations (tiling + compiler
+params) — one home so a jax rename or a tiling policy change is fixed
+in exactly one place."""
+from __future__ import annotations
+
+__all__ = ["fit_block", "tpu_compiler_params"]
+
+
+def fit_block(dim: int, preferred: int) -> int:
+    """The largest block size <= ``preferred`` that divides ``dim``
+    (pallas grids need exact tiling; ragged test shapes shrink the
+    tile instead of falling off the kernel path)."""
+    b = min(int(preferred), int(dim))
+    while dim % b:
+        b -= 1
+    return b
+
+
+def tpu_compiler_params(dimension_semantics):
+    """TPU compiler params for a kernel grid: the accumulator-carrying
+    axis is "arbitrary" (sequential), everything else parallel. (jax
+    renamed CompilerParams across versions — resolve whichever this
+    one ships.)"""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) \
+        or pltpu.TPUCompilerParams
+    return cls(dimension_semantics=tuple(dimension_semantics))

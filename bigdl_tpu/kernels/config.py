@@ -1,0 +1,192 @@
+"""Kernel selection policy: which pallas kernels run, and how.
+
+The reference's L0 was a build-time choice (MKL JNI vs BigQuant C++,
+SURVEY.md §1); ours is a *runtime policy*: a :class:`KernelConfig`
+names which pallas kernels the dispatch layer
+(:mod:`bigdl_tpu.kernels.dispatch`) may select, everything else runs
+the pure-jnp reference path. The default is resolved lazily from the
+backend — **decode + int8 on on real TPU** (pure wins over work the
+reference cannot skip), **flash opt-in even there** (the measured
+einsum numbers in ``nn/attention`` still win at the lengths it can
+hold), **everything off on CPU** — and the ``BIGDL_KERNELS`` env var
+overrides it without touching code:
+
+- ``BIGDL_KERNELS=1`` / ``on`` / ``all`` — every kernel on;
+- ``BIGDL_KERNELS=0`` / ``off`` — every kernel off;
+- ``BIGDL_KERNELS=flash,decode`` — a comma subset of
+  ``flash`` / ``decode`` / ``int8``.
+
+``interpret`` (``None`` = auto) runs the kernels through the pallas
+interpreter instead of Mosaic — auto means *interpret everywhere but
+real TPU*, which is how tier-1 on CPU executes the real kernel bodies
+(docs/kernels.md "Interpret-mode testing").
+
+The active config is read at TRACE time: a compiled program bakes in
+the kernel choice that was active when it was built (the serving
+``CompileCache`` keys programs per servable, so a toggle never mutates
+an already-compiled program — build a fresh engine/service to switch).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from dataclasses import dataclass
+from typing import Iterator, Optional
+
+__all__ = ["KernelConfig", "configure", "get_config", "use", "enabled",
+           "interpret_mode", "active_label"]
+
+#: the ops a config can enable, in the order the env parser accepts
+_OPS = ("flash", "decode", "int8")
+
+
+@dataclass(frozen=True)
+class KernelConfig:
+    """Which pallas kernels the dispatch layer may select.
+
+    ``flash_attention`` — the tiled flash-attention training kernel;
+    ``decode_attention`` — the ragged decode kernel (reads only
+    ``lengths[i]`` valid KV per slot); ``int8_matmul`` — the fused
+    dequant-int8-GEMM serving kernel. ``interpret=None`` auto-selects
+    the pallas interpreter off-TPU; ``block_q``/``block_k`` are
+    preferred tile sizes (shrunk to the largest divisor of the actual
+    dimension, so ragged test shapes stay eligible)."""
+
+    flash_attention: bool = False
+    decode_attention: bool = False
+    int8_matmul: bool = False
+    interpret: Optional[bool] = None
+    block_q: int = 128
+    block_k: int = 128
+
+    @classmethod
+    def all_on(cls, **kw) -> "KernelConfig":
+        """Every kernel enabled — ``BIGDL_KERNELS=1`` and the test/
+        bench on-legs. (The real-TPU *default* is decode + int8 only;
+        flash stays opt-in there until the bench KERNELS trajectory
+        justifies the flip — see the module docstring.)"""
+        return cls(flash_attention=True, decode_attention=True,
+                   int8_matmul=True, **kw)
+
+    @classmethod
+    def off(cls) -> "KernelConfig":
+        """Every kernel disabled — the pure-jnp reference everywhere
+        (the CPU default)."""
+        return cls()
+
+    @classmethod
+    def from_env(cls, value: str) -> "KernelConfig":
+        """Parse a ``BIGDL_KERNELS`` value (module docstring has the
+        grammar); unknown op names raise so a typo cannot silently run
+        the slow path."""
+        v = value.strip().lower()
+        if v in ("1", "on", "all", "true"):
+            return cls.all_on()
+        if v in ("0", "off", "false", "none", ""):
+            return cls.off()
+        ops = {p.strip() for p in v.split(",") if p.strip()}
+        unknown = ops - set(_OPS)
+        if unknown:
+            raise ValueError(
+                f"BIGDL_KERNELS={value!r}: unknown kernel(s) "
+                f"{sorted(unknown)} (choose from {list(_OPS)}, "
+                "or 1/on/all, 0/off)")
+        return cls(flash_attention="flash" in ops,
+                   decode_attention="decode" in ops,
+                   int8_matmul="int8" in ops)
+
+    @property
+    def any_enabled(self) -> bool:
+        """Whether any kernel is selected at all."""
+        return (self.flash_attention or self.decode_attention
+                or self.int8_matmul)
+
+    def resolve_interpret(self) -> bool:
+        """The effective interpret flag: auto (``None``) means
+        interpret everywhere but real TPU."""
+        if self.interpret is not None:
+            return bool(self.interpret)
+        import jax
+        return jax.default_backend() != "tpu"
+
+
+_LOCK = threading.Lock()
+_CONFIG: Optional[KernelConfig] = None  # None = resolve default lazily
+
+
+def _default() -> KernelConfig:
+    env = os.environ.get("BIGDL_KERNELS")
+    if env is not None:
+        return KernelConfig.from_env(env)
+    import jax
+    if jax.default_backend() == "tpu":
+        # decode + int8 are pure wins (they replace work the einsum
+        # path cannot skip); flash stays OPT-IN on TPU because the
+        # measured numbers in nn/attention (_FLASH_SCORE_BYTES note)
+        # show XLA's fused einsum winning wall-clock at every length
+        # it can hold — promote it via BIGDL_KERNELS=1/flash once the
+        # bench KERNELS trajectory on real TPU justifies the flip
+        return KernelConfig(decode_attention=True, int8_matmul=True)
+    return KernelConfig.off()
+
+
+def get_config() -> KernelConfig:
+    """The active :class:`KernelConfig` (resolving the backend/env
+    default on first use)."""
+    global _CONFIG
+    with _LOCK:
+        if _CONFIG is None:
+            _CONFIG = _default()
+        return _CONFIG
+
+
+def configure(config: Optional[KernelConfig]) -> None:
+    """Install ``config`` as the active kernel policy; ``None``
+    restores the backend/env default (re-resolved lazily)."""
+    global _CONFIG
+    with _LOCK:
+        _CONFIG = config
+
+
+@contextlib.contextmanager
+def use(config: KernelConfig) -> Iterator[KernelConfig]:
+    """Scoped :func:`configure`: the previous policy is restored on
+    exit — the tests' and bench legs' on/off toggle."""
+    global _CONFIG
+    with _LOCK:
+        prev = _CONFIG
+        _CONFIG = config
+    try:
+        yield config
+    finally:
+        with _LOCK:
+            _CONFIG = prev
+
+
+def enabled(op: str) -> bool:
+    """Whether kernel ``op`` (``flash`` | ``decode`` | ``int8``) is
+    enabled under the active config."""
+    cfg = get_config()
+    try:
+        return {"flash": cfg.flash_attention,
+                "decode": cfg.decode_attention,
+                "int8": cfg.int8_matmul}[op]
+    except KeyError:
+        raise ValueError(f"unknown kernel op {op!r} "
+                         f"(choose from {list(_OPS)})") from None
+
+
+def interpret_mode() -> bool:
+    """The active config's effective interpret flag."""
+    return get_config().resolve_interpret()
+
+
+def active_label() -> str:
+    """``"pallas"`` when any kernel is enabled, else ``"reference"`` —
+    the ``kernel=`` label value program profiles carry so MFU/HBM
+    gauges compare the two paths side by side
+    (:mod:`bigdl_tpu.telemetry.programs`)."""
+    return "pallas" if get_config().any_enabled else "reference"
+
+

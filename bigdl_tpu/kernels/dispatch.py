@@ -1,0 +1,189 @@
+"""The kernel dispatch layer — every pallas kernel enters here.
+
+Call sites (``nn.attention``, the generation decode path,
+``nn.quantized``) never invoke ``pl.pallas_call`` directly — the
+``raw-pallas-call`` lint rule enforces it — they ask this layer, which
+checks the active :class:`~bigdl_tpu.kernels.config.KernelConfig` and
+shape eligibility and returns either the kernel result or **None**,
+meaning "run your existing pure-jnp path". Returning None (rather than
+owning a second copy of the reference math) keeps exactly ONE
+reference implementation per op — the einsum/`ops.quant` code the
+equivalence tests compare against — and guarantees the kernels-off
+configuration is byte-identical to the pre-kernel tree.
+
+Dispatch decisions happen at TRACE time (config and shapes are
+static), so the per-trace counters below count compiled-program
+routing, not per-step calls: ``kernels/dispatch/pallas`` vs
+``kernels/dispatch/reference`` with an ``op=flash|decode|int8`` label.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax.numpy as jnp
+
+import bigdl_tpu.telemetry as telemetry
+from bigdl_tpu.kernels import config as _config
+from bigdl_tpu.kernels.common import fit_block
+
+__all__ = ["attention", "decode_attention", "int8_matmul",
+           "taken_in_thread"]
+
+# module-level registration so `tools.check --telemetry-audit` sees the
+# REAL instruments on import, not a hand-maintained name list
+_C_PALLAS = telemetry.counter(
+    "kernels/dispatch/pallas",
+    "traces routed to a pallas kernel (label op=flash|decode|int8)")
+_C_REFERENCE = telemetry.counter(
+    "kernels/dispatch/reference",
+    "traces declined by the dispatch layer to the pure-jnp reference")
+
+
+# trace-scoped routing evidence: tracing happens on the caller's
+# thread, so a thread-local tick lets a compile site ask "did THIS
+# trace route through a pallas kernel" — which is how program profiles
+# earn their kernel=pallas label (telemetry.programs), instead of
+# guessing from the global config
+_TRACE = threading.local()
+
+
+def taken_in_thread() -> int:
+    """Monotonic count of pallas dispatches taken on this thread —
+    snapshot before and after a ``lower()``/trace to learn whether the
+    traced program actually contains a kernel."""
+    return getattr(_TRACE, "taken", 0)
+
+
+def _declined(op: str) -> None:
+    _C_REFERENCE.inc(op=op)
+
+
+def _taken(op: str) -> None:
+    _C_PALLAS.inc(op=op)
+    _TRACE.taken = getattr(_TRACE, "taken", 0) + 1
+
+
+def _floating(*arrays) -> bool:
+    return all(jnp.issubdtype(a.dtype, jnp.floating) for a in arrays)
+
+
+#: compiled-mode VMEM working-set budget for one flash program: the
+#: working set must fit comfortably under ~16 MB/core; over budget the
+#: dispatch DECLINES so nn.attention's einsum / bundled-flash routes
+#: keep the long-context escape hatch (a Mosaic OOM would be an
+#: error, not a fallback)
+_FLASH_VMEM_BUDGET = 12 * 1024 * 1024
+
+
+def _flash_vmem_bytes(q, block_q: int) -> int:
+    """Upper-bound VMEM working set of ONE flash grid program — the
+    BACKWARD kernel's, which dominates: f32 casts of the full K and V
+    blocks, the two [S, D] f32 dK/dV scratch accumulators, and four
+    f32 [block_q, S] strips (scores, p, dp, ds). The forward (K+V at
+    input dtype + three strips) is strictly smaller, so budgeting on
+    the backward keeps jax.grad from OOMing at shapes the forward
+    alone would have accepted."""
+    s, d = q.shape[-2], q.shape[-1]
+    bq = fit_block(s, block_q)
+    kv_inputs = 2 * s * d * q.dtype.itemsize
+    kv_f32 = 2 * s * d * 4        # in-kernel f32 casts of K and V
+    scratch = 2 * s * d * 4       # dK/dV accumulators
+    strips = 4 * bq * s * 4       # scores / p / dp / ds
+    tiles = 4 * bq * d * 4        # q, o, do, dq tiles
+    return kv_inputs + kv_f32 + scratch + strips + tiles
+
+
+def attention(q, k, v, *, causal: bool = False, segment_ids=None,
+              sm_scale: Optional[float] = None):
+    """Flash-attention dispatch for ``[B, H, S, D]`` q/k/v: the tiled
+    pallas kernel (:mod:`bigdl_tpu.kernels.flash_attention`, segment-
+    mask aware, differentiable) when the active config enables
+    ``flash`` and the shapes qualify — else **None**, telling the
+    caller to run its jnp path (``nn.attention.dot_product_attention``
+    falls through to the einsum form, which itself still routes
+    HBM-busting lengths to jax's bundled flash kernel)."""
+    if not _config.enabled("flash"):
+        _declined("flash")
+        return None
+    if (q.ndim != 4 or k.shape != q.shape or v.shape != q.shape
+            or not _floating(q, k, v)):
+        _declined("flash")
+        return None
+    cfg = _config.get_config()
+    interpret = cfg.resolve_interpret()
+    if not interpret and _flash_vmem_bytes(q, cfg.block_q) \
+            > _FLASH_VMEM_BUDGET:
+        _declined("flash")
+        return None
+    from bigdl_tpu.kernels.flash_attention import flash_attention
+
+    _taken("flash")
+    return flash_attention(q, k, v, segment_ids, causal=causal,
+                           sm_scale=sm_scale, block_q=cfg.block_q,
+                           interpret=interpret)
+
+
+def decode_attention(q, k, v, lengths, *,
+                     sm_scale: Optional[float] = None):
+    """Ragged-decode dispatch: ``q [slots, H, D]`` (one token per
+    slot), ``k``/``v`` ``[slots, H, T, D]`` cache slices, ``lengths``
+    the host per-slot valid-KV vector. Returns the kernel result
+    (:mod:`bigdl_tpu.kernels.ragged_decode` — reads only
+    ``lengths[i]`` rows per slot) when ``decode`` is enabled and the
+    shapes qualify, else **None** (the caller's length-masked einsum
+    path runs)."""
+    if not _config.enabled("decode"):
+        _declined("decode")
+        return None
+    if (k.ndim != 4 or q.shape != k.shape[:2] + k.shape[3:]
+            or not _floating(q, k, v)):
+        _declined("decode")
+        return None
+    from bigdl_tpu.kernels.ragged_decode import ragged_decode_attention
+
+    cfg = _config.get_config()
+    _taken("decode")
+    return ragged_decode_attention(q, k, v, lengths, sm_scale=sm_scale,
+                                   block_k=cfg.block_k,
+                                   interpret=cfg.resolve_interpret())
+
+
+#: compiled (non-interpret) int8 tiles must fill the MXU: the same
+#: alignment gate nn.quantized always applied before taking the kernel
+_INT8_ALIGN = (256, 256, 512)
+
+
+def int8_matmul(x_q, w_q, x_scale, w_scale, bias=None):
+    """Fused dequant-int8-GEMM dispatch: ``x_q [M, K] i8 @ w_q [N, K]
+    i8^T`` rescaled by ``x_scale`` (per row or scalar — the calibrated
+    serving path) and per-channel ``w_scale``. Returns the pallas
+    kernel result (bias added OUTSIDE the kernel so the path stays
+    bit-identical to dequantize-then-matmul — see
+    :mod:`bigdl_tpu.kernels.int8_gemm`) when ``int8`` is enabled and
+    the shapes qualify, else **None** (the caller runs
+    ``ops.quant.quantized_linear``)."""
+    if not _config.enabled("int8"):
+        _declined("int8")
+        return None
+    m, k = x_q.shape
+    n = w_q.shape[0]
+    cfg = _config.get_config()
+    interpret = cfg.resolve_interpret()
+    if not interpret and not (m % _INT8_ALIGN[0] == 0
+                              and n % _INT8_ALIGN[1] == 0
+                              and k % _INT8_ALIGN[2] == 0):
+        _declined("int8")
+        return None
+    from bigdl_tpu.kernels.int8_gemm import pallas_quantized_matmul
+
+    _taken("int8")
+    xs = jnp.broadcast_to(
+        jnp.asarray(x_scale, jnp.float32).reshape(-1, 1), (m, 1))
+    out = pallas_quantized_matmul(x_q, w_q, xs, w_scale,
+                                  interpret=interpret)
+    if bias is not None:
+        # the ONE bias add both paths share (fusing it into the kernel
+        # costs a one-ulp FMA drift vs the reference; int8_gemm.py)
+        out = out + bias.reshape(1, -1).astype(jnp.float32)
+    return out

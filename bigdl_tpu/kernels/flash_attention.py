@@ -1,0 +1,280 @@
+"""Fused flash attention for training — pallas, segment-mask aware.
+
+The [S, S] score matrix never exists in memory: the grid tiles the
+query axis and each program holds one ``[block_q, S]`` score strip in
+VMEM, computes a numerically-stable softmax over the full key axis and
+contracts straight into the ``[block_q, D]`` output — O(S·block_q)
+live bytes instead of O(S²) (the memory property that lets S=32K run
+where the einsum path dies; nn/attention's ``_FLASH_SCORE_BYTES``
+measurement note). The backward recomputes the strip from the saved
+log-sum-exp and accumulates dK/dV across query tiles in VMEM scratch —
+no residual score matrix either.
+
+**Why full-row reductions instead of blockwise rescaling:** the
+classic online-softmax rescales the running accumulator by
+``exp(m_old - m_new)`` at every key block, which makes the result
+depend on where block boundaries fall. Packed training slabs
+(``bigdl_tpu.datapipe.packing``) put documents at arbitrary row
+offsets, and the datapipe's contract is that a packed forward is
+**bit-exact per token** against each document run alone — a guarantee
+blockwise rescaling breaks (the rescale rounds differently per
+offset). Reducing each query's full key row at once keeps masked
+positions as *exact zeros* in the sum, which commutes with document
+offset, so the packed-slab bitwise contract survives the kernel
+(tests/test_kernels.py asserts it per token). The decode kernel
+(:mod:`bigdl_tpu.kernels.decode_attention`), whose win is *skipping*
+tail key blocks, uses the true online rescaling form — its contract is
+tolerance, not bitwise.
+
+Masking: ``causal`` and/or ``segment_ids`` (``[B, S]`` int32; queries
+attend only same-segment keys — the packed-slab mask). Masked scores
+are ``-inf`` so they vanish exactly from max/sum; a fully-masked query
+row yields 0 output, not NaN.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from bigdl_tpu.kernels.common import fit_block, tpu_compiler_params
+
+__all__ = ["flash_attention", "fit_block"]
+
+_NEG_INF = float("-inf")
+
+
+def _mask_for(i, block_q, s, causal, seg_q, seg_k):
+    """The boolean keep-mask for query tile ``i``: ``[block_q, s]``,
+    or None when nothing masks."""
+    mask = None
+    if causal:
+        rows = i * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, s), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, s), 1)
+        mask = cols <= rows
+    if seg_q is not None:
+        seg = seg_q[:, None] == seg_k[None, :]
+        mask = seg if mask is None else mask & seg
+    return mask
+
+
+def _fwd_kernel(*refs, causal: bool, block_q: int, sm_scale: float,
+                segmented: bool):
+    if segmented:
+        q_ref, k_ref, v_ref, sq_ref, sk_ref, o_ref, lse_ref = refs
+        seg_q, seg_k = sq_ref[0], sk_ref[0]
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref = refs
+        seg_q = seg_k = None
+    i = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32) * sm_scale          # [bq, D]
+    k = k_ref[0, 0]                                         # [S, D]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    mask = _mask_for(i, block_q, s.shape[-1], causal, seg_q, seg_k)
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)                  # [bq, 1]
+    # exp(-inf - -inf) = nan on fully-masked rows; the where() zeroes
+    # every masked lane EXACTLY, which is what keeps packed slabs
+    # bit-faithful (module docstring)
+    p = jnp.exp(s - m)
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)                  # [bq, 1]
+    acc = jax.lax.dot_general(p, v_ref[0, 0].astype(jnp.float32),
+                              (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    o_ref[0, 0] = jnp.where(l > 0, acc / l, 0.0).astype(o_ref.dtype)
+    lse_ref[0, 0] = jnp.where(l[:, 0] > 0, m[:, 0] + jnp.log(l[:, 0]),
+                              _NEG_INF)
+
+
+def _bwd_kernel(*refs, causal: bool, block_q: int, sm_scale: float,
+                segmented: bool, q_tiles: int):
+    if segmented:
+        (q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, sq_ref, sk_ref,
+         dq_ref, dk_ref, dv_ref, dk_acc, dv_acc) = refs
+        seg_q, seg_k = sq_ref[0], sk_ref[0]
+    else:
+        (q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+         dq_ref, dk_ref, dv_ref, dk_acc, dv_acc) = refs
+        seg_q = seg_k = None
+    i = pl.program_id(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    q = q_ref[0, 0].astype(jnp.float32)                     # [bq, D]
+    k = k_ref[0, 0].astype(jnp.float32)                     # [S, D]
+    v = v_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0].astype(jnp.float32)                   # [bq, D]
+    o = o_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0]                                     # [bq]
+    s = jax.lax.dot_general(q * sm_scale, k,
+                            (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    mask = _mask_for(i, block_q, s.shape[-1], causal, seg_q, seg_k)
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG_INF)
+    # softmax weights straight from the saved log-sum-exp; masked (and
+    # fully-masked: -inf - -inf = nan) lanes zeroed exactly
+    p = jnp.exp(s - lse[:, None])
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    p = jnp.where(jnp.isfinite(lse)[:, None], p, 0.0)
+    dv_acc[...] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                       preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    delta = jnp.sum(do * o, axis=-1, keepdims=True)         # [bq, 1]
+    ds = p * (dp - delta) * sm_scale                        # [bq, S]
+    dq_ref[0, 0] = jax.lax.dot_general(
+        ds, k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dq_ref.dtype)
+    dk_acc[...] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                       preferred_element_type=jnp.float32)
+
+    @pl.when(i == q_tiles - 1)
+    def _write():
+        dk_ref[0, 0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _fwd_call(q, k, v, segment_ids, causal, sm_scale, block_q,
+              interpret):
+    b, h, s, d = q.shape
+    grid = (b, h, s // block_q)
+    segmented = segment_ids is not None
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, i: (b_, h_, i, 0)),
+        pl.BlockSpec((1, 1, s, d), lambda b_, h_, i: (b_, h_, 0, 0)),
+        pl.BlockSpec((1, 1, s, d), lambda b_, h_, i: (b_, h_, 0, 0)),
+    ]
+    args = [q, k, v]
+    if segmented:
+        in_specs += [
+            pl.BlockSpec((1, block_q), lambda b_, h_, i: (b_, i)),
+            pl.BlockSpec((1, s), lambda b_, h_, i: (b_, 0)),
+        ]
+        args += [segment_ids.astype(jnp.int32),
+                 segment_ids.astype(jnp.int32)]
+    kernel = functools.partial(_fwd_kernel, causal=causal,
+                               block_q=block_q, sm_scale=sm_scale,
+                               segmented=segmented)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda b_, h_, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b_, h_, i: (b_, h_, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, s), jnp.float32),
+        ],
+        compiler_params=_compiler_params(),
+        interpret=interpret,
+    )(*args)
+
+
+def _bwd_call(q, k, v, o, do, lse, segment_ids, causal, sm_scale,
+              block_q, interpret):
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, s, d = q.shape
+    q_tiles = s // block_q
+    grid = (b, h, q_tiles)
+    segmented = segment_ids is not None
+    tile = pl.BlockSpec((1, 1, block_q, d),
+                        lambda b_, h_, i: (b_, h_, i, 0))
+    full = pl.BlockSpec((1, 1, s, d), lambda b_, h_, i: (b_, h_, 0, 0))
+    in_specs = [tile, full, full, tile, tile,
+                pl.BlockSpec((1, 1, block_q),
+                             lambda b_, h_, i: (b_, h_, i))]
+    args = [q, k, v, o, do, lse]
+    if segmented:
+        in_specs += [
+            pl.BlockSpec((1, block_q), lambda b_, h_, i: (b_, i)),
+            pl.BlockSpec((1, s), lambda b_, h_, i: (b_, 0)),
+        ]
+        args += [segment_ids.astype(jnp.int32),
+                 segment_ids.astype(jnp.int32)]
+    kernel = functools.partial(_bwd_kernel, causal=causal,
+                               block_q=block_q, sm_scale=sm_scale,
+                               segmented=segmented, q_tiles=q_tiles)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[tile, full, full],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, s, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, s, d), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((s, d), jnp.float32),
+                        pltpu.VMEM((s, d), jnp.float32)],
+        compiler_params=_compiler_params(),
+        interpret=interpret,
+    )(*args)
+
+
+def _compiler_params():
+    """q tiles iterate innermost and carry the backward's dK/dV
+    scratch, so that axis is "arbitrary" (sequential); batch and heads
+    are parallel."""
+    return tpu_compiler_params(("parallel", "parallel", "arbitrary"))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, segment_ids, causal, sm_scale, block_q, interpret):
+    out, _ = _fwd_call(q, k, v, segment_ids, causal, sm_scale, block_q,
+                       interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, segment_ids, causal, sm_scale, block_q,
+               interpret):
+    out, lse = _fwd_call(q, k, v, segment_ids, causal, sm_scale,
+                         block_q, interpret)
+    return out, (q, k, v, out, lse, segment_ids)
+
+
+def _flash_bwd(causal, sm_scale, block_q, interpret, res, g):
+    q, k, v, out, lse, segment_ids = res
+    dq, dk, dv = _bwd_call(q, k, v, out, g, lse, segment_ids, causal,
+                           sm_scale, block_q, interpret)
+    return dq, dk, dv, None
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, segment_ids=None, *, causal: bool = False,
+                    sm_scale: float = None, block_q: int = 128,
+                    interpret: bool = False):
+    """Flash attention over ``[B, H, S, D]`` q/k/v (module docstring
+    has the memory/exactness contract). ``segment_ids`` is the packed
+    slab's ``[B, S]`` int32 plane — queries attend same-segment keys
+    only, ANDed with ``causal``. Differentiable via the fused backward
+    kernel; ``interpret`` runs the pallas interpreter (the CPU tier-1
+    path). Use through :func:`bigdl_tpu.kernels.attention`, which
+    owns eligibility and the jnp fallback."""
+    if q.ndim != 4:
+        raise ValueError(f"flash_attention wants [B,H,S,D], got "
+                         f"{q.shape}")
+    s, d = q.shape[-2], q.shape[-1]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    block_q = fit_block(s, block_q)
+    return _flash(q, k, v, segment_ids, bool(causal), float(sm_scale),
+                  int(block_q), bool(interpret))

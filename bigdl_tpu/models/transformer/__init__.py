@@ -83,14 +83,16 @@ class TransformerBlock(Module):
         return {"mlp": adopt_state(self.mlp)}
 
     def apply(self, params, state, input, *, training=False, rng=None,
-              cache=None, positions=None, attend_len=None, attn_mask=None):
+              cache=None, positions=None, attend_len=None, attn_mask=None,
+              attn_segments=None):
         r1, r2 = (jax.random.split(rng) if rng is not None else (None, None))
         h = self.ln1.forward_fn(params["ln1"], input)
         if cache is None:
             h = self.attn.forward_fn(params["attn"], h, training=training,
-                                     rng=r1, mask=attn_mask)
+                                     rng=r1, mask=attn_mask,
+                                     segments=attn_segments)
         else:
-            if attn_mask is not None:
+            if attn_mask is not None or attn_segments is not None:
                 raise ValueError(
                     "segment masks are not supported on the KV-cached "
                     "decode path (pack training slabs, not decode steps)")
@@ -172,7 +174,7 @@ class TransformerLM(Module):
     def apply(self, params, state, input, *, training=False, rng=None,
               cache=None, positions=None, attend_len=None):
         from bigdl_tpu.utils.table import Table
-        seg_mask = None
+        seg = None
         packed_pos = None
         if isinstance(input, Table):
             input = [input[i] for i in range(1, input.length() + 1)]
@@ -186,10 +188,12 @@ class TransformerLM(Module):
                     "packed 3-plane input is a training/scoring layout; "
                     "the KV-cached decode path takes plain token ids")
             tokens, segment_ids, packed_pos = input
+            # same-document attention only: the raw [B, S] plane rides
+            # down as attn_segments — nn.attention derives the
+            # [B, 1, Sq, Sk] equality mask for the einsum path (one
+            # derivation site) and hands the plane itself to the
+            # pallas flash kernel when enabled
             seg = segment_ids.astype(jnp.int32)
-            # same-document attention only: [B, 1, Sq, Sk]; ANDed with
-            # the causal structure inside dot_product_attention
-            seg_mask = seg[:, None, :, None] == seg[:, None, None, :]
             tokens = tokens.astype(jnp.int32)
         else:
             tokens = input.astype(jnp.int32)
@@ -218,11 +222,15 @@ class TransformerLM(Module):
         new_state = {}
         for i, blk in enumerate(self.blocks):
             if cache is None:
-                # attn_mask only rides along for packed inputs: the
-                # plain path keeps the bare apply signature (shapecheck
-                # interceptors and custom blocks see no new kwarg)
-                mask_kw = {} if seg_mask is None \
-                    else {"attn_mask": seg_mask}
+                # attn_segments only rides along for packed inputs:
+                # the plain path keeps the bare apply signature
+                # (shapecheck interceptors and custom blocks see no
+                # new kwarg). The raw segment-id plane travels instead
+                # of a prebuilt [B,1,S,S] mask — nn.attention derives
+                # the equality mask for the einsum path and feeds the
+                # plane to the pallas flash kernel when enabled.
+                mask_kw = {} if seg is None \
+                    else {"attn_segments": seg}
                 x, st = blk.apply(params[f"block_{i}"],
                                   state.get(f"block_{i}", {}), x,
                                   training=training, rng=keys[i],

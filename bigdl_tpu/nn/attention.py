@@ -60,15 +60,51 @@ def _flash_eligible(q, mask, dropout_rate, training) -> bool:
 
 def dot_product_attention(q, k, v, *, causal: bool = False, mask=None,
                           dropout_rate: float = 0.0, rng=None,
-                          training: bool = False, use_flash: bool = True):
+                          training: bool = False, use_flash: bool = True,
+                          segments=None):
     """Scaled dot-product attention. q,k,v: [B, H, S, D].
 
-    On TPU, sequences whose score matrix would bust HBM route to the
-    pallas flash kernel (O(S) memory); everything else uses the einsum
-    form, which XLA fuses onto the MXU and — measured on v5e — wins
-    wall-clock at every length it can hold (see _FLASH_SCORE_BYTES).
+    The first router is the kernel dispatch layer
+    (``bigdl_tpu.kernels``): with the flash kernel enabled
+    (``KernelConfig``/``BIGDL_KERNELS``) eligible shapes run the
+    fused pallas flash attention. Masking is EITHER ``mask`` (an
+    arbitrary boolean ``[B, 1, S, S]`` — never kernel-eligible, the
+    kernel cannot honor a free-form mask) OR ``segments`` (the packed
+    datapipe slab's ``[B, S]`` segment-id plane — the same-segment
+    mask is derived HERE for the einsum fallback, and the raw plane
+    rides into the kernel so packed slabs stay bit-faithful); passing
+    both raises, because the kernel would silently drop whatever the
+    mask adds beyond segment equality. A declined dispatch falls
+    through unchanged, so kernels-off is byte-identical to the
+    pre-kernel path.
+
+    On TPU with kernels off, sequences whose score matrix would bust
+    HBM still route to jax's bundled flash kernel (O(S) memory);
+    everything else uses the einsum form, which XLA fuses onto the MXU
+    and — measured on v5e — wins wall-clock at every length it can
+    hold (see _FLASH_SCORE_BYTES).
     """
     d = q.shape[-1]
+    if mask is not None and segments is not None:
+        raise ValueError(
+            "pass mask= OR segments=, not both: the kernel path can "
+            "only honor segment equality, so a mask carrying anything "
+            "more would be silently dropped — derive from segments "
+            "alone (the same-segment mask is built here) or keep a "
+            "custom mask on the einsum path")
+    if (use_flash and mask is None
+            and not (training and dropout_rate > 0.0)):
+        from bigdl_tpu import kernels as _kernels
+        out = _kernels.attention(q, k, v, causal=causal,
+                                 segment_ids=segments,
+                                 sm_scale=1.0 / math.sqrt(d))
+        if out is not None:
+            return out
+    if segments is not None:
+        # the einsum fallback's same-segment mask — one derivation
+        # site, bitwise the mask the packed model used to build itself
+        seg = segments.astype(jnp.int32)
+        mask = seg[:, None, :, None] == seg[:, None, None, :]
     on_tpu = jax.devices()[0].platform == "tpu"
     if (use_flash and on_tpu
             and _flash_eligible(q, mask, dropout_rate, training)):
@@ -156,15 +192,19 @@ class MultiHeadAttention(Module):
 
     def forward_fn(self, params, input, *, training=False, rng=None,
                    cache=None, positions=None, attend_len=None,
-                   mask=None):
+                   mask=None, segments=None):
         """Full-sequence attention, or — with ``cache=`` — one
         incremental (KV-cached) step.
 
         ``mask`` is an optional boolean ``[B, 1, S, S]`` (broadcastable)
-        attention mask ANDed with the causal structure — the segment
-        mask the packed-sequence data path supplies so rows holding
-        several documents never attend across document boundaries
-        (``bigdl_tpu.datapipe.packing``). Unsupported on the
+        attention mask ANDed with the causal structure. ``segments``
+        is the packed-sequence data path's ``[B, S]`` segment-id plane
+        (``bigdl_tpu.datapipe.packing``): the same-segment mask is
+        derived downstream for the einsum path, and the raw plane
+        feeds the pallas flash kernel (``bigdl_tpu.kernels``) when
+        enabled, so rows holding several documents never attend across
+        document boundaries. Pass one or the other, never both (a
+        custom mask cannot ride the kernel). Unsupported on the
         sequence-parallel and cached paths.
 
         ``cache`` is ``{"k": [B,H,T,D], "v": [B,H,T,D]}`` (T the
@@ -182,13 +222,14 @@ class MultiHeadAttention(Module):
         pre-cache implementation (weights are shared; generation adds
         no parameters)."""
         if cache is not None:
-            if mask is not None:
+            if mask is not None or segments is not None:
                 raise ValueError(
                     "segment masks are not supported on the KV-cached "
                     "decode path (pack training slabs, not decode steps)")
             return self._forward_cached(params, input, cache, positions,
                                         attend_len)
-        if mask is not None and self.ring_axis is not None:
+        if (mask is not None or segments is not None) \
+                and self.ring_axis is not None:
             raise ValueError(
                 "segment masks are not supported on the sequence-parallel "
                 "path (ring/ulysses kernels shard the key axis the mask "
@@ -220,7 +261,8 @@ class MultiHeadAttention(Module):
         if out is None:
             out = dot_product_attention(
                 q, k, v, causal=self.causal, mask=mask,
-                dropout_rate=self.dropout, rng=rng, training=training)
+                dropout_rate=self.dropout, rng=rng, training=training,
+                segments=segments)
         out = out.transpose(0, 2, 1, 3).reshape(b, s, e)
         return self._proj(params, out, "o")
 
@@ -258,15 +300,32 @@ class MultiHeadAttention(Module):
         t = ck.shape[2]
         al = t if attend_len is None else int(attend_len)
         ks, vs = ck[:, :, :al, :], cv[:, :, :al, :]
-        # length-masked causal mask: query i of row b sits at absolute
-        # position positions[b]+i and may see cache slots j <= that —
-        # fed through the ONE attention core above so the cached and
-        # full-sequence paths can never drift numerically
-        jpos = jnp.arange(al)[None, None, None, :]
-        qpos = positions[:, None, None, None] \
-            + jnp.arange(s)[None, None, :, None]
-        out = dot_product_attention(q, ks, vs, mask=jpos <= qpos,
-                                    use_flash=False)
+        out = None
+        if s == 1:
+            # the decode step (one new token per row): the ragged
+            # pallas kernel reads only positions[b]+1 valid cache rows
+            # per slot instead of scanning the whole attend_len slice
+            # — the host lengths vector the engine threads as
+            # `positions` is the kernel's ragged bound. Declined
+            # dispatch (kernels off / ineligible) falls through to the
+            # masked path below, bit-identical to the pre-kernel tree.
+            from bigdl_tpu import kernels as _kernels
+            out = _kernels.decode_attention(
+                q[:, :, 0, :], ks, vs,
+                positions.astype(jnp.int32) + 1)
+            if out is not None:
+                out = out[:, :, None, :]
+        if out is None:
+            # length-masked causal mask: query i of row b sits at
+            # absolute position positions[b]+i and may see cache slots
+            # j <= that — fed through the ONE attention core above so
+            # the cached and full-sequence paths can never drift
+            # numerically
+            jpos = jnp.arange(al)[None, None, None, :]
+            qpos = positions[:, None, None, None] \
+                + jnp.arange(s)[None, None, :, None]
+            out = dot_product_attention(q, ks, vs, mask=jpos <= qpos,
+                                        use_flash=False)
         out = out.transpose(0, 2, 1, 3).reshape(b, s, e)
         return self._proj(params, out, "o"), {"k": ck, "v": cv}
 

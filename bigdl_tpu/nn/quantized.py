@@ -82,11 +82,9 @@ class QuantizedLinear(Module):
     def _dispatch(self, x2, params):
         bias = params.get("bias")
         act_scale = params.get("act_scale")
-        m, k = x2.shape
-        n = self.output_size
-        if (jax.default_backend() == "tpu" and m % 256 == 0
-                and n % 256 == 0 and k % 512 == 0):
-            from bigdl_tpu.ops.pallas_kernels import pallas_quantized_matmul
+        m = x2.shape[0]
+        from bigdl_tpu import kernels as _kernels
+        if _kernels.enabled("int8"):
             from bigdl_tpu.ops.quant import quantize_with_scale
             # int8 dequant math is f32 by contract (BigQuant rescale)
             x32 = x2.astype(jnp.float32)  # bigdl: disable=implicit-upcast-in-trace
@@ -98,9 +96,13 @@ class QuantizedLinear(Module):
                 x_scale = jnp.broadcast_to(
                     act_scale.astype(jnp.float32), (m,))  # bigdl: disable=implicit-upcast-in-trace
                 x_q = quantize_with_scale(x32, x_scale.reshape(-1, 1))
-            return pallas_quantized_matmul(
-                x_q, params["weight_q"], x_scale,
-                params["w_scale"], bias)
+            # the fused pallas GEMM, or None (shape-ineligible under a
+            # compiled backend) — the jnp reference below then runs on
+            # the SAME quantization it always did
+            out = _kernels.int8_matmul(x_q, params["weight_q"], x_scale,
+                                       params["w_scale"], bias)
+            if out is not None:
+                return out
         return quantized_linear(x2, params["weight_q"], params["w_scale"],
                                 bias, x_scale=act_scale)
 

@@ -1,83 +1,14 @@
-"""Pallas TPU kernels for the quantized inference path.
+"""Legacy import site for the fused int8 GEMM kernel.
 
-The reference's BigQuant ships hand-written SIMD int8 GEMM (C++, loaded via
-JNI — SURVEY.md §1 L0). The TPU analogue is a pallas kernel that keeps the
-int8 multiply on the MXU and fuses the fp32 dequant + bias epilogue into the
-same kernel, avoiding an HBM round-trip of the int32 accumulator.
-
-Used when running on real TPU with tile-aligned shapes; other backends (and
-ragged shapes) fall back to the XLA reference path in ops/quant.py, which is
-numerically identical.
+The kernel body moved to :mod:`bigdl_tpu.kernels.int8_gemm` when the
+kernel layer became a subsystem (docs/kernels.md): every pallas kernel
+now lives under ``bigdl_tpu/kernels/`` behind the dispatch layer
+(``bigdl_tpu.kernels.int8_matmul``), which the ``raw-pallas-call``
+lint rule enforces. This module keeps the historical import path
+working.
 """
 from __future__ import annotations
 
-import functools
+from bigdl_tpu.kernels.int8_gemm import pallas_quantized_matmul
 
-import jax
-import jax.numpy as jnp
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
-
-
-def _qmm_kernel(x_ref, w_ref, xs_ref, ws_ref, b_ref, o_ref, acc_ref, *,
-                k_steps: int, with_bias: bool):
-    """One (bm, bn) output tile; K is the innermost ("arbitrary") grid dim.
-
-    x_ref: (bm, bk) int8 activations | w_ref: (bn, bk) int8 weights
-    xs_ref: (bm, 1) f32 row scales   | ws_ref: (1, bn) f32 channel scales
-    acc_ref: (bm, bn) int32 scratch accumulator
-    """
-    @pl.when(pl.program_id(2) == 0)
-    def _init():
-        acc_ref[...] = jnp.zeros_like(acc_ref)
-
-    acc_ref[...] += jax.lax.dot_general(
-        x_ref[...], w_ref[...], (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.int32)
-
-    @pl.when(pl.program_id(2) == k_steps - 1)
-    def _epilogue():
-        out = acc_ref[...].astype(jnp.float32) * xs_ref[...] * ws_ref[...]
-        if with_bias:
-            out = out + b_ref[...]
-        o_ref[...] = out
-
-
-@functools.partial(jax.jit, static_argnames=("bm", "bn", "bk", "interpret"))
-def pallas_quantized_matmul(x_q, w_q, x_scale, w_scale, bias=None, *,
-                            bm: int = 256, bn: int = 256, bk: int = 512,
-                            interpret: bool = False):
-    """Fused int8 GEMM + dequant: (x_q [M,K] i8) @ (w_q [N,K] i8)^T scaled.
-
-    Shapes must tile evenly by (bm, bn, bk); callers gate on that.
-    """
-    m, k = x_q.shape
-    n = w_q.shape[0]
-    assert m % bm == 0 and n % bn == 0 and k % bk == 0, (m, n, k)
-    k_steps = k // bk
-    with_bias = bias is not None
-    xs = x_scale.reshape(m, 1).astype(jnp.float32)
-    ws = w_scale.reshape(1, n).astype(jnp.float32)
-    b = (bias.reshape(1, n).astype(jnp.float32) if with_bias
-         else jnp.zeros((1, n), jnp.float32))
-
-    grid = (m // bm, n // bn, k_steps)
-    kernel = functools.partial(_qmm_kernel, k_steps=k_steps,
-                               with_bias=with_bias)
-    return pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
-            pl.BlockSpec((bn, bk), lambda i, j, kk: (j, kk)),
-            pl.BlockSpec((bm, 1), lambda i, j, kk: (i, 0)),
-            pl.BlockSpec((1, bn), lambda i, j, kk: (0, j)),
-            pl.BlockSpec((1, bn), lambda i, j, kk: (0, j)),
-        ],
-        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
-        out_shape=jax.ShapeDtypeStruct((m, n), jnp.float32),
-        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.int32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
-        interpret=interpret,
-    )(x_q, w_q, xs, ws, b)
+__all__ = ["pallas_quantized_matmul"]

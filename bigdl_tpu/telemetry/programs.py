@@ -192,15 +192,20 @@ class ProgramProfile:
     __slots__ = ("name", "kind", "flops", "bytes_accessed", "arg_bytes",
                  "out_bytes", "temp_bytes", "alias_bytes", "hbm_bytes",
                  "compile_s", "scan_length", "items_per_call",
-                 "donation", "extra", "rate_items_per_s", "achieved_tfs",
-                 "mfu")
+                 "donation", "kernel", "extra", "rate_items_per_s",
+                 "achieved_tfs", "mfu")
 
     def __init__(self, name: str, kind: str, analysis: Dict[str, float],
                  compile_s: float, scan_length: int = 1,
                  items_per_call: Optional[float] = None,
-                 donation: str = "", extra: Optional[dict] = None):
+                 donation: str = "", extra: Optional[dict] = None,
+                 kernel: Optional[str] = None):
         self.name = name
         self.kind = kind  # "train" | "serving" — the gauge family
+        #: which kernel path built this program: "pallas" |
+        #: "reference" | None (None = registered with kernels off and
+        #: no explicit label — the pre-kernel series identity)
+        self.kernel = kernel
         self.flops = analysis.get("flops", 0.0)
         self.bytes_accessed = analysis.get("bytes_accessed", 0.0)
         self.arg_bytes = analysis.get("arg_bytes", 0.0)
@@ -248,12 +253,24 @@ class ProgramRegistry:
                  analysis: Optional[Dict[str, float]] = None,
                  compile_s: float = 0.0, scan_length: int = 1,
                  items_per_call: Optional[float] = None,
-                 donation: str = "",
-                 extra: Optional[dict] = None) -> ProgramProfile:
+                 donation: str = "", extra: Optional[dict] = None,
+                 kernel: Optional[str] = None) -> ProgramProfile:
         """Register (or replace) one program's profile from either an
         AOT ``compiled`` object (analyzed here) or a pre-computed
         ``analysis`` dict; publishes the profile gauges and returns
-        the profile."""
+        the profile.
+
+        ``kernel`` labels which kernel path built the program —
+        ``"pallas"`` | ``"reference"`` (``bigdl_tpu.kernels``). The
+        wrapped compile sites (:func:`maybe_wrap_jitted`) set it from
+        trace EVIDENCE — whether the program's trace actually routed
+        through a pallas kernel — never from the global config, so a
+        program with no kernel-eligible ops stays unlabeled even on a
+        kernels-on backend and the pre-kernel gauge series identity
+        never churns. Gauge series carry the extra ``kernel=`` label
+        whenever the value is set, so MFU/HBM gauges compare the two
+        paths side by side (bench's KERNELS row passes
+        ``kernel="reference"`` explicitly for its off-legs)."""
         if kind not in ("train", "serving"):
             raise ValueError(f"kind must be train|serving, got {kind!r}")
         if analysis is None:
@@ -261,11 +278,13 @@ class ProgramRegistry:
                 else {}
         prof = ProgramProfile(name, kind, analysis, compile_s,
                               scan_length, items_per_call, donation,
-                              extra)
+                              extra, kernel)
         with self._lock:
             self._profiles[name] = prof
         r = self._registry()
         labels = {"program": name}
+        if kernel is not None:
+            labels["kernel"] = kernel
         r.gauge(f"{kind}/program/flops",
                 _PROFILE_GAUGES["flops"]).set(prof.flops, **labels)
         r.gauge(f"{kind}/program/bytes_accessed",
@@ -307,6 +326,8 @@ class ProgramRegistry:
         prof.mfu = prof.achieved_tfs / peak
         r = self._registry()
         labels = {"program": name}
+        if prof.kernel is not None:
+            labels["kernel"] = prof.kernel
         r.gauge(f"{prof.kind}/program/achieved_tfs",
                 _RATE_GAUGES["achieved_tfs"]).set(prof.achieved_tfs,
                                                   **labels)
@@ -394,9 +415,17 @@ class _ProfiledProgram:
     def _compile_and_register(self, sig, args, kwargs):
         import jax  # noqa: F401  (jax present whenever programs exist)
 
+        from bigdl_tpu.kernels.dispatch import taken_in_thread
+
         t0 = time.perf_counter()
+        # tracing runs on THIS thread: a pallas dispatch taken during
+        # lower() is evidence this program embeds a kernel — the honest
+        # basis for its kernel= label (a config-based guess would tag
+        # kernel-free programs on any kernels-on backend)
+        taken_before = taken_in_thread()
         compiled = self._jitted.lower(*args, **kwargs).compile()
         compile_s = time.perf_counter() - t0
+        kernel = "pallas" if taken_in_thread() > taken_before else None
         with self._lock:
             # one profile per signature: the first keeps the bare
             # name, later specializations get a #N suffix
@@ -418,7 +447,7 @@ class _ProfiledProgram:
         self._registry.register(
             name, self._kind, compiled=compiled, compile_s=compile_s,
             scan_length=scan_length, items_per_call=items,
-            donation=self._donation)
+            donation=self._donation, kernel=kernel)
         return compiled
 
     def __call__(self, *args, **kwargs):
@@ -463,7 +492,7 @@ class _ProfiledProgram:
 def maybe_wrap_jitted(name: str, kind: str, jitted, *, donation: str = "",
                       scan_length_for: Optional[Callable] = None,
                       items_for: Optional[Callable] = None,
-                      auto_rate: bool = False):
+                      auto_rate: bool = False, prog_registry=None):
     """The compile-site hook: when profiling is enabled, wrap a
     ``jax.jit`` callable so its programs register cost/memory profiles
     (see :class:`_ProfiledProgram`); disabled — the default — return
@@ -479,7 +508,8 @@ def maybe_wrap_jitted(name: str, kind: str, jitted, *, donation: str = "",
         return jitted
     return _ProfiledProgram(name, kind, jitted, donation=donation,
                             scan_length_for=scan_length_for,
-                            items_for=items_for, auto_rate=auto_rate)
+                            items_for=items_for, auto_rate=auto_rate,
+                            prog_registry=prog_registry)
 
 
 if os.environ.get("BIGDL_PROGRAM_PROFILES", "").strip() not in ("", "0"):

@@ -115,7 +115,8 @@ def collect_instrument_names():
                 "bigdl_tpu.tools.perf", "bigdl_tpu.tools.ceiling",
                 "bigdl_tpu.datapipe.readers", "bigdl_tpu.datapipe.shuffle",
                 "bigdl_tpu.datapipe.packing",
-                "bigdl_tpu.telemetry.flight"):
+                "bigdl_tpu.telemetry.flight",
+                "bigdl_tpu.kernels.dispatch"):
         importlib.import_module(mod)
     scratch = telemetry.MetricsRegistry()
     from bigdl_tpu.generation.loop import register_generation_instruments

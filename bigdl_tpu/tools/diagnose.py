@@ -287,8 +287,8 @@ def device_summary(program_rows: List[dict]) -> List[dict]:
     out = []
     for p in sorted(program_rows, key=lambda r: r.get("name", "")):
         out.append({k: p.get(k) for k in
-                    ("name", "kind", "mfu", "achieved_tfs", "flops",
-                     "hbm_bytes", "compile_s", "scan_length",
+                    ("name", "kind", "kernel", "mfu", "achieved_tfs",
+                     "flops", "hbm_bytes", "compile_s", "scan_length",
                      "rate_items_per_s")})
     return out
 
@@ -297,6 +297,8 @@ def _device_lines(rows: List[dict]) -> List[str]:
     out = []
     for r in rows:
         line = f"{r['name']}: "
+        if r.get("kernel"):
+            line += f"[{r['kernel']}] "
         if r.get("mfu") is not None:
             line += (f"MFU {100 * r['mfu']:.1f}% "
                      f"({r['achieved_tfs']:g} TF/s), ")

@@ -33,6 +33,12 @@ FAMILIES = {
                    "bigdl_tpu.generation.loop",
                    "bigdl_tpu.generation.stream",
                    "bigdl_tpu.generation.sampling"],
+    "kernels": ["bigdl_tpu.kernels", "bigdl_tpu.kernels.config",
+                "bigdl_tpu.kernels.dispatch",
+                "bigdl_tpu.kernels.flash_attention",
+                "bigdl_tpu.kernels.ragged_decode",
+                "bigdl_tpu.kernels.int8_gemm",
+                "bigdl_tpu.kernels.common"],
     "analysis": ["bigdl_tpu.analysis", "bigdl_tpu.analysis.shapecheck",
                  "bigdl_tpu.analysis.lint"],
     "telemetry": ["bigdl_tpu.telemetry", "bigdl_tpu.telemetry.tracer",

@@ -93,6 +93,16 @@ def main(argv=None):
                     help="train mode: additionally measure steps/sec at "
                     "K=1 vs K=8 fused windows and report both in the "
                     "JSON tail line")
+    ap.add_argument("--kernels", choices=["on", "off"], default=None,
+                    metavar="{on,off}",
+                    help="pallas kernel layer (bigdl_tpu.kernels): "
+                    "'on' enables flash attention / ragged decode / "
+                    "int8 GEMM dispatch (interpret mode off-TPU), "
+                    "'off' forces the pure-jnp reference everywhere; "
+                    "default: the backend/BIGDL_KERNELS policy. The "
+                    "JSON tail carries kernels= and the program's "
+                    "kernel label so a KERNELS on-vs-off pair is "
+                    "attributable")
     ap.add_argument("--zero", type=int, choices=(0, 1, 2, 3), default=0,
                     metavar="STAGE",
                     help="train mode: ZeRO weight-update sharding stage "
@@ -114,6 +124,11 @@ def main(argv=None):
     from bigdl_tpu.utils.random import RandomGenerator
 
     Engine.init()
+    if args.kernels is not None:
+        from bigdl_tpu import kernels as _kernels
+        _kernels.configure(_kernels.KernelConfig.all_on()
+                           if args.kernels == "on"
+                           else _kernels.KernelConfig.off())
     if args.dtype == "bf16":
         Engine.set_compute_dtype(jnp.bfloat16)
     policy = None
@@ -298,13 +313,24 @@ def main(argv=None):
     # alone never showed
     import os
     program_fields = {}
+    # one label serves the program profile AND the JSON tail, so the
+    # two can never disagree: "pallas" only on trace EVIDENCE (a
+    # dispatch actually taken while this process traced — a model
+    # with no kernel-eligible ops stays honest), "reference" for the
+    # forced-off leg, unset otherwise
+    kern_label = None
+    if args.kernels == "off":
+        kern_label = "reference"
+    elif args.kernels == "on":
+        from bigdl_tpu.kernels.dispatch import taken_in_thread
+        kern_label = "pallas" if taken_in_thread() > 0 else None
     if compiled_for_cost is not None:
         from bigdl_tpu.telemetry import programs
         prog_name = f"perf/{args.model}/{args.mode}"
         prof = programs.registry().register(
             prog_name, "train" if args.mode == "train" else "serving",
             compiled=compiled_for_cost, scan_length=sync_k,
-            items_per_call=recs_per_iter)
+            items_per_call=recs_per_iter, kernel=kern_label)
         rated = programs.registry().record_rate(prog_name,
                                                 recs_per_iter / med)
         if rated is not None and rated.achieved_tfs is not None:
@@ -323,10 +349,14 @@ def main(argv=None):
     # machine-readable JSON tail (the driver's scoreboard hook): the
     # run's steps/sec at its window size, plus the K=1-vs-K=8 dispatch
     # comparison when requested
+    from bigdl_tpu import kernels as _kernels_tail
     tail = {"tool": "perf", "model": args.model, "mode": args.mode,
             "batch_size": args.batch_size, "dtype": prec_tag,
             "backend": jax.default_backend(), "median_s": med,
-            "rate": rate, "steps_per_sync": sync_k}
+            "rate": rate, "steps_per_sync": sync_k,
+            "kernels": ("on" if _kernels_tail.get_config().any_enabled
+                        else "off"),
+            "kernel_label": kern_label}
     tail.update(zero_meta)
     tail.update(program_fields)
     if args.mode == "train":

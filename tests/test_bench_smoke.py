@@ -154,3 +154,33 @@ def test_bench_zero_row_contract():
         assert out["zero_opt_state_reduction_stage2"] >= 4
         assert out["zero_stage3_opt_state_bytes_per_chip"] <= \
             out["zero_stage0_opt_state_bytes_per_chip"] // 4
+
+
+@pytest.mark.slow
+def test_bench_kernels_row_contract_and_sentinel_accepts_it():
+    """The KERNELS row: attention-program throughput/MFU and decode
+    tokens/sec with the pallas kernel layer on vs off, plus the
+    speedup ratio — and the regression sentinel must parse the fresh
+    line without refusing it. On CPU the on-legs run the pallas
+    interpreter, so only sign/shape is asserted, never a win."""
+    out = _run_bench("synthetic", {
+        "BENCH_KERNELS": "1", "BENCH_KERNELS_BATCH": "1",
+        "BENCH_KERNELS_HEADS": "2", "BENCH_KERNELS_SEQ": "32",
+        "BENCH_KERNELS_HEAD_DIM": "8", "BENCH_KERNELS_VOCAB": "64",
+        "BENCH_KERNELS_HIDDEN": "32", "BENCH_KERNELS_LAYERS": "1",
+        "BENCH_KERNELS_LEN": "32", "BENCH_KERNELS_SLOTS": "2",
+        "BENCH_KERNELS_REQS": "4", "BENCH_KERNELS_NEW": "4"})
+    for key in ("kernels_attention_tokens_per_sec_on",
+                "kernels_attention_tokens_per_sec_off",
+                "kernels_decode_tokens_per_sec_on",
+                "kernels_decode_tokens_per_sec_off"):
+        assert out[key] > 0
+    assert out["kernels_decode_speedup"] > 0
+    assert out["kernels_attention_mfu_on"] >= 0
+    assert out["kernels_attention_mfu_off"] >= 0
+    # schema_version=2 stamped => the sentinel parses the row as a
+    # candidate instead of refusing it
+    from bigdl_tpu.tools.regress import extract_metrics
+    metrics = extract_metrics(out, "bench-line")
+    assert "kernels_decode_tokens_per_sec_on" in metrics
+    assert "kernels_attention_mfu_on" in metrics

@@ -266,6 +266,20 @@ def epoch_order(records):
     return records
 """,
     ),
+    "raw-pallas-call": (
+        """
+from jax.experimental import pallas as pl
+
+def double(x, kern):
+    return pl.pallas_call(kern, out_shape=None)(x)
+""",
+        """
+from jax.experimental import pallas as pl
+
+def double(x, kern):
+    return pl.pallas_call(kern, out_shape=None)(x)  # bigdl: disable=raw-pallas-call
+""",
+    ),
     "metric-label-cardinality": (
         """
 import bigdl_tpu.telemetry as telemetry
@@ -653,6 +667,40 @@ def f(x):
     return y
 """
     assert "gather-in-step-loop" not in names(run(body))
+
+
+def test_raw_pallas_call_exempts_the_kernels_package():
+    # the kernel layer is the sanctioned home: the SAME source that
+    # fires elsewhere is clean under bigdl_tpu/kernels/
+    src = HEADER + CASES["raw-pallas-call"][0]
+    assert "raw-pallas-call" in names(lint_source(src, "fixture.py"))
+    clean = lint_source(src, "bigdl_tpu/kernels/flashy.py")
+    assert "raw-pallas-call" not in names(clean)
+    clean2 = lint_source(
+        src, "/site-packages/bigdl_tpu/kernels/int8_gemm.py")
+    assert "raw-pallas-call" not in names(clean2)
+
+
+def test_raw_pallas_call_flags_from_import_spelling():
+    body = """
+from jax.experimental.pallas import pallas_call
+
+def f(x, kern):
+    return pallas_call(kern, out_shape=None)(x)
+"""
+    assert "raw-pallas-call" in names(run(body))
+
+
+def test_raw_pallas_call_ignores_dispatch_layer_calls():
+    # routing through bigdl_tpu.kernels is the sanctioned idiom
+    body = """
+from bigdl_tpu import kernels
+
+def f(q, k, v):
+    out = kernels.attention(q, k, v, causal=True)
+    return out if out is not None else q
+"""
+    assert "raw-pallas-call" not in names(run(body))
 
 
 def test_case_table_covers_every_shipped_rule():
