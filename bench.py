@@ -508,6 +508,14 @@ def main():
     # pallas interpreter.
     if _row_enabled("BENCH_KERNELS", platform):
         result.update(_bench_kernels())
+    # tenth tracked row: ELASTIC — preemption-tolerant checkpointing
+    # (bigdl_tpu.elastic): the per-checkpoint step-loop stall with the
+    # sync (gather + inline write) vs async (snapshot-only) writers,
+    # the hidden async write tail, and resume-to-first-step seconds
+    # from a committed format-3 checkpoint. Skipped on CPU smoke runs
+    # unless forced.
+    if _row_enabled("BENCH_ELASTIC", platform):
+        result.update(_bench_elastic())
     print(json.dumps(result))
     _maybe_metrics_snapshot(result)
 
@@ -1109,6 +1117,67 @@ def _bench_programs(model, run_chunk, carry, keys, batch, scan,
         prof = reg.record_rate("bench/resnet50/eval", infer_rate)
         if prof is not None and prof.mfu is not None:
             row["programs_resnet50_eval_mfu"] = round(prof.mfu, 4)
+    return row
+
+
+def _bench_elastic():
+    """ELASTIC row: what async per-shard checkpointing buys, as
+    sentinel-tracked numbers. Leg 1 trains the seeded chaos workload
+    with SYNC (gather + inline write) checkpoints and reads the mean
+    ``train/checkpoint/save_s`` stall; leg 2 repeats it with the
+    ASYNC format-3 writer — the stall shrinks to the snapshot copy
+    and the hidden tail lands in ``train/checkpoint/async_write_s``;
+    leg 3 times a fresh Optimizer resuming from the committed elastic
+    checkpoint to its first completed step (load + cross-layout
+    reshard + compile + one step: the number a preempted pod pays
+    before training again)."""
+    import shutil
+    import tempfile
+    import time
+
+    import bigdl_tpu.telemetry as telemetry
+    from bigdl_tpu.optim import SGD, max_iteration, several_iteration
+    from bigdl_tpu.optim.optimizer import Optimizer
+    from bigdl_tpu.tools.chaos import _build_workload
+
+    steps = int(os.environ.get("BENCH_ELASTIC_STEPS", 8))
+    every = int(os.environ.get("BENCH_ELASTIC_EVERY", 2))
+    save_h = telemetry.histogram("train/checkpoint/save_s")
+    async_h = telemetry.histogram("train/checkpoint/async_write_s")
+    workdir = tempfile.mkdtemp(prefix="bench-elastic-")
+
+    def leg(ckpt, async_write, extra_steps=0):
+        model, ds, crit = _build_workload("tiny", 42, 8)
+        opt = Optimizer(model, ds, crit, batch_size=8)
+        opt.set_optim_method(SGD(learning_rate=0.1, momentum=0.9))
+        opt.set_end_when(max_iteration(steps + extra_steps))
+        opt.set_checkpoint(ckpt, several_iteration(every),
+                           async_write=async_write)
+        opt.optimize()
+
+    row = {}
+    try:
+        c0, s0 = save_h.count(), save_h.sum()
+        leg(os.path.join(workdir, "sync"), False)
+        c1, s1 = save_h.count(), save_h.sum()
+        row["elastic_ckpt_stall_ms_sync"] = round(
+            (s1 - s0) / max(1, c1 - c0) * 1000.0, 3)
+
+        a0, t0 = async_h.count(), async_h.sum()
+        leg(os.path.join(workdir, "async"), True)
+        c2, s2 = save_h.count(), save_h.sum()
+        a1, t1 = async_h.count(), async_h.sum()
+        row["elastic_ckpt_stall_ms_async"] = round(
+            (s2 - s1) / max(1, c2 - c1) * 1000.0, 3)
+        row["elastic_ckpt_async_write_ms"] = round(
+            (t1 - t0) / max(1, a1 - a0) * 1000.0, 3)
+
+        w0 = time.time()
+        leg(os.path.join(workdir, "async"), True, extra_steps=1)
+        row["elastic_resume_to_first_step_s"] = round(time.time() - w0,
+                                                      3)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
     return row
 
 

@@ -85,6 +85,85 @@ def _is_fixed_interval(arg: ast.AST, loop_bound: set) -> bool:
     return False
 
 
+def _checkpoint_surface(ctx: FileContext) -> bool:
+    """True when the file participates in the checkpoint surface: it
+    imports ``bigdl_tpu.utils.serialization`` or ``bigdl_tpu.elastic``
+    (writers, the optimizer's checkpoint call sites, chaos/bench
+    harnesses) — the files whose host loops are the optimizer hot path
+    a blocking copy would stall."""
+    mods = ("bigdl_tpu.utils.serialization", "bigdl_tpu.elastic")
+    for node in ctx.walk(ast.Import):
+        if any(a.name.startswith(mods) for a in node.names):
+            return True
+    for node in ctx.walk(ast.ImportFrom):
+        if node.module and node.module.startswith(mods):
+            return True
+    return False
+
+
+@rule("blocking-copy-in-checkpoint",
+      "blocking device->host copy on the checkpointing hot path")
+def blocking_copy_in_checkpoint(ctx: FileContext):
+    """Flags ``jax.device_get(...)`` — and ``np.asarray(x)`` over a
+    per-iteration device-ish result — inside non-traced host loops of
+    checkpoint-surface files (they import
+    ``bigdl_tpu.utils.serialization`` or ``bigdl_tpu.elastic``).
+
+    A checkpoint that fetches leaves one blocking copy at a time
+    serializes the whole device->host sweep onto the step loop — the
+    stall async checkpointing exists to remove. The sanctioned
+    snapshot point (``elastic.checkpoint.snapshot_tree``) kicks every
+    copy off with ``copy_to_host_async`` FIRST and drains them once;
+    deliberate host fetches in a loop carry
+    ``# bigdl: disable=blocking-copy-in-checkpoint`` so each one is
+    auditable."""
+    from bigdl_tpu.analysis.rules.perf import (_fresh_call_names,
+                                               _imports_jax)
+    if not _imports_jax(ctx) or not _checkpoint_surface(ctx):
+        return
+    for loop in ctx.walk(ast.For, ast.While):
+        if ctx.in_traced(loop):
+            continue
+        body = []
+        # loop.body only: a For header's iterator expression
+        # (`for leaf in jax.device_get(tree):`) evaluates ONCE — a
+        # legitimate up-front materialization, not a per-iteration copy
+        stack = list(loop.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.For, ast.While)):
+                continue  # other scopes / the inner loop's own finding
+            body.append(node)
+            stack.extend(ast.iter_child_nodes(node))
+        fresh = _fresh_call_names(ctx, body)
+        for node in body:
+            if not isinstance(node, ast.Call):
+                continue
+            c = ctx.canon(node.func)
+            if c == "jax.device_get":
+                yield node, (
+                    "`jax.device_get` every loop iteration is a "
+                    "blocking device->host copy on the checkpoint hot "
+                    "path; snapshot through "
+                    "elastic.checkpoint.snapshot_tree (async D2H "
+                    "sweep, background write) or mark a deliberate "
+                    "fetch with "
+                    "`# bigdl: disable=blocking-copy-in-checkpoint`")
+            elif c == "numpy.asarray" and node.args:
+                arg_names = {n.id for n in ast.walk(node.args[0])
+                             if isinstance(n, ast.Name)}
+                if arg_names & fresh:
+                    yield node, (
+                        "`np.asarray` over a per-iteration device "
+                        "result blocks the host once per leaf — the "
+                        "serial-fetch checkpoint stall; start every "
+                        "copy with copy_to_host_async and drain once "
+                        "(elastic.checkpoint.snapshot_tree), or mark "
+                        "a sanctioned point with "
+                        "`# bigdl: disable=blocking-copy-in-checkpoint`")
+
+
 @rule("retry-no-backoff",
       "broad-except retry loop sleeping a fixed interval")
 def retry_no_backoff(ctx: FileContext):

@@ -1,0 +1,101 @@
+"""SIGTERM grace handling: flush an emergency checkpoint before dying.
+
+TPU pods are preempted with a grace window: the scheduler SIGTERMs the
+workload and SIGKILLs it some seconds later. A run that ignores the
+SIGTERM loses everything since its last periodic checkpoint; one that
+checkpoints *inside the signal handler* corrupts state (handlers
+interrupt arbitrary host code mid-step). The supported shape is the
+flag-and-drain pattern: :class:`GraceHandler` only sets an event; the
+optimizer's step loop notices it at the next step boundary — params and
+optimizer state are complete and consistent there — flushes any
+in-flight async write, writes an EMERGENCY checkpoint synchronously,
+dumps a flight-recorder bundle, and raises :class:`Preempted`.
+
+``Preempted`` subclasses ``BaseException`` on purpose: the classified
+retry-from-checkpoint loop catches ``Exception`` — a preemption must
+escape it (retrying inside a doomed process burns the grace window),
+reach the launcher as a nonzero exit, and let the GANG relaunch —
+possibly at a different world size — resume from the emergency
+checkpoint (``elastic.resume``).
+"""
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+from typing import Optional
+
+import bigdl_tpu.telemetry as telemetry
+
+logger = logging.getLogger("bigdl_tpu")
+
+_PREEMPTIONS = telemetry.counter(
+    "train/elastic/preemptions",
+    "SIGTERM grace exits taken (emergency checkpoint flushed)")
+
+
+class Preempted(BaseException):
+    """The run was preempted (SIGTERM) and exited through the grace
+    path AFTER flushing its emergency checkpoint. A ``BaseException``
+    so the optimizer's retry loop never swallows it — the relaunched
+    gang, not this dying process, is the recovery."""
+
+
+class GraceHandler:
+    """Install-once SIGTERM (by default) flag: the handler body only
+    sets a ``threading.Event`` — no locks, no IO, nothing a signal
+    context can deadlock on. Poll :meth:`requested` at safe points."""
+
+    def __init__(self, signals=(signal.SIGTERM,)):
+        self.signals = tuple(signals)
+        self._event = threading.Event()
+        self._prev = {}
+        self._installed = False
+
+    def _on_signal(self, signum, frame) -> None:
+        self._event.set()
+
+    def install(self) -> "GraceHandler":
+        """Install the handlers (main thread only — elsewhere the
+        handler is left uninstalled and :meth:`requested` simply never
+        fires; the run keeps its periodic checkpoints)."""
+        if self._installed:
+            return self
+        for s in self.signals:
+            try:
+                self._prev[s] = signal.signal(s, self._on_signal)
+            except ValueError:
+                logger.warning(
+                    "cannot install signal %s handler off the main "
+                    "thread; preemption grace disabled", s)
+                self.uninstall()
+                return self
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        """Restore the previous handlers."""
+        for s, prev in self._prev.items():
+            try:
+                signal.signal(s, prev)
+            except (ValueError, TypeError):
+                pass
+        self._prev.clear()
+        self._installed = False
+
+    def requested(self) -> bool:
+        """True once a grace signal arrived (sticky)."""
+        return self._event.is_set()
+
+    def request(self) -> None:
+        """Programmatic trigger (tests / embedding schedulers)."""
+        self._event.set()
+
+    def count_preemption(self) -> None:
+        """Record the preemption in telemetry + the flight ring — called
+        from the DRAIN path (loop context), never the signal handler."""
+        _PREEMPTIONS.inc()
+        telemetry.flight.note("preempt", grace="sigterm")
+
+
+__all__ = ["GraceHandler", "Preempted"]

@@ -220,6 +220,29 @@ def constrain_base(tree, mesh: Mesh, rules: Optional[Rules] = None):
             leaf, NamedSharding(mesh, spec)), tree, specs)
 
 
+def spec_to_entries(spec) -> list:
+    """JSON-able form of a ``PartitionSpec`` — one entry per dim:
+    ``None`` (unsharded), an axis name, or a list of axis names. The
+    wire form the elastic checkpoint MANIFEST records per leaf so a
+    resume onto a *different* mesh can reassemble the global array
+    from its parts (``elastic.checkpoint``)."""
+    if spec is None:
+        return []
+    out = []
+    for e in tuple(spec):
+        if e is None or isinstance(e, str):
+            out.append(e)
+        else:
+            out.append(list(e))
+    return out
+
+
+def entries_to_spec(entries) -> P:
+    """Inverse of :func:`spec_to_entries`."""
+    return P(*[e if e is None or isinstance(e, str) else tuple(e)
+               for e in (entries or [])])
+
+
 def tree_bytes_per_chip(tree, floating_as=None) -> int:
     """Resident bytes per chip for a (possibly sharded) pytree: each
     leaf contributes its per-device shard size — ``sharding.shard_shape``

@@ -66,12 +66,21 @@ DEFAULT_SCHEDULE = (
 )
 
 
-def _build_workload(model_kind: str, seed: int, batch_size: int):
+def _build_workload(model_kind: str, seed: int, batch_size: int,
+                    sharding=None):
     """Seeded (model, dataset, criterion): the feed is the epoch-exact
     device cache with deterministic augmentation (full-size crop, no
     flip), so every batch — and therefore every optimizer state — is a
     pure function of the iteration number. That is what entitles the
-    soak to demand bit-identical recovery."""
+    soak to demand bit-identical recovery. ``sharding`` places the
+    cache over a (possibly process-spanning) mesh; the device cache's
+    multi-host contract is that each process passes its LOCAL rows
+    (global n = local n x process_count), so the seeded corpus is
+    sliced contiguously by process rank here — the assembled GLOBAL
+    array, its size, and therefore the iteration-k batch stream are
+    identical whatever the world size: the invariant the host-kill
+    leg's cross-world-size resume comparison rides."""
+    import jax
     import numpy as np
 
     import bigdl_tpu.nn as nn
@@ -81,20 +90,39 @@ def _build_workload(model_kind: str, seed: int, batch_size: int):
 
     RandomGenerator.set_seed(seed)
     r = seeded_rng(seed)
+
+    def local_rows(arr):
+        """This process's contiguous slice of the seeded global
+        corpus (the device cache assembles the global array from
+        per-process contributions in rank order)."""
+        pc = jax.process_count() if sharding is not None else 1
+        if pc <= 1:
+            return arr
+        if len(arr) % pc:
+            raise ValueError(
+                f"hostkill workload rows {len(arr)} must divide the "
+                f"process count {pc}")
+        k = len(arr) // pc
+        return arr[jax.process_index() * k:(jax.process_index() + 1) * k]
+
     if model_kind == "lenet":
         from bigdl_tpu.models import LeNet5
-        imgs = r.randint(0, 255, (64, 1, 28, 28)).astype(np.uint8)
-        lbls = (r.randint(0, 10, 64) + 1).astype(np.float32)
+        imgs = local_rows(r.randint(0, 255, (64, 1, 28, 28))
+                          .astype(np.uint8))
+        lbls = local_rows((r.randint(0, 10, 64) + 1).astype(np.float32))
         ds = DeviceCachedArrayDataSet(imgs, lbls, batch_size, flip=False,
                                       mean=(127.0,), std=(64.0,),
-                                      shuffle_seed=seed)
+                                      shuffle_seed=seed,
+                                      sharding=sharding)
         model = LeNet5(10)
     else:
-        imgs = r.randint(0, 255, (32, 3, 8, 8)).astype(np.uint8)
-        lbls = (r.randint(0, 2, 32) + 1).astype(np.float32)
+        imgs = local_rows(r.randint(0, 255, (32, 3, 8, 8))
+                          .astype(np.uint8))
+        lbls = local_rows((r.randint(0, 2, 32) + 1).astype(np.float32))
         ds = DeviceCachedArrayDataSet(imgs, lbls, batch_size, flip=False,
                                       mean=(127.0,) * 3, std=(64.0,) * 3,
-                                      shuffle_seed=seed)
+                                      shuffle_seed=seed,
+                                      sharding=sharding)
         model = (nn.Sequential().add(nn.Reshape((3 * 8 * 8,)))
                  .add(nn.Linear(3 * 8 * 8, 16)).add(nn.Tanh())
                  .add(nn.Linear(16, 2)).add(nn.LogSoftMax()))
@@ -102,11 +130,12 @@ def _build_workload(model_kind: str, seed: int, batch_size: int):
 
 
 def _train_leg(model_kind: str, seed: int, batch_size: int, steps: int,
-               ckpt_dir: Optional[str], ckpt_every: int):
+               ckpt_dir: Optional[str], ckpt_every: int,
+               async_ckpt: bool = False):
     """One seeded training leg: fresh model + dataset, resume from
     ``ckpt_dir`` if it holds checkpoints, train to ``steps`` total
-    iterations. Returns the optimizer (final params live on its
-    model)."""
+    iterations (``async_ckpt`` uses the format-3 elastic writer).
+    Returns the optimizer (final params live on its model)."""
     from bigdl_tpu.optim import SGD, max_iteration, several_iteration
     from bigdl_tpu.optim.optimizer import Optimizer
 
@@ -116,7 +145,8 @@ def _train_leg(model_kind: str, seed: int, batch_size: int, steps: int,
     opt.set_end_when(max_iteration(steps))
     opt.retry_interval_s = 0.05  # keep the soak's backoff sleeps short
     if ckpt_dir is not None:
-        opt.set_checkpoint(ckpt_dir, several_iteration(ckpt_every))
+        opt.set_checkpoint(ckpt_dir, several_iteration(ckpt_every),
+                           async_write=async_ckpt)
     opt.optimize()
     return opt
 
@@ -336,7 +366,8 @@ def _run_worker(args) -> int:
     if args.schedule:
         faults.arm(args.schedule)
     opt = _train_leg(args.model, args.seed, args.batch_size, args.steps,
-                     args.ckpt_dir, args.ckpt_every)
+                     args.ckpt_dir, args.ckpt_every,
+                     async_ckpt=getattr(args, "async_ckpt", False))
     if args.save_params:
         import numpy as np
         np.savez(args.save_params, **_final_params(opt))
@@ -359,6 +390,207 @@ def _spawn_worker(model: str, seed: int, batch_size: int, steps: int,
         cmd += ["--schedule", schedule]
     return subprocess.run(cmd, capture_output=True, text=True,
                           timeout=timeout_s, env=env)
+
+
+# ------------------------------------------------- host-kill chaos leg
+
+def _run_hostkill_worker(args) -> int:
+    """Gang-worker entry for the host-kill leg (spawned by
+    ``tools.launch``): bring up jax.distributed from the launcher's env
+    when the gang spans processes, train the seeded workload over a
+    mesh of ALL devices with ASYNC elastic checkpoints + SIGTERM grace,
+    and (rank 0) save the final params for the parent's comparison."""
+    import jax
+    import numpy as np
+
+    if int(os.environ.get("JAX_NUM_PROCESSES", "1")) > 1:
+        from bigdl_tpu.utils.engine import Engine
+        Engine.init_distributed(initialization_timeout=120)
+    else:
+        jax.config.update("jax_platforms", "cpu")
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from bigdl_tpu.optim import SGD, max_iteration, several_iteration
+    from bigdl_tpu.optim.optimizer import Optimizer
+
+    if getattr(args, "step_delay_ms", 0):
+        # pure-latency pacing so the parent's monitor tick can land the
+        # host kill MID-WINDOW (latency rules recover nothing and are
+        # excluded from reconciliation by design)
+        from bigdl_tpu import faults
+        faults.arm(f"train/step=delay:{args.step_delay_ms},times:100000")
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    model, ds, crit = _build_workload(
+        args.model, args.seed, args.batch_size,
+        sharding=NamedSharding(mesh, P("data")))
+    opt = Optimizer(model, ds, crit, batch_size=args.batch_size,
+                    mesh=mesh)
+    opt.set_optim_method(SGD(learning_rate=0.1, momentum=0.9))
+    opt.set_end_when(max_iteration(args.steps))
+    opt.retry_interval_s = 0.05
+    opt.set_checkpoint(args.ckpt_dir, several_iteration(args.ckpt_every),
+                       async_write=True, keep_last=4)
+    opt.set_preemption_handler()
+    opt.optimize()
+    if args.save_params and jax.process_index() == 0:
+        np.savez(args.save_params, **_final_params(opt))
+    print(json.dumps({"ok": True, "neval": opt.driver_state["neval"],
+                      "world": jax.process_count()}))
+    return 0
+
+
+def run_hostkill(model: str = "tiny", steps: int = 12,
+                 ckpt_every: int = 2, batch_size: int = 8,
+                 seed: int = 42, nproc: int = 2, cpu_devices: int = 2,
+                 relaunch_nproc: int = 1, relaunch_cpu_devices: int = 2,
+                 kill_after_commits: int = 1,
+                 workdir: Optional[str] = None,
+                 tol: float = 1e-5) -> Dict:
+    """The multi-process host-kill leg: SIGKILL a WHOLE gang host
+    mid-window and prove elastic recovery at a DIFFERENT world size.
+
+    Phases: (1) capability probe — a runtime whose CPU backend cannot
+    execute cross-process collectives reports ``skipped`` with the
+    precise reason instead of crashing; (2) an uninterrupted
+    single-process reference run of the identical seeded workload
+    (the epoch-exact device cache makes the GLOBAL batch at iteration
+    k world-size-invariant); (3) gang A (``nproc`` x ``cpu_devices``)
+    through ``tools.launch.run_gang``, SIGKILLed whole-host by the
+    monitor hook once ``kill_after_commits`` async checkpoints have
+    COMMITTED; (4) relaunch at a different world size
+    (``relaunch_nproc``) which must resume from the last committed
+    elastic checkpoint and finish. Asserted: the torn in-flight write
+    is never visible (the resumed run loads only committed state), the
+    resumed params match the reference within ``tol`` (bit-identical
+    when the relaunch topology equals the original), and the one
+    injected host kill reconciles against exactly one successful
+    relaunch."""
+    import signal as _signal
+
+    import numpy as np
+
+    from bigdl_tpu.elastic.capability import multiprocess_cpu
+    from bigdl_tpu.tools import launch
+
+    report: Dict = {"model": model, "steps": steps, "seed": seed,
+                    "nproc": nproc, "relaunch_nproc": relaunch_nproc,
+                    "violations": []}
+    if max(nproc, relaunch_nproc) > 1:
+        # only a process-SPANNING gang needs cross-process collectives;
+        # an nproc=1 host kill (gang + SIGKILL + elastic resume across
+        # a device-count change) runs on any runtime
+        ok, reason = multiprocess_cpu()
+        if not ok:
+            report["skipped"] = reason
+            report["passed"] = True
+            return report
+
+    own_workdir = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="bigdl-hostkill-")
+    ckpt_dir = os.path.join(workdir, "ckpts")
+    ref_ckpt = os.path.join(workdir, "ref-ckpts")
+    ref_npz = os.path.join(workdir, "ref.npz")
+    out_npz = os.path.join(workdir, "resumed.npz")
+    script = os.path.abspath(__file__)
+    # workers run this file AS A SCRIPT: the package root must be
+    # importable however the parent was started
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(script)))
+    extra_env = {"PYTHONPATH": pkg_root + os.pathsep
+                 + os.environ.get("PYTHONPATH", "")}
+
+    def wargs(ckpt, save, extra=()):
+        return ["--hostkill-worker", "--model", model,
+                "--seed", str(seed), "--batch-size", str(batch_size),
+                "--steps", str(steps), "--ckpt-every", str(ckpt_every),
+                "--ckpt-dir", ckpt, "--save-params", save, *extra]
+
+    # gang A is PACED (pure-latency train/step rule) so the monitor's
+    # poll tick reliably lands the SIGKILL mid-window, between commits
+    paced = ["--step-delay-ms", "150"]
+
+    try:
+        # -- phase 2: uninterrupted single-process reference ----------
+        ref = launch.run_gang(launch.build_args(
+            script, wargs(ref_ckpt, ref_npz), nproc=1,
+            cpu_devices=relaunch_cpu_devices, extra_env=extra_env))
+        if not ref.ok:
+            report["violations"].append(
+                f"reference leg failed: {ref.reports}")
+            report["passed"] = False
+            return report
+
+        # -- phase 3: gang A, whole-host SIGKILL mid-window -----------
+        from bigdl_tpu.elastic import committed_checkpoints
+        killed = {"done": False}
+
+        def monitor(workers):
+            if killed["done"]:
+                return
+            if len(committed_checkpoints(ckpt_dir)) >= kill_after_commits:
+                launch.kill_gang(workers, sig=_signal.SIGKILL)
+                killed["done"] = True
+
+        gang_a = launch.run_gang(launch.build_args(
+            script, wargs(ckpt_dir, out_npz, paced), nproc=nproc,
+            cpu_devices=cpu_devices, extra_env=extra_env),
+            monitor=monitor)
+        if not killed["done"]:
+            report["violations"].append(
+                "gang A finished before any checkpoint committed — the "
+                "host kill never fired (raise steps or lower "
+                "kill_after_commits)")
+        kills = [r for r in gang_a.reports if r.kind == "killed"]
+        report["gang_a"] = [(r.rank, r.kind, r.returncode)
+                            for r in gang_a.reports]
+        if killed["done"] and len(kills) != nproc:
+            report["violations"].append(
+                f"host kill delivered but only {len(kills)}/{nproc} "
+                "workers report kind=killed")
+
+        # -- phase 4: relaunch at a DIFFERENT world size --------------
+        gang_b = launch.run_gang(launch.build_args(
+            script, wargs(ckpt_dir, out_npz), nproc=relaunch_nproc,
+            cpu_devices=relaunch_cpu_devices, max_restarts=1,
+            extra_env=extra_env))
+        report["gang_b"] = [(r.rank, r.kind, r.returncode)
+                            for r in gang_b.reports]
+        if not gang_b.ok:
+            report["violations"].append(
+                f"relaunch at world size {relaunch_nproc} failed: "
+                f"{[(r.rank, r.returncode, r.output_tail[-300:]) for r in gang_b.reports]}")
+
+        # -- invariants -----------------------------------------------
+        report["injected"] = {"hostkill": 1 if killed["done"] else 0}
+        report["recovered"] = {"relaunch": 1 if gang_b.ok else 0}
+        if report["injected"]["hostkill"] != report["recovered"][
+                "relaunch"]:
+            report["violations"].append(
+                "host kills and successful relaunches do not reconcile "
+                f"({report['injected']} vs {report['recovered']})")
+        if gang_b.ok:
+            same_topology = (relaunch_nproc == nproc
+                             and relaunch_cpu_devices == cpu_devices)
+            with np.load(ref_npz) as a, np.load(out_npz) as b:
+                bad, worst = [], 0.0
+                for k in sorted(set(a.files) | set(b.files)):
+                    if k not in a.files or k not in b.files:
+                        bad.append(k)
+                        continue
+                    err = float(np.abs(a[k] - b[k]).max())
+                    worst = max(worst, err)
+                    if (same_topology and err != 0.0) or err > tol:
+                        bad.append(f"{k} (err {err})")
+                report["params_max_err"] = worst
+                report["bit_identical"] = same_topology and worst == 0.0
+                if bad:
+                    report["violations"].append(
+                        "resumed params diverged from the "
+                        f"uninterrupted reference: {bad}")
+    finally:
+        if own_workdir:
+            shutil.rmtree(workdir, ignore_errors=True)
+    report["passed"] = not report["violations"]
+    return report
 
 
 # ----------------------------------------------------------- the soak
@@ -546,14 +778,70 @@ def main(argv=None) -> int:
     ap.add_argument("--workdir", default=None,
                     help="keep work files here instead of a temp dir")
     ap.add_argument("--json", action="store_true")
-    # internal: subprocess leg entry
+    # host-kill leg: SIGKILL a whole tools/launch gang host mid-window,
+    # relaunch at a different world size, assert elastic recovery
+    ap.add_argument("--hostkill", action="store_true",
+                    help="run the multi-process host-kill leg instead "
+                         "of the in-process soak (capability-probed; "
+                         "skips on runtimes without multiprocess CPU "
+                         "collectives)")
+    ap.add_argument("--hk-nproc", type=int, default=2,
+                    help="gang A processes (the host that dies)")
+    ap.add_argument("--hk-devices", type=int, default=2,
+                    help="virtual CPU devices per gang-A process")
+    ap.add_argument("--hk-relaunch-nproc", type=int, default=1,
+                    help="relaunch world size (different from "
+                         "--hk-nproc = the elastic resume under test)")
+    ap.add_argument("--hk-relaunch-devices", type=int, default=2,
+                    help="virtual CPU devices per relaunch process")
+    ap.add_argument("--kill-after-commits", type=int, default=1,
+                    help="SIGKILL the gang once this many async "
+                         "checkpoints have COMMITTED")
+    # internal: subprocess leg entries
     ap.add_argument("--worker", action="store_true",
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--hostkill-worker", action="store_true",
                     help=argparse.SUPPRESS)
     ap.add_argument("--ckpt-dir", default=None, help=argparse.SUPPRESS)
     ap.add_argument("--save-params", default=None,
                     help=argparse.SUPPRESS)
+    ap.add_argument("--step-delay-ms", type=int, default=0,
+                    help=argparse.SUPPRESS)
+    ap.add_argument("--async-ckpt", action="store_true",
+                    help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
 
+    if args.hostkill_worker:
+        if not args.ckpt_dir:
+            print("--hostkill-worker needs --ckpt-dir", file=sys.stderr)
+            return 2
+        return _run_hostkill_worker(args)
+    if args.hostkill:
+        report = run_hostkill(
+            model=args.model, steps=args.steps,
+            ckpt_every=args.ckpt_every, batch_size=args.batch_size,
+            seed=args.seed, nproc=args.hk_nproc,
+            cpu_devices=args.hk_devices,
+            relaunch_nproc=args.hk_relaunch_nproc,
+            relaunch_cpu_devices=args.hk_relaunch_devices,
+            kill_after_commits=args.kill_after_commits,
+            workdir=args.workdir)
+        if args.json:
+            print(json.dumps(report, indent=2, default=str))
+        elif report.get("skipped"):
+            print(f"SKIPPED: {report['skipped']}")
+        else:
+            print("== chaos host-kill leg ==")
+            print(f"gang A: {report.get('gang_a')}")
+            print(f"relaunch: {report.get('gang_b')}")
+            print(f"injected={report.get('injected')} "
+                  f"recovered={report.get('recovered')}")
+            print(f"params_max_err={report.get('params_max_err')} "
+                  f"bit_identical={report.get('bit_identical')}")
+            for v in report["violations"]:
+                print(f"VIOLATION: {v}")
+            print("PASS" if report["passed"] else "FAIL")
+        return 0 if report["passed"] else 1
     if args.worker:
         if not args.ckpt_dir:
             print("--worker needs --ckpt-dir", file=sys.stderr)

@@ -116,7 +116,9 @@ def collect_instrument_names():
                 "bigdl_tpu.datapipe.readers", "bigdl_tpu.datapipe.shuffle",
                 "bigdl_tpu.datapipe.packing",
                 "bigdl_tpu.telemetry.flight",
-                "bigdl_tpu.kernels.dispatch"):
+                "bigdl_tpu.kernels.dispatch",
+                "bigdl_tpu.elastic.checkpoint",
+                "bigdl_tpu.elastic.preempt"):
         importlib.import_module(mod)
     scratch = telemetry.MetricsRegistry()
     from bigdl_tpu.generation.loop import register_generation_instruments

@@ -48,6 +48,9 @@ FAMILIES = {
                   "bigdl_tpu.telemetry.flight"],
     "tools": ["bigdl_tpu.tools.regress"],
     "faults": ["bigdl_tpu.faults", "bigdl_tpu.faults.retry"],
+    "elastic": ["bigdl_tpu.elastic", "bigdl_tpu.elastic.checkpoint",
+                "bigdl_tpu.elastic.resume", "bigdl_tpu.elastic.preempt",
+                "bigdl_tpu.elastic.capability"],
     "parallel": ["bigdl_tpu.parallel", "bigdl_tpu.parallel.zero"],
     "precision": ["bigdl_tpu.precision", "bigdl_tpu.precision.policy",
                   "bigdl_tpu.precision.scaler",

@@ -183,6 +183,32 @@ def _part_blobs(flats, host):
     yield blob("host_state.json", json.dumps(host).encode())
 
 
+def publish_checkpoint_dir(staged: str, path: str,
+                           debris_prefixes=(".tmp-", ".old-")) -> None:
+    """Atomically publish a fully-written (MANIFEST-complete) staged
+    checkpoint dir — the ONE crash-safety-critical commit dance shared
+    by the sync format-2 writer and the elastic format-3 committer:
+    rename any existing destination aside (complete->complete only),
+    rename the staged dir into place, fsync the parent, and only THEN
+    sweep superseded ``<base><prefix>*`` debris. A crash at any point
+    leaves either the previous or the new complete checkpoint
+    reachable (a stray complete dir is still found by
+    ``find_latest_checkpoint`` via its MANIFEST)."""
+    import shutil
+    path = os.path.abspath(path)
+    parent = os.path.dirname(path)
+    base = os.path.basename(path)
+    old = f"{path}.old-{os.getpid()}"
+    if os.path.exists(path):
+        os.rename(path, old)
+    os.rename(staged, path)
+    _fsync_dir(parent)
+    doomed = tuple(base + p for p in debris_prefixes)
+    for name in os.listdir(parent):
+        if name.startswith(doomed):
+            shutil.rmtree(os.path.join(parent, name), ignore_errors=True)
+
+
 def _crash_env_matches(ctx) -> bool:
     """BIGDL_TEST_CRASH_IN_CHECKPOINT names this save's neval (read at
     fire time, like the pre-faults hook did — a harness may set the
@@ -289,7 +315,6 @@ def _save_checkpoint_impl(path: str, *, params, opt_state, model_state,
     import shutil
     path = os.path.abspath(path)
     parent = os.path.dirname(path)
-    base = os.path.basename(path)
     os.makedirs(parent, exist_ok=True)
     tmp = f"{path}.tmp-{os.getpid()}"
     if os.path.exists(tmp):  # our own earlier failed attempt
@@ -304,20 +329,7 @@ def _save_checkpoint_impl(path: str, *, params, opt_state, model_state,
                  neval=driver_state.get("neval", -1), path=path)
     _write_json(os.path.join(tmp, MANIFEST), manifest)
     _fsync_dir(tmp)
-    # commit: the destination only ever transitions complete->complete
-    # (a stray complete tmp/old dir is still found by
-    # find_latest_checkpoint via its MANIFEST, so no crash point leaves
-    # the latest state unreachable)
-    old = f"{path}.old-{os.getpid()}"
-    if os.path.exists(path):
-        os.rename(path, old)
-    os.rename(tmp, path)
-    _fsync_dir(parent)
-    # only AFTER the new checkpoint is committed: drop superseded debris
-    for name in os.listdir(parent):
-        if name.startswith(base + ".tmp-") or name.startswith(
-                base + ".old-"):
-            shutil.rmtree(os.path.join(parent, name), ignore_errors=True)
+    publish_checkpoint_dir(tmp, path)
 
 
 def verify_checkpoint(path: str) -> None:
@@ -329,6 +341,15 @@ def verify_checkpoint(path: str) -> None:
     contract."""
     mpath = file_io.join(path, MANIFEST)
     if not file_io.exists(mpath):
+        from bigdl_tpu.elastic.checkpoint import is_torn_commit
+        if is_torn_commit(path):
+            # phase-1 part files with no MANIFEST: a death between the
+            # last part write and the manifest fsync (the elastic
+            # two-phase commit's torn state) — quarantinable, never a
+            # format-0 pass
+            raise CheckpointCorrupt(
+                f"{path}: torn elastic commit (PART files present, no "
+                "MANIFEST)")
         return  # format-0 back-compat: nothing recorded to verify
     try:
         with file_io.open_file(mpath) as f:
@@ -376,6 +397,21 @@ def load_checkpoint(path: str, verify: bool = True) -> Dict[str, Any]:
     ``checkpoint/load`` span."""
     t0 = time.perf_counter()
     try:
+        mpath = file_io.join(path, MANIFEST)
+        if file_io.exists(mpath):
+            try:
+                with file_io.open_file(mpath) as f:
+                    fmt = int(json.load(f).get("format", 0))
+            except (OSError, ValueError) as e:
+                raise CheckpointCorrupt(
+                    f"{path}: unreadable MANIFEST ({e})")
+            if fmt >= 3:
+                # per-shard elastic layout: reassemble the global
+                # arrays from the parts via the manifest's sharding
+                # metadata (the same dict shape comes back, plus the
+                # "sharding"/"cursors" elastic extras)
+                from bigdl_tpu.elastic.resume import load_parts
+                return load_parts(path, verify=verify)
         with telemetry.span("checkpoint/load", path=path):
             if verify:
                 verify_checkpoint(path)
@@ -394,20 +430,24 @@ def load_checkpoint(path: str, verify: bool = True) -> Dict[str, Any]:
         _CKPT_LOAD_S.observe(time.perf_counter() - t0)
 
 
-def find_latest_checkpoint(directory: str) -> Optional[str]:
-    """Latest COMPLETE checkpoint dir
-    (DistriOptimizer.getLatestFile :867-880). Completeness is certified
-    by the MANIFEST written last by ``save_checkpoint`` — a torn dir
-    from a mid-write crash is never selected, so a resume after a
-    checkpoint-time death lands on the previous intact checkpoint.
-    Recency comes from the MANIFEST's recorded neval, and stray-but-
-    complete ``*.tmp-*``/``*.old-*`` dirs (a crash between the MANIFEST
-    write and the final rename) still count — no crash point makes the
-    newest complete state unreachable."""
+def list_complete_checkpoints(directory: str) -> list:
+    """Every COMPLETE checkpoint dir under ``directory`` as a sorted
+    ``[(recency_key, path), ...]`` (oldest first) — the ONE place the
+    completeness + recency rules live, consumed by both
+    :func:`find_latest_checkpoint` and the elastic retention GC
+    (``elastic.prune_checkpoints``), so the two can never drift on
+    which dirs count. Completeness is certified by the MANIFEST
+    written last by the savers (stray-but-complete ``*.tmp-*`` /
+    ``*.old-*`` / ``*.staging-*`` dirs — a crash between the MANIFEST
+    write and the final rename — still count), with the format-0
+    back-compat exception: properly-named pre-MANIFEST dirs, neval
+    from the name suffix. ``*.corrupt-*`` quarantines never count.
+    The recency key is ``(neval, proper)`` — a properly-named dir
+    wins over a same-neval stray."""
+    out = []
     if not file_io.isdir(directory):
-        return None
-    best, best_key = None, None
-    for name in file_io.listdir(directory):
+        return out
+    for name in sorted(file_io.listdir(directory)):
         full = file_io.join(directory, name)
         if not name.startswith("checkpoint") or not file_io.isdir(full):
             continue
@@ -433,11 +473,21 @@ def find_latest_checkpoint(directory: str) -> Optional[str]:
             neval = int(m.group(1)) if m else 0
         else:
             continue
-        # a properly-named dir wins over a same-neval stray
-        key = (neval, proper)
-        if best_key is None or key > best_key:
-            best, best_key = full, key
-    return best
+        out.append(((neval, proper), full))
+    out.sort(key=lambda e: e[0])
+    return out
+
+
+def find_latest_checkpoint(directory: str) -> Optional[str]:
+    """Latest COMPLETE checkpoint dir
+    (DistriOptimizer.getLatestFile :867-880), per the
+    :func:`list_complete_checkpoints` completeness/recency rules — a
+    torn dir from a mid-write crash is never selected, so a resume
+    after a checkpoint-time death lands on the previous intact
+    checkpoint, and no crash point makes the newest complete state
+    unreachable."""
+    entries = list_complete_checkpoints(directory)
+    return entries[-1][1] if entries else None
 
 
 # -- module-level save/load (ModuleSerializer analogue) ---------------------

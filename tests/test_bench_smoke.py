@@ -184,3 +184,25 @@ def test_bench_kernels_row_contract_and_sentinel_accepts_it():
     metrics = extract_metrics(out, "bench-line")
     assert "kernels_decode_tokens_per_sec_on" in metrics
     assert "kernels_attention_mfu_on" in metrics
+
+
+@pytest.mark.slow
+def test_bench_elastic_row_contract_and_sentinel_accepts_it():
+    """The ELASTIC row: checkpoint step-loop stall sync vs async (the
+    async stall is the snapshot copy alone), the hidden async write
+    tail, and resume-to-first-step seconds — all lower-is-better keys
+    the sentinel classifies by its documented suffix rules."""
+    out = _run_bench("synthetic", {"BENCH_ELASTIC": "1",
+                                   "BENCH_ELASTIC_STEPS": "6"})
+    for key in ("elastic_ckpt_stall_ms_sync",
+                "elastic_ckpt_stall_ms_async",
+                "elastic_ckpt_async_write_ms",
+                "elastic_resume_to_first_step_s"):
+        assert out[key] > 0, key
+    from bigdl_tpu.tools.regress import classify_key, extract_metrics
+    metrics = extract_metrics(out, "bench-line")
+    for key in ("elastic_ckpt_stall_ms_sync",
+                "elastic_ckpt_stall_ms_async",
+                "elastic_resume_to_first_step_s"):
+        assert key in metrics
+        assert classify_key(key) == "lower"

@@ -15,6 +15,8 @@ import sys
 
 import pytest
 
+from _capability import require_multiprocess_cpu
+
 _WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "_distributed_worker.py")
 
@@ -32,6 +34,10 @@ def _run_workers(mode=None, extra_args=(), timeout=300):
     worker completed training steps (its STEP_OK marker) is a mid-run
     collective deadlock and FAILS with both workers' output (a hung
     collective must not read as an environment skip)."""
+    # one probed, cached, auditable reason instead of 12 crash-shaped
+    # failures on runtimes whose CPU backend cannot EXECUTE
+    # cross-process collectives (rendezvous alone is not the capability)
+    require_multiprocess_cpu()
     port = _free_port()
     env = dict(os.environ)
     env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
@@ -206,6 +212,7 @@ def _run_launcher(tmp_env, ckpt, kill_at, max_restarts, crash_ckpt_at=0):
     bring-ups (Gloo rendezvous + compiles) can pass 10 minutes on a
     loaded CI host; skip rather than fail on timeout, like the sibling
     rendezvous tests."""
+    require_multiprocess_cpu()
     worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "_faulttol_worker.py")
     args = [sys.executable, "-m", "bigdl_tpu.tools.launch",

@@ -280,6 +280,26 @@ def double(x, kern):
     return pl.pallas_call(kern, out_shape=None)(x)  # bigdl: disable=raw-pallas-call
 """,
     ),
+    "blocking-copy-in-checkpoint": (
+        """
+from bigdl_tpu.utils.serialization import save_checkpoint
+def snapshot(leaves, step):
+    out = {}
+    for key in leaves:
+        shard = step(leaves[key])
+        out[key] = np.asarray(shard)
+    return out
+""",
+        """
+from bigdl_tpu.utils.serialization import save_checkpoint
+def snapshot(leaves, step):
+    out = {}
+    for key in leaves:
+        shard = step(leaves[key])
+        out[key] = np.asarray(shard)  # bigdl: disable=blocking-copy-in-checkpoint
+    return out
+""",
+    ),
     "metric-label-cardinality": (
         """
 import bigdl_tpu.telemetry as telemetry
@@ -297,6 +317,49 @@ def handle(batch):
 """,
     ),
 }
+
+
+def test_blocking_copy_skips_files_off_the_checkpoint_surface():
+    # the same loop WITHOUT a serialization/elastic import is ordinary
+    # host code (scoring, plotting) — not the checkpoint hot path
+    src = HEADER + """
+def snapshot(leaves, step):
+    out = {}
+    for key in leaves:
+        shard = step(leaves[key])
+        out[key] = np.asarray(shard)
+    return out
+"""
+    assert "blocking-copy-in-checkpoint" not in names(
+        lint_source(src, "fixture.py"))
+
+
+def test_blocking_copy_flags_device_get_in_loop():
+    src = HEADER + """
+from bigdl_tpu.elastic import save_checkpoint
+def fetch_all(tree):
+    host = []
+    for leaf in tree:
+        host.append(jax.device_get(leaf))
+    return host
+"""
+    assert "blocking-copy-in-checkpoint" in names(
+        lint_source(src, "fixture.py"))
+
+
+def test_blocking_copy_ignores_host_asarray_in_loop():
+    # np.asarray over plain host values (no device-ish producer in the
+    # loop) is list/parsing work, not a device fetch
+    src = HEADER + """
+from bigdl_tpu.utils.serialization import load_checkpoint
+def widen(rows):
+    out = []
+    for r in rows:
+        out.append(np.asarray(r))
+    return out
+"""
+    assert "blocking-copy-in-checkpoint" not in names(
+        lint_source(src, "fixture.py"))
 
 
 def test_retry_no_backoff_flags_fixed_attribute_interval():
