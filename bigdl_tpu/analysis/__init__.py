@@ -17,16 +17,30 @@ Two passes, both free of XLA compilation:
   ``apply`` methods, host clocks/global RNG in traces, and bare ``except``.
   Findings support ``# bigdl: disable=RULE`` suppressions.
 
-``python -m bigdl_tpu.tools.check`` runs both passes; the repository
-dogfoods it over ``bigdl_tpu`` itself (tests/test_lint_self.py).
+- **Compiled-program checks** (:mod:`bigdl_tpu.analysis.hlo` +
+  :mod:`bigdl_tpu.analysis.checks` + :mod:`bigdl_tpu.analysis.programs`):
+  a structural parser over lowered/compiled XLA text and a pluggable
+  check registry verifying the contracts that only exist *after*
+  lowering — donated buffers actually aliased, zero collectives at the
+  windowed dispatch boundary, ZeRO shardings in place, f32 islands
+  inside the precision policy, programs fitting HBM. Lowering/compiling
+  only, zero executions.
+
+``python -m bigdl_tpu.tools.check`` runs every pass; the repository
+dogfoods it over ``bigdl_tpu`` itself (tests/test_lint_self.py,
+tests/test_check_self.py).
 """
 from bigdl_tpu.analysis.shapecheck import (Diagnostic, ShapeCheckError,
                                            ShapeReport, check_module, spec)
 from bigdl_tpu.analysis.lint import (Finding, available_rules, format_text,
                                      lint_paths, lint_source, to_json)
+from bigdl_tpu.analysis.hlo import (HloModule, ProgramFinding, ProgramSpec,
+                                    available_checks, parse_hlo, run_checks)
 
 __all__ = [
     "Diagnostic", "ShapeCheckError", "ShapeReport", "check_module", "spec",
     "Finding", "available_rules", "format_text", "lint_paths",
     "lint_source", "to_json",
+    "HloModule", "ProgramFinding", "ProgramSpec", "available_checks",
+    "parse_hlo", "run_checks",
 ]

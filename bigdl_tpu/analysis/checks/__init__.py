@@ -1,0 +1,28 @@
+"""Built-in compiled-program checks (the HLO twin of
+``bigdl_tpu.analysis.rules``).
+
+Importing this package registers every built-in check with the
+:func:`bigdl_tpu.analysis.hlo.hlo_check` registry:
+
+- ``donation-dropped`` — an input declared donated has no entry in the
+  program's aliasing/donor table (silent 2x memory).
+- ``entry-collective`` — a communication collective in the ENTRY
+  computation of a windowed (``steps_per_sync``) program: the PR 8
+  dispatch-boundary contract as a reusable check.
+- ``scan-dispatch-ratio`` — a window program whose per-dispatch
+  collective count grows with K (an unrolled window / un-hoisted
+  gathers).
+- ``replicated-large-operand`` — a large, shardable entry parameter
+  left replicated on a multi-device mesh under ZeRO stage >= 2.
+- ``precision-leak`` — f32 compute escaping the sanctioned
+  norm/softmax/loss islands of a bf16/f16-policy program.
+- ``hbm-over-budget`` — ``memory_analysis`` arguments+outputs+temps
+  exceed the per-device budget: static infeasibility, no execution
+  (the autotuner's pruning primitive, ROADMAP item 4).
+"""
+from bigdl_tpu.analysis.checks import (  # noqa: F401  register on import
+    collectives, donation, memory, precision, sharding)
+
+from bigdl_tpu.analysis.hlo import available_checks  # noqa: F401
+
+__all__ = ["available_checks"]

@@ -1,0 +1,57 @@
+"""Collective-placement checks for windowed (``steps_per_sync``)
+programs: the dispatch boundary stays collective-free and the
+per-dispatch collective count stays K-independent."""
+from __future__ import annotations
+
+from bigdl_tpu.analysis.hlo import (COMMUNICATION_OPS, ProgramSpec,
+                                    collective_counts, hlo_check)
+
+
+@hlo_check(
+    "entry-collective",
+    "a communication collective in the ENTRY computation of a windowed "
+    "program — it runs at the host dispatch boundary instead of "
+    "overlapping with compute inside the scan")
+def entry_collective(spec: ProgramSpec):
+    if not spec.window or spec.module is None:
+        return
+    counts = collective_counts(spec.module)
+    for op in COMMUNICATION_OPS:
+        n = counts[op]["entry"]
+        if n:
+            yield ("error",
+                   f"{n} `{op}` op{'s' if n != 1 else ''} in the ENTRY "
+                   "computation of a steps_per_sync window program; "
+                   "collectives must live inside the scan body where "
+                   "XLA overlaps them with the neighbouring steps' "
+                   "compute (docs/performance.md, the PR 8 contract)")
+
+
+@hlo_check(
+    "scan-dispatch-ratio",
+    "a window program whose per-dispatch collective count grows with "
+    "K — the window unrolled (or its gathers un-hoisted from the scan)")
+def scan_dispatch_ratio(spec: ProgramSpec):
+    if not spec.window or spec.module is None or spec.companion is None:
+        return
+    if spec.companion.module is None:
+        return
+    k_hi = max(spec.scan_length, 1)
+    k_lo = max(spec.companion.scan_length, 1)
+    if k_hi <= k_lo:
+        return
+    def total(module):
+        counts = collective_counts(module)
+        return sum(counts[op]["total"] for op in COMMUNICATION_OPS)
+    hi, lo = total(spec.module), total(spec.companion.module)
+    # a lax.scan body appears ONCE in the program text whatever its trip
+    # count, so the instruction count must not scale with K; growth
+    # means the K steps were unrolled (or per-step gathers escaped the
+    # scan into K copies) and every dispatch pays them serially
+    if lo >= 0 and hi > lo:
+        yield ("error",
+               f"per-dispatch collective op count grew with K: "
+               f"{lo} ops at K={k_lo} vs {hi} at K={k_hi}; a scanned "
+               "window embeds its per-step collectives ONCE (the scan "
+               "body) — this program unrolls them per step, so each "
+               "dispatch serializes K rounds of communication")

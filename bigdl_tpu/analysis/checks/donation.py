@@ -1,0 +1,32 @@
+"""donation-dropped: every donated input must be aliased (or declared
+donatable) in the compiled program, or the donation silently buys
+nothing and the program holds 2x the memory the caller planned for."""
+from __future__ import annotations
+
+from bigdl_tpu.analysis.hlo import ProgramSpec, hlo_check
+
+
+@hlo_check(
+    "donation-dropped",
+    "an input declared in donate_argnums has no entry in the compiled "
+    "program's input/output aliasing table — silent 2x memory")
+def donation_dropped(spec: ProgramSpec):
+    if spec.donated < 0 or spec.module is None:
+        return  # no donation contract declared for this program
+    honored = len(spec.module.donated_params)
+    if honored >= spec.donated:
+        return
+    n_params = len(spec.module.entry_params())
+    detail = ""
+    if honored and spec.module.aliased_params:
+        missing = sorted(
+            set(range(spec.donated)) - spec.module.donated_params)
+        if missing:
+            detail = f" (parameter indices {missing[:8]} unaliased)"
+    yield ("error",
+           f"{spec.donated} leaves declared donated but only {honored} "
+           f"aliased/donatable in the compiled program "
+           f"({n_params} entry parameters){detail}; the un-aliased "
+           "donations hold BOTH the old and new buffer live — donate "
+           "only inputs an output can reuse (same shape/dtype), or "
+           "drop them from donate_argnums")

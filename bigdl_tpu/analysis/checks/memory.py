@@ -1,0 +1,32 @@
+"""hbm-over-budget: static HBM infeasibility from ``memory_analysis``
+— arguments + outputs + temps against a per-device budget, no
+compilation beyond the one already paid and no execution ever.
+
+The check is a thin wrapper over :func:`bigdl_tpu.analysis.hlo.
+hbm_fit`, which is deliberately a standalone API: the profile-guided
+autotuner (ROADMAP item 4) calls it per candidate configuration to
+prune HBM-infeasible points before measuring anything."""
+from __future__ import annotations
+
+from bigdl_tpu.analysis.hlo import ProgramSpec, hbm_fit, hlo_check
+
+
+@hlo_check(
+    "hbm-over-budget",
+    "memory_analysis arguments+outputs+temps exceed the per-device HBM "
+    "budget — the program cannot fit, statically, before any execution")
+def hbm_over_budget(spec: ProgramSpec):
+    if spec.memory is None or spec.hbm_budget is None:
+        return
+    fit = hbm_fit(spec.memory, spec.hbm_budget)
+    if fit["fits"]:
+        return
+    b = fit["breakdown"]
+    yield ("error",
+           f"program pins {fit['total_bytes']:,} bytes "
+           f"(args {int(b['arg_bytes']):,} + outputs "
+           f"{int(b['out_bytes']):,} + temps {int(b['temp_bytes']):,}) "
+           f"against a {spec.hbm_budget:,}-byte per-device budget; "
+           "shrink the batch/window, raise the ZeRO stage, or lower "
+           "the precision policy (tools/autotune prunes such configs "
+           "with this same analysis)")

@@ -1,0 +1,134 @@
+"""precision-leak: f32 compute escaping the sanctioned islands of a
+bf16/f16-policy program.
+
+The policy's contract (docs/precision.md): forward/backward run in
+``compute_dtype``; only the norm-stat / softmax / loss islands (and
+the master-copy update, which contains no matmuls) hold f32. Backends
+legalize dtypes during compilation (XLA CPU rewrites every bf16 dot to
+f32), so this check reads the **lowered** HLO — the policy's intent —
+and uses the parser's def-use edges to resolve the operand dtypes that
+lowered text leaves implicit.
+
+A ``dot``/``convolution`` with a large f32 operand is the leak
+signature *candidate* — but two legitimate patterns look the same at
+one-op distance, so the check classifies each wide operand's
+contiguous f32 def region (the walk follows def-use edges while
+results stay f32/f64 and stops at ``convert`` ops, the casts that
+delimit every island):
+
+- the region contains a transcendental (``exponential``/``log``/
+  ``rsqrt``/...) — it *is* a sanctioned island or its gradient flow
+  (the attention backward multiplies f32 softmax cotangents into
+  dQ/dK); sanctioned.
+- the region is a bare up-convert reached through shape-only ops —
+  the ``preferred_element_type`` accumulation boundary (bf16 operands
+  up-cast at the MXU's own f32-accumulate edge, including the
+  transposed weight-gradient dots every Linear emits); sanctioned.
+- the region performs **f32 arithmetic with no island evidence** — a
+  cast escaped and real compute now runs wide; flagged.
+"""
+from __future__ import annotations
+
+from bigdl_tpu.analysis.hlo import (HloComputation, HloModule, HloOp,
+                                    ProgramSpec, hlo_check)
+
+_LOW_PRECISION = {"bf16", "f16"}
+_WIDE = {"f32", "f64"}
+
+#: transcendental opcodes that mark a sanctioned f32 island — softmax
+#: (exp), log-softmax / NLL loss (log), norm statistics (rsqrt/sqrt),
+#: saturating activations computed wide (tanh/logistic/erf)
+_ISLAND_OPS = {
+    "exponential", "exponential-minus-one", "log", "log-plus-one",
+    "rsqrt", "sqrt", "cbrt", "power", "tanh", "logistic", "erf",
+    "erf-inv", "atan2",
+}
+
+#: data-movement opcodes: allowed between the up-convert and the dot
+#: without making the region "compute" (the accumulation pattern moves
+#: casts through transposes/reshapes)
+_SHAPE_OPS = {
+    "transpose", "reshape", "broadcast", "copy", "bitcast", "slice",
+    "get-tuple-element", "tuple", "concatenate", "reverse", "pad",
+    "parameter", "constant", "iota", "convert",
+}
+
+
+def _region_verdict(module: HloModule, comp: HloComputation,
+                    start: HloOp, limit: int = 4096) -> bool:
+    """True when ``start``'s f32 region is sanctioned: island evidence
+    found, or no real arithmetic at all (a bare accumulation-boundary
+    up-cast). Gives up sanctioning-side past ``limit`` visited ops —
+    a silent false positive on a monster program would be worse than
+    a miss."""
+    stack = [start]
+    seen = set()
+    compute_seen = False
+    while stack:
+        op = stack.pop()
+        if op.name in seen:
+            continue
+        seen.add(op.name)
+        if len(seen) > limit:
+            return True
+        if op.opcode in _ISLAND_OPS:
+            return True
+        for cname in op.called.values():
+            sub = module.computations.get(cname)
+            if sub is not None and any(o.opcode in _ISLAND_OPS
+                                       for o in sub.ops):
+                return True
+        if op.opcode not in _SHAPE_OPS:
+            compute_seen = True  # real f32 arithmetic in the region
+        if op.opcode == "convert":
+            continue  # island boundary: the cast ends the f32 region
+        for nm in op.operands:
+            nxt = comp.by_name.get(nm)
+            if nxt is not None and nxt.dtype in _WIDE:
+                stack.append(nxt)
+    return not compute_seen
+
+
+@hlo_check(
+    "precision-leak",
+    "f32 compute on large tensors inside a bf16/f16-policy program, "
+    "outside the sanctioned norm/softmax/loss islands")
+def precision_leak(spec: ProgramSpec):
+    if spec.compute_dtype not in _LOW_PRECISION:
+        return  # f32 policy (or unknown): nothing to leak
+    module = spec.lowered if spec.lowered is not None else spec.module
+    if module is None:
+        return
+    for comp, op in module.find_ops():
+        if op.opcode in ("dot", "convolution"):
+            resolved = [comp.by_name.get(nm) for nm in op.operands]
+            wide = [src for src in resolved
+                    if src is not None and src.dtype in _WIDE]
+            big = [src for src in wide
+                   if src.result_elements() >= spec.dot_elems]
+            if not big:
+                continue
+            bad = [src for src in big
+                   if not _region_verdict(module, comp, src)]
+            if not bad:
+                continue  # island gradient flow / accumulation casts
+            src = bad[0]
+            yield ("error",
+                   f"{op.opcode} `{op.name}` consumes a "
+                   f"{src.dtype}{list(src.dims)} operand "
+                   f"(`{src.name}`) computed by f32 arithmetic under "
+                   f"the {spec.policy or spec.compute_dtype} policy; "
+                   f"matmuls must run on {spec.compute_dtype} operands "
+                   "(f32 belongs only to the norm/softmax/loss "
+                   "islands and the master update) — drop the stray "
+                   "astype/upcast or route accumulation through "
+                   "preferred_element_type")
+        elif op.opcode == "convert" and op.dtype in _WIDE:
+            size = op.result_bytes()
+            if size >= spec.convert_bytes:
+                yield ("warning",
+                       f"convert `{op.name}` materializes "
+                       f"{op.dtype}{list(op.dims)} ({size:,} bytes) "
+                       f"under the {spec.policy or spec.compute_dtype} "
+                       "policy — larger than any sanctioned island; "
+                       "check for an activation-sized upcast")

@@ -1,0 +1,43 @@
+"""replicated-large-operand: under an active ZeRO stage >= 2 config on
+a multi-device mesh, the state the policy promised to shard must not
+arrive replicated — a large replicated operand silently costs n x its
+sharded footprint on every chip."""
+from __future__ import annotations
+
+from bigdl_tpu.analysis.hlo import ProgramSpec, hlo_check
+
+
+def _shardable(dims, ndev: int) -> bool:
+    """Mirror of ``parallel.zero.extend_spec``'s eligibility: some dim
+    divides the data axis, so the leaf COULD have been sharded."""
+    return any(d > 0 and d % ndev == 0 for d in dims)
+
+
+@hlo_check(
+    "replicated-large-operand",
+    "a large parameter the ZeRO (stage >= 2) policy should shard is "
+    "replicated on a multi-device mesh — n x the planned memory")
+def replicated_large_operand(spec: ProgramSpec):
+    if spec.zero_stage < 2 or spec.ndev <= 1 or not spec.sharded_params:
+        return
+    # shardings live on the PRE-partitioning parameters: compiled SPMD
+    # text already splits shapes per device and drops the annotations
+    module = spec.lowered if spec.lowered is not None else spec.module
+    if module is None:
+        return
+    params = {p.parameter_index: p for p in module.entry_params()}
+    for idx in spec.sharded_params:
+        op = params.get(idx)
+        if op is None:
+            continue
+        size = op.result_bytes()
+        if size < spec.large_bytes or not op.replicated \
+                or not _shardable(op.dims, spec.ndev):
+            continue
+        yield ("error",
+               f"parameter {idx} ({op.dtype}{list(op.dims)}, "
+               f"{size:,} bytes) is replicated across the "
+               f"{spec.ndev}-device mesh under ZeRO stage "
+               f"{spec.zero_stage}; shard it with "
+               "parallel.zero.shard_zero_tree / constrain_zero (or it "
+               f"costs {spec.ndev}x its sharded footprint per chip)")

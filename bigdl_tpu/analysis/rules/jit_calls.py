@@ -215,3 +215,101 @@ def jit_static_args(ctx: FileContext):
                     f"unhashable literal at static position {i} of "
                     f"jitted `{node.func.id}`; static arguments must "
                     "be hashable (use a tuple)")
+
+
+# --------------------------------------------------------- use after donate
+
+def _donate_bindings(ctx: FileContext):
+    """``name -> set of donated positional indices`` for bindings of
+    the form ``f = jax.jit(g, donate_argnums=...)`` (literal ints)."""
+    out = {}
+    for node in ctx.walk(ast.Call):
+        call = _jit_call(ctx, node)
+        if call is None:
+            continue
+        donated: Set[int] = set()
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                donated.update(_literal_ints(kw.value) or [])
+        if not donated:
+            continue
+        parent = ctx.parent(node)
+        if isinstance(parent, ast.Assign) and len(parent.targets) == 1 \
+                and isinstance(parent.targets[0], ast.Name):
+            out[parent.targets[0].id] = donated
+    return out
+
+
+def _store_lines(fn: ast.AST, name: str) -> List[int]:
+    """Line numbers where ``name`` is (re)bound inside ``fn``."""
+    lines = []
+    for node in ast.walk(fn):
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        elif isinstance(node, ast.For):
+            targets = [node.target]
+        elif isinstance(node, ast.withitem) and node.optional_vars:
+            targets = [node.optional_vars]
+        for t in targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name) and n.id == name:
+                    lines.append(n.lineno)
+    return lines
+
+
+@rule("use-after-donate",
+      "a buffer donated to a jitted call is read afterwards — its "
+      "memory now belongs to the program's outputs")
+def use_after_donate(ctx: FileContext):
+    donate_bindings = _donate_bindings(ctx)
+    if not donate_bindings:
+        return
+    for call in ctx.walk(ast.Call):
+        if not isinstance(call.func, ast.Name):
+            continue
+        donated = donate_bindings.get(call.func.id)
+        if not donated:
+            continue
+        fn = ctx.enclosing(call, ast.FunctionDef, ast.AsyncFunctionDef)
+        if fn is None:
+            continue
+        # names the call's own statement rebinds (p, o, m, loss =
+        # step(p, o, m, ...)) are exonerated — the optimizer's pattern
+        stmt = ctx.parent(call)
+        rebound: Set[str] = set()
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        rebound.add(n.id)
+        for i in sorted(donated):
+            if i >= len(call.args) \
+                    or not isinstance(call.args[i], ast.Name):
+                continue
+            var = call.args[i].id
+            if var in rebound:
+                continue
+            stores = _store_lines(fn, var)
+            # "after the call" means past its LAST line — a wrapped
+            # call's own continuation-line arguments are not reads
+            call_end = getattr(call, "end_lineno", None) or call.lineno
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Name) and node.id == var
+                        and isinstance(node.ctx, ast.Load)
+                        and node.lineno > call_end):
+                    continue
+                # an intervening rebind (to the call result or a fresh
+                # value) makes the later read fine
+                if any(call_end < s <= node.lineno for s in stores):
+                    continue
+                yield node, (
+                    f"`{var}` was donated (donate_argnums position "
+                    f"{i}) to jitted `{call.func.id}` on line "
+                    f"{call.lineno} and is read here; a donated "
+                    "buffer is invalidated by the call — rebind the "
+                    "name to the call's result (as the Optimizer "
+                    "does) or drop it from donate_argnums")
+                break  # one finding per donated name per call

@@ -70,6 +70,55 @@ class DecodeEngine:
             self._keys.setdefault(servable.key, set()).add(key)
         return prog
 
+    @staticmethod
+    def _prefill_jit(model, on_trace):
+        """The raw prefill jit (donated cache) — shared by the cached
+        :meth:`prefill_program` and the :meth:`abstract_programs`
+        verification hook, so both see the identical program."""
+        import jax
+        import jax.numpy as jnp
+
+        def fn(params, state, k, v, tokens, prompt_lens, slot_ids):
+            on_trace()
+            bp, sb = tokens.shape
+            layers, _, heads, _, hd = k.shape
+            zero_rows = jnp.zeros((layers, bp, heads, sb, hd),
+                                  k.dtype)
+            # the prompt's cache rows start empty — attention here
+            # is causal among the prompt tokens themselves
+            logits, _, rows = model.apply(
+                params, state, tokens, training=False,
+                cache={"k": zero_rows, "v": zero_rows},
+                positions=jnp.zeros((bp,), jnp.int32),
+                attend_len=sb)
+            last = jnp.take_along_axis(
+                logits, (prompt_lens.astype(jnp.int32) - 1)
+                [:, None, None], axis=1)[:, 0, :]
+            ids = slot_ids.astype(jnp.int32)
+            k = k.at[:, ids, :, :sb, :].set(rows["k"], mode="drop")
+            v = v.at[:, ids, :, :sb, :].set(rows["v"], mode="drop")
+            return last, k, v
+
+        return jax.jit(fn, donate_argnums=(2, 3))
+
+    @staticmethod
+    def _decode_jit(model, attend_len: int, on_trace):
+        """The raw decode-step jit for length bucket ``attend_len``
+        (donated cache) — shared like :meth:`_prefill_jit`."""
+        import jax
+        import jax.numpy as jnp
+
+        def fn(params, state, k, v, tokens, positions, active):
+            on_trace()
+            pos = jnp.where(active, positions.astype(jnp.int32), 0)
+            logits, _, cache = model.apply(
+                params, state, tokens[:, None], training=False,
+                cache={"k": k, "v": v}, positions=pos,
+                attend_len=attend_len)
+            return logits[:, 0, :], cache["k"], cache["v"]
+
+        return jax.jit(fn, donate_argnums=(2, 3))
+
     def prefill_program(self, servable, bucket: int):
         """The compiled prefill for prompt bucket ``bucket``:
         ``(params, state, k, v, tokens[Bp,S_b], prompt_lens[Bp],
@@ -77,36 +126,10 @@ class DecodeEngine:
         donated. Padding rows carry ``slot_ids == slots`` (out of
         bounds): their K/V scatter is dropped and their logits row is
         garbage the driver never reads."""
-        import jax
-        import jax.numpy as jnp
-
         model = servable.model
-
-        def build(on_trace):
-            def fn(params, state, k, v, tokens, prompt_lens, slot_ids):
-                on_trace()
-                bp, sb = tokens.shape
-                layers, _, heads, _, hd = k.shape
-                zero_rows = jnp.zeros((layers, bp, heads, sb, hd),
-                                      k.dtype)
-                # the prompt's cache rows start empty — attention here
-                # is causal among the prompt tokens themselves
-                logits, _, rows = model.apply(
-                    params, state, tokens, training=False,
-                    cache={"k": zero_rows, "v": zero_rows},
-                    positions=jnp.zeros((bp,), jnp.int32),
-                    attend_len=sb)
-                last = jnp.take_along_axis(
-                    logits, (prompt_lens.astype(jnp.int32) - 1)
-                    [:, None, None], axis=1)[:, 0, :]
-                ids = slot_ids.astype(jnp.int32)
-                k = k.at[:, ids, :, :sb, :].set(rows["k"], mode="drop")
-                v = v.at[:, ids, :, :sb, :].set(rows["v"], mode="drop")
-                return last, k, v
-
-            return jax.jit(fn, donate_argnums=(2, 3))
-
-        return self._program(servable, "prefill", bucket, build)
+        return self._program(
+            servable, "prefill", bucket,
+            lambda on_trace: self._prefill_jit(model, on_trace))
 
     def decode_program(self, servable, attend_len: int):
         """The compiled decode step for length bucket ``attend_len``:
@@ -117,24 +140,47 @@ class DecodeEngine:
         length-masked causal mask; inactive slots write into their own
         (free) row at position 0, which the slot's next prefill
         re-writes before anything can attend it."""
-        import jax
-        import jax.numpy as jnp
-
         model = servable.model
+        return self._program(
+            servable, "decode", attend_len,
+            lambda on_trace: self._decode_jit(model, attend_len,
+                                              on_trace))
 
-        def build(on_trace):
-            def fn(params, state, k, v, tokens, positions, active):
-                on_trace()
-                pos = jnp.where(active, positions.astype(jnp.int32), 0)
-                logits, _, cache = model.apply(
-                    params, state, tokens[:, None], training=False,
-                    cache={"k": k, "v": v}, positions=pos,
-                    attend_len=attend_len)
-                return logits[:, 0, :], cache["k"], cache["v"]
+    def abstract_programs(self, model, params, state,
+                          kv_dtype=None):
+        """Program-enumeration hook for the static verifier
+        (``bigdl_tpu.analysis.programs``): the prefill/decode jit pair
+        for the TOP ladder rung as ``(name, jitted, abstract_args)``
+        triples, built OUTSIDE the compile cache — no counters, no
+        cache mutation, nothing executed. ``params``/``state`` may be
+        ``jax.ShapeDtypeStruct`` trees; ``jitted.lower(*abstract_args)
+        .compile()`` yields exactly the programs :meth:`prefill` /
+        :meth:`decode` would run, donated cache included."""
+        import jax
 
-            return jax.jit(fn, donate_argnums=(2, 3))
+        import numpy as np
 
-        return self._program(servable, "decode", attend_len, build)
+        from bigdl_tpu.generation.kv_cache import KVCache
+
+        bucket = max(self.ladder)
+        k_spec, v_spec = KVCache.spec_for_model(
+            model, self.slots, bucket, kv_dtype)
+
+        def sds(shape, dtype):
+            return jax.ShapeDtypeStruct(tuple(shape), np.dtype(dtype))
+
+        noop = lambda: None  # noqa: E731  on_trace hook, nothing to count
+        return [
+            (f"prefill/{bucket}", self._prefill_jit(model, noop),
+             (params, state, k_spec, v_spec,
+              sds((self.prefill_rows, bucket), np.int32),
+              sds((self.prefill_rows,), np.int32),
+              sds((self.prefill_rows,), np.int32))),
+            (f"decode/{bucket}", self._decode_jit(model, bucket, noop),
+             (params, state, k_spec, v_spec,
+              sds((self.slots,), np.int32), sds((self.slots,), np.int32),
+              sds((self.slots,), bool))),
+        ]
 
     # ------------------------------------------------------ execution
     def prefill(self, servable, kv: KVCache, prompts: Sequence[np.ndarray],

@@ -98,12 +98,13 @@ class KVCache:
         self.allocator = SlotAllocator(slots)
 
     @classmethod
-    def for_model(cls, model, slots: int, max_len: int,
-                  dtype=None) -> "KVCache":
-        """Size a cache from a decoder model's declared geometry
-        (``num_layers``/``num_heads``/``head_dim`` or
-        ``hidden_size``), e.g. a
-        :class:`~bigdl_tpu.models.transformer.TransformerLM`."""
+    def _model_geometry(cls, model, slots: int, max_len: int) -> tuple:
+        """The ``(layers, slots, heads, max_len, head_dim)`` buffer
+        shape a decoder model's declared geometry (``num_layers``/
+        ``num_heads``/``head_dim`` or ``hidden_size``) implies — ONE
+        derivation (and positional-table bound) shared by
+        :meth:`for_model` and :meth:`spec_for_model`, so the verified
+        program shapes can never drift from the allocated ones."""
         layers = int(model.num_layers)
         heads = int(model.num_heads)
         head_dim = int(getattr(model, "head_dim",
@@ -112,7 +113,31 @@ class KVCache:
             raise ValueError(
                 f"cache max_len={max_len} exceeds the model's positional "
                 f"table ({model.max_len})")
-        return cls(layers, slots, heads, max_len, head_dim, dtype)
+        return (layers, slots, heads, max_len, head_dim)
+
+    @classmethod
+    def for_model(cls, model, slots: int, max_len: int,
+                  dtype=None) -> "KVCache":
+        """Size a cache from a decoder model's declared geometry,
+        e.g. a :class:`~bigdl_tpu.models.transformer.TransformerLM`."""
+        return cls(*cls._model_geometry(model, slots, max_len), dtype)
+
+    @classmethod
+    def spec_for_model(cls, model, slots: int, max_len: int,
+                       dtype=None):
+        """The ``(k, v)`` buffer shapes :meth:`for_model` would
+        allocate (same derivation, same validation), as
+        ``jax.ShapeDtypeStruct`` — nothing touches a device. The
+        static program verifier lowers the engine's prefill/decode
+        jits over these instead of a live cache."""
+        import jax
+
+        from bigdl_tpu.utils.engine import Engine
+
+        shape = cls._model_geometry(model, slots, max_len)
+        dt = dtype if dtype is not None else Engine.default_dtype()
+        return (jax.ShapeDtypeStruct(shape, dt),
+                jax.ShapeDtypeStruct(shape, dt))
 
     def occupancy(self) -> float:
         """Live-slot fraction (the ``cache_occupancy`` gauge)."""

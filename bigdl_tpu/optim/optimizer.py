@@ -463,6 +463,33 @@ def build_eval_step(module: Module, out_sharding=None, precision=None):
         items_for=lambda args, kwargs: _batch_rows(args[2]))
 
 
+def make_host_window(step):
+    """The K-step fused host-feed window over ``step`` — ONE
+    ``lax.scan`` dispatch per window, exactly the program
+    ``set_steps_per_sync`` compiles: ``(params, opt_state, model_state,
+    keys[K,...], lrs[K], xs[K,B,...], ys[K,B,...]) -> (params,
+    opt_state, model_state, losses[K])`` with the carry donated.
+
+    Factored out of the driver loop so the static program verifier
+    (``analysis.programs``) lowers the very artifact the Optimizer
+    dispatches — the windowed-HLO contracts (zero entry collectives,
+    donation aliased through the scan carry) are checked on the real
+    program, not a test replica."""
+    def _window_host(p, o, m, keys, lrs, xs, ys):
+        # scan over the [K, B, ...] stacked device buffer
+        # (dataset.prefetch.stack_windows layout)
+        def body(carry, sl):
+            p, o, m = carry
+            key, lr, x, yb = sl
+            p, o, m, loss = step(p, o, m, key, lr, x, yb)
+            return (p, o, m), loss
+        (p, o, m), losses = jax.lax.scan(
+            body, (p, o, m), (keys, lrs, xs, ys))
+        return p, o, m, losses
+
+    return jax.jit(_window_host, donate_argnums=(0, 1, 2))
+
+
 class Optimizer:
     """Driver loop + fluent config surface (optim/Optimizer.scala:42).
 
@@ -1620,18 +1647,6 @@ class Optimizer:
                 scan_length_for=lambda a, kw: int(a[3].shape[0]),
                 items_for=lambda a, kw: int(a[3].shape[0]) * plan_bsz)
         elif k_cap > 1:
-            def _window_host(p, o, m, keys, lrs, xs, ys):
-                # scan over the [K, B, ...] stacked device buffer
-                # (dataset.prefetch.stack_windows layout)
-                def body(carry, sl):
-                    p, o, m = carry
-                    key, lr, x, yb = sl
-                    p, o, m, loss = step(p, o, m, key, lr, x, yb)
-                    return (p, o, m), loss
-                (p, o, m), losses = jax.lax.scan(
-                    body, (p, o, m), (keys, lrs, xs, ys))
-                return p, o, m, losses
-
             def _host_window_items(a, kw):
                 # xs is the [K, B, ...] stacked window: K*B records
                 leaf = jax.tree_util.tree_leaves(a[5])[0]
@@ -1639,7 +1654,7 @@ class Optimizer:
 
             host_window_fn = telemetry.programs.maybe_wrap_jitted(
                 train_program_name(model, "window"), "train",
-                jax.jit(_window_host, donate_argnums=(0, 1, 2)),
+                make_host_window(step),
                 donation="params,opt_state,model_state",
                 scan_length_for=lambda a, kw: int(a[3].shape[0]),
                 items_for=_host_window_items)

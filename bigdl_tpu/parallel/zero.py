@@ -37,7 +37,6 @@ so stage-0 vs stage-1/2/3 differ only by collective reduction order
 from __future__ import annotations
 
 import dataclasses
-import re
 from typing import Dict, Optional
 
 import jax
@@ -276,11 +275,11 @@ def record_memory_gauges(params, opt_state) -> Dict[str, int]:
     return {"params_bytes_per_chip": pb, "opt_state_bytes_per_chip": ob}
 
 
-# HLO instruction form: "%name = TYPE op(operands)"; -start covers the
-# async variants real TPU schedules emit (their TYPE is a tuple with
-# spaces — "(f32[2,4]{1,0}, f32[16,4]{1,0})" — so the type is matched
-# lazily, not as one token). -done twins never match (the char after
-# the op name is "-", not "("), so each async pair counts once.
+# Deprecated shims: the HLO regex parsing that used to live here is now
+# the structural parser in ``bigdl_tpu.analysis.hlo`` (one parser for
+# these counters, the windowed-contract test assertions AND the
+# `check --programs` verifier — including the tuple-typed async -start
+# collective forms real TPU schedules emit). Imported names stay valid.
 _COLLECTIVES = ("all-gather", "reduce-scatter", "all-reduce",
                 "collective-permute", "all-to-all", "dynamic-slice")
 
@@ -296,35 +295,26 @@ def collective_counts(hlo_text: str) -> Dict[str, Dict[str, int]]:
     boundary. ``dynamic-slice`` (not itself a collective — it also
     serves ordinary indexing) is counted because XLA CPU lowers
     reduce-scatter to all-reduce + dynamic-slice — on that backend the
-    scatter evidence is the pair, not the fused op."""
-    counts = {op: {"total": 0, "entry": 0} for op in _COLLECTIVES}
-    in_entry = False
-    for line in hlo_text.splitlines():
-        if line.startswith("ENTRY"):
-            in_entry = True
-            continue
-        if in_entry and line.startswith("}"):
-            in_entry = False
-            continue
-        for op in _COLLECTIVES:
-            if re.search(rf"= .+? {op}(?:-start)?\(", line):
-                counts[op]["total"] += 1
-                if in_entry:
-                    counts[op]["entry"] += 1
-    return counts
+    scatter evidence is the pair, not the fused op.
+
+    Deprecated shim: delegates to
+    :func:`bigdl_tpu.analysis.hlo.collective_counts` (the structural
+    parser); new code should call that directly."""
+    from bigdl_tpu.analysis.hlo import collective_counts as _counts
+    return _counts(hlo_text)
 
 
 def window_collectives(compiled) -> Dict[str, Dict[str, int]]:
     """:func:`collective_counts` over a compiled jit program (the
-    object ``jax.jit(f).lower(...).compile()`` returns)."""
+    object ``jax.jit(f).lower(...).compile()`` returns). Deprecated
+    shim over :mod:`bigdl_tpu.analysis.hlo`."""
     return collective_counts(compiled.as_text())
 
 
 def reduce_scatter_evidence(counts: Dict[str, Dict[str, int]]) -> bool:
     """True when the program reduce-scatters gradients: a literal
     ``reduce-scatter`` op (TPU), or the CPU lowering's
-    all-reduce + dynamic-slice pair."""
-    if counts["reduce-scatter"]["total"] > 0:
-        return True
-    return (counts["all-reduce"]["total"] > 0
-            and counts["dynamic-slice"]["total"] > 0)
+    all-reduce + dynamic-slice pair. (Shared implementation:
+    :func:`bigdl_tpu.analysis.hlo.reduce_scatter_evidence`.)"""
+    from bigdl_tpu.analysis.hlo import reduce_scatter_evidence as _ev
+    return _ev(counts)

@@ -198,6 +198,17 @@ class CompileCache:
             # (params, state, x): the padded batch's rows are the items
             profile_items=lambda args, kwargs: args[2].shape[0])
 
+    @staticmethod
+    def abstract_step(model):
+        """Program-enumeration hook for the static verifier: the raw
+        jitted eval step :meth:`step_for` would compile for ``model``
+        — built outside the cache (no counters, nothing cached or
+        executed), ready for ``.lower(params, state, x)`` over
+        ``jax.ShapeDtypeStruct`` trees."""
+        from bigdl_tpu.optim.predictor import make_eval_step
+
+        return make_eval_step(model)
+
     def compile_count(self, key=None) -> int:
         """Compilations so far — for ``key``, or in total when None."""
         with self._lock:

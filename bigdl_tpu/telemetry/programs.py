@@ -39,7 +39,8 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 __all__ = ["ProgramProfile", "ProgramRegistry", "registry", "enable",
-           "disable", "enabled", "analyze_compiled",
+           "disable", "enabled", "enable_checks", "disable_checks",
+           "checks_enabled", "analyze_compiled",
            "resolve_per_item_flops", "mfu_fields", "record_rate",
            "maybe_wrap_jitted", "register_program_instruments",
            "DEVICE_TFS"]
@@ -85,6 +86,35 @@ def disable() -> None:
     readable."""
     global _ENABLED
     _ENABLED = False
+
+
+# like _ENABLED: one flag the compile-site hooks read; on, every
+# program compiled through maybe_wrap_jitted additionally runs the
+# context-light static HLO checks (analysis.programs) and stores the
+# findings on its profile — diagnose shows them per program and
+# flight-recorder bundles ship them in programs.json
+_CHECKS_ENABLED = False
+
+
+def checks_enabled() -> bool:
+    """Whether compile-site static HLO checks are on."""
+    return _CHECKS_ENABLED
+
+
+def enable_checks() -> None:
+    """Run the static program checks (``bigdl_tpu.analysis``) at every
+    profiled compile site; findings land on
+    :attr:`ProgramProfile.checks` (idempotent; implies nothing about
+    :func:`enabled` — profiles must also be on for sites to compile
+    ahead of time)."""
+    global _CHECKS_ENABLED
+    _CHECKS_ENABLED = True
+
+
+def disable_checks() -> None:
+    """Turn compile-site checks off (profiles keep prior verdicts)."""
+    global _CHECKS_ENABLED
+    _CHECKS_ENABLED = False
 
 
 def register_program_instruments(r) -> Dict[str, object]:
@@ -193,7 +223,7 @@ class ProgramProfile:
                  "out_bytes", "temp_bytes", "alias_bytes", "hbm_bytes",
                  "compile_s", "scan_length", "items_per_call",
                  "donation", "kernel", "extra", "rate_items_per_s",
-                 "achieved_tfs", "mfu")
+                 "achieved_tfs", "mfu", "checks")
 
     def __init__(self, name: str, kind: str, analysis: Dict[str, float],
                  compile_s: float, scan_length: int = 1,
@@ -221,6 +251,10 @@ class ProgramProfile:
         self.rate_items_per_s: Optional[float] = None
         self.achieved_tfs: Optional[float] = None
         self.mfu: Optional[float] = None
+        #: static HLO check verdict (None until a verifier ran):
+        #: {"clean": bool, "findings": [finding dicts]} — the payload
+        #: diagnose renders per program and programs.json bundles ship
+        self.checks: Optional[dict] = None
 
     def to_dict(self) -> dict:
         """JSON-ready dump (the ``programs.json`` bundle format and
@@ -335,6 +369,24 @@ class ProgramRegistry:
                 _RATE_GAUGES["mfu"]).set(prof.mfu, **labels)
         return prof
 
+    def attach_checks(self, name: str, findings) -> None:
+        """Record a static-verification verdict on profile ``name``
+        (no-op for unknown names): ``findings`` is a list of finding
+        dicts (``analysis.hlo.ProgramFinding.to_dict``); the verdict
+        counts only unsuppressed ones as dirty. Shared surface:
+        ``tools.diagnose`` prints it next to the MFU/HBM rows and
+        flight-recorder ``programs.json`` bundles carry it into
+        ``--postmortem``."""
+        rows = [f if isinstance(f, dict) else f.to_dict()
+                for f in (findings or [])]
+        verdict = {"clean": not any(not r.get("suppressed")
+                                    for r in rows),
+                   "findings": rows}
+        with self._lock:
+            prof = self._profiles.get(name)
+            if prof is not None:
+                prof.checks = verdict
+
     def get(self, name: str) -> Optional[ProgramProfile]:
         """The profile registered under ``name``, or None."""
         with self._lock:
@@ -423,7 +475,8 @@ class _ProfiledProgram:
         # basis for its kernel= label (a config-based guess would tag
         # kernel-free programs on any kernels-on backend)
         taken_before = taken_in_thread()
-        compiled = self._jitted.lower(*args, **kwargs).compile()
+        lowered = self._jitted.lower(*args, **kwargs)
+        compiled = lowered.compile()
         compile_s = time.perf_counter() - t0
         kernel = "pallas" if taken_in_thread() > taken_before else None
         with self._lock:
@@ -448,6 +501,17 @@ class _ProfiledProgram:
             name, self._kind, compiled=compiled, compile_s=compile_s,
             scan_length=scan_length, items_per_call=items,
             donation=self._donation, kernel=kernel)
+        if _CHECKS_ENABLED:
+            # static verification of the freshly compiled program
+            # (lowering already paid; zero executions) — the verdict
+            # rides the profile into diagnose and flight bundles
+            try:
+                from bigdl_tpu.analysis.programs import \
+                    check_compiled_program
+                self._registry.attach_checks(name, check_compiled_program(
+                    name, lowered, compiled, scan_length=scan_length))
+            except Exception:
+                pass  # verification is observability, never a crash
         return compiled
 
     def __call__(self, *args, **kwargs):
@@ -514,3 +578,6 @@ def maybe_wrap_jitted(name: str, kind: str, jitted, *, donation: str = "",
 
 if os.environ.get("BIGDL_PROGRAM_PROFILES", "").strip() not in ("", "0"):
     enable()
+if os.environ.get("BIGDL_PROGRAM_CHECKS", "").strip() not in ("", "0"):
+    enable()        # checks need the AOT compile the profile hook pays
+    enable_checks()

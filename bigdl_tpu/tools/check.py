@@ -1,23 +1,43 @@
-"""Pre-compile static analysis CLI: lint + zoo shape check.
+"""Static analysis CLI: lint + zoo shape check + telemetry audit +
+compiled-program verification.
 
-    python -m bigdl_tpu.tools.check [paths...]   # both passes
-        --lint-only | --shapes-only              # one pass
-        --rules r1,r2                            # restrict lint rules
-        --list-rules                             # rule catalogue
+    python -m bigdl_tpu.tools.check [paths...]   # the FULL gate
+        --lint-only | --shapes-only              # one source pass
+        --programs                               # HLO program checks only
+        --telemetry-audit                        # instrument-name gate only
+        --rules r1,r2                            # restrict lint rules AND
+                                                 # HLO checks (one namespace;
+                                                 # a full-gate pass with no
+                                                 # named rule of its kind is
+                                                 # skipped)
+        --list-rules                             # unified rule catalogue
         --show-suppressed                        # include muted findings
-        --telemetry-audit                        # instrument-name gate
         --json                                   # machine-readable output
 
 ``paths`` default to the installed ``bigdl_tpu`` package (a bare package
 name resolves to its directory), so ``python -m bigdl_tpu.tools.check
-bigdl_tpu`` is the repository's self-run gate (tests/test_lint_self.py
-enforces it stays clean).
+bigdl_tpu`` is the repository's self-run gate (tests/test_lint_self.py +
+tests/test_check_self.py enforce it stays clean).
+
+With no mode flag the CLI runs **all four passes** — AST lint, the
+whole-zoo symbolic shape pass, the telemetry instrument-name audit and
+the compiled-program verifier — the one-command pre-flight gate.
 
 The shape pass walks every model-zoo family under ``jax.eval_shape``
-with a symbolic batch dimension — zero FLOPs, zero compiles — so the
-whole zoo is structurally verified in seconds.
+with a symbolic batch dimension — zero FLOPs, zero compiles. The
+``--programs`` pass lowers (never executes) the package's
+representative programs — train/eval steps, a K=8 ``steps_per_sync``
+window, a ZeRO-2 step on the CPU mesh, a bf16-policy step and a
+generation prefill/decode pair — and runs the static HLO checks
+(donation aliasing, dispatch-boundary collectives, sharding placement,
+precision islands, HBM budget; see docs/analysis.md
+"Compiled-program checks").
 
-Exit codes: 0 clean, 1 findings, 2 usage/internal error.
+Exit codes (every mode):
+
+    0   clean — no unsuppressed findings / violations
+    1   findings (lint, shape, audit or program checks)
+    2   usage error, unknown rule/check, or internal failure
 """
 from __future__ import annotations
 
@@ -164,6 +184,81 @@ def run_telemetry_audit(as_json: bool) -> int:
     return 1 if violations else 0
 
 
+def run_telemetry_audit_into(payload: dict, as_json: bool) -> int:
+    """The full-gate flavor of the telemetry audit: merge the result
+    into ``payload`` (one JSON document for the whole gate) and print
+    only the summary + violations. Exit semantics match
+    :func:`run_telemetry_audit`."""
+    from bigdl_tpu.telemetry import NAME_RE
+    try:
+        names = collect_instrument_names()
+    except Exception as e:
+        print(f"telemetry audit failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2
+    violations = [n for n in names if not NAME_RE.match(n)]
+    payload["telemetry"] = {"scheme": NAME_RE.pattern,
+                            "instruments": names,
+                            "violations": violations}
+    if not as_json:
+        for n in violations:
+            print(f"instrument FAIL {n}")
+        print(f"telemetry audit: {len(names) - len(violations)}/"
+              f"{len(names)} instrument names match "
+              "family/component/metric")
+    return 1 if violations else 0
+
+
+def run_programs_pass(as_json: bool, checks=None, show_suppressed=False):
+    """--programs: lower (never execute) the representative program
+    suite and run the static HLO checks. Returns ``(rc, payload)`` —
+    rc 0 clean, 1 unsuppressed findings, 2 internal error."""
+    from bigdl_tpu.analysis.hlo import format_findings
+    from bigdl_tpu.analysis.programs import verify_programs
+    try:
+        findings, specs, notes = verify_programs(checks=checks)
+    except KeyError as e:
+        print(f"unknown program check {e}", file=sys.stderr)
+        return 2, {}
+    except Exception as e:  # enumeration broke: internal error
+        print(f"program verification failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 2, {}
+    payload = {
+        "programs": [s.name for s in specs],
+        "notes": notes,
+        "findings": [f.to_dict() for f in findings],
+    }
+    active = [f for f in findings if not f.suppressed]
+    if not as_json:
+        for note in notes:
+            print(f"programs note: {note}")
+        print(format_findings(findings, programs=len(specs),
+                              show_suppressed=show_suppressed))
+    return (1 if active else 0), payload
+
+
+def split_rules(names):
+    """One ``--rules`` namespace over lint rules AND HLO checks:
+    ``(lint_subset, check_subset)`` — each None when no name of that
+    kind was given; unknown names raise SystemExit(2)."""
+    from bigdl_tpu.analysis import available_rules
+    from bigdl_tpu.analysis.hlo import available_checks
+    lint_names = {r.name for r in available_rules()}
+    check_names = {c.name for c in available_checks()}
+    lint_sel, check_sel = [], []
+    for n in names:
+        if n in lint_names:
+            lint_sel.append(n)
+        elif n in check_names:
+            check_sel.append(n)
+        else:
+            print(f"unknown rule {n!r} (see --list-rules)",
+                  file=sys.stderr)
+            raise SystemExit(2)
+    return lint_sel or None, check_sel or None
+
+
 def resolve_paths(paths):
     """File/dir paths; a bare importable package name resolves to its
     source directory."""
@@ -191,8 +286,13 @@ def main(argv=None) -> int:
                          "default: the bigdl_tpu package")
     ap.add_argument("--lint-only", action="store_true")
     ap.add_argument("--shapes-only", action="store_true")
+    ap.add_argument("--programs", action="store_true",
+                    help="run only the compiled-program verifier "
+                         "(lower the representative program suite, "
+                         "run the static HLO checks)")
     ap.add_argument("--rules", default=None,
-                    help="comma-separated rule subset for the lint pass")
+                    help="comma-separated subset of lint rules and/or "
+                         "HLO program checks (one namespace)")
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--show-suppressed", action="store_true")
     ap.add_argument("--telemetry-audit", action="store_true",
@@ -208,23 +308,48 @@ def main(argv=None) -> int:
                                     lint_paths)
 
     if args.list_rules:
+        # ONE unified catalogue: AST lint rules and compiled-program
+        # (HLO) checks share the --rules namespace
+        from bigdl_tpu.analysis.hlo import available_checks
         for r in available_rules():
-            print(f"{r.name:20s} {r.description}")
+            print(f"{r.name:26s} [lint] {r.description}")
+        for c in available_checks():
+            print(f"{c.name:26s} [hlo]  {c.description}")
         return 0
-    if args.lint_only and args.shapes_only:
-        print("--lint-only and --shapes-only are mutually exclusive",
-              file=sys.stderr)
+    if sum((args.lint_only, args.shapes_only, args.programs)) > 1:
+        print("--lint-only, --shapes-only and --programs are mutually "
+              "exclusive", file=sys.stderr)
         return 2
+
+    rule_names = [r.strip() for r in args.rules.split(",") if r.strip()] \
+        if args.rules else []
+    try:
+        lint_rules, hlo_checks = split_rules(rule_names)
+    except SystemExit as e:
+        return int(e.code or 2)
 
     rc = 0
     payload = {}
+    full_gate = not (args.lint_only or args.shapes_only or args.programs)
+    # --rules is ONE namespace: under the full gate, a restriction that
+    # names no rule of a pass's kind SKIPS that pass entirely (asking
+    # for `--rules sync-in-loop` must not still lower + check the whole
+    # program suite, and vice versa); explicit mode flags override
+    skip_lint = full_gate and rule_names and lint_rules is None
+    skip_programs = full_gate and rule_names and hlo_checks is None
 
-    if not args.shapes_only:
+    if args.programs:
+        prc, prog_payload = run_programs_pass(
+            args.json, checks=hlo_checks,
+            show_suppressed=args.show_suppressed)
+        if args.json:
+            print(json.dumps({"programs": prog_payload}, indent=2))
+        return prc
+
+    if not args.shapes_only and not skip_lint:
         paths = resolve_paths(args.paths or ["bigdl_tpu"])
-        rules = [r.strip() for r in args.rules.split(",")] \
-            if args.rules else None
         try:
-            findings = lint_paths(paths, rules=rules)
+            findings = lint_paths(paths, rules=lint_rules)
         except KeyError as e:
             print(f"unknown rule {e}", file=sys.stderr)
             return 2
@@ -236,7 +361,10 @@ def main(argv=None) -> int:
             print(format_text(findings,
                               show_suppressed=args.show_suppressed))
 
-    if not args.lint_only:
+    if not args.lint_only and not (full_gate and rule_names):
+        # a --rules restriction names lint rules / HLO checks only;
+        # the shape pass has no named rules and drops out of a
+        # restricted full-gate run
         failures, rows = run_shape_pass(args.json)
         payload["shapes"] = rows
         if failures:
@@ -244,6 +372,19 @@ def main(argv=None) -> int:
         if not args.json:
             print(f"shape pass: {len(rows) - failures}/{len(rows)} zoo "
                   "models clean")
+
+    if full_gate:
+        # no mode flag = the FULL pre-flight gate: lint + shapes above,
+        # telemetry audit + compiled-program checks here
+        if not rule_names:
+            audit_rc = run_telemetry_audit_into(payload, args.json)
+            rc = max(rc, audit_rc) if audit_rc != 2 else 2
+        if rc != 2 and not skip_programs:
+            prc, prog_payload = run_programs_pass(
+                args.json, checks=hlo_checks,
+                show_suppressed=args.show_suppressed)
+            payload["programs"] = prog_payload
+            rc = max(rc, prc) if prc != 2 else 2
 
     if args.json:
         print(json.dumps(payload, indent=2))

@@ -289,7 +289,7 @@ def device_summary(program_rows: List[dict]) -> List[dict]:
         out.append({k: p.get(k) for k in
                     ("name", "kind", "kernel", "mfu", "achieved_tfs",
                      "flops", "hbm_bytes", "compile_s", "scan_length",
-                     "rate_items_per_s")})
+                     "rate_items_per_s", "checks")})
     return out
 
 
@@ -307,6 +307,24 @@ def _device_lines(rows: List[dict]) -> List[str]:
         if r.get("hbm_bytes"):
             line += f"{int(r['hbm_bytes']):,} HBM bytes, "
         line += f"compiled in {r.get('compile_s') or 0:.3f}s"
+        checks = r.get("checks")
+        if checks is not None:
+            # static HLO check verdict (BIGDL_PROGRAM_CHECKS=1 /
+            # analysis.programs) next to the cost rows
+            active = [f for f in checks.get("findings", [])
+                      if not f.get("suppressed")]
+            if checks.get("clean"):
+                line += ", checks clean"
+            else:
+                # headline the most SEVERE finding, not the first in
+                # (alphabetical) report order
+                worst = min(
+                    active,
+                    key=lambda f: 0 if f.get("severity") == "error"
+                    else 1) if active else {}
+                line += (f", checks: {len(active)} finding"
+                         f"{'s' if len(active) != 1 else ''}"
+                         f" [{worst.get('check', '?')}]")
         out.append(line)
     return out
 

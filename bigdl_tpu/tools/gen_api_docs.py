@@ -40,7 +40,9 @@ FAMILIES = {
                 "bigdl_tpu.kernels.int8_gemm",
                 "bigdl_tpu.kernels.common"],
     "analysis": ["bigdl_tpu.analysis", "bigdl_tpu.analysis.shapecheck",
-                 "bigdl_tpu.analysis.lint"],
+                 "bigdl_tpu.analysis.lint", "bigdl_tpu.analysis.hlo",
+                 "bigdl_tpu.analysis.checks",
+                 "bigdl_tpu.analysis.programs"],
     "telemetry": ["bigdl_tpu.telemetry", "bigdl_tpu.telemetry.tracer",
                   "bigdl_tpu.telemetry.metrics",
                   "bigdl_tpu.telemetry.export",

@@ -134,6 +134,22 @@ def g(x, mode):
 f = jax.jit(g)
 """,
     ),
+    "use-after-donate": (
+        """
+def train(params, grads):
+    step = jax.jit(lambda p, g: p - g, donate_argnums=(0,))
+    new_params = step(params, grads)
+    norm = jnp.sum(params)
+    return new_params, norm
+""",
+        """
+def train(params, grads):
+    step = jax.jit(lambda p, g: p - g, donate_argnums=(0,))
+    new_params = step(params, grads)
+    norm = jnp.sum(params)  # bigdl: disable=use-after-donate
+    return new_params, norm
+""",
+    ),
     "apply-mutates-self": (
         """
 class Layer:
@@ -968,3 +984,57 @@ def outer(xs):
     return lax.scan(body, 0.0, xs)
 """
     assert "telemetry-in-trace" in names(run(body))
+
+
+# ------------------------------------------------------ use-after-donate
+
+def test_use_after_donate_rebind_exonerates():
+    """Rebinding the donated name to the call's result — the
+    Optimizer's own pattern — is the sanctioned shape."""
+    body = """
+def train(p, o, g):
+    step = jax.jit(lambda p, o, g: (p - g, o), donate_argnums=(0, 1))
+    p, o = step(p, o, g)
+    return jnp.sum(p) + jnp.sum(o["v"])
+"""
+    assert "use-after-donate" not in names(run(body))
+
+
+def test_use_after_donate_intervening_store_exonerates():
+    """A fresh assignment between the call and the later read makes
+    the read fine — the name no longer aliases the donated buffer."""
+    body = """
+def train(params, grads):
+    step = jax.jit(lambda p, g: p - g, donate_argnums=(0,))
+    out = step(params, grads)
+    params = out
+    return jnp.sum(params)
+"""
+    assert "use-after-donate" not in names(run(body))
+
+
+def test_use_after_donate_only_donated_positions_flagged():
+    """Reading a NON-donated argument after the call is fine; only the
+    donated positions invalidate their buffers."""
+    body = """
+def train(params, grads):
+    step = jax.jit(lambda p, g: p - g, donate_argnums=(0,))
+    out = step(params, grads)
+    norm = jnp.sum(grads)
+    return out, norm
+"""
+    assert "use-after-donate" not in names(run(body))
+
+
+def test_use_after_donate_multiline_call_args_not_flagged():
+    """A donated call wrapped across lines must not flag its OWN
+    continuation-line arguments as post-call reads (reads past the
+    call's end_lineno only)."""
+    body = """
+def train(params, grads):
+    step = jax.jit(lambda p, g: p - g, donate_argnums=(0,))
+    new = step(
+        params, grads)
+    return new
+"""
+    assert "use-after-donate" not in names(run(body))
