@@ -516,6 +516,15 @@ def main():
     # unless forced.
     if _row_enabled("BENCH_ELASTIC", platform):
         result.update(_bench_elastic())
+    # eleventh tracked row: FLEET — planet-scale generation serving
+    # (bigdl_tpu.fleet): goodput-under-load (tokens/sec at a fixed p99
+    # TTFT budget) for 1 vs N replicas behind the router, prefix-cache
+    # full-hit TTFT p50 vs the cold prefill p50, and speculative
+    # decoding accepted-token rate + tokens/sec on vs off. Skipped on
+    # CPU smoke runs unless forced — per-replica warmup compiles
+    # dominate CI.
+    if _row_enabled("BENCH_FLEET", platform):
+        result.update(_bench_fleet())
     print(json.dumps(result))
     _maybe_metrics_snapshot(result)
 
@@ -608,6 +617,138 @@ def _bench_generation():
                 "token_ms_p50", "token_ms_p99"):
         if key in m:
             row[f"generation_{key}"] = round(float(m[key]), 3)
+    return row
+
+
+def _bench_fleet():
+    """FLEET row: the planet-scale serving numbers (bigdl_tpu.fleet).
+
+    Leg 1 — goodput under load: the same seeded burst through a
+    1-replica and an N-replica router; goodput = tokens/sec times the
+    fraction of ACCEPTED requests meeting the p99 TTFT budget (shed
+    requests failed fast and typed — that is the router working).
+    Leg 2 — prefix/KV reuse: one service with the prefix cache on,
+    the same prompts twice; cold p50 TTFT pays the prefill, hit p50
+    pays one seed-copy + decode step (the acceptance bound: hit p50
+    within 2x the decode-step p50).  Leg 3 — speculative decoding:
+    the same prompts through target-only generation vs the
+    draft-propose/target-verify decoder; accepted-token rate decides
+    whether the draft pays for itself."""
+    import numpy as np
+
+    import bigdl_tpu.telemetry as telemetry
+    from bigdl_tpu.fleet import (FleetRouter, SpeculativeConfig,
+                                 SpeculativeDecoder, build_replicas,
+                                 run_fleet_soak)
+    from bigdl_tpu.generation import GenerationConfig, GenerationService
+    from bigdl_tpu.models import TransformerLM
+    from bigdl_tpu.tools.synthetic import seeded_rng
+    from bigdl_tpu.utils.random import RandomGenerator
+
+    vocab = int(os.environ.get("BENCH_FLEET_VOCAB", 1024))
+    hidden = int(os.environ.get("BENCH_FLEET_HIDDEN", 128))
+    layers = int(os.environ.get("BENCH_FLEET_LAYERS", 2))
+    heads = int(os.environ.get("BENCH_FLEET_HEADS", 4))
+    max_len = int(os.environ.get("BENCH_FLEET_LEN", 64))
+    slots = int(os.environ.get("BENCH_FLEET_SLOTS", 4))
+    n_replicas = int(os.environ.get("BENCH_FLEET_REPLICAS", 2))
+    n_reqs = int(os.environ.get("BENCH_FLEET_REQS", 24))
+    max_new = int(os.environ.get("BENCH_FLEET_NEW", 8))
+    budget_ms = float(os.environ.get("BENCH_FLEET_TTFT_BUDGET_MS",
+                                     2000.0))
+    row = {"fleet_replicas": n_replicas,
+           "fleet_ttft_budget_ms": budget_ms}
+
+    # -- leg 1: goodput under load, 1 vs N replicas -------------------
+    for tag, n in (("1r", 1), ("nr", n_replicas)):
+        router = FleetRouter(build_replicas(
+            n, seed=21, vocab=vocab, hidden=hidden, layers=layers,
+            heads=heads, slots=slots, max_len=max_len, max_queue=8,
+            metrics=telemetry.MetricsRegistry()))
+        rep = run_fleet_soak(router=router, requests=n_reqs,
+                             threads=4, max_new=max_new,
+                             prompt_len=max_len // 4, seed=22,
+                             open_breaker_on=None,
+                             ttft_budget_ms=budget_ms,
+                             token_budget_ms=budget_ms)
+        router.shutdown()
+        row[f"fleet_goodput_tokens_per_sec_{tag}"] = round(
+            rep["tokens_per_sec"]
+            * rep["ttft_within_budget_fraction"], 2)
+        row[f"fleet_ttft_ms_p99_{tag}"] = rep.get("ttft_ms_p99", 0.0)
+    if row["fleet_goodput_tokens_per_sec_1r"]:
+        row["fleet_goodput_scaling"] = round(
+            row["fleet_goodput_tokens_per_sec_nr"]
+            / row["fleet_goodput_tokens_per_sec_1r"], 3)
+
+    # -- leg 2: prefix-cache hit vs cold TTFT -------------------------
+    RandomGenerator.set_seed(23)
+    model = TransformerLM(vocab_size=vocab, hidden_size=hidden,
+                          num_layers=layers, num_heads=heads,
+                          max_len=max_len).evaluate()
+    model.ensure_initialized()
+    svc = GenerationService(config=GenerationConfig(
+        slots=slots, max_len=max_len, prefill_rows=min(2, slots),
+        prefix_cache_bytes=256 << 20))
+    svc.load("lm", model)
+    r = seeded_rng(24)
+    prompts = [r.randint(1, vocab, max_len - max_new - 1)
+               .astype(np.int32) for _ in range(8)]
+    cold_ttft, hit_ttft = [], []
+    for leg in (cold_ttft, hit_ttft):
+        for p in prompts:
+            s = svc.generate("lm", p, max_new_tokens=max_new)
+            s.result(120)
+            leg.append(s.ttft_ms)
+    m = svc.metrics("lm")
+    assert m["prefix_hits"] >= len(prompts), m
+    svc.shutdown()
+    row.update({
+        "fleet_prefix_cold_ttft_ms_p50": round(
+            float(np.median(cold_ttft)), 3),
+        "fleet_prefix_hit_ttft_ms_p50": round(
+            float(np.median(hit_ttft)), 3),
+        "fleet_token_ms_p50": round(float(m["token_ms_p50"]), 3),
+        "fleet_prefix_ttft_speedup": round(
+            float(np.median(cold_ttft) / max(np.median(hit_ttft),
+                                             1e-9)), 2),
+    })
+
+    # -- leg 3: speculative decoding on vs off ------------------------
+    RandomGenerator.set_seed(25)
+    draft = TransformerLM(vocab_size=vocab, hidden_size=hidden // 2,
+                          num_layers=1, num_heads=heads,
+                          max_len=max_len).evaluate()
+    draft.ensure_initialized()
+    spec_prompts = [r.randint(1, vocab, max_len // 4).astype(np.int32)
+                    for _ in range(slots)]
+    spec_new = min(max_new, max_len // 2)
+    svc_off = GenerationService(config=GenerationConfig(
+        slots=slots, max_len=max_len, prefill_rows=min(2, slots)))
+    svc_off.load("lm", model)
+    t0 = time.time()
+    streams = [svc_off.generate("lm", p, max_new_tokens=spec_new)
+               for p in spec_prompts]
+    off_tokens = sum(len(s.result(120)) for s in streams)
+    off_dt = time.time() - t0
+    svc_off.shutdown()
+    dec = SpeculativeDecoder(model, draft, SpeculativeConfig(
+        k=int(os.environ.get("BENCH_FLEET_SPEC_K", 4)), slots=slots,
+        max_len=max_len))
+    # full-depth warmup: compiles every verify/decode rung the timed
+    # run will touch (attend buckets grow with the sequence)
+    dec.generate(spec_prompts, spec_new)
+    t0 = time.time()
+    outs, stats = dec.generate(spec_prompts, spec_new)
+    on_dt = time.time() - t0
+    row.update({
+        "fleet_spec_accept_rate": round(stats["accept_rate"], 4),
+        "fleet_spec_tokens_per_sec_off": round(off_tokens / off_dt, 1),
+        "fleet_spec_tokens_per_sec_on": round(
+            stats["tokens"] / on_dt, 1),
+        "fleet_spec_speedup": round(
+            (stats["tokens"] / on_dt) / (off_tokens / off_dt), 3),
+    })
     return row
 
 

@@ -317,6 +317,177 @@ def raw_pallas_call(ctx: FileContext):
                 "`# bigdl: disable=raw-pallas-call`")
 
 
+#: serving-surface package prefixes: files importing these (or living
+#: under them) hold state at TRAFFIC rate, where a grow-only container
+#: is a memory leak per request
+_SERVING_PACKAGES = ("bigdl_tpu.serving", "bigdl_tpu.generation",
+                     "bigdl_tpu.fleet")
+_SERVING_DIRS = ("bigdl_tpu/serving/", "bigdl_tpu/generation/",
+                 "bigdl_tpu/fleet/")
+
+_GROW_METHODS = frozenset({"append", "appendleft", "add", "setdefault",
+                           "insert", "extend", "update"})
+_SHRINK_METHODS = frozenset({"pop", "popitem", "popleft", "clear",
+                             "remove", "discard"})
+
+
+def _serving_surface(ctx: FileContext) -> bool:
+    norm = ctx.path.replace("\\", "/")
+    if any(d in norm for d in _SERVING_DIRS):
+        return True
+    for node in ctx.walk(ast.Import):
+        if any(a.name.startswith(_SERVING_PACKAGES) for a in node.names):
+            return True
+    for node in ctx.walk(ast.ImportFrom):
+        if node.module and node.module.startswith(_SERVING_PACKAGES):
+            return True
+        if node.module == "bigdl_tpu" and any(
+                f"bigdl_tpu.{a.name}".startswith(_SERVING_PACKAGES)
+                for a in node.names):
+            return True
+    return False
+
+
+def _fresh_container(node: ast.AST) -> bool:
+    """A value that creates an EMPTY growable container: ``{}``,
+    ``[]``, ``set()``, ``dict()``/``list()``/``OrderedDict()``/
+    ``defaultdict(...)`` and maxlen-less ``deque()`` (a
+    ``deque(maxlen=...)`` is bounded by construction and never a
+    candidate)."""
+    if isinstance(node, (ast.Dict, ast.List, ast.Set)):
+        return True
+    if isinstance(node, ast.Call):
+        f = node.func
+        name = f.id if isinstance(f, ast.Name) else (
+            f.attr if isinstance(f, ast.Attribute) else None)
+        if name == "deque":
+            return not any(kw.arg == "maxlen" for kw in node.keywords)
+        return name in ("dict", "list", "set", "OrderedDict",
+                        "defaultdict")
+    return False
+
+
+def _self_attr(node: ast.AST):
+    """``self.X`` -> ``"X"``, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _scan_container_use(nodes, attr_of):
+    """Walk statements classifying container use: returns
+    ``(candidates, grown, shrunk)`` where each maps attr name ->
+    first relevant node. ``attr_of(expr)`` names the tracked
+    container an expression refers to (self-attr or module global)."""
+    candidates, grown, shrunk = {}, {}, set()
+    for node in nodes:
+        for n in ast.walk(node):
+            if isinstance(n, ast.AugAssign):
+                # `self.x += [item]` / `|= {...}` IS growth, never a
+                # rebind-reset
+                name = attr_of(n.target)
+                if name is not None:
+                    grown.setdefault(name, n)
+            elif isinstance(n, (ast.Assign, ast.AnnAssign)):
+                targets = n.targets if isinstance(n, ast.Assign) \
+                    else [n.target]
+                value = n.value
+                for t in targets:
+                    name = attr_of(t)
+                    if name is not None:
+                        if value is not None and _fresh_container(value):
+                            if name not in candidates:
+                                candidates[name] = n
+                            else:
+                                # re-initialized later: a reset IS the
+                                # bound (epoch-style rebuild)
+                                shrunk.add(name)
+                        elif value is not None:
+                            shrunk.add(name)  # rebound to something else
+                        continue
+                    # self.X[key] = ... / X[key] = ... grows the store
+                    if isinstance(t, ast.Subscript):
+                        name = attr_of(t.value)
+                        if name is not None:
+                            grown.setdefault(name, n)
+            elif isinstance(n, ast.Delete):
+                for t in n.targets:
+                    if isinstance(t, ast.Subscript):
+                        name = attr_of(t.value)
+                        if name is not None:
+                            shrunk.add(name)
+            elif isinstance(n, ast.Call) and \
+                    isinstance(n.func, ast.Attribute):
+                name = attr_of(n.func.value)
+                if name is None:
+                    continue
+                meth = n.func.attr
+                if meth in _SHRINK_METHODS or "evict" in meth:
+                    shrunk.add(name)
+                elif meth in _GROW_METHODS:
+                    grown.setdefault(name, n)
+    return candidates, grown, shrunk
+
+
+@rule("unbounded-cache-growth",
+      "serving-surface container attribute that only ever grows")
+def unbounded_cache_growth(ctx: FileContext):
+    """Flags a dict/list/set attribute (``self.X = {}`` in a class, or
+    a module-level ``X = {}``) that the same class/module only ever
+    GROWS (``[key] = ...``, ``.append``, ``.add``, ``.setdefault``,
+    ...) with no shrink site anywhere in that scope (``.pop``,
+    ``del x[...]``, ``.clear``, ``.remove``, an ``*evict*`` method
+    call, a rebind, or ``deque(maxlen=...)``) — in **serving-surface**
+    files (they import or live under ``bigdl_tpu.serving`` /
+    ``generation`` / ``fleet``), where state accumulates at traffic
+    rate and a grow-only container is a memory leak per request. The
+    sanctioned pattern is the fleet prefix cache
+    (``bigdl_tpu/fleet/prefix.py``): capacity-bounded, LRU-evicted,
+    refcount-guarded. A deliberately request-bounded accumulator
+    (e.g. one stream's own token list) carries
+    ``# bigdl: disable=unbounded-cache-growth``."""
+    if not _serving_surface(ctx):
+        return
+
+    def report(candidates, grown, shrunk, where):
+        for name in sorted(set(candidates) & set(grown) - shrunk):
+            yield grown[name], (
+                f"`{name}` in {where} only ever grows — every "
+                "request/entry leaks resident memory at traffic rate; "
+                "bound it (capacity + LRU eviction like the fleet "
+                "prefix cache, `deque(maxlen=...)`, or an explicit "
+                "`pop`/`del`/`clear` lifecycle), or mark a "
+                "request-bounded accumulator with "
+                "`# bigdl: disable=unbounded-cache-growth`")
+
+    for cls in ctx.walk(ast.ClassDef):
+        yield from report(*_scan_container_use(cls.body, _self_attr),
+                          where=f"class {cls.name}")
+    # module-level containers: candidates declared at top level, grown
+    # anywhere in the file outside a class's own scan
+    module_candidates = {}
+    for node in ctx.tree.body:
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            value = node.value
+            for t in targets:
+                if isinstance(t, ast.Name) and value is not None \
+                        and _fresh_container(value):
+                    module_candidates[t.id] = node
+
+    def global_of(expr):
+        if isinstance(expr, ast.Name) and expr.id in module_candidates:
+            return expr.id
+        return None
+
+    if module_candidates:
+        _, grown, shrunk = _scan_container_use(ctx.tree.body, global_of)
+        yield from report(module_candidates, grown, shrunk,
+                          where="module scope")
+
+
 @rule("sync-in-loop",
       "per-iteration host-device sync inside a host step loop")
 def sync_in_loop(ctx: FileContext):

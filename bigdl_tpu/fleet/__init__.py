@@ -1,0 +1,53 @@
+"""Planet-scale generation serving: prefix/KV reuse, speculative
+decoding, and a health-aware replica fleet router.
+
+The :mod:`bigdl_tpu.generation` DecodeEngine serves one replica well;
+this package is the layer that makes it a FLEET (docs/serving.md
+"Fleet"):
+
+- :mod:`~bigdl_tpu.fleet.prefix` — repeated prompts (system prompts,
+  few-shot templates) skip prefill entirely: a reference-counted,
+  capacity-bounded cache of committed KV blocks seeds the slot by
+  device copy, so a full-prefix hit's TTFT approaches one decode step;
+- :mod:`~bigdl_tpu.fleet.speculative` — a small draft model proposes
+  ``k`` tokens, the target adjudicates them in ONE batched forward
+  (one extra program rung: the per-(version, bucket) compile bound
+  grows 2 → 3, asserted); greedy acceptance is bitwise identical to
+  target-only decode, seeded sampling uses standard rejection
+  sampling;
+- :mod:`~bigdl_tpu.fleet.router` / :mod:`~bigdl_tpu.fleet.replica` —
+  N engine replicas (thread- or process-hosted) behind least-loaded
+  sticky placement with per-replica circuit-breaker health, draining
+  rebalance for hot-swap, typed fast-reject when the whole fleet
+  sheds, and re-routing streams when a replica dies mid-flight;
+- :mod:`~bigdl_tpu.fleet.soak` — the sustained heavy-traffic soak
+  asserting p99 TTFT/token latency under QueueFull pressure with a
+  replica's breaker open (also the bench FLEET row's engine).
+"""
+from bigdl_tpu.fleet.prefix import (PrefixCache, PrefixEntry,
+                                    register_prefix_instruments)
+from bigdl_tpu.fleet.replica import ProcessReplica, Replica
+from bigdl_tpu.fleet.router import (MAX_SESSIONS, FleetRouter,
+                                    FleetStream,
+                                    register_router_instruments)
+from bigdl_tpu.fleet.soak import build_replicas, run_fleet_soak
+from bigdl_tpu.fleet.speculative import (SpeculativeConfig,
+                                         SpeculativeDecoder,
+                                         register_speculative_instruments)
+
+__all__ = [
+    "FleetRouter", "FleetStream", "MAX_SESSIONS", "PrefixCache",
+    "PrefixEntry", "ProcessReplica", "Replica", "SpeculativeConfig",
+    "SpeculativeDecoder", "build_replicas", "register_fleet_instruments",
+    "register_prefix_instruments", "register_router_instruments",
+    "register_speculative_instruments", "run_fleet_soak",
+]
+
+
+def register_fleet_instruments(r):
+    """Get-or-create the whole ``fleet/*`` instrument surface in
+    registry ``r`` — one call for ``tools.check --telemetry-audit``."""
+    out = dict(register_prefix_instruments(r))
+    out.update(register_router_instruments(r))
+    out.update(register_speculative_instruments(r))
+    return out

@@ -1,0 +1,202 @@
+"""Sustained heavy-traffic fleet soak: p99 latency as an invariant.
+
+The honest millions-of-users question is not "how fast is one
+request" but "what p99 does the fleet hold while overloaded and
+partially sick". This soak drives a seeded burst through a
+:class:`~bigdl_tpu.fleet.router.FleetRouter` with the admission queue
+deliberately small (so :class:`QueueFull` pressure is REACHED — load
+shedding is part of the system under test, not a failure of it) and,
+optionally, one replica's breaker forced open (a sick replica the
+router must route around). Asserted:
+
+- every accepted stream resolves (tokens or a typed error) within the
+  deadline — zero hangs;
+- p99 TTFT and p99 per-token latency of accepted requests stay under
+  the given budgets (requests the fleet *accepted* must meet the SLO;
+  requests it shed failed fast and typed, which is the design);
+- queue-full pressure was actually observed (no vacuous pass).
+
+Used three ways: the ``tests/test_fleet.py`` smoke, the bench FLEET
+row's goodput legs, and ``tools.chaos --fleet``.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from bigdl_tpu.serving.batcher import QueueFull
+from bigdl_tpu.serving.breaker import Degraded
+from bigdl_tpu.utils.profiling import percentile_summary
+
+
+def build_replicas(n: int, *, seed: int = 42, vocab: int = 32,
+                   hidden: int = 16, layers: int = 1, heads: int = 2,
+                   slots: int = 2, max_len: int = 16,
+                   max_queue: int = 4, metrics=None,
+                   prefix_cache_bytes: int = 0) -> List:
+    """N thread-hosted replicas of ONE seeded tiny TransformerLM
+    (identical weights — greedy outputs are comparable across
+    replicas, which is what lets chaos assert bit-identity after a
+    re-route)."""
+    from bigdl_tpu.fleet.replica import Replica
+    from bigdl_tpu.generation.service import GenerationConfig
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.utils.random import RandomGenerator
+
+    out = []
+    for i in range(n):
+        RandomGenerator.set_seed(seed)  # same weights on every replica
+        model = TransformerLM(vocab_size=vocab, hidden_size=hidden,
+                              num_layers=layers, num_heads=heads,
+                              max_len=max_len).evaluate()
+        model.ensure_initialized()
+        out.append(Replica(
+            f"r{i}", model,
+            config=GenerationConfig(
+                slots=slots, max_len=max_len, length_buckets=(max_len,),
+                prefill_rows=min(2, slots), max_queue=max_queue,
+                prefix_cache_bytes=prefix_cache_bytes),
+            metrics=metrics))
+    return out
+
+
+def run_fleet_soak(*, replicas: int = 2, requests: int = 24,
+                   threads: int = 4, max_new: int = 4,
+                   prompt_len: int = 3, seed: int = 42,
+                   max_queue: int = 4,
+                   open_breaker_on: Optional[str] = "r0",
+                   ttft_budget_ms: float = 5000.0,
+                   token_budget_ms: float = 2000.0,
+                   deadline_s: float = 120.0,
+                   router=None) -> Dict:
+    """Run the soak (module docstring has the invariants); returns a
+    report dict whose ``"passed"`` key is the verdict. Pass a prebuilt
+    ``router`` to soak an existing fleet (the bench goodput legs do);
+    otherwise a seeded tiny fleet is built and torn down here."""
+    from bigdl_tpu.fleet.router import FleetRouter
+    from bigdl_tpu.tools.synthetic import seeded_rng
+
+    own_router = router is None
+    if own_router:
+        router = FleetRouter(build_replicas(
+            replicas, seed=seed, max_queue=max_queue))
+    report: Dict = {"replicas": len(router.replicas()),
+                    "requests": requests, "violations": []}
+    sick = None
+    if open_breaker_on is not None:
+        for rep in router.replicas():
+            if rep.name == open_breaker_on:
+                sick = rep
+                for _ in range(rep.breaker.failures):
+                    rep.breaker.on_failure()
+        report["breaker_open"] = open_breaker_on
+        if sick is not None:
+            assert sick.breaker.state == "open"
+
+    r = seeded_rng(seed + 1)
+    prompts = [r.randint(1, 31, prompt_len).astype(np.int32)
+               for _ in range(requests)]
+    streams: List = []
+    shed = {"queue_full": 0, "degraded": 0}
+    lock = threading.Lock()
+    idx = {"next": 0}
+
+    def pump():
+        while True:
+            with lock:
+                i = idx["next"]
+                if i >= requests:
+                    return
+                idx["next"] += 1
+            while True:
+                try:
+                    s = router.submit(prompts[i],
+                                      session=f"sess-{i % 8}",
+                                      max_new_tokens=max_new)
+                except QueueFull:
+                    with lock:
+                        shed["queue_full"] += 1
+                    time.sleep(0.005)
+                    continue
+                except Degraded:
+                    with lock:
+                        shed["degraded"] += 1
+                    time.sleep(0.01)
+                    continue
+                with lock:
+                    streams.append((time.monotonic(), s))
+                break
+
+    t0 = time.monotonic()
+    workers = [threading.Thread(target=pump, daemon=True,
+                                name=f"fleet-soak-{i}")
+               for i in range(threads)]
+    for w in workers:
+        w.start()
+    for w in workers:
+        w.join(timeout=deadline_s)
+    from concurrent.futures import TimeoutError as FutTimeout
+    resolved = {"ok": 0, "typed_errors": 0, "hung": 0}
+    ttfts, token_ms = [], []
+    end = time.monotonic() + deadline_s
+    for t_submit, s in streams:
+        try:
+            out = s.result(timeout=max(0.0, end - time.monotonic()))
+            resolved["ok"] += 1
+            done = time.monotonic()
+            if s.ttft_ms is not None:
+                ttfts.append(s.ttft_ms)
+                if len(out) > 1:
+                    token_ms.append(
+                        ((done - t_submit) * 1000.0 - s.ttft_ms)
+                        / (len(out) - 1))
+        except (TimeoutError, FutTimeout):
+            # on 3.10 concurrent.futures.TimeoutError is NOT the
+            # builtin — catching only one would count hangs as typed
+            resolved["hung"] += 1
+        except Exception:
+            resolved["typed_errors"] += 1
+    dt = time.monotonic() - t0
+    total_tokens = resolved["ok"] * max_new
+    within = sum(1 for t in ttfts if t <= ttft_budget_ms)
+    report.update({
+        "resolved": resolved, "shed": shed,
+        "wall_s": round(dt, 3),
+        "tokens_per_sec": round(total_tokens / dt, 2) if dt else 0.0,
+        # goodput basis: the fraction of accepted requests that met
+        # the TTFT budget (shed requests failed fast + typed — they
+        # are the fleet working as designed, not SLO misses)
+        "ttft_within_budget_fraction": round(
+            within / len(ttfts), 4) if ttfts else 0.0,
+    })
+    for name, samples in (("ttft_ms", ttfts), ("token_ms", token_ms)):
+        for k, v in percentile_summary(samples, (50, 99)).items():
+            report[f"{name}_{k}"] = round(v, 3)
+
+    if resolved["hung"]:
+        report["violations"].append(
+            f"{resolved['hung']} streams never resolved")
+    if resolved["ok"] == 0:
+        report["violations"].append("no request ever completed")
+    if max_queue <= requests // max(len(router.replicas()), 1) \
+            and not shed["queue_full"] and sick is None:
+        report["violations"].append(
+            "queue-full pressure never observed — the soak ran "
+            "unloaded (raise requests or shrink max_queue)")
+    p99_ttft = report.get("ttft_ms_p99")
+    if p99_ttft is not None and p99_ttft > ttft_budget_ms:
+        report["violations"].append(
+            f"p99 TTFT {p99_ttft:.1f}ms over the {ttft_budget_ms}ms "
+            "budget")
+    p99_tok = report.get("token_ms_p99")
+    if p99_tok is not None and p99_tok > token_budget_ms:
+        report["violations"].append(
+            f"p99 token latency {p99_tok:.1f}ms over the "
+            f"{token_budget_ms}ms budget")
+    if own_router:
+        router.shutdown(drain=True)
+    report["passed"] = not report["violations"]
+    return report

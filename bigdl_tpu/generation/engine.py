@@ -20,6 +20,13 @@ programs** per model version, warmed eagerly as pairs by
 :meth:`DecodeEngine.warmup` and counted — not trusted — through the
 shared :class:`~bigdl_tpu.serving.compile_cache.CompileCache` compile
 counter the serving tests already assert against.
+
+Speculative decoding (``bigdl_tpu.fleet.speculative``) adds one
+**verify** program per rung — ``[slots, w]`` draft tokens through the
+same cached incremental forward, adjudicated host-side — growing the
+documented bound to **at most 3 programs per (version, bucket)**
+(prefill, decode, verify), asserted structurally at registration and
+via the compile counter in tests/test_fleet.py.
 """
 from __future__ import annotations
 
@@ -60,14 +67,25 @@ class DecodeEngine:
         "prefill": lambda args, kwargs: (args[4].shape[0]
                                          * args[4].shape[1]),
         "decode": lambda args, kwargs: args[4].shape[0],
+        "verify": lambda args, kwargs: (args[4].shape[0]
+                                        * args[4].shape[1]),
     }
+
+    #: the full program-kind vocabulary per ladder rung — the
+    #: documented ≤ 3-programs-per-(version, bucket) bound
+    _KINDS = frozenset({"prefill", "decode", "verify"})
 
     def _program(self, servable, kind: str, bucket: int, build):
         key = servable.key + (kind, bucket)
         prog = self.cache.program_for(
             key, build, profile_items=self._PROFILE_ITEMS.get(kind))
         with self._lock:
-            self._keys.setdefault(servable.key, set()).add(key)
+            keys = self._keys.setdefault(servable.key, set())
+            keys.add(key)
+            kinds = {k[-2] for k in keys if k[-1] == bucket}
+            assert kinds <= self._KINDS and len(kinds) <= 3, \
+                (f"program kinds {sorted(kinds)} for bucket {bucket} "
+                 f"break the ≤3-per-(version, bucket) bound")
         return prog
 
     @staticmethod
@@ -119,6 +137,26 @@ class DecodeEngine:
 
         return jax.jit(fn, donate_argnums=(2, 3))
 
+    @staticmethod
+    def _verify_jit(model, attend_len: int, on_trace):
+        """The raw speculative-verify jit for length bucket
+        ``attend_len`` (donated cache) — ``w`` draft tokens per slot
+        through ONE cached incremental forward, shared by
+        :meth:`verify_program` and :meth:`abstract_programs`."""
+        import jax
+        import jax.numpy as jnp
+
+        def fn(params, state, k, v, tokens, positions, active):
+            on_trace()
+            pos = jnp.where(active, positions.astype(jnp.int32), 0)
+            logits, _, cache = model.apply(
+                params, state, tokens, training=False,
+                cache={"k": k, "v": v}, positions=pos,
+                attend_len=attend_len)
+            return logits, cache["k"], cache["v"]
+
+        return jax.jit(fn, donate_argnums=(2, 3))
+
     def prefill_program(self, servable, bucket: int):
         """The compiled prefill for prompt bucket ``bucket``:
         ``(params, state, k, v, tokens[Bp,S_b], prompt_lens[Bp],
@@ -145,6 +183,40 @@ class DecodeEngine:
             servable, "decode", attend_len,
             lambda on_trace: self._decode_jit(model, attend_len,
                                               on_trace))
+
+    def verify_program(self, servable, attend_len: int):
+        """The compiled speculative-verify step for length bucket
+        ``attend_len``: ``(params, state, k, v, tokens[slots, w],
+        positions[slots], active[slots]) -> (logits[slots, w, V], k',
+        v')``, cache donated. Row ``s`` writes K/V for its ``w`` input
+        tokens at ``positions[s] .. positions[s]+w-1`` and
+        ``logits[s, i]`` is the target distribution for the token
+        AFTER input ``i`` — the adjudication rows speculative decoding
+        accepts draft proposals against. One verify program per rung
+        (``w`` is fixed per decoder config), the third and last kind
+        of the ≤ 3-per-(version, bucket) bound."""
+        model = servable.model
+        return self._program(
+            servable, "verify", attend_len,
+            lambda on_trace: self._verify_jit(model, attend_len,
+                                              on_trace))
+
+    def verify(self, servable, kv: KVCache, tokens: np.ndarray,
+               positions: np.ndarray, active: np.ndarray):
+        """Run one speculative-verify step (``tokens`` is
+        ``[slots, w]``); returns the ``[slots, w, V]`` logits as a host
+        ndarray plus the attend bucket. The attend length must cover
+        the deepest write (``positions + w``), so the bucket is taken
+        from the longest live row plus the verify width."""
+        w = int(tokens.shape[1])
+        longest = int(positions[active].max()) + w if active.any() else w
+        attend_len = self.ladder.bucket_for(longest)
+        prog = self.verify_program(servable, attend_len)
+        logits, kv.k, kv.v = prog(
+            servable.params, servable.state, kv.k, kv.v,
+            tokens.astype(np.int32), positions.astype(np.int32),
+            active.astype(bool))
+        return np.asarray(logits), attend_len
 
     def abstract_programs(self, model, params, state,
                           kv_dtype=None):
@@ -179,6 +251,14 @@ class DecodeEngine:
             (f"decode/{bucket}", self._decode_jit(model, bucket, noop),
              (params, state, k_spec, v_spec,
               sds((self.slots,), np.int32), sds((self.slots,), np.int32),
+              sds((self.slots,), bool))),
+            # the speculative-verify rung (fleet.speculative): a
+            # representative draft width of 4 — the verify program's
+            # donation/HBM contract is width-independent
+            (f"verify/{bucket}", self._verify_jit(model, bucket, noop),
+             (params, state, k_spec, v_spec,
+              sds((self.slots, 4), np.int32),
+              sds((self.slots,), np.int32),
               sds((self.slots,), bool))),
         ]
 

@@ -90,7 +90,7 @@ class _Gen:
     """One in-flight generation (driver-private)."""
 
     __slots__ = ("prompt", "stream", "sampler", "max_new", "deadline",
-                 "last", "produced", "slot")
+                 "last", "produced", "slot", "prefix_entry")
 
     def __init__(self, prompt: np.ndarray, stream: TokenStream,
                  sampler: Sampler, max_new: int,
@@ -103,6 +103,9 @@ class _Gen:
         self.last: int = -1       # the newest sampled, not-yet-cached token
         self.produced: int = 0
         self.slot: int = -1
+        #: pinned fleet.PrefixCache entry this gen seeded from (hit
+        #: path); released when the slot frees
+        self.prefix_entry = None
 
 
 class _Group:
@@ -126,11 +129,14 @@ class DecodeLoop:
                  eos_token: Optional[int] = None, max_queue: int = 256,
                  default_max_new: int = 64,
                  timeout_ms: Optional[float] = None, metrics=None,
-                 kv_dtype=None, cache_provider=None):
+                 kv_dtype=None, cache_provider=None, prefix_cache=None):
         self._name = name
         self._registry = registry
         self._engine = engine
         self._max_len = max_len
+        #: optional fleet.PrefixCache: admissions whose full prompt is
+        #: cached seed their slot by device copy and skip prefill
+        self._prefix = prefix_cache
         #: servable -> KVCache for a new group; the service's provider
         #: hands over the cache its load-time warmup already allocated
         self._cache_provider = cache_provider or (
@@ -273,6 +279,7 @@ class DecodeLoop:
                     f"{type(e).__name__}: {e}")
                 err.__cause__ = e
                 for g in died:
+                    self._unpin(g)
                     try:
                         g.stream._fail(err)
                     except Exception:
@@ -313,6 +320,7 @@ class DecodeLoop:
         self._g_depth.set(0, **self._labels)
         self._g_occupancy.set(0.0, **self._labels)
         for g in doomed:
+            self._unpin(g)
             g.stream._fail(err)
 
     def _expire_queued_locked(self, now: float) -> None:
@@ -357,15 +365,39 @@ class DecodeLoop:
             for g in gens:
                 g.slot = group.kv.allocator.alloc()
                 group.gens[g.slot] = g
+        # prefix/KV reuse (bigdl_tpu.fleet.prefix): a full-prompt hit
+        # seeds its slot's cache rows by device copy and goes straight
+        # to decode — only the misses pay a prefill program
+        hits: List[_Gen] = []
+        misses: List[_Gen] = list(gens)
+        if self._prefix is not None:
+            hits, misses = [], []
+            for g in gens:
+                g.prefix_entry = self._prefix.lookup(
+                    servable.key, g.prompt, **self._labels)
+                (hits if g.prefix_entry is not None else misses).append(g)
         t0 = time.monotonic()
-        with telemetry.span("serving/prefill", model=self._name, rows=n):
-            logits, _ = self._engine.prefill(
-                servable, group.kv, [g.prompt for g in gens],
-                [g.slot for g in gens])
+        for g in hits:
+            self._prefix.seed(group.kv, g.slot, g.prefix_entry)
+        if misses:
+            with telemetry.span("serving/prefill", model=self._name,
+                                rows=len(misses)):
+                logits, _ = self._engine.prefill(
+                    servable, group.kv, [g.prompt for g in misses],
+                    [g.slot for g in misses])
+            self._h_prefill_fill.observe(
+                len(misses) / self._engine.prefill_rows, **self._labels)
+            if self._prefix is not None:
+                ladder = self._engine.ladder
+                for i, g in enumerate(misses):
+                    rung = ladder.bucket_for(int(g.prompt.shape[0]))
+                    kr, vr = self._prefix.extract(group.kv, g.slot, rung)
+                    self._prefix.insert(servable.key, g.prompt, kr, vr,
+                                        logits[i], **self._labels)
         t1 = time.monotonic()
-        self._h_prefill_fill.observe(n / self._engine.prefill_rows,
-                                     **self._labels)
-        for i, g in enumerate(gens):
+        for g in hits:
+            self._emit(group, g, g.sampler.sample(g.prefix_entry.logits))
+        for i, g in enumerate(misses):
             self._emit(group, g, g.sampler.sample(logits[i]))
         if telemetry.enabled():
             self._request_tracks_prefill(gens, t0, t1,
@@ -489,6 +521,15 @@ class DecodeLoop:
         group.gens.pop(g.slot, None)
         group.kv.lengths[g.slot] = 0
         group.kv.allocator.free(g.slot)
+        self._unpin(g)
+
+    def _unpin(self, g: _Gen) -> None:
+        """Release the gen's pinned prefix entry (every slot-release
+        path, including supervisor death and abort, must unpin — a
+        leaked pin would make its entry unevictable forever)."""
+        if g.prefix_entry is not None:
+            self._prefix.release(g.prefix_entry)
+            g.prefix_entry = None
 
     # ------------------------------------------------------ shutdown
     def shutdown(self, drain: bool = True) -> None:

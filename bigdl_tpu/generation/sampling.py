@@ -48,13 +48,19 @@ class Sampler:
         self.params = params
         self._rng = np.random.Generator(np.random.PCG64(params.seed))
 
-    def sample(self, logits: np.ndarray) -> int:
-        """Draw the next token id from one ``[V]`` logits row."""
+    def probs(self, logits: np.ndarray) -> np.ndarray:
+        """The ``[V]`` float64 sampling distribution this policy
+        induces over one logits row — the EXACT transformation
+        :meth:`sample` draws from (temperature scale, top-k mask,
+        softmax), factored out so speculative decoding's rejection
+        sampling adjudicates against the same numbers the plain
+        sampler would use. Greedy policies return the one-hot argmax
+        distribution (ties to the lowest id, like :meth:`sample`)."""
         p = self.params
         if p.temperature <= 0.0:
-            # greedy: ties break to the lowest id (np.argmax), which
-            # keeps greedy decode reproducible bit for bit
-            return int(np.argmax(logits))
+            out = np.zeros(np.asarray(logits).shape[0], np.float64)
+            out[int(np.argmax(logits))] = 1.0
+            return out
         scores = logits.astype(np.float64) / p.temperature
         if p.top_k is not None and p.top_k < scores.shape[0]:
             kth = np.partition(scores, -p.top_k)[-p.top_k]
@@ -62,8 +68,25 @@ class Sampler:
         scores = scores - scores.max()
         probs = np.exp(scores)
         probs /= probs.sum()
-        # inverse-CDF over one uniform draw: deterministic given the
-        # seed, independent of numpy's Generator.choice internals
+        return probs
+
+    def draw(self, probs: np.ndarray) -> int:
+        """One inverse-CDF draw from a ``[V]`` probability vector off
+        this sampler's seeded stream (deterministic given the seed,
+        independent of numpy's ``Generator.choice`` internals)."""
         u = self._rng.random()
         return int(np.searchsorted(np.cumsum(probs), u, side="right")
                    .clip(0, probs.shape[0] - 1))
+
+    def uniform(self) -> float:
+        """One uniform draw off the seeded stream (the rejection-
+        sampling accept coin in ``fleet.speculative``)."""
+        return float(self._rng.random())
+
+    def sample(self, logits: np.ndarray) -> int:
+        """Draw the next token id from one ``[V]`` logits row."""
+        if self.params.temperature <= 0.0:
+            # greedy: ties break to the lowest id (np.argmax), which
+            # keeps greedy decode reproducible bit for bit
+            return int(np.argmax(logits))
+        return self.draw(self.probs(logits))

@@ -61,6 +61,10 @@ class GenerationConfig:
     eos_token: Optional[int] = None
     max_new_tokens: int = 64
     timeout_ms: Optional[float] = None
+    #: device-byte budget for the prefix/KV reuse cache
+    #: (``bigdl_tpu.fleet.prefix``): repeated full prompts seed their
+    #: slot by device copy and skip prefill entirely. 0 disables.
+    prefix_cache_bytes: int = 0
 
 
 class GenerationService:
@@ -95,6 +99,11 @@ class GenerationService:
         self.engine = DecodeEngine(self.cache, self.ladder,
                                    self.config.slots,
                                    self.config.prefill_rows)
+        self.prefix = None
+        if self.config.prefix_cache_bytes > 0:
+            from bigdl_tpu.fleet.prefix import PrefixCache
+            self.prefix = PrefixCache(self.config.prefix_cache_bytes,
+                                      metrics=self.metrics_registry)
         self._lock = threading.Lock()
         self._loops: Dict[str, DecodeLoop] = {}
         self._unloading: set = set()
@@ -162,6 +171,8 @@ class GenerationService:
                     self.engine.drop(key)
                     self.cache.drop(key)
                     self._warm_caches.pop(key, None)
+                    if self.prefix is not None:
+                        self.prefix.drop_version(key)
             finally:
                 with self._lock:
                     self._unloading.discard(name)
@@ -170,6 +181,8 @@ class GenerationService:
             self.engine.drop(key)
             self.cache.drop(key)
             self._warm_caches.pop(key, None)
+            if self.prefix is not None:
+                self.prefix.drop_version(key)
 
     def shutdown(self, drain: bool = True) -> None:
         """Stop admission on every decode loop; with ``drain`` finish
@@ -198,7 +211,8 @@ class GenerationService:
                     default_max_new=self.config.max_new_tokens,
                     timeout_ms=self.config.timeout_ms,
                     metrics=self.metrics_registry,
-                    cache_provider=self._cache_for)
+                    cache_provider=self._cache_for,
+                    prefix_cache=self.prefix)
                 self._loops[name] = loop
         return loop
 
@@ -277,6 +291,12 @@ class GenerationService:
         if loop is not None:
             out["queue_depth"] = loop.queue_depth()
             out["live_slots"] = loop.live_slots()
+        if self.prefix is not None:
+            out["prefix_hits"] = int(r.counter(
+                "fleet/prefix/hits").value(**labels))
+            out["prefix_misses"] = int(r.counter(
+                "fleet/prefix/misses").value(**labels))
+            out["prefix_entries"] = len(self.prefix)
         for metric, hist in (("ttft_ms", "serving/generation/ttft_ms"),
                              ("token_ms", "serving/generation/token_ms")):
             samples = r.histogram(hist).samples(**labels)

@@ -50,6 +50,7 @@ class TokenStream:
         self._tokens: List[int] = []
         self._error: Optional[BaseException] = None
         self._token_futures: Dict[int, Future] = {}
+        self._observer = None  # fleet router hook (see _attach)
         self._t_submit = time.monotonic()
         self._t_first: Optional[float] = None
 
@@ -130,16 +131,42 @@ class TokenStream:
         return (self._t_first - self._t_submit) * 1000.0
 
     # -------------------------------------------------- driver side
+    def _attach(self, observer) -> None:
+        """Fleet-private: register ONE observer (``on_token(i, tok)``
+        / ``on_finish(reason)`` / ``on_fail(err)`` callbacks, invoked
+        from the driver thread outside the stream lock). Tokens
+        already pushed are replayed first, and a stream that already
+        resolved delivers its terminal callback immediately — so the
+        fleet router can attach after submit without a race."""
+        with self._cond:
+            self._observer = observer
+            replay = list(enumerate(self._tokens))
+            closed, reason, err = self._closed, self.finish_reason, \
+                self._error
+        for i, tok in replay:
+            observer.on_token(i, tok)
+        if closed:
+            if err is not None:
+                observer.on_fail(err)
+            else:
+                observer.on_finish(reason)
+
     def _push(self, token: int) -> None:
         with self._cond:
             if self._t_first is None:
                 self._t_first = time.monotonic()
             i = len(self._tokens)
+            # bounded per request by max_new_tokens: the token list IS
+            # the stream's product, released with the stream object
+            # bigdl: disable=unbounded-cache-growth
             self._tokens.append(int(token))
             fut = self._token_futures.pop(i, None)
+            obs = self._observer
             self._cond.notify_all()
         if fut is not None:
             fut.set_result(int(token))
+        if obs is not None:
+            obs.on_token(i, int(token))
 
     def _finish(self, reason: str) -> None:
         with self._cond:
@@ -150,10 +177,13 @@ class TokenStream:
             pending = list(self._token_futures.values())
             self._token_futures.clear()
             out = np.asarray(self._tokens, np.int32)
+            obs = self._observer
             self._cond.notify_all()
         for fut in pending:
             fut.set_result(None)
         self.completion.set_result(out)
+        if obs is not None:
+            obs.on_finish(reason)
 
     def _fail(self, err: BaseException) -> None:
         with self._cond:
@@ -163,7 +193,10 @@ class TokenStream:
             self._error = err
             pending = list(self._token_futures.values())
             self._token_futures.clear()
+            obs = self._observer
             self._cond.notify_all()
         for fut in pending:
             fut.set_exception(err)
         self.completion.set_exception(err)
+        if obs is not None:
+            obs.on_fail(err)

@@ -65,6 +65,12 @@ DEFAULT_SCHEDULE = (
     "serving/dispatch=delay:2,times:2"
 )
 
+#: --fleet leg default: the 3rd request the router places on replica
+#: r1 kills that replica mid-burst (the fleet/replica faultpoint in
+#: Replica.submit translates an injected raise into a replica death)
+DEFAULT_FLEET_SCHEDULE = \
+    "fleet/replica=nth:3,raise:RuntimeError,match:replica=r1"
+
 
 def _build_workload(model_kind: str, seed: int, batch_size: int,
                     sharding=None):
@@ -217,6 +223,9 @@ class _Burst:
             except RuntimeError:
                 break  # service shut down under us
             with self._fut_lock:
+                # run-bounded soak collector: EVERY future must stay
+                # reachable for the no-hang invariant check
+                # bigdl: disable=unbounded-cache-growth
                 self.futures.append(f)
             time.sleep(0.002)
 
@@ -299,6 +308,8 @@ class _GenBurst:
             except RuntimeError:
                 break  # service shut down under us
             with self._lock:
+                # run-bounded soak collector (see _Burst.futures)
+                # bigdl: disable=unbounded-cache-growth
                 self.streams.append(s)
             time.sleep(0.002)
 
@@ -593,6 +604,146 @@ def run_hostkill(model: str = "tiny", steps: int = 12,
     return report
 
 
+# -------------------------------------------------- fleet chaos leg
+
+def run_fleet(replicas: int = 3, requests: int = 18, threads: int = 3,
+              max_new: int = 4, seed: int = 42,
+              schedule: str = DEFAULT_FLEET_SCHEDULE,
+              deadline_s: float = 120.0) -> Dict:
+    """The ``--fleet`` leg: kill one replica mid-burst under a seeded
+    schedule and prove the router's failure contract.
+
+    Phases: (1) build a thread-hosted fleet of identical seeded
+    replicas behind a :class:`~bigdl_tpu.fleet.router.FleetRouter` and
+    record each prompt's greedy reference output (replica weights are
+    identical, so ONE reference adjudicates every replica); (2) arm
+    the schedule — an injected ``fleet/replica`` fault at a replica's
+    submit path IS that replica's death — and burst seeded requests
+    from several threads, holding the window open until every
+    deterministic rule fired; (3) resolve every stream. Asserted:
+    every in-flight stream resolves within the deadline as tokens
+    (possibly re-routed) or a TYPED error — never a hang; every
+    successful greedy stream is bit-identical to the reference,
+    re-routed or not; and injected ``fleet/replica`` faults reconcile
+    counter-for-counter against the router's
+    ``fleet/replica/evictions``."""
+    import numpy as np
+
+    import bigdl_tpu.telemetry as telemetry
+    from bigdl_tpu import faults
+    from bigdl_tpu.fleet import FleetRouter, build_replicas
+    from bigdl_tpu.serving import Degraded, QueueFull
+    from bigdl_tpu.tools.synthetic import seeded_rng
+
+    report: Dict = {"replicas": replicas, "requests": requests,
+                    "schedule": schedule, "violations": []}
+    metrics = telemetry.MetricsRegistry()
+    router = FleetRouter(
+        build_replicas(replicas, seed=seed, max_queue=8,
+                       metrics=metrics), metrics=metrics)
+    r = seeded_rng(seed + 1)
+    prompts = [r.randint(1, 31, 3).astype(np.int32) for _ in range(4)]
+    try:
+        # -- phase 1: greedy references, before any chaos -------------
+        refs = []
+        for p in prompts:
+            refs.append(list(router.submit(
+                p, max_new_tokens=max_new).result(60)))
+
+        # -- phase 2: the burst, one replica dying under it -----------
+        streams: List = []
+        lock = threading.Lock()
+        nxt = {"i": 0}
+
+        def pump():
+            while True:
+                with lock:
+                    i = nxt["i"]
+                    if i >= requests:
+                        return
+                    nxt["i"] += 1
+                while True:
+                    try:
+                        s = router.submit(prompts[i % len(prompts)],
+                                          session=f"sess-{i % 6}",
+                                          max_new_tokens=max_new)
+                    except (QueueFull, Degraded):
+                        time.sleep(0.005)
+                        continue
+                    with lock:
+                        streams.append((i % len(prompts), s))
+                    break
+
+        # pre-pin the burst's sessions round-robin so every replica —
+        # including the schedule's target — deterministically receives
+        # submits (stickiness then keeps them there until the kill)
+        names = [rep.name for rep in router.replicas()]
+        for i in range(6):
+            router._sessions[f"sess-{i}"] = names[i % len(names)]
+        sched = faults.arm(schedule)
+        try:
+            workers = [threading.Thread(target=pump, daemon=True,
+                                        name=f"chaos-fleet-{i}")
+                       for i in range(threads)]
+            for w in workers:
+                w.start()
+            for w in workers:
+                w.join(timeout=deadline_s)
+            _await_deterministic_rules(sched, ("fleet/replica",),
+                                       timeout_s=15.0)
+        finally:
+            faults.disarm()
+
+        # -- phase 3: every stream resolves, typed or tokens ----------
+        from concurrent.futures import TimeoutError as FutTimeout
+        resolved = {"ok": 0, "typed_errors": 0, "hung": 0}
+        end = time.monotonic() + deadline_s
+        mismatched = []
+        for pi, s in streams:
+            try:
+                out = list(s.result(
+                    timeout=max(0.0, end - time.monotonic())))
+                resolved["ok"] += 1
+                if out != refs[pi]:
+                    mismatched.append((pi, out, refs[pi]))
+            except FutTimeout:
+                resolved["hung"] += 1
+            except Exception:
+                resolved["typed_errors"] += 1
+        report["burst"] = resolved
+        if resolved["hung"]:
+            report["violations"].append(
+                f"{resolved['hung']} fleet streams never resolved")
+        if mismatched:
+            report["violations"].append(
+                "greedy outputs diverged from the pre-chaos reference "
+                f"(first: {mismatched[0]})")
+        report["bit_identical"] = not mismatched
+
+        # -- invariants: injected == evictions, rules fired -----------
+        injected = sched.fired().get("fleet/replica", 0)
+        evictions = int(metrics.counter(
+            "fleet/replica/evictions").total())
+        reroutes = int(metrics.counter("fleet/router/reroutes").total())
+        report["injected"] = {"fleet/replica": injected}
+        report["recovered"] = {"evictions": evictions,
+                               "reroutes": reroutes}
+        if injected != evictions:
+            report["violations"].append(
+                f"injected {injected} replica kills but the router "
+                f"evicted {evictions}")
+        for rule in sched.rules:
+            if rule.prob is None and rule.action == "raise" \
+                    and rule.fired == 0:
+                report["violations"].append(
+                    f"scheduled fault never fired: {rule!r}")
+        report["states"] = router.metrics()["states"]
+    finally:
+        router.shutdown(drain=True)
+    report["passed"] = not report["violations"]
+    return report
+
+
 # ----------------------------------------------------------- the soak
 
 def _corrupt_latest(ckpt_dir: str) -> str:
@@ -778,6 +929,16 @@ def main(argv=None) -> int:
     ap.add_argument("--workdir", default=None,
                     help="keep work files here instead of a temp dir")
     ap.add_argument("--json", action="store_true")
+    # fleet leg: kill one generation replica mid-burst, assert typed
+    # resolution / re-route, eviction reconciliation, bit-identity
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the replica-fleet chaos leg instead of "
+                         "the training soak (bigdl_tpu.fleet router)")
+    ap.add_argument("--fleet-replicas", type=int, default=3)
+    ap.add_argument("--fleet-requests", type=int, default=18)
+    ap.add_argument("--fleet-schedule", default=DEFAULT_FLEET_SCHEDULE,
+                    help="fleet-leg fault schedule (the fleet/replica "
+                         "point kills the matched replica)")
     # host-kill leg: SIGKILL a whole tools/launch gang host mid-window,
     # relaunch at a different world size, assert elastic recovery
     ap.add_argument("--hostkill", action="store_true",
@@ -811,6 +972,27 @@ def main(argv=None) -> int:
                     help=argparse.SUPPRESS)
     args = ap.parse_args(argv)
 
+    if args.fleet:
+        report = run_fleet(replicas=args.fleet_replicas,
+                           requests=args.fleet_requests,
+                           seed=args.seed,
+                           schedule=args.fleet_schedule)
+        if args.json:
+            print(json.dumps(report, indent=2, default=str))
+        else:
+            print("== chaos fleet leg ==")
+            print(f"replicas={report['replicas']} "
+                  f"requests={report['requests']}")
+            print(f"burst:     {report.get('burst')}")
+            print(f"injected:  {report.get('injected')} "
+                  f"recovered: {report.get('recovered')}")
+            print(f"states:    {report.get('states')}")
+            print(f"bit-identical greedy outputs: "
+                  f"{report.get('bit_identical')}")
+            for v in report["violations"]:
+                print(f"VIOLATION: {v}")
+            print("PASS" if report["passed"] else "FAIL")
+        return 0 if report["passed"] else 1
     if args.hostkill_worker:
         if not args.ckpt_dir:
             print("--hostkill-worker needs --ckpt-dir", file=sys.stderr)

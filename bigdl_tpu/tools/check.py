@@ -141,6 +141,7 @@ def collect_instrument_names():
                 "bigdl_tpu.elastic.preempt"):
         importlib.import_module(mod)
     scratch = telemetry.MetricsRegistry()
+    from bigdl_tpu.fleet import register_fleet_instruments
     from bigdl_tpu.generation.loop import register_generation_instruments
     from bigdl_tpu.optim.optimizer import Metrics
     from bigdl_tpu.serving.batcher import BatcherStats
@@ -149,6 +150,7 @@ def collect_instrument_names():
     BatcherStats(registry=scratch, model="audit")
     CompileCache(metrics=scratch)
     register_generation_instruments(scratch)
+    register_fleet_instruments(scratch)
     register_program_instruments(scratch)
     m = Metrics(registry=scratch)
     m.add("data time", 0.0)

@@ -33,6 +33,9 @@ FAMILIES = {
                    "bigdl_tpu.generation.loop",
                    "bigdl_tpu.generation.stream",
                    "bigdl_tpu.generation.sampling"],
+    "fleet": ["bigdl_tpu.fleet", "bigdl_tpu.fleet.prefix",
+              "bigdl_tpu.fleet.speculative", "bigdl_tpu.fleet.router",
+              "bigdl_tpu.fleet.replica", "bigdl_tpu.fleet.soak"],
     "kernels": ["bigdl_tpu.kernels", "bigdl_tpu.kernels.config",
                 "bigdl_tpu.kernels.dispatch",
                 "bigdl_tpu.kernels.flash_attention",

@@ -206,3 +206,38 @@ def test_bench_elastic_row_contract_and_sentinel_accepts_it():
                 "elastic_resume_to_first_step_s"):
         assert key in metrics
         assert classify_key(key) == "lower"
+
+
+@pytest.mark.slow
+def test_bench_fleet_row_contract_and_sentinel_accepts_it():
+    """The FLEET row (bigdl_tpu.fleet): goodput-under-load for 1 vs N
+    replicas at a fixed p99 TTFT budget, prefix-cache hit vs cold
+    TTFT p50 (the acceptance bound: a full-prefix hit costs at most
+    2x one decode step — the prefill is GONE), and speculative
+    accepted-token rate + tokens/sec on vs off — and the regression
+    sentinel accepts the row as a schema_version=2 candidate."""
+    out = _run_bench("synthetic", {"BENCH_FLEET": "1",
+                                   "BENCH_FLEET_REQS": "12"})
+    for key in ("fleet_goodput_tokens_per_sec_1r",
+                "fleet_goodput_tokens_per_sec_nr",
+                "fleet_prefix_cold_ttft_ms_p50",
+                "fleet_prefix_hit_ttft_ms_p50",
+                "fleet_token_ms_p50",
+                "fleet_spec_tokens_per_sec_on",
+                "fleet_spec_tokens_per_sec_off"):
+        assert out[key] > 0, key
+    assert 0.0 <= out["fleet_spec_accept_rate"] <= 1.0
+    # the prefix acceptance bound: a full-prefix hit pays the seed
+    # splice + sampling from cached logits — at most 2x one decode
+    # step, and strictly cheaper than the cold prefill it replaced
+    assert out["fleet_prefix_hit_ttft_ms_p50"] <= \
+        2.0 * out["fleet_token_ms_p50"], out
+    assert out["fleet_prefix_hit_ttft_ms_p50"] < \
+        out["fleet_prefix_cold_ttft_ms_p50"], out
+    from bigdl_tpu.tools.regress import KNOWN_SCHEMA_VERSIONS, \
+        extract_metrics
+    assert out["schema_version"] in KNOWN_SCHEMA_VERSIONS
+    metrics = extract_metrics(out, "bench-line")
+    for key in ("fleet_goodput_tokens_per_sec_nr",
+                "fleet_spec_accept_rate"):
+        assert key in metrics

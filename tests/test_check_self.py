@@ -37,6 +37,9 @@ def test_verify_programs_self_gate(suite):
     assert "train/transformer_lm/step@bf16" in names
     assert "serving/transformer_lm/prefill/16" in names
     assert "serving/transformer_lm/decode/16" in names
+    # the fleet speculative-verify rung rides the same enumeration
+    # hook: donation + HBM checks cover it like prefill/decode
+    assert "serving/transformer_lm/verify/16" in names
     # conftest forces 8 virtual devices, so the mesh leg must be there
     assert "train/mlp/zero2/step" in names, notes
     assert notes == []

@@ -332,6 +332,28 @@ def handle(batch):
         reqs.inc(req=f"req-{i}")  # bigdl: disable=metric-label-cardinality
 """,
     ),
+    "unbounded-cache-growth": (
+        """
+import bigdl_tpu.serving
+
+class ResponseCache:
+    def __init__(self):
+        self._seen = {}
+
+    def put(self, key, value):
+        self._seen[key] = value
+""",
+        """
+import bigdl_tpu.serving
+
+class ResponseCache:
+    def __init__(self):
+        self._seen = {}
+
+    def put(self, key, value):
+        self._seen[key] = value  # bigdl: disable=unbounded-cache-growth
+""",
+    ),
 }
 
 
@@ -1038,3 +1060,81 @@ def train(params, grads):
     return new
 """
     assert "use-after-donate" not in names(run(body))
+
+
+def test_unbounded_cache_growth_eviction_lifecycle_passes():
+    """The sanctioned shape (the fleet prefix cache's): grow sites
+    paired with pop/del eviction in the same class pass clean — as
+    does a deque bounded by construction."""
+    body = """
+import bigdl_tpu.generation
+
+class BoundedCache:
+    def __init__(self):
+        self._entries = {}
+        self._ring = deque(maxlen=64)
+
+    def put(self, key, value):
+        while len(self._entries) > 32:
+            victim = next(iter(self._entries))
+            self._entries.pop(victim)
+        self._entries[key] = value
+        self._ring.append(key)
+"""
+    body = "from collections import deque\n" + body
+    assert "unbounded-cache-growth" not in names(run(body))
+
+
+def test_unbounded_cache_growth_skips_non_serving_files():
+    """The identical grow-only dict OFF the serving surface (no
+    serving/generation/fleet import, path outside those dirs) is
+    ordinary bookkeeping — not flagged."""
+    body = """
+class Memo:
+    def __init__(self):
+        self._seen = {}
+
+    def put(self, key, value):
+        self._seen[key] = value
+"""
+    assert "unbounded-cache-growth" not in names(run(body))
+    # the same source UNDER a serving dir is on-surface by path alone
+    from bigdl_tpu.analysis import lint_source
+    flagged = lint_source(HEADER + body,
+                          "bigdl_tpu/generation/widget.py")
+    assert "unbounded-cache-growth" in names(flagged)
+
+
+def test_unbounded_cache_growth_module_dict_and_append_sites():
+    """Module-level grow-only dicts and .append-grown lists are
+    flagged too; a del site anywhere in the scope exonerates."""
+    grow_only = """
+import bigdl_tpu.fleet
+
+_RESPONSES = {}
+
+def remember(key, value):
+    _RESPONSES[key] = value
+"""
+    assert "unbounded-cache-growth" in names(run(grow_only))
+    with_del = grow_only + """
+
+def forget(key):
+    del _RESPONSES[key]
+"""
+    assert "unbounded-cache-growth" not in names(run(with_del))
+    append_only = """
+import bigdl_tpu.serving
+
+class Log:
+    def __init__(self):
+        self._rows = []
+
+    def record(self, row):
+        self._rows.append(row)
+"""
+    assert "unbounded-cache-growth" in names(run(append_only))
+    # `+=` is the same growth as .append, not a rebind-reset
+    aug_only = append_only.replace("self._rows.append(row)",
+                                   "self._rows += [row]")
+    assert "unbounded-cache-growth" in names(run(aug_only))
