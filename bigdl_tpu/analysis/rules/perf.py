@@ -488,6 +488,93 @@ def unbounded_cache_growth(ctx: FileContext):
                           where="module scope")
 
 
+#: knobs the autotuner owns: a literal value for one of these in a
+#: tool/bench file silently overrides what a sweep measured
+_TUNED_NAMES = frozenset({"steps_per_sync", "length_buckets",
+                          "prefix_cache_bytes"})
+
+#: the one module where hand-picked tuned-constant literals are
+#: sanctioned (they live there WITH their rationale)
+_TUNED_DEFAULTS_MODULE = "bigdl_tpu/autotune/defaults"
+
+
+def _literal_value(node: ast.AST) -> bool:
+    """A compile-time numeric literal: constants, tuples/lists of
+    them, and arithmetic over them (``256 << 20`` is still a
+    hand-picked number)."""
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, (int, float)) \
+            and not isinstance(node.value, bool)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return bool(node.elts) and all(_literal_value(e)
+                                       for e in node.elts)
+    if isinstance(node, ast.BinOp):
+        return _literal_value(node.left) and _literal_value(node.right)
+    if isinstance(node, ast.UnaryOp):
+        return _literal_value(node.operand)
+    return False
+
+
+@rule("hardcoded-tuned-constant",
+      "literal tuned-knob value in a tool/bench file outside the "
+      "sanctioned defaults module")
+def hardcoded_tuned_constant(ctx: FileContext):
+    """Flags literal ``steps_per_sync`` / ``length_buckets`` /
+    ``prefix_cache_bytes`` values — assignments, call keywords, and
+    ``.set_steps_per_sync(<literal>)`` — in the TOOL and BENCH layers
+    (``bigdl_tpu/tools/``, ``bench.py``, scripts), where a hand-picked
+    number silently overrides whatever ``python -m
+    bigdl_tpu.tools.autotune`` measured. The sanctioned homes are
+    ``bigdl_tpu/autotune/defaults.py`` (hand-picked values WITH their
+    rationale) and a ``tuned.json`` artifact applied via ``--config``
+    / ``apply_tuned_config``; library modules (axis definitions,
+    dataclass defaults) are definition sites, not choices, and are
+    exempt. Mark a deliberate fixed-value site (a chaos drill's tiny
+    geometry, a bench leg pinning one axis) with
+    ``# bigdl: disable=hardcoded-tuned-constant``."""
+    norm = ctx.path.replace("\\", "/")
+    if _TUNED_DEFAULTS_MODULE in norm:
+        return  # THE sanctioned home
+    if "bigdl_tpu/" in norm and "bigdl_tpu/tools/" not in norm:
+        return  # library modules define the knobs; tools choose values
+
+    def msg(name: str) -> str:
+        return (
+            f"literal `{name}` here overrides whatever the autotuner "
+            "measured; read it from bigdl_tpu.autotune.defaults, apply "
+            "a tuned.json (`--config` / `apply_tuned_config`), or mark "
+            "a deliberate fixed-value site with "
+            "`# bigdl: disable=hardcoded-tuned-constant`")
+
+    for node in ctx.walk(ast.Assign, ast.AnnAssign):
+        # class bodies are definition sites (dataclass field defaults)
+        encl = ctx.enclosing(node, ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef)
+        if isinstance(encl, ast.ClassDef):
+            continue
+        value = node.value
+        if value is None or not _literal_value(value):
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        for t in targets:
+            name = t.id if isinstance(t, ast.Name) else (
+                t.attr if isinstance(t, ast.Attribute) else None)
+            if name in _TUNED_NAMES:
+                yield node, msg(name)
+    for node in ctx.walk(ast.Call):
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "set_steps_per_sync" \
+                and node.args and _literal_value(node.args[0]):
+            yield node, msg("set_steps_per_sync")
+            continue
+        for kw in node.keywords:
+            if kw.arg in _TUNED_NAMES and _literal_value(kw.value):
+                # anchor on the literal so a disable tag on ITS line
+                # works inside multi-line calls
+                yield kw.value, msg(kw.arg)
+
+
 @rule("sync-in-loop",
       "per-iteration host-device sync inside a host step loop")
 def sync_in_loop(ctx: FileContext):

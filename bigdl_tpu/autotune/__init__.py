@@ -1,0 +1,63 @@
+"""Profile-guided configuration autotuner: static prune → timed
+measure → tuned-config artifact.
+
+The package measured everything (``telemetry``), can price programs
+without running them (``analysis/hlo``'s cost + ``hbm_fit``), and
+every performance knob — ``steps_per_sync`` K, ZeRO stage, precision
+preset, the flash toggle, length buckets, slots, speculation depth,
+prefix-cache bytes — was still hand-picked. This subsystem closes the
+loop from gauges back to configuration:
+
+1. :mod:`~bigdl_tpu.autotune.space` — typed, bounded search spaces
+   with validity constraints in code;
+2. :mod:`~bigdl_tpu.autotune.prune` — static HBM/contract pruning with
+   ZERO executions (footprint-gate rejections never even compile);
+3. :mod:`~bigdl_tpu.autotune.measure` — short seeded timed windows
+   with per-candidate failure isolation, objectives read from the
+   telemetry registry's own instruments;
+4. :mod:`~bigdl_tpu.autotune.config` — the versioned, fingerprinted
+   ``tuned.json`` artifact that ``tools/perf --config``, bench's TUNED
+   row and the serving facade consume.
+
+CLI: ``python -m bigdl_tpu.tools.autotune`` (``docs/autotune.md``).
+"""
+from bigdl_tpu import telemetry as _telemetry
+
+#: sweep instruments (audited by ``tools.check --telemetry-audit``)
+CANDIDATES_TOTAL = _telemetry.counter(
+    "autotune/sweep/candidates_total",
+    "candidates enumerated from the search space (valid + invalid)")
+PRUNED_STATIC = _telemetry.counter(
+    "autotune/sweep/pruned_static",
+    "candidates rejected before any execution (invalid combination, "
+    "static HBM footprint, compiled-program contract)")
+MEASURED = _telemetry.counter(
+    "autotune/sweep/measured",
+    "candidates that got a timed measurement window")
+BEST_OBJECTIVE = _telemetry.gauge(
+    "autotune/sweep/best_objective",
+    "winning objective value per regime (labels: regime, objective)")
+
+from bigdl_tpu.autotune.config import (FingerprintMismatchError,  # noqa: E402
+                                       Fingerprint, TunedConfig,
+                                       TunedConfigError,
+                                       apply_to_perf_args,
+                                       apply_tuned_optimizer,
+                                       load_tuned, save_tuned)
+from bigdl_tpu.autotune.measure import (MeasureResult,  # noqa: E402
+                                        measure_candidates)
+from bigdl_tpu.autotune.prune import (PruneReport,  # noqa: E402
+                                      PrunedCandidate, static_prune)
+from bigdl_tpu.autotune.space import (Candidate, ServingSpace,  # noqa: E402
+                                      SpaceError, TrainSpace,
+                                      enumerate_candidates)
+
+__all__ = [
+    "CANDIDATES_TOTAL", "PRUNED_STATIC", "MEASURED", "BEST_OBJECTIVE",
+    "SpaceError", "Candidate", "TrainSpace", "ServingSpace",
+    "enumerate_candidates", "PrunedCandidate", "PruneReport",
+    "static_prune", "MeasureResult", "measure_candidates",
+    "TunedConfigError", "FingerprintMismatchError", "Fingerprint",
+    "TunedConfig", "save_tuned", "load_tuned", "apply_to_perf_args",
+    "apply_tuned_optimizer",
+]

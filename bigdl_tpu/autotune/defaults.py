@@ -1,0 +1,100 @@
+"""The sanctioned home of tuned-constant defaults and smoke spaces.
+
+The ``hardcoded-tuned-constant`` lint rule flags literal
+``steps_per_sync`` / bucket-ladder / cache-byte values in the tool and
+bench layers — a hand-picked constant there silently overrides what the
+autotuner measured. THIS module is the one place such literals are
+sanctioned: the hand-picked defaults live here with their rationale,
+the smoke search spaces are built here, and every consumer
+(``tools/autotune``, ``tools/perf --config``, bench's TUNED row) reads
+them through this module or through a ``tuned.json`` artifact.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+from bigdl_tpu.autotune.space import ServingSpace, TrainSpace
+
+__all__ = ["DEFAULT_TRAIN_CONFIG", "DEFAULT_SERVING_CONFIG",
+           "SMOKE_HBM_BUDGET_BYTES", "INFEASIBLE_BATCH",
+           "smoke_train_space", "smoke_serving_space",
+           "default_train_space", "default_serving_space"]
+
+#: the hand-picked training defaults the tuned artifact is measured
+#: against (K=1 per-step dispatch, no ZeRO, full f32, reference
+#: kernels — the package's conservative out-of-the-box behavior)
+DEFAULT_TRAIN_CONFIG: Dict[str, object] = {
+    "steps_per_sync": 1, "zero_stage": 0, "precision": "f32",
+    "flash": False, "batch_size": 16,
+}
+
+#: the hand-picked serving defaults (one full-length bucket, 4 slots,
+#: no speculation, prefix cache off — GenerationConfig's own spirit at
+#: smoke scale)
+DEFAULT_SERVING_CONFIG: Dict[str, object] = {
+    "length_buckets": (64,), "slots": 4, "speculation_k": 0,
+    "prefix_cache_bytes": 0,
+}
+
+#: the CPU-smoke per-device HBM budget (1 MiB): small enough that the
+#: smoke space's deliberately oversized batch is infeasible on ANY
+#: host, large enough that the tiny-model candidates all fit
+SMOKE_HBM_BUDGET_BYTES = 1 << 20
+
+#: deliberately HBM-infeasible batch size for the smoke space: at
+#: 65536 rows x 16 f32 features the batch alone is 4 MiB — over the
+#: 1 MiB smoke budget, so the static pruner MUST reject it before
+#: anything compiles (the CLI acceptance bound)
+INFEASIBLE_BATCH = 65536
+
+
+def smoke_train_space() -> TrainSpace:
+    """The bounded CPU-smoke training space: <= 8 candidates spanning
+    K, precision and batch size — including the hand-picked default
+    point (so the winner's objective >= the default's by construction)
+    and one deliberately HBM-infeasible batch the static pruner must
+    reject with zero compiles."""
+    return TrainSpace(
+        steps_per_sync=(1, 4),
+        zero_stage=(0,),
+        precision=("f32", "bf16_mixed"),
+        flash=(False,),
+        batch_size=(16, INFEASIBLE_BATCH),
+        model="mlp")
+
+
+def default_train_space() -> TrainSpace:
+    """The standard training sweep ``tools/autotune`` runs without
+    ``--smoke``: K x precision x flash over the attention-bearing tiny
+    twin, at the default batch (ZeRO stages need a multi-device mesh to
+    change anything — sweep them where they act)."""
+    return TrainSpace(
+        steps_per_sync=(1, 4, 8),
+        zero_stage=(0,),
+        precision=("f32", "bf16_mixed"),
+        flash=(False, True),
+        batch_size=(16,),
+        model="transformer_lm")
+
+
+def default_serving_space() -> ServingSpace:
+    """The standard serving sweep: ladder shape x slots x prefix-cache
+    budget at a 64-token smoke horizon."""
+    return ServingSpace(
+        max_len=64,
+        length_buckets=((64,), (16, 32, 64)),
+        slots=(2, 4),
+        speculation_k=(0,),
+        prefix_cache_bytes=(0, 1 << 20))
+
+
+def smoke_serving_space() -> ServingSpace:
+    """The bounded CPU-smoke serving space: <= 4 candidates over the
+    ladder and prefix-cache axes, including the hand-picked default
+    point."""
+    return ServingSpace(
+        max_len=64,
+        length_buckets=((64,), (32, 64)),
+        slots=(4,),
+        speculation_k=(0,),
+        prefix_cache_bytes=(0, 1 << 20))

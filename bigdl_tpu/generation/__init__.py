@@ -24,11 +24,12 @@ from bigdl_tpu.generation.kv_cache import KVCache, SlotAllocator
 from bigdl_tpu.generation.loop import DecodeLoop
 from bigdl_tpu.generation.sampling import Sampler, SamplingParams
 from bigdl_tpu.generation.service import (GenerationConfig,
-                                          GenerationService)
+                                          GenerationService,
+                                          apply_tuned_config)
 from bigdl_tpu.generation.stream import TokenStream
 
 __all__ = [
     "DecodeEngine", "DecodeLoop", "GenerationConfig",
     "GenerationService", "KVCache", "Sampler", "SamplingParams",
-    "SlotAllocator", "TokenStream",
+    "SlotAllocator", "TokenStream", "apply_tuned_config",
 ]

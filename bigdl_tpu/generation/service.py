@@ -67,6 +67,49 @@ class GenerationConfig:
     prefix_cache_bytes: int = 0
 
 
+def apply_tuned_config(tuned, base: Optional[GenerationConfig] = None,
+                       *, allow_mismatch: bool = False
+                       ) -> GenerationConfig:
+    """Build a :class:`GenerationConfig` from an autotuner artifact's
+    serving winner (``python -m bigdl_tpu.tools.autotune``).
+
+    ``tuned`` is a ``tuned.json`` path or an already-loaded
+    ``autotune.TunedConfig``; paths are fingerprint-checked on load
+    (typed ``FingerprintMismatchError`` on a foreign environment unless
+    ``allow_mismatch``). The winner's ``length_buckets`` / ``slots`` /
+    ``prefix_cache_bytes`` land on a copy of ``base`` (default: a fresh
+    :class:`GenerationConfig`), with ``max_len`` snapped to the
+    winner's ladder top — the service's own top-rung-is-the-cache-axis
+    invariant. A winner tuned for the speculative decoder
+    (``speculation_k > 0``) is refused: that path is configured on
+    :class:`~bigdl_tpu.generation.speculative.SpeculativeDecoder`, not
+    here, and dropping the axis silently would misapply the tuning."""
+    import dataclasses
+
+    from bigdl_tpu.autotune.config import (TunedConfig,
+                                           TunedConfigError, load_tuned)
+
+    if not isinstance(tuned, TunedConfig):
+        tuned = load_tuned(tuned, allow_mismatch=allow_mismatch)
+    winner = tuned.winner("serving")
+    if int(winner.get("speculation_k", 0) or 0) > 0:
+        raise TunedConfigError(
+            f"serving winner has speculation_k="
+            f"{winner['speculation_k']}: apply it to a "
+            f"SpeculativeDecoder, not GenerationConfig")
+    cfg = base or GenerationConfig()
+    updates: Dict[str, object] = {}
+    if "length_buckets" in winner:
+        ladder = tuple(int(b) for b in winner["length_buckets"])
+        updates["length_buckets"] = ladder
+        updates["max_len"] = ladder[-1]
+    if "slots" in winner:
+        updates["slots"] = int(winner["slots"])
+    if "prefix_cache_bytes" in winner:
+        updates["prefix_cache_bytes"] = int(winner["prefix_cache_bytes"])
+    return dataclasses.replace(cfg, **updates)
+
+
 class GenerationService:
     """Token-streaming generation over a hot-swappable multi-model
     registry (module docstring has the wiring; ``generate`` is the

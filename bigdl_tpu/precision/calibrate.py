@@ -26,14 +26,28 @@ import numpy as np
 
 from bigdl_tpu.ops.quant import quantize_symmetric, scale_from_amax
 
-__all__ = ["calibrate_weight", "collect_activation_scales",
-           "scale_from_amax"]
+__all__ = ["calibrate_weight", "calibrate_activation",
+           "collect_activation_scales", "scale_from_amax"]
 
 
 def calibrate_weight(w, axis: int = 0):
     """Per-channel symmetric int8 weight quantization along ``axis``
     (delegates to the one ``ops/quant`` path). Returns ``(q, scale)``."""
     return quantize_symmetric(w, axis=axis)
+
+
+def calibrate_activation(x, axis: int = 0):
+    """DYNAMIC per-batch activation quantization along ``axis`` — the
+    same symmetric max-abs rule as everything else here, applied to one
+    observed batch instead of a calibration sweep. Returns
+    ``(q, scale)``.
+
+    This is the estimate :func:`collect_activation_scales` exists to
+    replace on serving hot paths (static scales are cheaper and
+    certifiable); it remains the right call for one-off measurement
+    sweeps (``tools/int8_sweep``) where each batch IS the entire
+    distribution being measured."""
+    return quantize_symmetric(x, axis=axis)
 
 
 def _quantizable(m) -> bool:

@@ -138,7 +138,8 @@ def collect_instrument_names():
                 "bigdl_tpu.telemetry.flight",
                 "bigdl_tpu.kernels.dispatch",
                 "bigdl_tpu.elastic.checkpoint",
-                "bigdl_tpu.elastic.preempt"):
+                "bigdl_tpu.elastic.preempt",
+                "bigdl_tpu.autotune"):
         importlib.import_module(mod)
     scratch = telemetry.MetricsRegistry()
     from bigdl_tpu.fleet import register_fleet_instruments

@@ -42,6 +42,11 @@ FAMILIES = {
                 "bigdl_tpu.kernels.ragged_decode",
                 "bigdl_tpu.kernels.int8_gemm",
                 "bigdl_tpu.kernels.common"],
+    "autotune": ["bigdl_tpu.autotune", "bigdl_tpu.autotune.space",
+                 "bigdl_tpu.autotune.defaults",
+                 "bigdl_tpu.autotune.prune",
+                 "bigdl_tpu.autotune.measure",
+                 "bigdl_tpu.autotune.config"],
     "analysis": ["bigdl_tpu.analysis", "bigdl_tpu.analysis.shapecheck",
                  "bigdl_tpu.analysis.lint", "bigdl_tpu.analysis.hlo",
                  "bigdl_tpu.analysis.checks",

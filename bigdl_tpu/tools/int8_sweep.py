@@ -18,7 +18,9 @@ tunnel).
     scales there and remains CLI-compatible, but new code should
     calibrate through ``precision.calibrate`` / ``ModelRegistry.load(
     quantize=True, calibration=...)`` rather than calling
-    ``quantize_symmetric`` directly.
+    ``quantize_symmetric`` directly. For choosing a precision policy
+    from measurements, prefer the profile-guided autotuner:
+    ``python -m bigdl_tpu.tools.autotune`` (docs/autotune.md).
 """
 import json
 import sys
@@ -60,14 +62,19 @@ def main(argv=None):
     import jax.numpy as jnp
 
     from bigdl_tpu.ops.pallas_kernels import pallas_quantized_matmul
-    from bigdl_tpu.ops.quant import quantize_symmetric, quantized_linear
+    from bigdl_tpu.ops.quant import quantized_linear
     # the one scale-estimation path (precision/calibrate.py delegates to
-    # ops/quant's max-abs rule): weight scales below come from here
-    from bigdl_tpu.precision.calibrate import calibrate_weight
+    # ops/quant's max-abs rule): weight AND activation scales below
+    # come from here — this tool holds no quantization math of its own
+    from bigdl_tpu.precision.calibrate import (calibrate_activation,
+                                               calibrate_weight)
 
     import os
     args = argv if argv is not None else sys.argv[1:]
     iters = int(args[0]) if args else 3
+    print("# int8_sweep measures kernels only; to pick a precision "
+          "policy from measurements use: python -m "
+          "bigdl_tpu.tools.autotune")
     # scan long enough that compute dominates the ~100 ms tunnel
     # roundtrip per chunk; at scan 8 every shape measured ~13 ms/step
     # (pure dispatch latency) regardless of FLOPs
@@ -107,7 +114,7 @@ def main(argv=None):
 
         t_pl8 = None
         if on_tpu:
-            x_q, x_s = quantize_symmetric(x, axis=0)  # per-sample rows
+            x_q, x_s = calibrate_activation(x, axis=0)  # per-sample rows
 
             def pl8(x_q, w_q, x_s, w_s):
                 return pallas_quantized_matmul(x_q, w_q, x_s, w_s)

@@ -110,7 +110,22 @@ def main(argv=None):
                     "zero.py — 1: sharded opt state, 2: + gradient "
                     "reduce-scatter, 3: + params sharded at rest); the "
                     "JSON tail reports opt_state/params bytes per chip")
+    ap.add_argument("--config", default=None, metavar="TUNED_JSON",
+                    help="apply a tuned.json artifact from `python -m "
+                    "bigdl_tpu.tools.autotune` — its train winner "
+                    "overrides --steps-per-sync/--zero/--precision/"
+                    "--batch-size/--kernels; refused (typed error) if "
+                    "the artifact's environment fingerprint mismatches "
+                    "this machine")
     args = ap.parse_args(argv)
+    tuned_applied = []
+    if args.config is not None:
+        from bigdl_tpu.autotune.config import (apply_to_perf_args,
+                                               load_tuned)
+        tuned = load_tuned(args.config)
+        tuned_applied = apply_to_perf_args(tuned, args)
+        print(f"# tuned config {args.config}: applied "
+              f"{','.join(tuned_applied) or 'nothing'}")
     if args.steps_per_sync < 1:
         raise SystemExit("--steps-per-sync must be >= 1")
 
@@ -357,6 +372,9 @@ def main(argv=None):
             "kernels": ("on" if _kernels_tail.get_config().any_enabled
                         else "off"),
             "kernel_label": kern_label}
+    if args.config is not None:
+        tail["tuned_config"] = args.config
+        tail["tuned_applied"] = tuned_applied
     tail.update(zero_meta)
     tail.update(program_fields)
     if args.mode == "train":

@@ -241,3 +241,31 @@ def test_bench_fleet_row_contract_and_sentinel_accepts_it():
     for key in ("fleet_goodput_tokens_per_sec_nr",
                 "fleet_spec_accept_rate"):
         assert key in metrics
+
+
+@pytest.mark.slow
+def test_bench_tuned_row_contract_and_sentinel_accepts_it():
+    """The TUNED row: the autotuner's winner vs the hand-picked
+    defaults from ONE prune-then-measure sweep over the bounded smoke
+    spaces. The default config is a point IN those spaces, so the
+    winner can never lose to it on the same seeded windows — the
+    speedup keys are >= 1 by construction, and the regression sentinel
+    accepts the fresh line as a schema_version=2 candidate."""
+    out = _run_bench("synthetic", {"BENCH_TUNED": "1",
+                                   "BENCH_ITERS": "2"})
+    for key in ("tuned_train_steps_per_sec",
+                "default_train_steps_per_sec",
+                "tuned_decode_tokens_per_sec",
+                "default_decode_tokens_per_sec"):
+        assert out[key] > 0, key
+    assert out["tuned_vs_default_train_speedup"] >= 1.0, out
+    assert out["tuned_vs_default_serving_speedup"] >= 1.0, out
+    from bigdl_tpu.tools.regress import KNOWN_SCHEMA_VERSIONS, \
+        extract_metrics
+    assert out["schema_version"] in KNOWN_SCHEMA_VERSIONS
+    # "per_sec"/"speedup" keys classify higher-is-better in the
+    # sentinel's documented suffix rules
+    metrics = extract_metrics(out, "bench-line")
+    for key in ("tuned_train_steps_per_sec",
+                "tuned_vs_default_train_speedup"):
+        assert key in metrics

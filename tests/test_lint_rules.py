@@ -236,6 +236,24 @@ def train(step, params, batches):
         print(float(loss))  # bigdl: disable=sync-in-loop
 """,
     ),
+    "hardcoded-tuned-constant": (
+        """
+steps_per_sync = 4
+
+def serve(svc, opt):
+    svc.configure(length_buckets=(16, 32),
+                  prefix_cache_bytes=256 << 20)
+    opt.set_steps_per_sync(8)
+""",
+        """
+steps_per_sync = 4  # bigdl: disable=hardcoded-tuned-constant
+
+def serve(svc, opt):
+    svc.configure(length_buckets=(16, 32),  # bigdl: disable=hardcoded-tuned-constant
+                  prefix_cache_bytes=256 << 20)  # bigdl: disable=hardcoded-tuned-constant
+    opt.set_steps_per_sync(8)  # bigdl: disable=hardcoded-tuned-constant
+""",
+    ),
     "retry-no-backoff": (
         """
 def run(fn):
@@ -768,6 +786,52 @@ def f(x):
     return y
 """
     assert "gather-in-step-loop" not in names(run(body))
+
+
+def test_hardcoded_tuned_constant_path_scope():
+    # tools/bench files are choice sites; library modules and the
+    # sanctioned defaults module are definition sites
+    src = HEADER + CASES["hardcoded-tuned-constant"][0]
+    assert "hardcoded-tuned-constant" in names(
+        lint_source(src, "fixture.py"))
+    assert "hardcoded-tuned-constant" in names(
+        lint_source(src, "bigdl_tpu/tools/perf.py"))
+    assert "hardcoded-tuned-constant" not in names(
+        lint_source(src, "bigdl_tpu/optim/optimizer.py"),
+        only_active=False)
+    assert "hardcoded-tuned-constant" not in names(
+        lint_source(src, "bigdl_tpu/autotune/defaults.py"),
+        only_active=False)
+
+
+def test_hardcoded_tuned_constant_exempts_class_defaults():
+    # dataclass/class-body defaults are the knob DEFINITIONS
+    body = """
+class Config:
+    steps_per_sync = 4
+    length_buckets = (16, 32)
+"""
+    assert "hardcoded-tuned-constant" not in names(run(body))
+
+
+def test_hardcoded_tuned_constant_ignores_computed_values():
+    # values flowed in from args / a tuned artifact are the point
+    body = """
+def main(args, svc, tuned):
+    steps_per_sync = args.steps_per_sync
+    svc.configure(length_buckets=tuple(tuned["length_buckets"]),
+                  prefix_cache_bytes=args.cache_bytes)
+"""
+    assert "hardcoded-tuned-constant" not in names(run(body))
+
+
+def test_hardcoded_tuned_constant_flags_arithmetic_literals():
+    # 256 << 20 is still a hand-picked number
+    body = """
+def main(svc):
+    svc.configure(prefix_cache_bytes=256 << 20)
+"""
+    assert "hardcoded-tuned-constant" in names(run(body))
 
 
 def test_raw_pallas_call_exempts_the_kernels_package():
