@@ -17,6 +17,13 @@ Two passes, both free of XLA compilation:
   ``apply`` methods, host clocks/global RNG in traces, and bare ``except``.
   Findings support ``# bigdl: disable=RULE`` suppressions.
 
+- **Concurrency checks** (:mod:`bigdl_tpu.analysis.concur`): compositional
+  lock-discipline inference over the package's own threads — thread-escape
+  roots, lock-guarded attribute inference, a package-wide lock-order graph
+  with deadlock-cycle detection, blocking calls under held locks, and the
+  flag-only signal-handler contract. Same suppression grammar, its own
+  ``[concur]`` namespace in ``tools.check``.
+
 - **Compiled-program checks** (:mod:`bigdl_tpu.analysis.hlo` +
   :mod:`bigdl_tpu.analysis.checks` + :mod:`bigdl_tpu.analysis.programs`):
   a structural parser over lowered/compiled XLA text and a pluggable
@@ -36,6 +43,8 @@ from bigdl_tpu.analysis.lint import (Finding, available_rules, format_text,
                                      lint_paths, lint_source, to_json)
 from bigdl_tpu.analysis.hlo import (HloModule, ProgramFinding, ProgramSpec,
                                     available_checks, parse_hlo, run_checks)
+from bigdl_tpu.analysis.concur import (analyze_paths, analyze_source,
+                                       available_concur_rules)
 
 __all__ = [
     "Diagnostic", "ShapeCheckError", "ShapeReport", "check_module", "spec",
@@ -43,4 +52,5 @@ __all__ = [
     "lint_source", "to_json",
     "HloModule", "ProgramFinding", "ProgramSpec", "available_checks",
     "parse_hlo", "run_checks",
+    "analyze_paths", "analyze_source", "available_concur_rules",
 ]

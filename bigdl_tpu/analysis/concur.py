@@ -1,0 +1,959 @@
+"""Static concurrency analyzer: lock-discipline inference over the package.
+
+The framework runs much of its hot path off the main thread — the
+MicroBatcher dispatch worker, the DecodeLoop continuous-batching driver,
+the AsyncCheckpointWriter, the prefetch stager, fleet replica drivers —
+and the bug class this breeds (silent-hang workers, pin leaks from
+verdicts read outside the lock, torn multi-attribute rebinds) is
+mechanical enough to check statically. This module rides the
+:mod:`bigdl_tpu.analysis.lint` engine primitives (``FileContext`` parent
+links + alias-aware ``canon()``, the ``# bigdl: disable=`` suppression
+grammar, the ``Finding`` record) but registers its own ``[concur]``
+namespace, the way :mod:`bigdl_tpu.analysis.hlo` owns ``[hlo]``.
+
+Compositional, per-class inference in the RacerD style — no whole-program
+may-alias analysis:
+
+* **thread-escape** — a function is an off-main-thread root when it is
+  passed as ``threading.Thread(target=...)``, handed to an executor
+  ``.submit``, installed with ``signal.signal``, or named like a known
+  worker entry point; reachability propagates through intra-class
+  ``self._helper()`` calls and lexical nesting, exactly like the lint
+  engine's traced-context analysis.
+* **guarded-attribute inference** — per class, attributes written under
+  ``with self._lock:`` (in any method outside ``__init__``) are inferred
+  lock-guarded. ``*_locked``-suffixed methods run with the caller holding
+  the lock by convention: their writes infer guardedness and their
+  accesses are exempt.
+* **lock-order graph** — ``with``-acquisitions nested lexically or
+  through resolvable calls (``self.helper()``, ``self.attr.method()``
+  where ``self.attr = SomeClass(...)``) build a directed graph over lock
+  *classes* ``Owner.attr``; cycles are deadlock candidates.
+
+Rules (``--rules`` namespace shared with lint/hlo via
+``python -m bigdl_tpu.tools.check``):
+
+``unguarded-shared-state``  guarded attr touched by an escaping method
+                            outside the lock
+``torn-invariant-write``    partial rebind of a multi-attribute invariant
+                            (attrs always stored together under the lock)
+``lock-order-cycle``        cycle in the package lock-order graph
+``blocking-under-lock``     Future.result / queue get-put / thread.join /
+                            jax.block_until_ready / subprocess /
+                            retry sleeps inside a held-lock region
+``signal-handler-impure``   signal handlers must be flag-only (the PR 12
+                            GraceHandler contract: simple stores or
+                            ``Event.set()``, no locks/IO/jnp)
+
+Suppression is the lint grammar: ``# bigdl: disable=rule`` on (or the
+standalone comment line above) the flagged line, stating the invariant
+that makes the site safe.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass
+from typing import (Callable, Dict, Iterable, Iterator, List, Optional,
+                    Sequence, Set, Tuple)
+
+from bigdl_tpu.analysis.lint import (Finding, FileContext,
+                                     iter_python_files)
+
+__all__ = ["ConcurRule", "concur_rule", "available_concur_rules",
+           "analyze_source", "analyze_paths", "Finding"]
+
+# ------------------------------------------------------------ vocabulary
+
+LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition"}
+EVENT_CTORS = {"threading.Event"}
+THREAD_CTORS = {"threading.Thread"}
+QUEUE_CTORS = {"queue.Queue", "queue.LifoQueue", "queue.PriorityQueue",
+               "queue.SimpleQueue"}
+
+# worker entry points by convention: bodies that run off the main thread
+# even when the Thread(...) construction lives elsewhere
+WORKER_ENTRY_NAMES = frozenset({
+    "_dispatch_loop", "_decode_loop", "_read_loop", "_stage_loop",
+    "_worker_loop", "_supervised", "_worker"})
+
+# container mutations that count as writes for guarded-attr inference
+MUTATORS = frozenset({
+    "append", "appendleft", "add", "clear", "discard", "extend",
+    "insert", "pop", "popitem", "popleft", "remove", "update",
+    "setdefault", "move_to_end", "sort", "reverse"})
+
+# canonical dotted calls that block the calling thread
+BLOCKING_CANON = {
+    "time.sleep", "jax.block_until_ready", "subprocess.run",
+    "subprocess.call", "subprocess.check_call", "subprocess.check_output",
+}
+# suffix match for package-relative imports of the retry/backoff sleeps
+BLOCKING_SUFFIXES = ("faults.retry.retry_call",)
+
+# caller-holds-the-lock convention marker
+HELD_UNKNOWN = "*"
+
+
+# ------------------------------------------------------------- registry
+
+@dataclass
+class ConcurRule:
+    """A registered concurrency rule: ``fn(pkg)`` yields
+    ``(module, node, message)`` findings over the whole package."""
+
+    name: str
+    description: str
+    fn: Callable[["Package"],
+                 Iterator[Tuple["ModuleInfo", ast.AST, str]]]
+
+
+_CONCUR_RULES: Dict[str, ConcurRule] = {}
+
+
+def concur_rule(name: str, description: str):
+    """Decorator registering a concurrency rule under ``name``."""
+    def deco(fn):
+        if name in _CONCUR_RULES:
+            raise ValueError(f"duplicate concur rule {name!r}")
+        _CONCUR_RULES[name] = ConcurRule(name, description, fn)
+        return fn
+    return deco
+
+
+def available_concur_rules() -> List[ConcurRule]:
+    """All registered concurrency rules, sorted by name."""
+    return [_CONCUR_RULES[k] for k in sorted(_CONCUR_RULES)]
+
+
+# ------------------------------------------------------------ class facts
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``self.X`` -> ``"X"`` (plain one-level attribute on self)."""
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _flat_targets(targets: Iterable[ast.AST]) -> Iterator[ast.AST]:
+    for t in targets:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                yield e
+        else:
+            yield t
+
+
+class ClassInfo:
+    """Per-class concurrency facts: lock/event/queue/thread attributes,
+    thread-escaping methods, inferred guarded attributes and the
+    multi-attribute invariant groups written together under one lock."""
+
+    def __init__(self, ctx: FileContext, node: ast.ClassDef, module: str):
+        self.ctx = ctx
+        self.node = node
+        self.module = module
+        self.name = node.name
+        self.methods: Dict[str, ast.AST] = {
+            m.name: m for m in node.body
+            if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        self.lock_attrs: Dict[str, str] = {}
+        self.event_attrs: Set[str] = set()
+        self.queue_attrs: Set[str] = set()
+        self.thread_attrs: Set[str] = set()
+        # self.<attr> = SomeClass(...): canonical class name, for
+        # resolving cross-object lock acquisition in the order graph
+        self.attr_classes: Dict[str, str] = {}
+        self._collect_attr_types()
+        self.escaping: Set[str] = set()   # filled by ModuleInfo
+        self.guarded: Dict[str, str] = {}
+        self.groups: List[Tuple[str, frozenset]] = []
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.module, self.name)
+
+    def _collect_attr_types(self) -> None:
+        for m in self.methods.values():
+            for n in ast.walk(m):
+                if not isinstance(n, (ast.Assign, ast.AnnAssign)):
+                    continue
+                value = n.value
+                if not isinstance(value, ast.Call):
+                    continue
+                canon = self.ctx.canon(value.func)
+                if canon is None:
+                    continue
+                targets = n.targets if isinstance(n, ast.Assign) \
+                    else [n.target]
+                for t in _flat_targets(targets):
+                    attr = _self_attr(t)
+                    if attr is None:
+                        continue
+                    if canon in LOCK_CTORS:
+                        self.lock_attrs[attr] = canon.rsplit(".", 1)[-1]
+                    elif canon in EVENT_CTORS:
+                        self.event_attrs.add(attr)
+                    elif canon in QUEUE_CTORS:
+                        self.queue_attrs.add(attr)
+                    elif canon in THREAD_CTORS:
+                        self.thread_attrs.add(attr)
+                    elif canon[:1].isupper() or "." in canon:
+                        self.attr_classes.setdefault(attr, canon)
+
+    # ---- lexical lock regions -------------------------------------------
+    def with_locks(self, with_node: ast.With) -> List[str]:
+        """Lock attrs of ``self`` acquired by one ``with`` statement."""
+        out = []
+        for item in with_node.items:
+            attr = _self_attr(item.context_expr)
+            if attr is not None and attr in self.lock_attrs:
+                out.append(attr)
+        return out
+
+    def held_locks(self, node: ast.AST, fn: ast.AST) -> Set[str]:
+        """Lock attrs lexically held at ``node`` inside method ``fn``.
+        Stops at nested function boundaries (a closure defined under a
+        lock is not assumed to run under it); ``*_locked`` methods add
+        the :data:`HELD_UNKNOWN` marker (caller holds the lock by
+        convention)."""
+        held: Set[str] = set()
+        cur = self.ctx.parent(node)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            cur = node if node is fn else self.ctx.parent(node)
+        while cur is not None:
+            if isinstance(cur, ast.With):
+                held.update(self.with_locks(cur))
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                if cur is fn and getattr(fn, "name", "").endswith(
+                        "_locked"):
+                    held.add(HELD_UNKNOWN)
+                break
+            cur = self.ctx.parent(cur)
+        return held
+
+    # ---- writes ----------------------------------------------------------
+    def attr_writes(self, node: ast.AST) -> Iterator[
+            Tuple[str, ast.AST, bool]]:
+        """``(attr, site, plain_store)`` for every write of a ``self``
+        attribute under ``node``: rebinds, subscript stores, deletes and
+        known container-mutator calls."""
+        for n in ast.walk(node):
+            targets: List[ast.AST] = []
+            if isinstance(n, ast.Assign):
+                targets = list(_flat_targets(n.targets))
+            elif isinstance(n, ast.AugAssign):
+                targets = [n.target]
+            elif isinstance(n, ast.AnnAssign) and n.value is not None:
+                targets = [n.target]
+            elif isinstance(n, ast.Delete):
+                targets = list(_flat_targets(n.targets))
+            for t in targets:
+                attr = _self_attr(t)
+                if attr is not None:
+                    yield attr, n, not isinstance(n, ast.Delete)
+                elif isinstance(t, ast.Subscript):
+                    attr = _self_attr(t.value)
+                    if attr is not None:
+                        yield attr, n, False
+            if isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr in MUTATORS:
+                attr = _self_attr(n.func.value)
+                if attr is not None:
+                    yield attr, n, False
+
+    def infer_guarded(self) -> None:
+        """Infer lock-guarded attributes and invariant groups. ``__init__``
+        writes are exempt (happens-before thread start); lock/event/queue/
+        thread handles are lifecycle state, never inferred guarded."""
+        handles = (set(self.lock_attrs) | self.event_attrs
+                   | self.queue_attrs | self.thread_attrs)
+        sole_lock = next(iter(self.lock_attrs)) \
+            if len(self.lock_attrs) == 1 else None
+        for name, m in self.methods.items():
+            if name in ("__init__", "__new__"):
+                continue
+            for attr, site, _plain in self.attr_writes(m):
+                if attr in handles or attr in self.guarded:
+                    continue
+                held = self.held_locks(site, m)
+                real = [h for h in held if h != HELD_UNKNOWN]
+                if real:
+                    self.guarded[attr] = real[0]
+                elif HELD_UNKNOWN in held and sole_lock is not None:
+                    self.guarded[attr] = sole_lock
+        # invariant groups: attrs PLAIN-stored together in one with-block
+        seen: Set[Tuple[str, frozenset]] = set()
+        for name, m in self.methods.items():
+            if name in ("__init__", "__new__"):
+                continue
+            for w in ast.walk(m):
+                if not isinstance(w, ast.With):
+                    continue
+                locks = self.with_locks(w)
+                if not locks:
+                    continue
+                stored = frozenset(
+                    attr for attr, _site, plain in self.attr_writes(w)
+                    if plain and attr not in handles)
+                if len(stored) >= 2:
+                    key = (locks[0], stored)
+                    if key not in seen:
+                        seen.add(key)
+                        self.groups.append(key)
+
+
+# ----------------------------------------------------------- module facts
+
+def _module_name(path: str) -> str:
+    parts = os.path.normpath(path).split(os.sep)
+    if "bigdl_tpu" in parts:
+        parts = parts[parts.index("bigdl_tpu"):]
+        if parts[-1].endswith(".py"):
+            parts[-1] = parts[-1][:-3]
+        if parts[-1] == "__init__":
+            parts.pop()
+        return ".".join(parts)
+    base = os.path.basename(path)
+    return base[:-3] if base.endswith(".py") else base
+
+
+class ModuleInfo:
+    """One parsed file: its classes, thread-escape roots and the signal
+    handlers installed from it."""
+
+    def __init__(self, ctx: FileContext):
+        self.ctx = ctx
+        self.path = ctx.path
+        self.module = _module_name(ctx.path)
+        self.classes: Dict[str, ClassInfo] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                self.classes[node.name] = ClassInfo(ctx, node, self.module)
+        self._defs: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._defs.setdefault(node.name, []).append(node)
+        self.signal_handlers: List[ast.AST] = []
+        self.escaping_ids: Set[int] = set()
+        self._find_escape_roots()
+        self._propagate_escape()
+        for ci in self.classes.values():
+            ci.escaping = {name for name, m in ci.methods.items()
+                           if id(m) in self.escaping_ids}
+            ci.infer_guarded()
+        # names bound to bare lock constructions anywhere in the file
+        # (module-level / function-local locks, for blocking-under-lock)
+        self.lock_names: Set[str] = set()
+        for n in ast.walk(ctx.tree):
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call) \
+                    and ctx.canon(n.value.func) in LOCK_CTORS:
+                for t in _flat_targets(n.targets):
+                    if isinstance(t, ast.Name):
+                        self.lock_names.add(t.id)
+
+    # ---- thread-escape analysis -----------------------------------------
+    def _mark(self, arg: ast.AST, cls: Optional[ClassInfo],
+              handler: bool = False) -> None:
+        """Mark the function behind ``arg`` (a Name, ``self.method`` or
+        lambda) as an off-main-thread root."""
+        fns: List[ast.AST] = []
+        if isinstance(arg, ast.Lambda):
+            fns = [arg]
+        elif isinstance(arg, ast.Name):
+            fns = self._defs.get(arg.id, [])
+        else:
+            attr = _self_attr(arg)
+            if attr is not None:
+                if cls is not None and attr in cls.methods:
+                    fns = [cls.methods[attr]]
+                else:  # self.X outside a resolvable class: any match
+                    for ci in self.classes.values():
+                        if attr in ci.methods:
+                            fns.append(ci.methods[attr])
+        for fn in fns:
+            self.escaping_ids.add(id(fn))
+            if handler:
+                self.signal_handlers.append(fn)
+
+    def _enclosing_class(self, node: ast.AST) -> Optional[ClassInfo]:
+        cls = self.ctx.enclosing(node, ast.ClassDef)
+        return self.classes.get(cls.name) if cls is not None else None
+
+    def _find_escape_roots(self) -> None:
+        for call in self.ctx.walk(ast.Call):
+            canon = self.ctx.canon(call.func)
+            cls = self._enclosing_class(call)
+            if canon in THREAD_CTORS:
+                for kw in call.keywords:
+                    if kw.arg == "target":
+                        self._mark(kw.value, cls)
+            elif canon == "signal.signal" and len(call.args) >= 2:
+                self._mark(call.args[1], cls, handler=True)
+            elif isinstance(call.func, ast.Attribute) \
+                    and call.func.attr == "submit" and call.args:
+                # executor.submit(fn, ...): only when the first argument
+                # resolves to a function in this file (data submits to
+                # e.g. MicroBatcher.submit stay invisible)
+                first = call.args[0]
+                if isinstance(first, (ast.Name, ast.Lambda)) \
+                        or _self_attr(first) is not None:
+                    self._mark(first, cls)
+        for ci in self.classes.values():
+            for name, m in ci.methods.items():
+                if name in WORKER_ENTRY_NAMES:
+                    self.escaping_ids.add(id(m))
+
+    def _propagate_escape(self) -> None:
+        """Fixpoint: lexical nesting + intra-class self-calls, the same
+        propagation shape as the lint engine's traced-context set."""
+        changed = True
+        while changed:
+            changed = False
+            for node in self.ctx.walk(ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.Lambda):
+                if id(node) in self.escaping_ids:
+                    continue
+                cur = self.ctx.parent(node)
+                while cur is not None:
+                    if isinstance(cur, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef,
+                                        ast.Lambda)) \
+                            and id(cur) in self.escaping_ids:
+                        self.escaping_ids.add(id(node))
+                        changed = True
+                        break
+                    cur = self.ctx.parent(cur)
+            for ci in self.classes.values():
+                for m in ci.methods.values():
+                    if id(m) not in self.escaping_ids:
+                        continue
+                    for call in ast.walk(m):
+                        if not isinstance(call, ast.Call):
+                            continue
+                        attr = _self_attr(call.func)
+                        callee = ci.methods.get(attr) if attr else None
+                        if callee is not None \
+                                and id(callee) not in self.escaping_ids:
+                            self.escaping_ids.add(id(callee))
+                            changed = True
+
+
+# -------------------------------------------------------------- package
+
+LockId = Tuple[str, str, str]  # (module, class, lock attr)
+
+
+def _lock_label(lid: LockId) -> str:
+    return f"{lid[1]}.{lid[2]}"
+
+
+class Package:
+    """All modules under analysis + cross-module class resolution and the
+    lock-order graph (computed lazily)."""
+
+    def __init__(self, modules: List[ModuleInfo]):
+        self.modules = modules
+        self.by_canon: Dict[str, ClassInfo] = {}
+        by_name: Dict[str, List[ClassInfo]] = {}
+        for mi in modules:
+            for ci in mi.classes.values():
+                self.by_canon[f"{ci.module}.{ci.name}"] = ci
+                by_name.setdefault(ci.name, []).append(ci)
+        # bare-name resolution only when unambiguous package-wide
+        self.by_name: Dict[str, ClassInfo] = {
+            n: cis[0] for n, cis in by_name.items() if len(cis) == 1}
+        self._summaries: Optional[Dict[Tuple[Tuple[str, str], str],
+                                       Set[LockId]]] = None
+
+    def resolve_class(self, canon: str) -> Optional[ClassInfo]:
+        ci = self.by_canon.get(canon)
+        if ci is not None:
+            return ci
+        return self.by_name.get(canon.rsplit(".", 1)[-1])
+
+    def _callee(self, ci: ClassInfo, call: ast.Call) \
+            -> Optional[Tuple[Tuple[str, str], str]]:
+        """Resolve ``self.m()`` and ``self.attr.m()`` call targets to a
+        ``(class key, method)`` summary key."""
+        func = call.func
+        if not isinstance(func, ast.Attribute):
+            return None
+        attr = _self_attr(func)
+        if attr is not None:
+            return (ci.key, attr) if attr in ci.methods else None
+        inner = _self_attr(func.value)
+        if inner is not None and inner in ci.attr_classes:
+            target = self.resolve_class(ci.attr_classes[inner])
+            if target is not None and func.attr in target.methods:
+                return (target.key, func.attr)
+        return None
+
+    def summaries(self) -> Dict[Tuple[Tuple[str, str], str], Set[LockId]]:
+        """Fixpoint ``(class, method) -> lock classes acquired``,
+        transitively through resolvable calls — the compositional
+        summary the lock-order graph is built from."""
+        if self._summaries is not None:
+            return self._summaries
+        summ: Dict[Tuple[Tuple[str, str], str], Set[LockId]] = {}
+        all_methods = [(mi, ci, name, m) for mi in self.modules
+                       for ci in mi.classes.values()
+                       for name, m in ci.methods.items()]
+        for _mi, ci, name, m in all_methods:
+            acquired: Set[LockId] = set()
+            for w in ast.walk(m):
+                if isinstance(w, ast.With):
+                    for lock in ci.with_locks(w):
+                        acquired.add((ci.module, ci.name, lock))
+            summ[(ci.key, name)] = acquired
+        changed = True
+        while changed:
+            changed = False
+            for _mi, ci, name, m in all_methods:
+                s = summ[(ci.key, name)]
+                for call in ast.walk(m):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    key = self._callee(ci, call)
+                    if key is not None and key in summ:
+                        extra = summ[key] - s
+                        if extra:
+                            s |= extra
+                            changed = True
+        self._summaries = summ
+        return summ
+
+    def lock_edges(self) -> Dict[Tuple[LockId, LockId],
+                                 Tuple[ModuleInfo, ast.AST]]:
+        """Directed lock-order edges ``held -> acquired`` with one
+        witness site each: lexically nested ``with`` blocks plus calls
+        made under a held lock whose summary acquires other locks."""
+        summ = self.summaries()
+        edges: Dict[Tuple[LockId, LockId],
+                    Tuple[ModuleInfo, ast.AST]] = {}
+
+        def add(src: LockId, dst: LockId, mi: ModuleInfo,
+                node: ast.AST) -> None:
+            if src != dst:
+                edges.setdefault((src, dst), (mi, node))
+
+        for mi in self.modules:
+            for ci in mi.classes.values():
+                for m in ci.methods.values():
+                    self._walk_edges(mi, ci, m, m, [], add, summ)
+        return edges
+
+    def _walk_edges(self, mi, ci, fn, node, held, add, summ) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                # a closure starts with no lexically held locks
+                self._walk_edges(mi, ci, fn, child, [], add, summ)
+                continue
+            inner = held
+            if isinstance(child, ast.With):
+                acquired = [(ci.module, ci.name, lock)
+                            for lock in ci.with_locks(child)]
+                for h in held:
+                    for a in acquired:
+                        add(h, a, mi, child)
+                inner = held + acquired
+            if isinstance(child, ast.Call) and held:
+                key = self._callee(ci, child)
+                if key is not None:
+                    for dst in summ.get(key, ()):
+                        if dst not in held:
+                            for h in held:
+                                add(h, dst, mi, child)
+            self._walk_edges(mi, ci, fn, child, inner, add, summ)
+
+
+def _find_cycles(edges: Dict[Tuple[LockId, LockId], object]) \
+        -> List[List[LockId]]:
+    """Distinct simple cycles in the lock-order graph (one per cyclic
+    strongly-connected region, canonicalized by rotation)."""
+    adj: Dict[LockId, List[LockId]] = {}
+    for (src, dst) in edges:
+        adj.setdefault(src, []).append(dst)
+        adj.setdefault(dst, [])
+    cycles: List[List[LockId]] = []
+    seen: Set[Tuple[LockId, ...]] = set()
+    for start in sorted(adj):
+        stack: List[Tuple[LockId, List[LockId]]] = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in sorted(adj.get(node, ())):
+                if nxt == start and len(path) >= 1 and len(path) <= 8:
+                    i = path.index(min(path))
+                    canonical = tuple(path[i:] + path[:i])
+                    if canonical not in seen:
+                        seen.add(canonical)
+                        cycles.append(list(canonical))
+                elif nxt not in path and len(path) < 8 and nxt > start:
+                    stack.append((nxt, path + [nxt]))
+    return cycles
+
+
+# ---------------------------------------------------------------- rules
+
+def _escaping_checked_methods(ci: ClassInfo) -> Iterator[
+        Tuple[str, ast.AST]]:
+    """Escaping methods whose bodies are subject to the unlocked-access
+    rules: ``__init__`` (happens-before thread start) and
+    ``*_locked``-suffixed methods (caller holds the lock) are exempt."""
+    for name in sorted(ci.escaping):
+        if name in ("__init__", "__new__") or name.endswith("_locked"):
+            continue
+        yield name, ci.methods[name]
+
+
+@concur_rule("unguarded-shared-state",
+             "lock-guarded attribute accessed off-thread without the lock")
+def unguarded_shared_state(pkg: "Package"):
+    for mi in pkg.modules:
+        for ci in mi.classes.values():
+            if not ci.lock_attrs or not ci.guarded or not ci.escaping:
+                continue
+            for mname, m in _escaping_checked_methods(ci):
+                for node in ast.walk(m):
+                    attr = _self_attr(node)
+                    if attr is None or attr not in ci.guarded:
+                        continue
+                    lock = ci.guarded[attr]
+                    held = ci.held_locks(node, m)
+                    if lock in held or HELD_UNKNOWN in held:
+                        continue
+                    yield mi, node, (
+                        f"`self.{attr}` is guarded by `self.{lock}` "
+                        f"(written under it elsewhere in {ci.name}) but "
+                        f"`{mname}` runs off the main thread and touches "
+                        f"it without the lock; wrap the access in `with "
+                        f"self.{lock}:` or add `# bigdl: disable="
+                        f"unguarded-shared-state` stating the invariant")
+
+
+@concur_rule("torn-invariant-write",
+             "partial rebind of a multi-attribute lock invariant")
+def torn_invariant_write(pkg: "Package"):
+    for mi in pkg.modules:
+        for ci in mi.classes.values():
+            if not ci.groups:
+                continue
+            # (a) an escaping method rebinds part of an invariant group
+            # outside the lock: readers can observe the torn pair
+            for mname, m in _escaping_checked_methods(ci):
+                for stmt in ast.walk(m):
+                    if not isinstance(stmt, (ast.Assign, ast.AugAssign,
+                                             ast.AnnAssign)):
+                        continue
+                    targets = stmt.targets \
+                        if isinstance(stmt, ast.Assign) else [stmt.target]
+                    wrote = {a for a in
+                             (_self_attr(t)
+                              for t in _flat_targets(targets))
+                             if a is not None}
+                    if not wrote:
+                        continue
+                    if ci.held_locks(stmt, m):
+                        continue
+                    for lock, group in ci.groups:
+                        part = wrote & group
+                        if part and part < group:
+                            missing = ", ".join(sorted(group - part))
+                            yield mi, stmt, (
+                                f"partial unlocked write of invariant "
+                                f"({', '.join(sorted(group))}) — "
+                                f"{ci.name} stores these together under "
+                                f"`self.{lock}`; rebinding only "
+                                f"{', '.join(sorted(part))} (not "
+                                f"{missing}) lets readers see a torn "
+                                f"pair; rebind atomically under the "
+                                f"lock")
+            # (b) one method splits an invariant group across separate
+            # lock acquisitions: the window between them is a torn state
+            for mname, m in ci.methods.items():
+                if mname in ("__init__", "__new__"):
+                    continue
+                blocks: List[Tuple[ast.With, Set[str]]] = []
+                for w in ast.walk(m):
+                    if isinstance(w, ast.With) and ci.with_locks(w):
+                        stored = {a for a, _s, plain in ci.attr_writes(w)
+                                  if plain}
+                        blocks.append((w, stored))
+                for lock, group in ci.groups:
+                    hits = [(w, s & group) for w, s in blocks if s & group]
+                    union: Set[str] = set()
+                    for _w, s in hits:
+                        union |= s
+                    if len(hits) >= 2 and len(union) >= 2 \
+                            and not any(s == group for _w, s in hits):
+                        yield mi, hits[1][0], (
+                            f"invariant ({', '.join(sorted(group))}) "
+                            f"updated across separate `with self.{lock}:`"
+                            f" blocks in `{mname}`; the window between "
+                            f"acquisitions exposes a torn state — "
+                            f"update the group under one acquisition")
+
+
+@concur_rule("lock-order-cycle",
+             "cycle in the package-wide lock acquisition-order graph")
+def lock_order_cycle(pkg: "Package"):
+    edges = pkg.lock_edges()
+    for cycle in _find_cycles(edges):
+        ring = cycle + [cycle[0]]
+        legs = []
+        witness_mi: Optional[ModuleInfo] = None
+        witness_node: Optional[ast.AST] = None
+        for src, dst in zip(ring, ring[1:]):
+            mi, node = edges[(src, dst)]
+            if witness_mi is None:
+                witness_mi, witness_node = mi, node
+            legs.append(f"{_lock_label(src)} -> {_lock_label(dst)} "
+                        f"({mi.path}:{getattr(node, 'lineno', 1)})")
+        assert witness_mi is not None and witness_node is not None
+        yield witness_mi, witness_node, (
+            "lock-order cycle: " + "; ".join(legs)
+            + " — threads taking these locks in different orders can "
+              "deadlock; pick one global order")
+
+
+def _call_desc(ctx: FileContext, call: ast.Call) -> str:
+    canon = ctx.canon(call.func)
+    if canon:
+        return canon
+    if isinstance(call.func, ast.Attribute):
+        return f".{call.func.attr}"
+    return "<call>"
+
+
+def _local_assigned_from(ctx: FileContext, fn: ast.AST, name: str,
+                         ctors: Set[str]) -> bool:
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call) \
+                and ctx.canon(n.value.func) in ctors:
+            for t in _flat_targets(n.targets):
+                if isinstance(t, ast.Name) and t.id == name:
+                    return True
+    return False
+
+
+@concur_rule("blocking-under-lock",
+             "blocking call (future/queue/join/sync/sleep) in a "
+             "held-lock region")
+def blocking_under_lock(pkg: "Package"):
+    for mi in pkg.modules:
+        ctx = mi.ctx
+        for ci in mi.classes.values():
+            if not ci.lock_attrs:
+                continue
+            for mname, m in ci.methods.items():
+                for call in ast.walk(m):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    held = ci.held_locks(call, m)
+                    if not held:
+                        continue
+                    reason = _blocking_reason(mi, ci, m, call, held)
+                    if reason is None:
+                        continue
+                    real = sorted(h for h in held if h != HELD_UNKNOWN)
+                    where = f"under `with self.{real[0]}:`" if real else \
+                        "in a `*_locked` method (caller holds the lock)"
+                    yield mi, call, (
+                        f"{reason} {where} blocks every thread waiting "
+                        f"on the lock; move it outside the held region")
+
+
+def _blocking_reason(mi: ModuleInfo, ci: ClassInfo, fn: ast.AST,
+                     call: ast.Call, held: Set[str]) -> Optional[str]:
+    ctx = mi.ctx
+    canon = ctx.canon(call.func)
+    if canon in BLOCKING_CANON or (
+            canon and canon.endswith(BLOCKING_SUFFIXES)):
+        return f"blocking call `{canon}(...)`"
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    attr = call.func.attr
+    recv = call.func.value
+    recv_attr = _self_attr(recv)
+    if attr == "result":
+        return "`Future.result()`"
+    if attr in ("get", "put"):
+        blocked = True
+        for kw in call.keywords:
+            if kw.arg == "block" and isinstance(kw.value, ast.Constant) \
+                    and kw.value.value is False:
+                blocked = False
+        is_queue = (recv_attr in ci.queue_attrs) or (
+            isinstance(recv, ast.Name) and _local_assigned_from(
+                ctx, fn, recv.id, QUEUE_CTORS))
+        if is_queue and blocked:
+            return f"blocking `queue.{attr}()`"
+        return None
+    if attr == "join":
+        is_thread = (recv_attr in ci.thread_attrs) or (
+            isinstance(recv, ast.Name) and _local_assigned_from(
+                ctx, fn, recv.id, THREAD_CTORS))
+        if is_thread or (recv_attr or "").endswith("thread") \
+                or (isinstance(recv, ast.Name)
+                    and recv.id.endswith("thread")):
+            return "`thread.join()`"
+        if recv_attr in ci.queue_attrs:
+            return "`queue.join()`"
+        return None
+    if attr in ("wait", "wait_for"):
+        if recv_attr is not None and recv_attr in held:
+            return None  # cond.wait() on the HELD condition releases it
+        if recv_attr in ci.event_attrs or (
+                isinstance(recv, ast.Name) and _local_assigned_from(
+                    ctx, fn, recv.id, EVENT_CTORS)):
+            return f"`Event.{attr}()`"
+        if recv_attr is not None and \
+                ci.lock_attrs.get(recv_attr) == "Condition":
+            return f"`Condition.{attr}()` on a condition this region " \
+                   "does not hold"
+        if canon and canon.startswith("subprocess."):
+            return f"`{canon}(...)`"
+        return None
+    if attr in ("communicate",) and canon is None:
+        return "`Popen.communicate()`" \
+            if (recv_attr or "").startswith(("proc", "_proc")) or (
+                isinstance(recv, ast.Name)
+                and recv.id.startswith("proc")) else None
+    if attr == "block_until_ready":
+        return "`.block_until_ready()`"
+    return None
+
+
+_ALLOWED_VALUES = (ast.Constant, ast.Name, ast.Attribute)
+
+
+def _handler_stmt_ok(stmt: ast.stmt) -> bool:
+    if isinstance(stmt, (ast.Pass, ast.Global, ast.Nonlocal)):
+        return True
+    if isinstance(stmt, ast.Return):
+        return stmt.value is None \
+            or isinstance(stmt.value, _ALLOWED_VALUES)
+    if isinstance(stmt, ast.Expr):
+        v = stmt.value
+        if isinstance(v, ast.Constant):  # docstring
+            return True
+        return (isinstance(v, ast.Call)
+                and isinstance(v.func, ast.Attribute)
+                and v.func.attr == "set"
+                and not v.args and not v.keywords)
+    if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+        value = stmt.value
+        if value is None:
+            return True
+        if isinstance(value, ast.Tuple):
+            return all(isinstance(e, _ALLOWED_VALUES)
+                       for e in value.elts)
+        return isinstance(value, _ALLOWED_VALUES)
+    if isinstance(stmt, ast.If):
+        return _expr_call_free(stmt.test) \
+            and all(_handler_stmt_ok(s) for s in stmt.body) \
+            and all(_handler_stmt_ok(s) for s in stmt.orelse)
+    return False
+
+
+def _expr_call_free(expr: ast.AST) -> bool:
+    return not any(isinstance(n, ast.Call) for n in ast.walk(expr))
+
+
+@concur_rule("signal-handler-impure",
+             "signal handler does more than set a flag (GraceHandler "
+             "contract)")
+def signal_handler_impure(pkg: "Package"):
+    for mi in pkg.modules:
+        for fn in mi.signal_handlers:
+            name = getattr(fn, "name", "<lambda>")
+            if isinstance(fn, ast.Lambda):
+                body = fn.body
+                ok = (isinstance(body, ast.Call)
+                      and isinstance(body.func, ast.Attribute)
+                      and body.func.attr == "set"
+                      and not body.args and not body.keywords) \
+                    or isinstance(body, _ALLOWED_VALUES)
+                if not ok:
+                    yield mi, fn, (
+                        "signal handler lambda must only set a flag "
+                        "(`event.set()`); anything else — locks, IO, "
+                        "jnp, telemetry — is unsafe at interrupt time")
+                continue
+            for stmt in fn.body:
+                if not _handler_stmt_ok(stmt):
+                    yield mi, stmt, (
+                        f"signal handler `{name}` must be flag-only "
+                        f"(simple stores or `event.set()`); this "
+                        f"statement can deadlock or re-enter at "
+                        f"interrupt time — set a flag here and act on "
+                        f"it from the main loop")
+
+
+# --------------------------------------------------------------- engine
+
+def _run(pkg: Package,
+         rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    if rules:
+        unknown = [r for r in rules if r not in _CONCUR_RULES]
+        if unknown:
+            raise KeyError(unknown[0])
+        selected = [_CONCUR_RULES[r] for r in rules]
+    else:
+        selected = available_concur_rules()
+    findings: List[Finding] = []
+    seen = set()
+    for r in selected:
+        for mi, node, message in r.fn(pkg):
+            line = getattr(node, "lineno", 1)
+            col = getattr(node, "col_offset", 0)
+            key = (r.name, mi.path, line, col, message)
+            if key in seen:
+                continue
+            seen.add(key)
+            on_line = mi.ctx.line_disables.get(line, set())
+            suppressed = (r.name in mi.ctx.file_disables
+                          or "all" in mi.ctx.file_disables
+                          or r.name in on_line or "all" in on_line)
+            findings.append(Finding(r.name, mi.path, line, col, message,
+                                    suppressed))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def analyze_source(source: str, path: str = "<string>",
+                   rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Analyze one source string (single-module package view)."""
+    try:
+        ctx = FileContext(source, path)
+    except SyntaxError as e:
+        return [Finding("parse-error", path, e.lineno or 1, 0,
+                        f"could not parse: {e.msg}")]
+    return _run(Package([ModuleInfo(ctx)]), rules)
+
+
+def analyze_paths(paths: Iterable[str],
+                  rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Analyze every .py file under ``paths`` as ONE package — the
+    lock-order graph spans files; unknown rule names raise KeyError."""
+    modules: List[ModuleInfo] = []
+    findings: List[Finding] = []
+    for fp in iter_python_files(paths):
+        with open(fp, encoding="utf-8") as f:
+            source = f.read()
+        try:
+            ctx = FileContext(source, fp)
+        except SyntaxError as e:
+            findings.append(Finding("parse-error", fp, e.lineno or 1, 0,
+                                    f"could not parse: {e.msg}"))
+            continue
+        modules.append(ModuleInfo(ctx))
+    findings.extend(_run(Package(modules), rules))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

@@ -102,31 +102,42 @@ class FleetStream(TokenStream):
         self._replica = None
         self._inner: Optional[TokenStream] = None
         self._pending: Dict[int, int] = {}
+        # serializes the dedup window below: during a re-route the NEW
+        # replica's driver thread delivers tokens concurrently with the
+        # death-callback thread's attach-replay of the OLD stream's
+        # tokens; the check-then-push must be atomic or a replayed
+        # token can slip in twice (lock order: _route_lock -> _cond,
+        # nothing under _cond calls back into the router layer)
+        self._route_lock = threading.Lock()
 
     # --------------------------------------------------- observer side
     def _bind(self, replica, inner: TokenStream) -> None:
-        self._replica = replica
-        self._inner = inner
+        with self._route_lock:
+            self._replica = replica
+            self._inner = inner
+        # attach OUTSIDE the lock: the replay it triggers re-enters
+        # on_token, which takes _route_lock itself
         inner._attach(self)
 
     def on_token(self, i: int, token: int) -> None:
         """Inner-stream token (replayed tokens after a re-route arrive
         again with their original indices and are dropped here)."""
-        with self._cond:
-            have = len(self._tokens)
-        if i < have:
-            return  # deterministic replay of a token we already hold
-        if i > have:
-            self._pending[i] = token  # attach-replay racing a push
-            return
-        self._push(token)
-        nxt = len(self.tokens())
-        while nxt in self._pending:
-            self._push(self._pending.pop(nxt))
-            nxt += 1
+        with self._route_lock:
+            have = len(self.tokens())
+            if i < have:
+                return  # deterministic replay of a token we already hold
+            if i > have:
+                self._pending[i] = token  # attach-replay racing a push
+                return
+            self._push(token)
+            nxt = len(self.tokens())
+            while nxt in self._pending:
+                self._push(self._pending.pop(nxt))
+                nxt += 1
 
     def on_finish(self, reason: str) -> None:
-        inner = self._inner
+        with self._route_lock:
+            inner = self._inner
         if inner is not None:
             # flush any tokens the observer hasn't seen yet (attach
             # raced the final pushes)

@@ -432,7 +432,11 @@ class DecodeLoop:
 
     # ---------------------------------------------------- decode step
     def _decode_step(self) -> None:
-        for key, group in list(self._groups.items()):
+        with self._cond:
+            # snapshot under the lock: submit/_admit mutate the group
+            # map concurrently with this driver-thread sweep
+            groups = list(self._groups.items())
+        for key, group in groups:
             if not group.gens:
                 # an old version's slots drained after a hot-swap (or
                 # traffic paused): release its cache

@@ -32,7 +32,7 @@ import threading
 import time
 from collections import deque
 from concurrent.futures import Future
-from typing import Callable, Deque, List, Optional
+from typing import Callable, Deque, Dict, List, Optional
 
 import numpy as np
 
@@ -189,6 +189,29 @@ class BatcherStats:
     def on_depth(self, depth: int) -> None:
         """Publish the current queue depth."""
         self._g_depth.set(depth, **self._labels)
+
+    # -- consistent multi-counter reads ----------------------------------
+    def snapshot(self) -> Dict[str, object]:
+        """One consistent view of the whole counter family, read under
+        ``self.lock``. The bare properties below are each internally
+        consistent (their instrument lock suffices) but can tear ACROSS
+        counters — a writer like :meth:`on_batch` may land between two
+        property reads, so derived ratios (``fill_sum / batches``,
+        padded-row ratio) must come from here."""
+        with self.lock:
+            return {
+                "requests": self.requests, "rows": self.rows,
+                "rejected": self.rejected, "timed_out": self.timed_out,
+                "errors": self.errors,
+                "failed_batches": self.failed_batches,
+                "worker_restarts": self.worker_restarts,
+                "worker_failed": self.worker_failed,
+                "batches": self.batches,
+                "batched_rows": self.batched_rows,
+                "padded_rows": self.padded_rows,
+                "fill_sum": self.fill_sum,
+                "latencies_ms": list(self.latencies_ms),
+            }
 
     # -- legacy read surface ---------------------------------------------
     def _count(self, c) -> int:
@@ -472,7 +495,10 @@ class MicroBatcher:
                 self.stats.on_depth(len(self._queue))
             if batch:
                 self._dispatch(batch, rows)
-            self._inflight = []
+            with self._cond:
+                # cleared under the lock: the supervisor's crash-path
+                # rebind of _inflight must never race this one
+                self._inflight = []
 
     def _request_tracks(self, batch: List[_Request], t_dispatch: float,
                         t_done: float, rows: int, bucket: int) -> None:

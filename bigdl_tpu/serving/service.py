@@ -258,21 +258,22 @@ class InferenceService:
             "shed": 0, "worker_restarts": 0, "failed_batches": 0,
         }
         if b is not None:
-            st = b.stats
-            with st.lock:
-                lat = list(st.latencies_ms)
-                out.update(
-                    request_count=st.requests, rows=st.rows,
-                    rejected=st.rejected, timed_out=st.timed_out,
-                    errors=st.errors, batch_count=st.batches,
-                    worker_restarts=st.worker_restarts,
-                    failed_batches=st.failed_batches,
-                    batch_fill=(st.fill_sum / st.batches
-                                if st.batches else 0.0),
-                    padded_row_ratio=(
-                        st.padded_rows /
-                        (st.batched_rows + st.padded_rows)
-                        if st.batched_rows + st.padded_rows else 0.0))
+            # one locked multi-counter view: the derived ratios below
+            # must not mix counters from different instants
+            st = b.stats.snapshot()
+            lat = st["latencies_ms"]
+            out.update(
+                request_count=st["requests"], rows=st["rows"],
+                rejected=st["rejected"], timed_out=st["timed_out"],
+                errors=st["errors"], batch_count=st["batches"],
+                worker_restarts=st["worker_restarts"],
+                failed_batches=st["failed_batches"],
+                batch_fill=(st["fill_sum"] / st["batches"]
+                            if st["batches"] else 0.0),
+                padded_row_ratio=(
+                    st["padded_rows"] /
+                    (st["batched_rows"] + st["padded_rows"])
+                    if st["batched_rows"] + st["padded_rows"] else 0.0))
             out["queue_depth"] = b.queue_depth()
             out["shed"] = int(self._c_shed.value(model=name))
             for k, v in percentile_summary(lat, (50, 99)).items():

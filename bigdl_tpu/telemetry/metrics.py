@@ -265,7 +265,15 @@ class MetricsRegistry:
     def snapshot(self) -> List[dict]:
         """Point-in-time dump every exporter renders from: one row per
         instrument with per-label-set values (histograms carry count,
-        sum and the percentile digest)."""
+        sum and the percentile digest).
+
+        Locking contract (audited against concurrent get-or-create):
+        the instrument map is copied under the registry ``_lock`` —
+        the same lock :meth:`_get` creates under — then each
+        instrument's series are read under that instrument's own lock
+        (``series_snapshot`` reads a histogram's count/sum/reservoir
+        in ONE acquisition, so a row is never torn). An instrument
+        registered after the copy simply lands in the next snapshot."""
         with self._lock:
             instruments = [self._instruments[n]
                            for n in sorted(self._instruments)]

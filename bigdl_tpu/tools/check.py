@@ -1,11 +1,13 @@
-"""Static analysis CLI: lint + zoo shape check + telemetry audit +
-compiled-program verification.
+"""Static analysis CLI: lint + concurrency checks + zoo shape check +
+telemetry audit + compiled-program verification.
 
     python -m bigdl_tpu.tools.check [paths...]   # the FULL gate
         --lint-only | --shapes-only              # one source pass
+        --concurrency                            # concurrency checks only
         --programs                               # HLO program checks only
         --telemetry-audit                        # instrument-name gate only
-        --rules r1,r2                            # restrict lint rules AND
+        --rules r1,r2                            # restrict lint rules,
+                                                 # concurrency rules AND
                                                  # HLO checks (one namespace;
                                                  # a full-gate pass with no
                                                  # named rule of its kind is
@@ -19,9 +21,15 @@ name resolves to its directory), so ``python -m bigdl_tpu.tools.check
 bigdl_tpu`` is the repository's self-run gate (tests/test_lint_self.py +
 tests/test_check_self.py enforce it stays clean).
 
-With no mode flag the CLI runs **all four passes** — AST lint, the
-whole-zoo symbolic shape pass, the telemetry instrument-name audit and
-the compiled-program verifier — the one-command pre-flight gate.
+With no mode flag the CLI runs **all five passes** — AST lint, the
+static concurrency analyzer, the whole-zoo symbolic shape pass, the
+telemetry instrument-name audit and the compiled-program verifier —
+the one-command pre-flight gate.
+
+The ``--concurrency`` pass (:mod:`bigdl_tpu.analysis.concur`) infers
+lock-guarded attributes and thread-escape roots per class, builds the
+package-wide lock-order graph and enforces the flag-only
+signal-handler contract (docs/analysis.md "Concurrency checks").
 
 The shape pass walks every model-zoo family under ``jax.eval_shape``
 with a symbolic batch dimension — zero FLOPs, zero compiles. The
@@ -36,7 +44,7 @@ precision islands, HBM budget; see docs/analysis.md
 Exit codes (every mode):
 
     0   clean — no unsuppressed findings / violations
-    1   findings (lint, shape, audit or program checks)
+    1   findings (lint, concurrency, shape, audit or program checks)
     2   usage error, unknown rule/check, or internal failure
 """
 from __future__ import annotations
@@ -241,25 +249,53 @@ def run_programs_pass(as_json: bool, checks=None, show_suppressed=False):
     return (1 if active else 0), payload
 
 
+def run_concur_pass(paths, as_json: bool, rules=None,
+                    show_suppressed=False):
+    """--concurrency: the static concurrency analyzer over ``paths``
+    as one package (the lock-order graph spans files). Returns
+    ``(rc, findings-as-dicts)`` — rc 0 clean, 1 unsuppressed findings,
+    2 unknown rule."""
+    from bigdl_tpu.analysis.concur import analyze_paths
+    try:
+        findings = analyze_paths(paths, rules=rules)
+    except KeyError as e:
+        print(f"unknown concurrency rule {e}", file=sys.stderr)
+        return 2, []
+    active = [f for f in findings if not f.suppressed]
+    if not as_json:
+        for f in findings:
+            if show_suppressed or not f.suppressed:
+                print(f.format())
+        muted = len(findings) - len(active)
+        print(f"concurrency pass: {len(active)} finding"
+              f"{'s' if len(active) != 1 else ''} ({muted} suppressed)")
+    return (1 if active else 0), [f.to_dict() for f in findings]
+
+
 def split_rules(names):
-    """One ``--rules`` namespace over lint rules AND HLO checks:
-    ``(lint_subset, check_subset)`` — each None when no name of that
-    kind was given; unknown names raise SystemExit(2)."""
+    """One ``--rules`` namespace over lint rules, concurrency rules AND
+    HLO checks: ``(lint_subset, concur_subset, check_subset)`` — each
+    None when no name of that kind was given; unknown names raise
+    SystemExit(2)."""
     from bigdl_tpu.analysis import available_rules
+    from bigdl_tpu.analysis.concur import available_concur_rules
     from bigdl_tpu.analysis.hlo import available_checks
     lint_names = {r.name for r in available_rules()}
+    concur_names = {r.name for r in available_concur_rules()}
     check_names = {c.name for c in available_checks()}
-    lint_sel, check_sel = [], []
+    lint_sel, concur_sel, check_sel = [], [], []
     for n in names:
         if n in lint_names:
             lint_sel.append(n)
+        elif n in concur_names:
+            concur_sel.append(n)
         elif n in check_names:
             check_sel.append(n)
         else:
             print(f"unknown rule {n!r} (see --list-rules)",
                   file=sys.stderr)
             raise SystemExit(2)
-    return lint_sel or None, check_sel or None
+    return lint_sel or None, concur_sel or None, check_sel or None
 
 
 def resolve_paths(paths):
@@ -289,6 +325,10 @@ def main(argv=None) -> int:
                          "default: the bigdl_tpu package")
     ap.add_argument("--lint-only", action="store_true")
     ap.add_argument("--shapes-only", action="store_true")
+    ap.add_argument("--concurrency", action="store_true",
+                    help="run only the static concurrency analyzer "
+                         "(lock-discipline inference, lock-order "
+                         "graph, signal/thread-safety checks)")
     ap.add_argument("--programs", action="store_true",
                     help="run only the compiled-program verifier "
                          "(lower the representative program suite, "
@@ -311,34 +351,40 @@ def main(argv=None) -> int:
                                     lint_paths)
 
     if args.list_rules:
-        # ONE unified catalogue: AST lint rules and compiled-program
-        # (HLO) checks share the --rules namespace
+        # ONE unified catalogue: AST lint rules, concurrency rules and
+        # compiled-program (HLO) checks share the --rules namespace
+        from bigdl_tpu.analysis.concur import available_concur_rules
         from bigdl_tpu.analysis.hlo import available_checks
         for r in available_rules():
             print(f"{r.name:26s} [lint] {r.description}")
+        for r in available_concur_rules():
+            print(f"{r.name:26s} [concur] {r.description}")
         for c in available_checks():
             print(f"{c.name:26s} [hlo]  {c.description}")
         return 0
-    if sum((args.lint_only, args.shapes_only, args.programs)) > 1:
-        print("--lint-only, --shapes-only and --programs are mutually "
-              "exclusive", file=sys.stderr)
+    if sum((args.lint_only, args.shapes_only, args.concurrency,
+            args.programs)) > 1:
+        print("--lint-only, --shapes-only, --concurrency and --programs "
+              "are mutually exclusive", file=sys.stderr)
         return 2
 
     rule_names = [r.strip() for r in args.rules.split(",") if r.strip()] \
         if args.rules else []
     try:
-        lint_rules, hlo_checks = split_rules(rule_names)
+        lint_rules, concur_rules, hlo_checks = split_rules(rule_names)
     except SystemExit as e:
         return int(e.code or 2)
 
     rc = 0
     payload = {}
-    full_gate = not (args.lint_only or args.shapes_only or args.programs)
+    full_gate = not (args.lint_only or args.shapes_only
+                     or args.concurrency or args.programs)
     # --rules is ONE namespace: under the full gate, a restriction that
     # names no rule of a pass's kind SKIPS that pass entirely (asking
     # for `--rules sync-in-loop` must not still lower + check the whole
     # program suite, and vice versa); explicit mode flags override
     skip_lint = full_gate and rule_names and lint_rules is None
+    skip_concur = full_gate and rule_names and concur_rules is None
     skip_programs = full_gate and rule_names and hlo_checks is None
 
     if args.programs:
@@ -348,6 +394,15 @@ def main(argv=None) -> int:
         if args.json:
             print(json.dumps({"programs": prog_payload}, indent=2))
         return prc
+
+    if args.concurrency:
+        paths = resolve_paths(args.paths or ["bigdl_tpu"])
+        crc, concur_payload = run_concur_pass(
+            paths, args.json, rules=concur_rules,
+            show_suppressed=args.show_suppressed)
+        if args.json:
+            print(json.dumps({"concur": concur_payload}, indent=2))
+        return crc
 
     if not args.shapes_only and not skip_lint:
         paths = resolve_paths(args.paths or ["bigdl_tpu"])
@@ -363,6 +418,16 @@ def main(argv=None) -> int:
         if not args.json:
             print(format_text(findings,
                               show_suppressed=args.show_suppressed))
+
+    if full_gate and not skip_concur:
+        # the concurrency analyzer rides the full gate as its own
+        # source pass (same paths, its own [concur] rule namespace)
+        paths = resolve_paths(args.paths or ["bigdl_tpu"])
+        crc, concur_payload = run_concur_pass(
+            paths, args.json, rules=concur_rules,
+            show_suppressed=args.show_suppressed)
+        payload["concur"] = concur_payload
+        rc = max(rc, crc) if crc != 2 else 2
 
     if not args.lint_only and not (full_gate and rule_names):
         # a --rules restriction names lint rules / HLO checks only;

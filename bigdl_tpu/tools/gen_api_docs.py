@@ -48,8 +48,8 @@ FAMILIES = {
                  "bigdl_tpu.autotune.measure",
                  "bigdl_tpu.autotune.config"],
     "analysis": ["bigdl_tpu.analysis", "bigdl_tpu.analysis.shapecheck",
-                 "bigdl_tpu.analysis.lint", "bigdl_tpu.analysis.hlo",
-                 "bigdl_tpu.analysis.checks",
+                 "bigdl_tpu.analysis.lint", "bigdl_tpu.analysis.concur",
+                 "bigdl_tpu.analysis.hlo", "bigdl_tpu.analysis.checks",
                  "bigdl_tpu.analysis.programs"],
     "telemetry": ["bigdl_tpu.telemetry", "bigdl_tpu.telemetry.tracer",
                   "bigdl_tpu.telemetry.metrics",

@@ -17,6 +17,9 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+import threading
+import time
+
 import numpy as np
 import pytest
 
@@ -27,3 +30,41 @@ def _seed():
     RandomGenerator.set_seed(42)
     np.random.seed(42)
     yield
+
+
+#: test modules exercising the package's thread-owning surfaces; each
+#: must return the live non-daemon thread count to its baseline (the
+#: PR 4 batcher-drain regression, generalized package-wide)
+_THREAD_SURFACE_MODULES = ("tests.test_serving", "tests.test_generation",
+                          "tests.test_fleet", "tests.test_elastic",
+                          "test_serving", "test_generation",
+                          "test_fleet", "test_elastic")
+
+
+def _live_non_daemon():
+    return {t for t in threading.enumerate()
+            if t.is_alive() and not t.daemon
+            and t is not threading.main_thread()}
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _no_thread_leak(request):
+    """A concurrency-surface test module must not leak non-daemon
+    threads: every batcher/loop/replica/writer it starts must be shut
+    down by module end (daemon workers are excluded — supervised
+    worker threads are daemonized by design and die with the process).
+    A short grace poll absorbs joins that are in flight at teardown."""
+    name = request.module.__name__
+    if not name.startswith(_THREAD_SURFACE_MODULES):
+        yield
+        return
+    baseline = _live_non_daemon()
+    yield
+    deadline = time.monotonic() + 5.0
+    while _live_non_daemon() - baseline \
+            and time.monotonic() < deadline:
+        time.sleep(0.05)
+    leaked = _live_non_daemon() - baseline
+    assert not leaked, (
+        f"{name} leaked non-daemon threads: "
+        f"{sorted(t.name for t in leaked)}")

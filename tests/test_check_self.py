@@ -86,6 +86,45 @@ def test_check_cli_list_rules_is_unified():
                  "use-after-donate", "host-sync"):
         assert name in proc.stdout, name
     assert "[hlo]" in proc.stdout and "[lint]" in proc.stdout
+    for name in ("unguarded-shared-state", "torn-invariant-write",
+                 "lock-order-cycle", "blocking-under-lock",
+                 "signal-handler-impure"):
+        assert name in proc.stdout, name
+    assert "[concur]" in proc.stdout
+
+
+def test_concur_self_gate_in_process():
+    """The package self-analyzes clean under the concurrency analyzer:
+    every thread-escaping access of a lock-guarded attribute is locked,
+    the package-wide lock-order graph is acyclic, no held-lock region
+    blocks, and the preempt signal handler stays flag-only."""
+    from bigdl_tpu.analysis.concur import analyze_paths
+    findings = analyze_paths([PKG_DIR])
+    active = [f for f in findings if not f.suppressed]
+    assert active == [], "\n".join(f.format() for f in active)
+
+
+def test_check_cli_concurrency_exits_zero():
+    proc = subprocess.run(
+        [sys.executable, "-m", "bigdl_tpu.tools.check", "--concurrency",
+         "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)["concur"]
+    assert [f for f in payload if not f.get("suppressed")] == []
+
+
+def test_check_cli_concur_rule_subset():
+    """--rules with a concur rule name routes to the concurrency pass
+    alone (no lint/shape/program passes run)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "bigdl_tpu.tools.check", "--concurrency",
+         "--rules", "lock-order-cycle", "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(proc.stdout)["concur"] == []
 
 
 def test_rule_subset_restricts_checks(suite):

@@ -1,0 +1,549 @@
+"""The static concurrency analyzer (analysis.concur): one positive +
+suppressed fixture pair per rule, golden seeded-mutant shapes for the
+review-record bug classes (the MicroBatcher unlocked-worker shape, the
+PR-14 PrefixCache pin-leak with the doomed verdict read outside the
+lock), and targeted regressions for the dogfood fixes (FleetStream
+re-route dedup, BatcherStats consistent snapshots, metrics registry
+get-or-create vs snapshot)."""
+import threading
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.analysis.concur import analyze_source, available_concur_rules
+
+HEADER = """\
+import queue
+import signal
+import subprocess
+import threading
+import time
+"""
+
+
+def run(body, rules=None):
+    return analyze_source(HEADER + body, "fixture.py", rules=rules)
+
+
+def names(findings, active_only=True):
+    return [f.rule for f in findings
+            if not (active_only and f.suppressed)]
+
+
+# --------------------------------------------------------- fixture pairs
+# one (positive, suppressed) source pair per rule: the positive MUST
+# fire, the suppressed twin MUST be muted (and stay recorded)
+
+CASES = {
+    "unguarded-shared-state": (
+        """
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._jobs = []
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def submit(self, job):
+        with self._lock:
+            self._jobs.append(job)
+
+    def _run(self):
+        while True:
+            job = self._jobs.pop()
+""",
+        """
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._jobs = []
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def submit(self, job):
+        with self._lock:
+            self._jobs.append(job)
+
+    def _run(self):
+        while True:
+            # single-consumer queue: only this thread pops
+            # bigdl: disable=unguarded-shared-state
+            job = self._jobs.pop()
+""",
+    ),
+    "torn-invariant-write": (
+        """
+class Cursor:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._spos = 0
+        self._offset = 0
+        self._thread = threading.Thread(target=self._advance,
+                                        daemon=True)
+
+    def seek(self, spos, offset):
+        with self._lock:
+            self._spos = spos
+            self._offset = offset
+
+    def _advance(self):
+        self._spos = self._spos + 1
+""",
+        """
+class Cursor:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._spos = 0
+        self._offset = 0
+        self._thread = threading.Thread(target=self._advance,
+                                        daemon=True)
+
+    def seek(self, spos, offset):
+        with self._lock:
+            self._spos = spos
+            self._offset = offset
+
+    def _advance(self):
+        # offset is reset by the same statement's reader contract
+        # bigdl: disable=torn-invariant-write,unguarded-shared-state
+        self._spos = self._spos + 1
+""",
+    ),
+    "lock-order-cycle": (
+        """
+class Pair:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def fwd(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def rev(self):
+        with self._b:
+            with self._a:
+                pass
+""",
+        """
+class Pair:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def fwd(self):
+        with self._a:
+            # rev() is only ever called at single-threaded shutdown
+            # bigdl: disable=lock-order-cycle
+            with self._b:
+                pass
+
+    def rev(self):
+        with self._b:
+            with self._a:
+                pass
+""",
+    ),
+    "blocking-under-lock": (
+        """
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+
+    def drain(self):
+        with self._lock:
+            item = self._q.get()
+            return item
+""",
+        """
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+
+    def drain(self):
+        with self._lock:
+            # producer never blocks on this lock; queue is pre-filled
+            # bigdl: disable=blocking-under-lock
+            item = self._q.get()
+            return item
+""",
+    ),
+    "signal-handler-impure": (
+        """
+class Handler:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._hits = 0
+
+    def install(self):
+        signal.signal(signal.SIGTERM, self._on_signal)
+
+    def _on_signal(self, signum, frame):
+        with self._lock:
+            self._hits = self._hits + 1
+""",
+        """
+class Handler:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._hits = 0
+
+    def install(self):
+        signal.signal(signal.SIGTERM, self._on_signal)
+
+    def _on_signal(self, signum, frame):
+        # uninstalled before any other thread takes this lock
+        # bigdl: disable=signal-handler-impure
+        with self._lock:
+            self._hits = self._hits + 1
+""",
+    ),
+}
+
+
+@pytest.mark.parametrize("rule", sorted(CASES))
+def test_rule_fires_on_positive(rule):
+    positive, _ = CASES[rule]
+    assert rule in names(run(positive)), \
+        f"{rule} did not fire:\n" + "\n".join(
+            f.format() for f in run(positive))
+
+
+@pytest.mark.parametrize("rule", sorted(CASES))
+def test_rule_suppressed_twin_is_muted(rule):
+    _, suppressed = CASES[rule]
+    findings = run(suppressed)
+    assert rule not in names(findings)
+    # the suppressed finding is retained for audit, not dropped
+    assert rule in names(findings, active_only=False)
+
+
+def test_every_rule_has_a_fixture_pair():
+    assert sorted(CASES) == [r.name for r in available_concur_rules()]
+
+
+# ------------------------------------------------------- seeded mutants
+# golden shapes from the review record: each mutant reintroduces a bug
+# the analyzer must catch; its fixed twin must be silent (zero false
+# positives on the pair)
+
+MUTANT_BATCHER = """
+class MiniBatcher:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._queue = []
+        self._stopping = False
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def submit(self, row):
+        with self._cond:
+            self._queue.append(row)
+            self._cond.notify()
+
+    def shutdown(self):
+        with self._cond:
+            self._stopping = True
+            self._cond.notify_all()
+
+    def _loop(self):
+        while not self._stopping:
+            batch = list(self._queue)
+            self._queue.clear()
+"""
+
+FIXED_BATCHER = """
+class MiniBatcher:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._queue = []
+        self._stopping = False
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def submit(self, row):
+        with self._cond:
+            self._queue.append(row)
+            self._cond.notify()
+
+    def shutdown(self):
+        with self._cond:
+            self._stopping = True
+            self._cond.notify_all()
+
+    def _loop(self):
+        while True:
+            with self._cond:
+                if self._stopping:
+                    return
+                batch = list(self._queue)
+                self._queue.clear()
+"""
+
+
+def test_mutant_batcher_unlocked_worker_caught():
+    """The pre-PR-5 shape: the dispatch worker reads/mutates the queue
+    and the stop flag outside the condition."""
+    findings = [f for f in run(MUTANT_BATCHER)
+                if f.rule == "unguarded-shared-state" and not f.suppressed]
+    flagged = {m for f in findings
+               for m in ("_stopping", "_queue") if m in f.message}
+    assert flagged == {"_stopping", "_queue"}, \
+        "\n".join(f.format() for f in run(MUTANT_BATCHER))
+
+
+def test_fixed_batcher_is_clean():
+    assert names(run(FIXED_BATCHER)) == []
+
+
+MUTANT_PREFIX = """
+class MiniPrefixCache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}
+
+    def insert(self, key, entry):
+        with self._lock:
+            self._entries[key] = entry
+
+    def drop_version(self, version):
+        with self._lock:
+            for k in list(self._entries):
+                if k[0] == version:
+                    del self._entries[k]
+
+    def _dispatch_loop(self):
+        while True:
+            entry = self._entries.get(("v", 0))
+            if entry is not None and not entry.doomed:
+                entry.refs += 1
+"""
+
+FIXED_PREFIX = """
+class MiniPrefixCache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}
+
+    def insert(self, key, entry):
+        with self._lock:
+            self._entries[key] = entry
+
+    def drop_version(self, version):
+        with self._lock:
+            for k in list(self._entries):
+                if k[0] == version:
+                    del self._entries[k]
+
+    def _dispatch_loop(self):
+        while True:
+            with self._lock:
+                entry = self._entries.get(("v", 0))
+                if entry is not None and not entry.doomed:
+                    entry.refs += 1
+"""
+
+
+def test_mutant_prefix_pin_leak_caught():
+    """The PR-14 review shape reintroduced: the doomed verdict is read
+    outside the lock from a worker-entry method, racing
+    ``drop_version``'s doom-and-sweep."""
+    findings = run(MUTANT_PREFIX)
+    hits = [f for f in findings
+            if f.rule == "unguarded-shared-state" and not f.suppressed
+            and "_entries" in f.message]
+    assert hits, "\n".join(f.format() for f in findings)
+
+
+def test_fixed_prefix_is_clean():
+    assert names(run(FIXED_PREFIX)) == []
+
+
+# ------------------------------------------------ analyzer edge contracts
+
+def test_cond_wait_on_held_condition_is_exempt():
+    """``cond.wait()`` on the condition this region holds RELEASES the
+    lock — the idiomatic worker wait loop must not be flagged."""
+    src = """
+class Loop:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._queue = []
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def submit(self, x):
+        with self._cond:
+            self._queue.append(x)
+            self._cond.notify()
+
+    def _run(self):
+        with self._cond:
+            while not self._queue:
+                self._cond.wait(timeout=0.1)
+            self._queue.clear()
+"""
+    assert names(run(src)) == []
+
+
+def test_locked_suffix_methods_follow_the_convention():
+    """``*_locked`` methods run with the caller holding the lock: their
+    writes infer guardedness, their accesses are exempt, and blocking
+    calls inside them are still flagged."""
+    src = """
+class Conventional:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _take_locked(self):
+        out = list(self._items)
+        self._items = []
+        time.sleep(0.5)
+        return out
+
+    def _run(self):
+        with self._lock:
+            batch = self._take_locked()
+"""
+    got = names(run(src))
+    assert "unguarded-shared-state" not in got
+    assert "blocking-under-lock" in got  # the sleep under the held lock
+
+
+def test_init_writes_are_happens_before_exempt():
+    src = """
+class Simple:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = "new"
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def set_state(self, s):
+        with self._lock:
+            self._state = s
+
+    def state(self):
+        return self._state
+"""
+    # state() is NOT thread-escaping, __init__ is exempt: clean
+    assert names(run(src)) == []
+
+
+def test_lock_cycle_message_carries_both_witness_paths():
+    findings = [f for f in run(CASES["lock-order-cycle"][0])
+                if f.rule == "lock-order-cycle"]
+    assert len(findings) == 1
+    msg = findings[0].message
+    assert "Pair._a -> Pair._b" in msg and "Pair._b -> Pair._a" in msg
+    assert msg.count("fixture.py:") == 2
+
+
+def test_flag_only_signal_handler_is_clean():
+    """The PR 12 GraceHandler contract: an Event.set()-only handler
+    passes."""
+    src = """
+class Grace:
+    def __init__(self):
+        self._event = threading.Event()
+
+    def install(self):
+        signal.signal(signal.SIGTERM, self._on_signal)
+
+    def _on_signal(self, signum, frame):
+        self._event.set()
+"""
+    assert names(run(src)) == []
+
+
+# ------------------------------------------- dogfood-fix regressions
+
+def test_fleet_stream_concurrent_delivery_dedups_exactly():
+    """The re-route window: the new replica's driver and the
+    death-callback's attach-replay deliver the same token indices
+    concurrently; every token must land exactly once, in order."""
+    from bigdl_tpu.fleet.router import FleetStream
+    stream = FleetStream(None, np.array([1, 2, 3], np.int32),
+                         {"max_new_tokens": 0}, retries=0,
+                         trace_id="test/req-1")
+    n = 400
+    start = threading.Barrier(4)
+
+    def deliver():
+        start.wait()
+        for i in range(n):
+            stream.on_token(i, 1000 + i)
+
+    threads = [threading.Thread(target=deliver) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert stream.tokens() == [1000 + i for i in range(n)]
+
+
+def test_fleet_stream_out_of_order_replay_buffers():
+    from bigdl_tpu.fleet.router import FleetStream
+    stream = FleetStream(None, np.array([1], np.int32),
+                         {"max_new_tokens": 0}, retries=0,
+                         trace_id="test/req-2")
+    stream.on_token(2, 12)  # attach-replay racing ahead
+    stream.on_token(0, 10)
+    stream.on_token(1, 11)  # fills the gap; pending 2 drains after it
+    assert stream.tokens() == [10, 11, 12]
+
+
+def test_batcher_stats_snapshot_is_consistent_under_writers():
+    """Derived ratios must come from ONE locked view: on_batch writes
+    four counters under ``stats.lock``; a torn read would break the
+    per-batch arithmetic invariants below."""
+    from bigdl_tpu.serving.batcher import BatcherStats
+    stats = BatcherStats(model="snap-test")
+    stop = threading.Event()
+
+    def writer():
+        while not stop.is_set():
+            stats.on_batch(1, 2)  # 1 real row padded to bucket 2
+
+    threads = [threading.Thread(target=writer, daemon=True)
+               for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(200):
+            st = stats.snapshot()
+            assert st["batched_rows"] == st["batches"]
+            assert st["padded_rows"] == st["batches"]
+            assert abs(st["fill_sum"] - 0.5 * st["batches"]) < 1e-6
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5.0)
+
+
+def test_metrics_registry_get_or_create_vs_snapshot():
+    """The audited contract: instrument creation and snapshot share the
+    registry lock; concurrent create+inc against snapshot never tears
+    a row or raises."""
+    from bigdl_tpu.telemetry import MetricsRegistry
+    r = MetricsRegistry()
+    n_threads, n_each = 4, 50
+    start = threading.Barrier(n_threads + 1)
+
+    def creator(tid):
+        start.wait()
+        for i in range(n_each):
+            r.counter(f"load/worker{tid}/c{i}").inc()
+
+    threads = [threading.Thread(target=creator, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    start.wait()
+    for _ in range(50):
+        for row in r.snapshot():
+            for series in row["series"]:
+                assert series.get("value", 0) >= 0
+    for t in threads:
+        t.join()
+    final = {row["name"] for row in r.snapshot()}
+    assert len(final) == n_threads * n_each
