@@ -534,6 +534,16 @@ def main():
     # smoke runs unless forced.
     if _row_enabled("BENCH_TUNED", platform):
         result.update(_bench_tuned())
+    # thirteenth tracked row: SLO — the fleet observability plane end
+    # to end (telemetry.agg + telemetry.slo): a fleet soak with
+    # per-replica PRIVATE registries, merged through
+    # aggregate_snapshots, goodput + p99 TTFT read from the MERGED
+    # snapshot and judged by one declarative SloSpec. Tracked so a
+    # regression in the merge/SLO path (or in fleet goodput itself)
+    # trips tools/regress like any perf number. Skipped on CPU smoke
+    # runs unless forced.
+    if _row_enabled("BENCH_SLO", platform):
+        result.update(_bench_slo())
     print(json.dumps(result))
     _maybe_metrics_snapshot(result)
 
@@ -761,6 +771,60 @@ def _bench_fleet():
             (stats["tokens"] / on_dt) / (off_tokens / off_dt), 3),
     })
     return row
+
+
+def _bench_slo():
+    """SLO row: fleet soak goodput + p99 TTFT **from the merged
+    cross-process snapshot** (telemetry.agg), judged by one
+    declarative SloSpec (telemetry.slo). Each replica serves from its
+    own PRIVATE registry — the merge is load-bearing, not cosmetic:
+    a broken aggregator shows up here as a zero/missing p99 and
+    ``slo_passed`` drops to 0."""
+    import bigdl_tpu.telemetry as telemetry
+    from bigdl_tpu.fleet import (FleetRouter, build_replicas,
+                                 run_fleet_soak)
+    from bigdl_tpu.telemetry import agg
+    from bigdl_tpu.telemetry import slo as slo_mod
+
+    n_replicas = int(os.environ.get("BENCH_SLO_REPLICAS", 2))
+    n_reqs = int(os.environ.get("BENCH_SLO_REQS", 24))
+    max_new = int(os.environ.get("BENCH_SLO_NEW", 6))
+    budget_ms = float(os.environ.get("BENCH_SLO_TTFT_BUDGET_MS",
+                                     5000.0))
+
+    # metrics=None -> every replica's GenerationService creates its
+    # own registry; the router keeps a separate one of its own
+    reps = build_replicas(n_replicas, seed=31, max_queue=8,
+                          metrics=None)
+    router = FleetRouter(reps, metrics=telemetry.MetricsRegistry())
+    try:
+        soak = run_fleet_soak(router=router, requests=n_reqs,
+                              threads=4, max_new=max_new, seed=32,
+                              open_breaker_on=None,
+                              ttft_budget_ms=budget_ms)
+    finally:
+        router.shutdown(drain=True)
+
+    sources = [({"replica": r.name},
+                r.service.metrics_registry.snapshot(True))
+               for r in reps]
+    sources.append(({"replica": "router"},
+                    router.metrics_registry.snapshot(True)))
+    merged = agg.aggregate_snapshots(sources)
+    bad = agg.check_merge_invariant(sources, merged)
+    spec = slo_mod.SloSpec.parse(
+        f"p99_ttft: serving/generation/ttft_ms.p99 <= {budget_ms};"
+        "goodput: goodput_tokens_per_sec >= 0.001")
+    rep = slo_mod.evaluate(
+        spec, merged,
+        {"goodput_tokens_per_sec": soak["goodput_tokens_per_sec"]})
+    by = {v.objective.name: v.value for v in rep.verdicts}
+    return {
+        "slo_goodput_tokens_per_sec": round(
+            soak["goodput_tokens_per_sec"], 2),
+        "slo_ttft_ms_p99": round(by.get("p99_ttft") or 0.0, 3),
+        "slo_passed": int(rep.passed and soak["passed"] and not bad),
+    }
 
 
 def _bench_data():

@@ -47,7 +47,9 @@ __all__ = [
 def register_fleet_instruments(r):
     """Get-or-create the whole ``fleet/*`` instrument surface in
     registry ``r`` — one call for ``tools.check --telemetry-audit``."""
+    from bigdl_tpu.telemetry.slo import register_slo_instruments
     out = dict(register_prefix_instruments(r))
     out.update(register_router_instruments(r))
     out.update(register_speculative_instruments(r))
+    out.update(register_slo_instruments(r))
     return out

@@ -167,9 +167,11 @@ class ProcessReplica:
                  slots: int = 2, max_len: int = 32,
                  breaker_failures: int = 3,
                  breaker_cooldown_ms: float = 250.0,
-                 startup_timeout_s: float = 120.0):
+                 startup_timeout_s: float = 120.0,
+                 telemetry_dir: Optional[str] = None):
         self.name = name
         self.state = "serving"
+        self.telemetry_dir = telemetry_dir
         self.breaker = CircuitBreaker(failures=breaker_failures,
                                       cooldown_ms=breaker_cooldown_ms)
         self._lock = threading.Lock()
@@ -177,6 +179,16 @@ class ProcessReplica:
         self._streams: Dict[int, TokenStream] = {}
         env = dict(os.environ)
         env.setdefault("JAX_PLATFORMS", "cpu")
+        if telemetry_dir:
+            # the worker arms its flight recorder and ships identity-
+            # stamped snapshot JSONL into the (router-owned) directory
+            # at import, so a SIGKILLed replica still leaves a
+            # postmortem bundle the parent can read
+            env["BIGDL_TELEMETRY_SHIP_DIR"] = telemetry_dir
+            env["BIGDL_TELEMETRY_SHIP_EVERY_S"] = "0.2"
+            env["BIGDL_FLIGHT_DIR"] = os.path.join(
+                telemetry_dir, "flight")
+            env["BIGDL_REPLICA_ID"] = name
         pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
         env["PYTHONPATH"] = pkg_root + os.pathsep \
@@ -338,6 +350,7 @@ def _worker(argv) -> int:
     import jax
     jax.config.update("jax_platforms", "cpu")
 
+    from bigdl_tpu import telemetry
     from bigdl_tpu.models.transformer import TransformerLM
     from bigdl_tpu.utils.random import RandomGenerator
 
@@ -350,10 +363,15 @@ def _worker(argv) -> int:
         num_heads=int(spec["num_heads"]),
         max_len=int(spec.get("max_len", args.max_len))).evaluate()
     model.ensure_initialized()
+    # when the parent armed the shipper (BIGDL_TELEMETRY_SHIP_DIR via
+    # ProcessReplica telemetry_dir), serve out of the process registry
+    # so the shipped snapshots carry the serving instruments
+    shipping = telemetry.agg.shipping()
     svc = GenerationService(config=GenerationConfig(
         slots=args.slots, max_len=args.max_len,
         length_buckets=(args.max_len,),
-        prefill_rows=min(2, args.slots)))
+        prefill_rows=min(2, args.slots)),
+        metrics_registry=telemetry.registry() if shipping else None)
     svc.load("lm", model)
     out_lock = threading.Lock()
 
@@ -362,20 +380,26 @@ def _worker(argv) -> int:
             print(json.dumps(obj), flush=True)
 
     emit({"ready": True})
+    telemetry.agg.maybe_ship(force=True)
 
     def pump(rid, stream):
         try:
             for tok in stream:
                 emit({"id": rid, "token": int(tok)})
             emit({"id": rid, "done": stream.finish_reason or "done"})
+            telemetry.flight.note("request_done", id=rid)
         except Exception as e:
             emit({"id": rid, "error": f"{type(e).__name__}: {e}"})
+            telemetry.flight.note("request_error", id=rid,
+                                  error=f"{type(e).__name__}: {e}")
+        telemetry.agg.maybe_ship()
 
     for line in sys.stdin:
         try:
             req = json.loads(line)
         except ValueError:
             continue
+        telemetry.flight.note("request", id=req.get("id"))
         try:
             stream = svc.generate(
                 "lm", np.asarray(req["prompt"], np.int32),
@@ -389,6 +413,7 @@ def _worker(argv) -> int:
         threading.Thread(target=pump, args=(req["id"], stream),
                          daemon=True).start()
     svc.shutdown(drain=True)
+    telemetry.agg.stop_shipping()
     return 0
 
 

@@ -40,6 +40,7 @@ version (or replace the replica) and ``resume``/``remove`` it.
 """
 from __future__ import annotations
 
+import os
 import threading
 from collections import OrderedDict
 from typing import Dict, List, Optional
@@ -155,13 +156,20 @@ class FleetRouter:
     docstring has the placement and failure contracts)."""
 
     def __init__(self, replicas=(), *, metrics=None,
-                 reroute_retries: int = 1):
+                 reroute_retries: int = 1,
+                 telemetry_dir: Optional[str] = None):
         self._lock = threading.Lock()
         self._replicas: "OrderedDict[str, object]" = OrderedDict()
         self._sessions: "OrderedDict[str, str]" = OrderedDict()
         self._evicted: set = set()
         self._seq = 0
         self.reroute_retries = int(reroute_retries)
+        # the router owns the fleet snapshot directory: process
+        # replicas ship identity-stamped snapshot JSONL here (pass it
+        # as their telemetry_dir) and fleet_snapshot() merges them
+        self.telemetry_dir = telemetry_dir
+        if telemetry_dir:
+            os.makedirs(telemetry_dir, exist_ok=True)
         r = metrics if metrics is not None else telemetry.registry()
         self.metrics_registry = r
         inst = register_router_instruments(r)
@@ -370,6 +378,21 @@ class FleetRouter:
             "sessions": sessions,
             "states": {rep.name: rep.state for rep in reps},
         }
+
+    def fleet_snapshot(self):
+        """The merged fleet snapshot: every replica's shipped snapshot
+        file in the router-owned ``telemetry_dir`` plus the router's
+        own registry, through ``telemetry.agg.aggregate_snapshots``
+        (counters sum to the digit; ``telemetry.slo`` evaluates
+        SloSpecs over the result). Returns ``[]`` when the router owns
+        no telemetry directory."""
+        from bigdl_tpu.telemetry import agg
+        if not self.telemetry_dir:
+            return []
+        sources = agg.read_snapshot_dir(self.telemetry_dir)
+        sources.append(({"replica": "router", "pid": os.getpid()},
+                        self.metrics_registry.snapshot(True)))
+        return agg.aggregate_snapshots(sources)
 
     def shutdown(self, drain: bool = True) -> None:
         """Stop every replica (``drain`` finishes held streams)."""

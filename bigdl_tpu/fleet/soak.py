@@ -71,11 +71,20 @@ def run_fleet_soak(*, replicas: int = 2, requests: int = 24,
                    ttft_budget_ms: float = 5000.0,
                    token_budget_ms: float = 2000.0,
                    deadline_s: float = 120.0,
-                   router=None) -> Dict:
+                   router=None, slo_spec=None,
+                   min_goodput_tokens_per_sec: float = 0.0) -> Dict:
     """Run the soak (module docstring has the invariants); returns a
     report dict whose ``"passed"`` key is the verdict. Pass a prebuilt
     ``router`` to soak an existing fleet (the bench goodput legs do);
-    otherwise a seeded tiny fleet is built and torn down here."""
+    otherwise a seeded tiny fleet is built and torn down here.
+
+    The latency/goodput budgets are enforced by the ONE SLO engine
+    (``telemetry.slo``): a default :class:`SloSpec` is built from the
+    budget arguments (override with ``slo_spec``), evaluated over the
+    router's merged fleet snapshot (when it owns a telemetry
+    directory) plus the soak's own observations, and embedded typed
+    under ``report["slo"]``; breached objectives become
+    ``violations``."""
     from bigdl_tpu.fleet.router import FleetRouter
     from bigdl_tpu.tools.synthetic import seeded_rng
 
@@ -186,16 +195,32 @@ def run_fleet_soak(*, replicas: int = 2, requests: int = 24,
         report["violations"].append(
             "queue-full pressure never observed — the soak ran "
             "unloaded (raise requests or shrink max_queue)")
-    p99_ttft = report.get("ttft_ms_p99")
-    if p99_ttft is not None and p99_ttft > ttft_budget_ms:
-        report["violations"].append(
-            f"p99 TTFT {p99_ttft:.1f}ms over the {ttft_budget_ms}ms "
-            "budget")
-    p99_tok = report.get("token_ms_p99")
-    if p99_tok is not None and p99_tok > token_budget_ms:
-        report["violations"].append(
-            f"p99 token latency {p99_tok:.1f}ms over the "
-            f"{token_budget_ms}ms budget")
+    # the p99/goodput budgets run through the ONE SLO engine (the
+    # chaos legs and the control plane read the same typed report)
+    from bigdl_tpu.telemetry import slo as slo_mod
+    report["goodput_tokens_per_sec"] = round(
+        report["tokens_per_sec"]
+        * report["ttft_within_budget_fraction"], 2)
+    if slo_spec is None:
+        slo_spec = slo_mod.SloSpec([
+            slo_mod.SloObjective("p99_ttft", "ttft_ms_p99", "<=",
+                                 ttft_budget_ms, default=0.0),
+            slo_mod.SloObjective("p99_token", "token_ms_p99", "<=",
+                                 token_budget_ms, default=0.0),
+            slo_mod.SloObjective("goodput", "goodput_tokens_per_sec",
+                                 ">=", min_goodput_tokens_per_sec),
+        ])
+    merged = router.fleet_snapshot() \
+        if getattr(router, "telemetry_dir", None) else None
+    obs = {k: report[k] for k in
+           ("ttft_ms_p99", "token_ms_p99", "goodput_tokens_per_sec",
+            "tokens_per_sec", "ttft_within_budget_fraction")
+           if k in report}
+    slo_report = slo_mod.evaluate(slo_spec, merged, obs)
+    report["slo"] = slo_report.to_dict()
+    report["violations"].extend(
+        "SLO breach: " + v.describe()
+        for v in slo_report.verdicts if not v.ok)
     if own_router:
         router.shutdown(drain=True)
     report["passed"] = not report["violations"]

@@ -1912,6 +1912,7 @@ class Optimizer:
                         train_program_name(model, "window"),
                         sum(sizes) / t_compute)
                 telemetry.flight.note_metrics({"step": state["neval"]})
+                telemetry.agg.maybe_ship()
                 rate = sum(sizes) / max(1e-9, t_data + t_compute)
                 for i in range(k_now):
                     post_step(loss_vals[i], lr_list[i], sizes[i], rate)
@@ -1968,9 +1969,13 @@ class Optimizer:
                 telemetry.programs.record_rate(
                     train_program_name(model), bsz / t_compute)
             telemetry.flight.note_metrics({"step": state["neval"]})
+            telemetry.agg.maybe_ship()
             post_step(loss_f, lr, bsz,
                       bsz / max(1e-9, t_data + t_compute))
 
+        # a run shorter than the ship interval must still leave its
+        # end-of-run totals in the fleet snapshot file
+        telemetry.agg.maybe_ship(force=True)
         logger.info("training done in %.1fs; %s", time.time() - wall_start,
                     self.metrics.summary())
         # the run is over: a checkpoint still on the background writer

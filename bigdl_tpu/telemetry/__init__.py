@@ -39,9 +39,11 @@ from __future__ import annotations
 import os
 from typing import Optional
 
-from bigdl_tpu.telemetry.export import (JsonlExporter, TensorBoardExporter,
+from bigdl_tpu.telemetry.export import (SNAPSHOT_HEADER_FORMAT,
+                                        JsonlExporter, TensorBoardExporter,
                                         parse_prometheus_text,
-                                        prometheus_text, read_jsonl,
+                                        process_identity, prometheus_text,
+                                        read_jsonl, read_jsonl_with_identity,
                                         scalarize, write_prometheus)
 from bigdl_tpu.telemetry.metrics import (NAME_RE, Counter, Gauge, Histogram,
                                          MetricsRegistry, audit_names)
@@ -54,7 +56,8 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "SpanTracer",
     "SpanRecord", "TensorBoardExporter", "JsonlExporter",
     "write_prometheus", "prometheus_text", "parse_prometheus_text",
-    "read_jsonl", "scalarize", "audit_names", "NAME_RE",
+    "read_jsonl", "read_jsonl_with_identity", "process_identity",
+    "SNAPSHOT_HEADER_FORMAT", "scalarize", "audit_names", "NAME_RE",
 ]
 
 # -- the process-wide tracer ---------------------------------------------
@@ -176,6 +179,9 @@ if os.environ.get("BIGDL_TELEMETRY", "").strip() not in ("", "0"):
 #   analysis, MFU math; BIGDL_PROGRAM_PROFILES=1 arms compile sites)
 # - telemetry.flight — crash flight recorder (post-mortem bundles;
 #   BIGDL_FLIGHT_DIR=/path arms it)
-from bigdl_tpu.telemetry import flight, programs  # noqa: E402,F401
+# - telemetry.agg — cross-process snapshot shipping + merging
+#   (BIGDL_TELEMETRY_SHIP_DIR=/path arms the shipper)
+# - telemetry.slo — declarative SLOs over merged snapshots
+from bigdl_tpu.telemetry import agg, flight, programs, slo  # noqa: E402,F401
 
-__all__ += ["flight", "programs"]
+__all__ += ["agg", "flight", "programs", "slo"]

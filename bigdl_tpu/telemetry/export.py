@@ -19,6 +19,7 @@ across exporters by construction (asserted in tests).
 from __future__ import annotations
 
 import json
+import os
 import re
 import time
 from typing import Dict, List, Optional, Tuple
@@ -27,7 +28,36 @@ from bigdl_tpu.telemetry.metrics import MetricsRegistry
 
 __all__ = ["TensorBoardExporter", "JsonlExporter", "write_prometheus",
            "prometheus_text", "parse_prometheus_text", "read_jsonl",
-           "scalarize"]
+           "read_jsonl_with_identity", "process_identity", "scalarize",
+           "SNAPSHOT_HEADER_FORMAT"]
+
+#: schema tag of the process-identity header line new JSONL snapshot
+#: files start with (``{"header": SNAPSHOT_HEADER_FORMAT, ...}``);
+#: headerless pre-header files still parse (back-compat).
+SNAPSHOT_HEADER_FORMAT = "bigdl-snap-1"
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def process_identity(**overrides) -> dict:
+    """This process's identity stamp for cross-process telemetry: host
+    (gang process index from ``JAX_PROCESS_ID``), process_count
+    (``JAX_NUM_PROCESSES``), replica id (``BIGDL_REPLICA_ID``, set by
+    fleet ProcessReplica parents) and pid. ``overrides`` replace any
+    field; ``telemetry.agg`` keys merged gauge series off this."""
+    ident = {
+        "pid": os.getpid(),
+        "host": _env_int("JAX_PROCESS_ID", 0),
+        "process_count": _env_int("JAX_NUM_PROCESSES", 1),
+        "replica": os.environ.get("BIGDL_REPLICA_ID") or None,
+    }
+    ident.update(overrides)
+    return ident
 
 
 def scalarize(snapshot: List[dict]) -> Dict[str, float]:
@@ -221,29 +251,89 @@ class JsonlExporter:
     """Append-only JSONL snapshots: one self-contained JSON object per
     ``export()`` call (wall time, optional step/run metadata, full
     snapshot rows). Files append across runs so a BENCH trajectory
-    accumulates one line per run."""
+    accumulates one line per run.
 
-    def __init__(self, registry: MetricsRegistry, path: str):
+    A new (absent or empty) file starts with a process-identity header
+    line (``SNAPSHOT_HEADER_FORMAT``) so ``telemetry.agg`` can merge
+    snapshots from many processes; ``read_jsonl`` skips it, so
+    pre-header readers and files interoperate both ways.
+    ``include_samples=True`` ships each histogram series' raw reservoir
+    — required for exact cross-process percentile merging."""
+
+    def __init__(self, registry: MetricsRegistry, path: str,
+                 identity: Optional[dict] = None,
+                 include_samples: bool = False):
         self.registry = registry
         self.path = path
+        self.identity = identity if identity is not None \
+            else process_identity()
+        self.include_samples = include_samples
+
+    def _header_needed(self) -> bool:
+        try:
+            return os.path.getsize(self.path) == 0
+        except OSError:
+            return True
 
     def export(self, step: Optional[int] = None,
                meta: Optional[dict] = None) -> dict:
         """Append one snapshot line; returns the record written."""
         rec = {"wall_time": time.time(), "step": step,
                "meta": meta or {},
-               "metrics": self.registry.snapshot()}
+               "metrics": self.registry.snapshot(self.include_samples)}
+        header = None
+        if self._header_needed():
+            header = {"header": SNAPSHOT_HEADER_FORMAT, "schema": 1,
+                      "identity": self.identity}
         with open(self.path, "a") as f:
+            if header is not None:
+                f.write(json.dumps(header) + "\n")
             f.write(json.dumps(rec) + "\n")
         return rec
 
 
+def _is_header(rec: dict) -> bool:
+    return isinstance(rec, dict) and isinstance(rec.get("header"), str)
+
+
 def read_jsonl(path: str) -> List[dict]:
-    """Read every snapshot record from a JSONL metrics file."""
+    """Read every snapshot record from a JSONL metrics file
+    (process-identity header lines are skipped, so headered and
+    pre-header files read identically)."""
     out = []
     with open(path) as f:
         for line in f:
             line = line.strip()
             if line:
-                out.append(json.loads(line))
+                rec = json.loads(line)
+                if not _is_header(rec):
+                    out.append(rec)
     return out
+
+
+def read_jsonl_with_identity(path: str, tolerant: bool = False
+                             ) -> Tuple[Optional[dict], List[dict]]:
+    """``(identity, records)`` from a JSONL metrics file: the header's
+    identity dict (None for pre-header files) plus every snapshot
+    record. ``tolerant=True`` skips undecodable lines instead of
+    raising — a process SIGKILLed mid-append leaves a torn final line,
+    and the postmortem reader must still recover the rest."""
+    identity: Optional[dict] = None
+    out: List[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                if tolerant:
+                    continue
+                raise
+            if _is_header(rec):
+                if identity is None:
+                    identity = rec.get("identity") or {}
+            else:
+                out.append(rec)
+    return identity, out

@@ -192,19 +192,25 @@ class Histogram(_Instrument):
         from bigdl_tpu.utils.profiling import percentile_summary
         return percentile_summary(self.samples(**labels), qs)
 
-    def series_snapshot(self, qs=(50, 90, 99), **labels) -> Dict[str, float]:
+    def series_snapshot(self, qs=(50, 90, 99), include_samples=False,
+                        **labels) -> Dict[str, float]:
         """Count, sum and percentile digest read under ONE lock
         acquisition — an exporter scrape taken mid-traffic must not mix
         a count from one instant with a sum from the next (sum/count
-        averages would lie)."""
+        averages would lie). ``include_samples`` adds the raw reservoir
+        under ``"samples"`` so cross-process mergers
+        (``telemetry.agg``) can re-digest exact percentiles."""
         from bigdl_tpu.utils.profiling import percentile_summary
         with self._lock:
             s = self._values.get(_label_key(labels))
             count = s.count if s else 0
             total = s.sum if s else 0.0
             samples = list(s.reservoir) if s else []
-        return {"count": count, "sum": total,
-                **percentile_summary(samples, qs)}
+        out = {"count": count, "sum": total,
+               **percentile_summary(samples, qs)}
+        if include_samples:
+            out["samples"] = samples
+        return out
 
     def _series(self):
         return list(self._values)
@@ -262,10 +268,11 @@ class MetricsRegistry:
         with self._lock:
             return self._instruments.get(name)
 
-    def snapshot(self) -> List[dict]:
+    def snapshot(self, include_samples: bool = False) -> List[dict]:
         """Point-in-time dump every exporter renders from: one row per
         instrument with per-label-set values (histograms carry count,
-        sum and the percentile digest).
+        sum and the percentile digest; ``include_samples`` adds each
+        histogram series' raw reservoir for cross-process merging).
 
         Locking contract (audited against concurrent get-or-create):
         the instrument map is copied under the registry ``_lock`` —
@@ -284,7 +291,9 @@ class MetricsRegistry:
                 if inst.kind == "histogram":
                     series.append({
                         "labels": labels,
-                        **inst.series_snapshot((50, 90, 99), **labels)})
+                        **inst.series_snapshot((50, 90, 99),
+                                               include_samples,
+                                               **labels)})
                 else:
                     series.append({"labels": labels,
                                    "value": inst.value(**labels)})

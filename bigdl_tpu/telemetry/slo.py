@@ -1,0 +1,325 @@
+"""Declarative SLOs over merged fleet snapshots: SloSpec -> SloReport.
+
+The fleet needs ONE answer to "is the service meeting its objectives",
+computed from the merged cross-process snapshot (``telemetry.agg``)
+instead of ad-hoc per-leg budget asserts. An :class:`SloSpec` declares
+objectives ("p99 TTFT <= 250 ms", "goodput >= 40 tok/s", "evictions
+<= 0"); :func:`evaluate` resolves each objective's metric selector
+against a merged snapshot (plus optional out-of-band observations) and
+returns a typed :class:`SloReport` — ``fleet/soak.py`` asserts on it,
+``tools/chaos --fleet``/``--hostkill`` fail typed
+(:class:`SloBreach`) on it, and the future control plane consumes it.
+
+Spec grammar (one clause per objective, ``;``/newline separated)::
+
+    name: metric <= bound [default D]
+    p99_ttft: serving/generation/ttft_ms.p99 <= 250
+    goodput:  goodput_tokens_per_sec >= 40 default 0
+
+Metric selectors are ``scalarize`` tags (histograms via ``.p99``/
+``.count``/``.sum`` suffixes). A selector that matches several label
+series reduces deterministically: counters and ``.count``/``.sum``
+sum, everything else takes the WORST series (max) — a p99 objective
+holds only if every series holds. ``default D`` substitutes when the
+metric is absent (a clean run with zero evictions has no eviction
+series to read); without a default, missing data is itself a breach.
+
+:class:`SloEngine` adds multi-window burn-rate state across repeated
+evaluations; ``telemetry.agg.detect_stragglers`` flags the gang host
+whose step-time/data-wait lags the fleet median beyond a bound
+(surfaced by ``tools/diagnose --fleet``).
+"""
+from __future__ import annotations
+
+import math
+import re
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import bigdl_tpu.telemetry as telemetry
+from bigdl_tpu.telemetry.export import scalarize
+from bigdl_tpu.telemetry.metrics import MetricsRegistry
+
+__all__ = ["SloObjective", "SloSpec", "SloVerdict", "SloReport",
+           "SloBreach", "SloEngine", "evaluate",
+           "register_slo_instruments"]
+
+
+def register_slo_instruments(r: MetricsRegistry) -> dict:
+    """Get-or-create the ``fleet/slo/*`` instruments in ``r``
+    (covered by ``check --telemetry-audit``)."""
+    return {
+        "evaluations": r.counter(
+            "fleet/slo/evaluations", "SloSpec evaluations"),
+        "breaches": r.counter(
+            "fleet/slo/breaches", "objectives found in breach"),
+        "burn_rate": r.gauge(
+            "fleet/slo/burn_rate",
+            "error-budget burn rate per window (labelled window=<s>)"),
+    }
+
+
+_INST = register_slo_instruments(telemetry.registry())
+
+_CLAUSE_RE = re.compile(
+    r"^\s*([a-z0-9_]+)\s*:\s*(\S+)\s*(<=|>=)\s*([-+0-9.eE]+)"
+    r"(?:\s+default\s+([-+0-9.eE]+))?\s*$")
+
+
+class SloObjective:
+    """One declarative objective: ``value(metric) op bound``.
+
+    ``metric`` is a ``scalarize`` tag (or an observation key passed to
+    :func:`evaluate`); ``op`` is ``"<="`` or ``">="``; ``default``
+    substitutes when the metric is absent (None = absence breaches)."""
+
+    def __init__(self, name: str, metric: str, op: str, bound: float,
+                 default: Optional[float] = None):
+        if op not in ("<=", ">="):
+            raise ValueError(f"{name}: op must be <= or >=, got {op!r}")
+        self.name = name
+        self.metric = metric
+        self.op = op
+        self.bound = float(bound)
+        self.default = default if default is None else float(default)
+
+    def holds(self, value: float) -> bool:
+        """Whether ``value`` satisfies this objective."""
+        return (value <= self.bound if self.op == "<="
+                else value >= self.bound)
+
+    def to_dict(self) -> dict:
+        """JSON-friendly form (round-trips through ``SloSpec.parse``'s
+        clause grammar)."""
+        return {"name": self.name, "metric": self.metric,
+                "op": self.op, "bound": self.bound,
+                "default": self.default}
+
+    def __repr__(self) -> str:
+        return (f"SloObjective({self.name}: {self.metric} "
+                f"{self.op} {self.bound})")
+
+
+class SloSpec:
+    """An ordered set of :class:`SloObjective`\\ s — the declarative
+    contract one :func:`evaluate` call checks against a merged
+    snapshot."""
+
+    def __init__(self, objectives: Sequence[SloObjective]):
+        self.objectives = list(objectives)
+        names = [o.name for o in self.objectives]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate objective names in {names}")
+
+    @classmethod
+    def parse(cls, text: str) -> "SloSpec":
+        """Parse the spec grammar: ``name: metric <= bound
+        [default D]`` clauses separated by ``;`` or newlines."""
+        objectives = []
+        for clause in re.split(r"[;\n]", text):
+            if not clause.strip():
+                continue
+            m = _CLAUSE_RE.match(clause)
+            if not m:
+                raise ValueError(f"unparseable SLO clause: {clause!r}")
+            name, metric, op, bound, default = m.groups()
+            objectives.append(SloObjective(
+                name, metric, op, float(bound),
+                None if default is None else float(default)))
+        if not objectives:
+            raise ValueError("empty SloSpec")
+        return cls(objectives)
+
+    def to_dict(self) -> dict:
+        """JSON-friendly form."""
+        return {"objectives": [o.to_dict() for o in self.objectives]}
+
+    def __repr__(self) -> str:
+        return f"SloSpec({[o.name for o in self.objectives]})"
+
+
+class SloVerdict:
+    """One objective's outcome: the resolved value (None = no data),
+    where it came from (observation/snapshot/default) and whether the
+    objective holds."""
+
+    def __init__(self, objective: SloObjective, value: Optional[float],
+                 ok: bool, source: str):
+        self.objective = objective
+        self.value = value
+        self.ok = ok
+        self.source = source
+
+    def to_dict(self) -> dict:
+        """JSON-friendly form."""
+        return {"objective": self.objective.to_dict(),
+                "value": self.value, "ok": self.ok,
+                "source": self.source}
+
+    def describe(self) -> str:
+        """One human line: ``name: value op bound -> ok|BREACH``."""
+        o = self.objective
+        val = "no data" if self.value is None else f"{self.value:g}"
+        state = "ok" if self.ok else "BREACH"
+        return (f"{o.name}: {o.metric} = {val} "
+                f"(want {o.op} {o.bound:g}) -> {state}")
+
+
+class SloBreach(RuntimeError):
+    """Typed breach error carrying the full :class:`SloReport` —
+    what chaos legs raise so callers can branch on ``.report``."""
+
+    def __init__(self, report: "SloReport"):
+        self.report = report
+        super().__init__(
+            "SLO breach: " + ", ".join(report.breached))
+
+
+class SloReport:
+    """Typed result of one spec evaluation: per-objective verdicts,
+    the breached-objective names, and a pass flag. ``check()`` raises
+    :class:`SloBreach` on breach; ``to_dict()`` embeds in leg
+    reports."""
+
+    def __init__(self, spec: SloSpec, verdicts: Sequence[SloVerdict],
+                 wall_time: Optional[float] = None):
+        self.spec = spec
+        self.verdicts = list(verdicts)
+        self.wall_time = time.time() if wall_time is None else wall_time
+        self.breached = [v.objective.name for v in self.verdicts
+                         if not v.ok]
+        self.passed = not self.breached
+
+    def check(self) -> "SloReport":
+        """Raise :class:`SloBreach` if any objective breached; returns
+        self so call sites can chain."""
+        if not self.passed:
+            raise SloBreach(self)
+        return self
+
+    def to_dict(self) -> dict:
+        """JSON-friendly form (what chaos/soak reports embed)."""
+        return {"passed": self.passed, "breached": list(self.breached),
+                "wall_time": self.wall_time,
+                "verdicts": [v.to_dict() for v in self.verdicts]}
+
+    def describe(self) -> List[str]:
+        """Human lines, one per objective."""
+        return [v.describe() for v in self.verdicts]
+
+    def __repr__(self) -> str:
+        state = "passed" if self.passed else f"breached={self.breached}"
+        return f"SloReport({state})"
+
+
+def _kind_map(snapshot: Sequence[dict]) -> Dict[str, str]:
+    return {row["name"]: row["kind"] for row in snapshot}
+
+
+def _resolve(metric: str, scalars: Dict[str, float],
+             kinds: Dict[str, str]) -> Optional[Tuple[float, str]]:
+    if metric in scalars:
+        return scalars[metric], "snapshot"
+    # label-set reduction: name[labels].suffix tags matching the
+    # selector's name + suffix
+    m = re.search(r"\.(count|sum|p\d+)$", metric)
+    base = metric[:m.start()] if m else metric
+    suffix = m.group(0) if m else ""
+    tag_re = re.compile(
+        re.escape(base) + r"\[[^]]*\]" + re.escape(suffix) + r"$")
+    hits = [v for t, v in sorted(scalars.items()) if tag_re.match(t)]
+    if not hits:
+        return None
+    if suffix in (".count", ".sum") or kinds.get(base) == "counter":
+        return math.fsum(sorted(hits)), "snapshot-sum"
+    return max(hits), "snapshot-max"
+
+
+def evaluate(spec: SloSpec, snapshot: Optional[Sequence[dict]] = None,
+             observations: Optional[Dict[str, float]] = None
+             ) -> SloReport:
+    """Evaluate ``spec`` over a (merged) snapshot and/or a dict of
+    out-of-band observations (observation keys win over snapshot
+    tags). Returns the typed :class:`SloReport`; never raises — call
+    ``report.check()`` to get the typed :class:`SloBreach`."""
+    scalars = scalarize(list(snapshot)) if snapshot else {}
+    kinds = _kind_map(snapshot or [])
+    verdicts = []
+    for obj in spec.objectives:
+        if observations and obj.metric in observations:
+            value, source = float(observations[obj.metric]), \
+                "observation"
+        else:
+            hit = _resolve(obj.metric, scalars, kinds)
+            if hit is not None:
+                value, source = hit
+            elif obj.default is not None:
+                value, source = obj.default, "default"
+            else:
+                verdicts.append(SloVerdict(obj, None, False, "missing"))
+                continue
+        verdicts.append(SloVerdict(obj, value, obj.holds(value),
+                                   source))
+    report = SloReport(spec, verdicts)
+    _INST["evaluations"].inc()
+    if report.breached:
+        _INST["breaches"].inc(len(report.breached))
+    return report
+
+
+class SloEngine:
+    """Multi-window burn-rate state over repeated evaluations.
+
+    Each :meth:`evaluate` records a (timestamp, breached?) event; a
+    window's **burn rate** is its breach fraction divided by the
+    error budget (1.0 = spending budget exactly at the sustainable
+    rate). :meth:`burning` is the classic multi-window alert — true
+    only when EVERY window burns past ``burn_threshold``, so a single
+    bad scrape (short window only) or stale history (long window
+    only) does not page. Timestamps are injectable (``now=``) so
+    tests are deterministic."""
+
+    def __init__(self, spec: SloSpec, error_budget: float = 0.01,
+                 windows: Tuple[float, ...] = (60.0, 600.0),
+                 burn_threshold: float = 1.0):
+        if error_budget <= 0:
+            raise ValueError("error_budget must be > 0")
+        self.spec = spec
+        self.error_budget = float(error_budget)
+        self.windows = tuple(sorted(float(w) for w in windows))
+        self.burn_threshold = float(burn_threshold)
+        self._events: deque = deque()
+
+    def evaluate(self, snapshot: Optional[Sequence[dict]] = None,
+                 observations: Optional[Dict[str, float]] = None,
+                 now: Optional[float] = None) -> SloReport:
+        """One spec evaluation, recorded into the burn-rate windows."""
+        report = evaluate(self.spec, snapshot, observations)
+        t = time.time() if now is None else now
+        self._events.append((t, not report.passed))
+        horizon = t - max(self.windows)
+        while self._events and self._events[0][0] < horizon:
+            self._events.popleft()
+        for w, rate in self.burn_rates(now=t).items():
+            _INST["burn_rate"].set(rate, window=f"{w:g}s")
+        return report
+
+    def burn_rates(self, now: Optional[float] = None
+                   ) -> Dict[float, float]:
+        """``{window_s: burn_rate}`` over the recorded evaluations
+        (an empty window burns 0.0)."""
+        t = time.time() if now is None else now
+        out: Dict[float, float] = {}
+        for w in self.windows:
+            hits = [bad for ts, bad in self._events if ts > t - w]
+            frac = (sum(1 for b in hits if b) / len(hits)) if hits \
+                else 0.0
+            out[w] = frac / self.error_budget
+        return out
+
+    def burning(self, now: Optional[float] = None) -> bool:
+        """True when every window's burn rate exceeds the threshold —
+        the page/abort condition."""
+        rates = self.burn_rates(now=now)
+        return all(r > self.burn_threshold for r in rates.values())

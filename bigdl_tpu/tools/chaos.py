@@ -458,7 +458,7 @@ def run_hostkill(model: str = "tiny", steps: int = 12,
                  relaunch_nproc: int = 1, relaunch_cpu_devices: int = 2,
                  kill_after_commits: int = 1,
                  workdir: Optional[str] = None,
-                 tol: float = 1e-5) -> Dict:
+                 tol: float = 1e-5, slo_spec=None) -> Dict:
     """The multi-process host-kill leg: SIGKILL a WHOLE gang host
     mid-window and prove elastic recovery at a DIFFERENT world size.
 
@@ -504,6 +504,11 @@ def run_hostkill(model: str = "tiny", steps: int = 12,
     ref_ckpt = os.path.join(workdir, "ref-ckpts")
     ref_npz = os.path.join(workdir, "ref.npz")
     out_npz = os.path.join(workdir, "resumed.npz")
+    # per-gang snapshot-shipping dirs: gang A's files are the
+    # postmortem evidence the SIGKILL cannot destroy, gang B's feed
+    # the merged-fleet SLO below
+    tel_a = os.path.join(workdir, "telemetry-a")
+    tel_b = os.path.join(workdir, "telemetry-b")
     script = os.path.abspath(__file__)
     # workers run this file AS A SCRIPT: the package root must be
     # importable however the parent was started
@@ -545,7 +550,8 @@ def run_hostkill(model: str = "tiny", steps: int = 12,
 
         gang_a = launch.run_gang(launch.build_args(
             script, wargs(ckpt_dir, out_npz, paced), nproc=nproc,
-            cpu_devices=cpu_devices, extra_env=extra_env),
+            cpu_devices=cpu_devices, extra_env=extra_env,
+            ship_telemetry=tel_a),
             monitor=monitor)
         if not killed["done"]:
             report["violations"].append(
@@ -564,7 +570,7 @@ def run_hostkill(model: str = "tiny", steps: int = 12,
         gang_b = launch.run_gang(launch.build_args(
             script, wargs(ckpt_dir, out_npz), nproc=relaunch_nproc,
             cpu_devices=relaunch_cpu_devices, max_restarts=1,
-            extra_env=extra_env))
+            extra_env=extra_env, ship_telemetry=tel_b))
         report["gang_b"] = [(r.rank, r.kind, r.returncode)
                             for r in gang_b.reports]
         if not gang_b.ok:
@@ -599,6 +605,48 @@ def run_hostkill(model: str = "tiny", steps: int = 12,
                     report["violations"].append(
                         "resumed params diverged from the "
                         f"uninterrupted reference: {bad}")
+
+        # -- observability: postmortem snapshots, stragglers, SLO -----
+        # gang A shipped step-cadence snapshots before the SIGKILL —
+        # append-only files the kill cannot destroy; gang B's merged
+        # fleet snapshot feeds the progress/skew SLO
+        from bigdl_tpu.telemetry import agg, slo as slo_mod
+        sources_a = agg.read_snapshot_dir(tel_a)
+        report["postmortem_snapshots"] = len(sources_a)
+        if killed["done"] and not sources_a:
+            report["violations"].append(
+                "SIGKILLed gang A left no shipped telemetry "
+                "snapshots — the postmortem evidence trail is empty")
+        sources_b = agg.read_snapshot_dir(tel_b)
+        if sources_b:
+            merged = agg.aggregate_snapshots(sources_b)
+            for bad_line in agg.check_merge_invariant(
+                    sources_b, merged):
+                report["violations"].append(
+                    "merge invariant: " + bad_line)
+            strag = agg.detect_stragglers(sources_b)
+            report["stragglers"] = {"median": strag["median"],
+                                    "stragglers": strag["stragglers"]}
+            skew = max((v / strag["median"]
+                        for v in strag["per_source"].values()),
+                       default=1.0) if strag["median"] > 0 else 1.0
+            spec = slo_spec if slo_spec is not None else slo_mod.SloSpec([
+                slo_mod.SloObjective(
+                    "progress", "train/optimizer/steps", ">=", 1.0),
+                # generous: flags pathological skew only, not CI noise
+                slo_mod.SloObjective(
+                    "step_skew", "step_time_skew", "<=", 100.0,
+                    default=1.0),
+            ])
+            slo_report = slo_mod.evaluate(
+                spec, merged, {"step_time_skew": skew})
+            report["slo"] = slo_report.to_dict()
+            report["violations"].extend(
+                "SLO breach: " + v.describe()
+                for v in slo_report.verdicts if not v.ok)
+        elif gang_b.ok:
+            report["violations"].append(
+                "relaunched gang shipped no telemetry snapshots")
     finally:
         if own_workdir:
             shutil.rmtree(workdir, ignore_errors=True)
@@ -608,10 +656,24 @@ def run_hostkill(model: str = "tiny", steps: int = 12,
 
 # -------------------------------------------------- fleet chaos leg
 
+class _NoFaults:
+    """Stand-in schedule for a fault-free control run."""
+    rules = ()
+
+    def fired(self):
+        return {}
+
+
+_NO_FAULTS = _NoFaults()
+
+
 def run_fleet(replicas: int = 3, requests: int = 18, threads: int = 3,
               max_new: int = 4, seed: int = 42,
-              schedule: str = DEFAULT_FLEET_SCHEDULE,
-              deadline_s: float = 120.0) -> Dict:
+              schedule: Optional[str] = DEFAULT_FLEET_SCHEDULE,
+              deadline_s: float = 120.0,
+              out_dir: Optional[str] = None,
+              slo_spec=None,
+              ttft_budget_ms: float = 30000.0) -> Dict:
     """The ``--fleet`` leg: kill one replica mid-burst under a seeded
     schedule and prove the router's failure contract.
 
@@ -628,21 +690,45 @@ def run_fleet(replicas: int = 3, requests: int = 18, threads: int = 3,
     successful greedy stream is bit-identical to the reference,
     re-routed or not; and injected ``fleet/replica`` faults reconcile
     counter-for-counter against the router's
-    ``fleet/replica/evictions``."""
+    ``fleet/replica/evictions``.
+
+    Observability plane (this leg doubles as its end-to-end check):
+    every replica serves out of its OWN registry; the per-source
+    snapshots are shipped to ``out_dir`` (default: a kept temp dir,
+    path under ``report["artifacts"]``), merged via
+    ``telemetry.agg.aggregate_snapshots`` (merge invariant asserted),
+    the burst's spans become ONE merged Perfetto timeline, and
+    ``slo_spec`` (default: evictions==0 + p99 TTFT budget) is
+    evaluated over the MERGED snapshot. A seeded replica death must
+    surface as a typed ``SloBreach``; a clean schedule must pass."""
     import numpy as np
 
     import bigdl_tpu.telemetry as telemetry
     from bigdl_tpu import faults
     from bigdl_tpu.fleet import FleetRouter, build_replicas
     from bigdl_tpu.serving import Degraded, QueueFull
+    from bigdl_tpu.telemetry import agg, slo as slo_mod
     from bigdl_tpu.tools.synthetic import seeded_rng
 
     report: Dict = {"replicas": replicas, "requests": requests,
                     "schedule": schedule, "violations": []}
+    if out_dir is None:
+        out_dir = tempfile.mkdtemp(prefix="bigdl-chaos-fleet-")
+    os.makedirs(out_dir, exist_ok=True)
+    snap_dir = os.path.join(out_dir, "snapshots")
+    os.makedirs(snap_dir, exist_ok=True)
+    # spans from the burst feed the merged timeline; restore the
+    # caller's tracing state afterwards
+    tracing_was_on = telemetry.enabled()
+    telemetry.enable()
     metrics = telemetry.MetricsRegistry()
-    router = FleetRouter(
-        build_replicas(replicas, seed=seed, max_queue=8,
-                       metrics=metrics), metrics=metrics)
+    # metrics=None: each replica's GenerationService keeps its OWN
+    # registry (the cross-process shape, thread-hosted); the router's
+    # instruments live in `metrics` and the observability plane must
+    # merge them all back together
+    reps = build_replicas(replicas, seed=seed, max_queue=8,
+                          metrics=None)
+    router = FleetRouter(reps, metrics=metrics)
     r = seeded_rng(seed + 1)
     prompts = [r.randint(1, 31, 3).astype(np.int32) for _ in range(4)]
     try:
@@ -682,7 +768,9 @@ def run_fleet(replicas: int = 3, requests: int = 18, threads: int = 3,
         names = [rep.name for rep in router.replicas()]
         for i in range(6):
             router._sessions[f"sess-{i}"] = names[i % len(names)]
-        sched = faults.arm(schedule)
+        # schedule=None runs the same burst fault-free — the control
+        # leg that proves a clean fleet does NOT breach the SLO
+        sched = faults.arm(schedule) if schedule else _NO_FAULTS
         try:
             workers = [threading.Thread(target=pump, daemon=True,
                                         name=f"chaos-fleet-{i}")
@@ -691,10 +779,12 @@ def run_fleet(replicas: int = 3, requests: int = 18, threads: int = 3,
                 w.start()
             for w in workers:
                 w.join(timeout=deadline_s)
-            _await_deterministic_rules(sched, ("fleet/replica",),
-                                       timeout_s=15.0)
+            if schedule:
+                _await_deterministic_rules(sched, ("fleet/replica",),
+                                           timeout_s=15.0)
         finally:
-            faults.disarm()
+            if schedule:
+                faults.disarm()
 
         # -- phase 3: every stream resolves, typed or tokens ----------
         from concurrent.futures import TimeoutError as FutTimeout
@@ -740,8 +830,65 @@ def run_fleet(replicas: int = 3, requests: int = 18, threads: int = 3,
                 report["violations"].append(
                     f"scheduled fault never fired: {rule!r}")
         report["states"] = router.metrics()["states"]
+
+        # -- observability plane: ship, merge, SLO --------------------
+        # ship every per-replica registry (dead ones included — that
+        # is the postmortem) plus the router's through the real JSONL
+        # wire format, then read the directory back like a collector
+        for rep in reps:
+            telemetry.JsonlExporter(
+                rep.service.metrics_registry,
+                os.path.join(snap_dir, f"snap-{rep.name}.jsonl"),
+                identity=telemetry.process_identity(replica=rep.name),
+                include_samples=True).export()
+        telemetry.JsonlExporter(
+            metrics, os.path.join(snap_dir, "snap-router.jsonl"),
+            identity=telemetry.process_identity(replica="router"),
+            include_samples=True).export()
+        sources = agg.read_snapshot_dir(snap_dir)
+        merged = agg.aggregate_snapshots(sources)
+        for bad in agg.check_merge_invariant(sources, merged):
+            report["violations"].append(f"merge invariant: {bad}")
+        trace_path = os.path.join(out_dir, "fleet-trace.json")
+        agg.write_merged_trace(
+            trace_path,
+            [("fleet", telemetry.tracer().chrome_trace_events())])
+        if slo_spec is None:
+            slo_spec = slo_mod.SloSpec([
+                slo_mod.SloObjective(
+                    "evictions", "fleet/replica/evictions", "<=", 0.0,
+                    default=0.0),
+                slo_mod.SloObjective(
+                    "p99_ttft", "serving/generation/ttft_ms.p99",
+                    "<=", ttft_budget_ms, default=0.0),
+            ])
+        slo_report = slo_mod.evaluate(slo_spec, merged)
+        report["slo"] = slo_report.to_dict()
+        slo_path = os.path.join(out_dir, "slo.json")
+        with open(slo_path, "w") as f:
+            json.dump(report["slo"], f, indent=2, default=str)
+        report["artifacts"] = {"dir": out_dir, "snapshots": snap_dir,
+                               "trace": trace_path, "slo": slo_path}
+        breach = None
+        try:
+            slo_report.check()
+        except slo_mod.SloBreach as e:
+            breach = e
+        report["slo_breach_detected"] = breach is not None
+        # the contract this leg certifies: a seeded replica death
+        # under load IS an SLO breach (typed), a clean run is not
+        if injected > 0 and breach is None:
+            report["violations"].append(
+                "seeded replica death did not surface as a typed "
+                "SLO breach")
+        if injected == 0 and breach is not None:
+            report["violations"].append(
+                f"clean fleet run breached SLO: "
+                f"{breach.report.breached}")
     finally:
         router.shutdown(drain=True)
+        if not tracing_was_on:
+            telemetry.disable()
     report["passed"] = not report["violations"]
     return report
 
@@ -940,7 +1087,17 @@ def main(argv=None) -> int:
     ap.add_argument("--fleet-requests", type=int, default=18)
     ap.add_argument("--fleet-schedule", default=DEFAULT_FLEET_SCHEDULE,
                     help="fleet-leg fault schedule (the fleet/replica "
-                         "point kills the matched replica)")
+                         "point kills the matched replica); 'none' "
+                         "runs the fault-free control leg")
+    ap.add_argument("--fleet-out", default=None,
+                    help="fleet-leg artifact directory (per-replica "
+                         "snapshots, merged Perfetto trace, SLO "
+                         "report); default: a temp dir, printed")
+    ap.add_argument("--slo", default=None,
+                    help="override the fleet leg's SloSpec, e.g. "
+                         "'evictions: fleet/replica/evictions <= 0 "
+                         "default 0; p99: serving/generation/"
+                         "ttft_ms.p99 <= 5000 default 0'")
     # host-kill leg: SIGKILL a whole tools/launch gang host mid-window,
     # relaunch at a different world size, assert elastic recovery
     ap.add_argument("--hostkill", action="store_true",
@@ -975,10 +1132,14 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     if args.fleet:
+        from bigdl_tpu.telemetry import slo as slo_mod
+        spec = slo_mod.SloSpec.parse(args.slo) if args.slo else None
+        schedule = None if args.fleet_schedule in ("none", "") \
+            else args.fleet_schedule
         report = run_fleet(replicas=args.fleet_replicas,
                            requests=args.fleet_requests,
-                           seed=args.seed,
-                           schedule=args.fleet_schedule)
+                           seed=args.seed, schedule=schedule,
+                           out_dir=args.fleet_out, slo_spec=spec)
         if args.json:
             print(json.dumps(report, indent=2, default=str))
         else:
@@ -991,6 +1152,13 @@ def main(argv=None) -> int:
             print(f"states:    {report.get('states')}")
             print(f"bit-identical greedy outputs: "
                   f"{report.get('bit_identical')}")
+            slo = report.get("slo") or {}
+            print(f"slo:       breached={slo.get('breached')} "
+                  f"breach_detected="
+                  f"{report.get('slo_breach_detected')}")
+            art = report.get("artifacts") or {}
+            print(f"artifacts: merged trace {art.get('trace')}  "
+                  f"slo {art.get('slo')}")
             for v in report["violations"]:
                 print(f"VIOLATION: {v}")
             print("PASS" if report["passed"] else "FAIL")
@@ -1022,6 +1190,11 @@ def main(argv=None) -> int:
                   f"recovered={report.get('recovered')}")
             print(f"params_max_err={report.get('params_max_err')} "
                   f"bit_identical={report.get('bit_identical')}")
+            print(f"postmortem snapshots: "
+                  f"{report.get('postmortem_snapshots')}  "
+                  f"stragglers: {report.get('stragglers')}")
+            slo = report.get("slo") or {}
+            print(f"slo: breached={slo.get('breached')}")
             for v in report["violations"]:
                 print(f"VIOLATION: {v}")
             print("PASS" if report["passed"] else "FAIL")

@@ -155,11 +155,13 @@ def collect_instrument_names():
     from bigdl_tpu.optim.optimizer import Metrics
     from bigdl_tpu.serving.batcher import BatcherStats
     from bigdl_tpu.serving.compile_cache import CompileCache
+    from bigdl_tpu.telemetry.agg import register_agg_instruments
     from bigdl_tpu.telemetry.programs import register_program_instruments
     BatcherStats(registry=scratch, model="audit")
     CompileCache(metrics=scratch)
     register_generation_instruments(scratch)
-    register_fleet_instruments(scratch)
+    register_fleet_instruments(scratch)  # includes fleet/slo/*
+    register_agg_instruments(scratch)
     register_program_instruments(scratch)
     m = Metrics(registry=scratch)
     m.add("data time", 0.0)

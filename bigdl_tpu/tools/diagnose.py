@@ -7,6 +7,9 @@
         --jsonl FILE                                  # ingest snapshots
         --postmortem DIR                              # ingest a flight-
                                                       # recorder bundle
+                                                      # (or a dir of them)
+        --fleet DIR                                   # ingest a fleet
+                                                      # snapshot dir
         --json                                        # machine output
 
 Default mode runs a short INSTRUMENTED workload — a LeNet training run
@@ -35,9 +38,21 @@ events); ``--jsonl`` renders the LAST snapshot of a JSONL metrics file
 (the ones ``tools/perf --metrics-jsonl`` / ``BIGDL_METRICS_JSONL``
 emit); ``--postmortem`` ingests a crash flight-recorder bundle
 (``telemetry.flight``) — manifest + trace + metrics + program profiles
-+ the last ring events — into the same report.
++ the last ring events — into the same report. A ``--postmortem``
+directory WITHOUT a top-level MANIFEST.json is scanned for per-process
+bundles (the layout a killed gang leaves behind) and their traces,
+snapshots and program rows are merged into one report.
 
-Exit codes: 0 report printed, 2 usage/ingest error.
+``--fleet DIR`` ingests a snapshot-shipping directory
+(``telemetry.agg``): the merged fleet registry is rendered as the
+metrics/feed sections, the merged-registry agreement is checked
+(``check_merge_invariant``; any violation prints and exits 1),
+per-source step-time/data-wait skew vs the fleet median flags
+stragglers, and any flight-recorder bundles or Chrome traces under
+the directory merge into one timeline and one device section.
+
+Exit codes: 0 report printed, 1 fleet merge-invariant violation,
+2 usage/ingest error.
 """
 from __future__ import annotations
 
@@ -103,11 +118,15 @@ def _fmt_report(rows: List[dict], metrics_lines: List[str],
                 feed_lines: Optional[List[str]] = None,
                 precision_lines: Optional[List[str]] = None,
                 device_lines: Optional[List[str]] = None,
-                postmortem_lines: Optional[List[str]] = None) -> str:
+                postmortem_lines: Optional[List[str]] = None,
+                fleet_lines: Optional[List[str]] = None) -> str:
     lines = ["== where did the time go =="]
     if postmortem_lines:
         lines.append("postmortem:")
         lines.extend(f"  {m}" for m in postmortem_lines)
+    if fleet_lines:
+        lines.append("fleet:")
+        lines.extend(f"  {m}" for m in fleet_lines)
     group = None
     for r in rows:
         if r["group"] != group:
@@ -333,11 +352,23 @@ def load_postmortem(bundle_dir: str) -> dict:
     """Read a flight-recorder bundle (``telemetry.flight.dump``
     layout) into ``{manifest, events, snapshot, flight_events,
     programs}``; raises OSError/ValueError on an unreadable or
-    foreign bundle."""
+    foreign bundle. A directory without a top-level MANIFEST.json but
+    with bundle SUBdirectories (what a killed multi-process gang
+    leaves) is merged into one report: traces combine per-source via
+    :func:`telemetry.agg.merge_chrome_traces`, snapshots via
+    :func:`telemetry.agg.aggregate_snapshots`."""
     import os
 
     from bigdl_tpu.telemetry.flight import MANIFEST_FORMAT
 
+    if not os.path.exists(os.path.join(bundle_dir, "MANIFEST.json")):
+        subs = sorted(
+            d for d in os.listdir(bundle_dir)
+            if os.path.exists(
+                os.path.join(bundle_dir, d, "MANIFEST.json")))
+        if subs:
+            return _load_postmortem_fleet(
+                [os.path.join(bundle_dir, d) for d in subs])
     with open(os.path.join(bundle_dir, "MANIFEST.json")) as f:
         manifest = json.load(f)
     if manifest.get("format") != MANIFEST_FORMAT:
@@ -367,6 +398,47 @@ def load_postmortem(bundle_dir: str) -> dict:
             out["flight_events"] = [json.loads(ln) for ln in f
                                     if ln.strip()]
     return out
+
+
+def _load_postmortem_fleet(bundle_dirs: List[str]) -> dict:
+    """Merge several per-process flight bundles into one
+    ``load_postmortem``-shaped dict (each bundle becomes its own
+    process track in the merged trace; registry snapshots aggregate
+    with the fleet merge semantics)."""
+    import os
+
+    from bigdl_tpu.telemetry import agg
+
+    bundles = [(os.path.basename(d.rstrip(os.sep)),
+                load_postmortem(d)) for d in bundle_dirs]
+    events = agg.merge_chrome_traces(
+        [(tag, b["events"]) for tag, b in bundles])
+    snapshot = agg.aggregate_snapshots(
+        [({"pid": b["manifest"].get("pid")}, b["snapshot"])
+         for _, b in bundles])
+    manifests = [b["manifest"] for _, b in bundles]
+    err = next((m.get("error") for m in manifests if m.get("error")),
+               None)
+    manifest = {
+        "format": manifests[0].get("format"),
+        "reason": "; ".join(f"{tag}: {b['manifest'].get('reason')}"
+                            for tag, b in bundles),
+        "error": err,
+        "pid": ",".join(str(m.get("pid")) for m in manifests),
+        "events": sum(int(m.get("events", 0)) for m in manifests),
+        "bundles": len(bundles),
+    }
+    flight_events, programs, seen = [], [], set()
+    for tag, b in bundles:
+        flight_events.extend({**ev, "src": tag}
+                             for ev in b["flight_events"])
+        for row in b["programs"]:
+            if row.get("name") not in seen:
+                seen.add(row.get("name"))
+                programs.append(row)
+    return {"manifest": manifest, "events": events,
+            "snapshot": snapshot, "flight_events": flight_events,
+            "programs": programs}
 
 
 def _postmortem_lines(pm: dict) -> List[str]:
@@ -402,6 +474,70 @@ def _metrics_lines(snapshot: List[dict]) -> List[str]:
                            f"{ps}".rstrip())
             else:
                 out.append(f"{tag}: {s['value']:g}")
+    return out
+
+
+def _load_fleet(directory: str, threshold: float = 1.5
+                ) -> Optional[dict]:
+    """Ingest a snapshot-shipping directory (``telemetry.agg``):
+    returns ``{sources, snapshot, violations, stragglers, events,
+    programs}`` — the merged fleet registry, the merge-invariant
+    verdict, per-metric straggler skew, plus one merged timeline and
+    deduped program rows from any flight bundles / Chrome traces
+    found under the directory. ``None`` when no snapshot files."""
+    import os
+
+    from bigdl_tpu.telemetry import agg
+
+    sources = agg.read_snapshot_dir(directory)
+    if not sources:
+        return None
+    snapshot = agg.aggregate_snapshots(sources)
+    violations = agg.check_merge_invariant(sources, snapshot)
+    stragglers = {}
+    for metric, label in (
+            ("train/optimizer/computing_time", "step_time"),
+            ("train/optimizer/data_time", "data_wait"),
+            ("serving/generation/ttft_ms", "ttft")):
+        st = agg.detect_stragglers(sources, metric=metric,
+                                   threshold=threshold)
+        if st["per_source"]:
+            stragglers[label] = st
+    trace_paths, programs, seen = [], [], set()
+    for root, _, files in os.walk(directory):
+        for name in files:
+            path = os.path.join(root, name)
+            if name == "trace.json" or name.endswith("-trace.json"):
+                trace_paths.append(path)
+            elif name == "programs.json":
+                with open(path) as f:
+                    for row in json.load(f):
+                        if row.get("name") not in seen:
+                            seen.add(row.get("name"))
+                            programs.append(row)
+    events = agg.merge_chrome_trace_files(sorted(trace_paths)) \
+        if trace_paths else []
+    return {"sources": [agg.source_tag(i) for i, _ in sources],
+            "snapshot": snapshot, "violations": violations,
+            "stragglers": stragglers, "events": events,
+            "programs": programs}
+
+
+def _fleet_lines(fleet: dict) -> List[str]:
+    out = [f"{len(fleet['sources'])} sources: "
+           + ", ".join(fleet["sources"])]
+    for v in fleet["violations"]:
+        out.append(f"MERGE INVARIANT VIOLATION: {v}")
+    if not fleet["violations"]:
+        out.append("merged totals equal per-process sums (exact)")
+    for label, st in sorted(fleet["stragglers"].items()):
+        out.append(f"{label} {st['stat']} by source "
+                   f"(fleet median {st['median']:.4f}):")
+        flagged = {s["source"] for s in st["stragglers"]}
+        for tag in sorted(st["per_source"]):
+            val = st["per_source"][tag]
+            mark = "  <-- STRAGGLER" if tag in flagged else ""
+            out.append(f"  {tag}: {val:.4f}{mark}")
     return out
 
 
@@ -502,15 +638,24 @@ def main(argv=None) -> int:
                          "running the workload")
     ap.add_argument("--postmortem", default=None, metavar="DIR",
                     help="ingest a crash flight-recorder bundle "
-                         "(telemetry.flight.dump directory) instead "
+                         "(telemetry.flight.dump directory, or a "
+                         "directory of per-process bundles) instead "
                          "of running the workload")
+    ap.add_argument("--fleet", default=None, metavar="DIR",
+                    help="ingest a fleet snapshot-shipping directory "
+                         "(telemetry.agg): merged registry + merge-"
+                         "invariant check + straggler skew + merged "
+                         "traces/bundles found under it")
+    ap.add_argument("--straggler-threshold", type=float, default=1.5,
+                    help="--fleet: flag a source whose step time "
+                         "exceeds this multiple of the fleet median")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
 
     if sum(bool(m) for m in (args.trace, args.jsonl,
-                             args.postmortem)) > 1:
-        print("--trace, --jsonl and --postmortem are mutually "
-              "exclusive", file=sys.stderr)
+                             args.postmortem, args.fleet)) > 1:
+        print("--trace, --jsonl, --postmortem and --fleet are "
+              "mutually exclusive", file=sys.stderr)
         return 2
 
     summary = None
@@ -518,8 +663,22 @@ def main(argv=None) -> int:
     history: Optional[List[List[dict]]] = None
     program_rows: List[dict] = []
     postmortem = None
+    fleet: Optional[dict] = None
     wrote_trace = False
-    if args.postmortem:
+    if args.fleet:
+        try:
+            fleet = _load_fleet(args.fleet, args.straggler_threshold)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"cannot read fleet directory {args.fleet}: {e}",
+                  file=sys.stderr)
+            return 2
+        if fleet is None:
+            print(f"{args.fleet}: no snapshot files", file=sys.stderr)
+            return 2
+        events = fleet["events"]
+        snapshot = fleet["snapshot"]
+        program_rows = fleet["programs"]
+    elif args.postmortem:
         try:
             postmortem = load_postmortem(args.postmortem)
         except (OSError, ValueError, KeyError) as e:
@@ -558,7 +717,7 @@ def main(argv=None) -> int:
         summary = opt.metrics.summary()
         wrote_trace = args.out_trace is not None
 
-    if not args.postmortem:
+    if not args.postmortem and not args.fleet:
         # live modes read whatever programs this process registered
         from bigdl_tpu.telemetry import programs as _programs
         program_rows = _programs.registry().to_dict()
@@ -576,17 +735,22 @@ def main(argv=None) -> int:
                           "device": device,
                           "postmortem": postmortem["manifest"]
                           if postmortem else None,
+                          "fleet": {k: fleet[k] for k in
+                                    ("sources", "violations",
+                                     "stragglers")}
+                          if fleet else None,
                           "optimizer_summary": summary}, indent=2))
     else:
         print(_fmt_report(rows, _metrics_lines(snapshot), summary,
                           _feed_lines(feed), _precision_lines(prec),
                           _device_lines(device),
                           _postmortem_lines(postmortem)
-                          if postmortem else None))
+                          if postmortem else None,
+                          _fleet_lines(fleet) if fleet else None))
         if wrote_trace:
             print(f"chrome trace written to {args.out_trace} "
                   "(load in Perfetto / chrome://tracing)")
-    return 0
+    return 1 if fleet and fleet["violations"] else 0
 
 
 if __name__ == "__main__":

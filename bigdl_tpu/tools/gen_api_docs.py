@@ -55,7 +55,9 @@ FAMILIES = {
                   "bigdl_tpu.telemetry.metrics",
                   "bigdl_tpu.telemetry.export",
                   "bigdl_tpu.telemetry.programs",
-                  "bigdl_tpu.telemetry.flight"],
+                  "bigdl_tpu.telemetry.flight",
+                  "bigdl_tpu.telemetry.agg",
+                  "bigdl_tpu.telemetry.slo"],
     "tools": ["bigdl_tpu.tools.regress"],
     "faults": ["bigdl_tpu.faults", "bigdl_tpu.faults.retry"],
     "elastic": ["bigdl_tpu.elastic", "bigdl_tpu.elastic.checkpoint",

@@ -164,6 +164,12 @@ def _launch_gang(args, coord: str, attempt: int) -> List[_Worker]:
         env["JAX_NUM_PROCESSES"] = str(total)
         env["JAX_PROCESS_ID"] = str(rank)
         env["BIGDL_RESTART_ATTEMPT"] = str(attempt)
+        if getattr(args, "ship_telemetry", None):
+            # every worker ships identity-stamped snapshots into one
+            # directory; telemetry.agg merges them fleet-wide
+            env["BIGDL_TELEMETRY_SHIP_DIR"] = args.ship_telemetry
+            env["BIGDL_FLIGHT_DIR"] = os.path.join(
+                args.ship_telemetry, "flight")
         if args.cpu_devices:
             env["JAX_PLATFORMS"] = "cpu"
             env["XLA_FLAGS"] = (
@@ -364,16 +370,20 @@ def build_args(script: str, script_args=(), *, nproc: int = 1,
                coordinator: Optional[str] = None, cpu_devices: int = 0,
                max_restarts: int = 0, startup_grace: float = 20.0,
                start_retries: int = 3,
-               extra_env: Optional[dict] = None) -> argparse.Namespace:
+               extra_env: Optional[dict] = None,
+               ship_telemetry: Optional[str] = None) -> argparse.Namespace:
     """The programmatic form of the CLI arguments (what
     ``tools.chaos --hostkill`` passes to :func:`run_gang`).
-    ``extra_env`` overlays the inherited environment per worker."""
+    ``extra_env`` overlays the inherited environment per worker;
+    ``ship_telemetry`` arms every worker's snapshot shipper and flight
+    recorder into that directory (``diagnose --fleet`` reads it)."""
     return argparse.Namespace(
         nproc=nproc, nnodes=nnodes, node_rank=node_rank,
         coordinator=coordinator, cpu_devices=cpu_devices,
         max_restarts=max_restarts, startup_grace=startup_grace,
         start_retries=start_retries, script=script,
-        script_args=list(script_args), extra_env=dict(extra_env or {}))
+        script_args=list(script_args), extra_env=dict(extra_env or {}),
+        ship_telemetry=ship_telemetry)
 
 
 def main(argv=None):
@@ -403,6 +413,12 @@ def main(argv=None):
                     help="retry a failed gang START this many times on "
                          "a fresh coordinator port (classified backoff "
                          "via faults.retry)")
+    ap.add_argument("--ship-telemetry", dest="ship_telemetry",
+                    default=None, metavar="DIR",
+                    help="arm every worker's snapshot shipper + flight "
+                         "recorder into DIR (merge with "
+                         "`python -m bigdl_tpu.tools.diagnose "
+                         "--fleet DIR`)")
     ap.add_argument("script")
     ap.add_argument("script_args", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)

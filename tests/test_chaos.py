@@ -224,6 +224,12 @@ def test_chaos_fleet_leg_in_process():
     assert report["recovered"]["evictions"] == \
         report["injected"]["fleet/replica"]
     assert "dead" in report["states"].values()
+    # observability plane: the seeded death surfaced as a typed SLO
+    # breach over the MERGED fleet snapshot, and the artifacts exist
+    assert report["slo_breach_detected"] is True
+    assert "evictions" in report["slo"]["breached"]
+    assert os.path.exists(report["artifacts"]["trace"])
+    assert os.path.exists(report["artifacts"]["slo"])
 
 
 @pytest.mark.slow
