@@ -544,6 +544,16 @@ def main():
     # runs unless forced.
     if _row_enabled("BENCH_SLO", platform):
         result.update(_bench_slo())
+    # fourteenth tracked row: LONGCTX — long-context attention and
+    # serving (the blockwise flash kernel past the VMEM budget +
+    # chunked prefill): per-S train-step tokens/sec and MFU with the
+    # blockwise kernel vs the einsum/bundled-flash fallback, and
+    # chunked-prefill TTFT both ways. The fallback legs stop at
+    # BENCH_LONGCTX_EINSUM_MAX (default 32K) — past it the O(S^2)
+    # reference cannot run at all, which is the row's point. Skipped
+    # on CPU smoke runs unless forced.
+    if _row_enabled("BENCH_LONGCTX", platform):
+        result.update(_bench_longctx())
     print(json.dumps(result))
     _maybe_metrics_snapshot(result)
 
@@ -825,6 +835,131 @@ def _bench_slo():
         "slo_ttft_ms_p99": round(by.get("p99_ttft") or 0.0, 3),
         "slo_passed": int(rep.passed and soak["passed"] and not bad),
     }
+
+
+def _bench_longctx():
+    """LONGCTX row: what the long-context stack buys, as
+    sentinel-tracked numbers at S in BENCH_LONGCTX_SEQS (default
+    8K/32K/128K).
+
+    Leg 1 — training attention: one fused fwd+bwd causal attention
+    step (``jit(value_and_grad)``, so the custom-VJP backward is the
+    program measured) per S, blockwise flash kernel on vs the
+    einsum/bundled-flash reference, each registered in
+    ``telemetry.programs`` with the kernel= label decided by trace
+    EVIDENCE — tokens/sec + MFU both ways and the speedup. Past
+    ``BENCH_LONGCTX_EINSUM_MAX`` the quadratic reference is not run
+    (it cannot fit); the blockwise numbers stand alone, which is the
+    row's point. Leg 2 — serving: TTFT of an ~S-token prompt through
+    chunked prefill (fixed BENCH_LONGCTX_CHUNK-wide chunks through the
+    existing bucket rungs), kernels on vs off under the same chunking,
+    with the prefill chunk count and compile count carried so the
+    <=2-programs-per-bucket bound stays checkable. On CPU the
+    kernel-on legs run the pallas interpreter, so CPU numbers document
+    equivalence overhead, not a win — shrink BIGDL_VMEM_BUDGET_MB to
+    steer small smoke shapes down the blockwise route."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bigdl_tpu import kernels
+    from bigdl_tpu.generation import GenerationConfig, GenerationService
+    from bigdl_tpu.kernels.dispatch import taken_in_thread
+    from bigdl_tpu.models import TransformerLM
+    from bigdl_tpu.nn.attention import dot_product_attention
+    from bigdl_tpu.telemetry import programs
+    from bigdl_tpu.tools.synthetic import seeded_rng
+    from bigdl_tpu.utils.random import RandomGenerator
+
+    seqs = [int(s) for s in os.environ.get(
+        "BENCH_LONGCTX_SEQS", "8192,32768,131072").split(",")]
+    b = int(os.environ.get("BENCH_LONGCTX_BATCH", 1))
+    heads = int(os.environ.get("BENCH_LONGCTX_HEADS", 8))
+    hd = int(os.environ.get("BENCH_LONGCTX_HEAD_DIM", 64))
+    einsum_max = int(os.environ.get("BENCH_LONGCTX_EINSUM_MAX", 32768))
+    chunk = int(os.environ.get("BENCH_LONGCTX_CHUNK", 2048))
+    vocab = int(os.environ.get("BENCH_LONGCTX_VOCAB", 8192))
+    hidden = int(os.environ.get("BENCH_LONGCTX_HIDDEN", 512))
+    layers = int(os.environ.get("BENCH_LONGCTX_LAYERS", 2))
+    max_new = int(os.environ.get("BENCH_LONGCTX_NEW", 8))
+    iters = int(os.environ.get("BENCH_ITERS", 6))
+    reg = programs.registry()
+    row = {"longctx_einsum_max": einsum_max,
+           "longctx_prefill_chunk": chunk}
+
+    def attn_leg(s, tag, cfg):
+        kq, kk, kv = jax.random.split(jax.random.PRNGKey(41 + s % 97), 3)
+        q = jax.random.normal(kq, (b, heads, s, hd), jnp.float32)
+        k = jax.random.normal(kk, (b, heads, s, hd), jnp.float32)
+        v = jax.random.normal(kv, (b, heads, s, hd), jnp.float32)
+        with kernels.use(cfg):
+            fn = jax.jit(jax.value_and_grad(
+                lambda q_, k_, v_: dot_product_attention(
+                    q_, k_, v_, causal=True).sum(), argnums=(0, 1, 2)))
+            taken_before = taken_in_thread()
+            t0 = time.perf_counter()
+            compiled = fn.lower(q, k, v).compile()
+            compile_s = time.perf_counter() - t0
+            taken = int(taken_in_thread() > taken_before)
+            name = f"bench/longctx/s{s}/{tag}"
+            reg.register(name, "train", compiled=compiled,
+                         compile_s=compile_s, items_per_call=b * s,
+                         kernel="pallas" if taken else "reference")
+            jax.block_until_ready(compiled(q, k, v))  # warm
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(iters):
+                out = compiled(q, k, v)
+            jax.block_until_ready(out)
+            rate = b * s * iters / (time.perf_counter() - t0)
+            prof = reg.record_rate(name, rate)
+            mfu = prof.mfu if prof is not None else None
+            return rate, (mfu or 0.0), taken
+
+    def ttft_leg(s, cfg):
+        with kernels.use(cfg):
+            RandomGenerator.set_seed(43)
+            model = TransformerLM(vocab_size=vocab, hidden_size=hidden,
+                                  num_layers=layers, num_heads=heads,
+                                  max_len=s).evaluate()
+            model.ensure_initialized()
+            svc = GenerationService(config=GenerationConfig(
+                slots=2, max_len=s, prefill_rows=2,
+                prefill_chunk=chunk))
+            svc.load("longlm", model)  # warmup compiles off the clock
+            r = seeded_rng(44)
+            prompt = r.randint(1, vocab, s - max_new).astype(np.int32)
+            stream = svc.generate("longlm", prompt,
+                                  max_new_tokens=max_new)
+            stream.result()
+            ttft = stream.ttft_ms
+            m = svc.metrics("longlm")
+            svc.shutdown()
+            return ttft, int(m.get("prefill_chunks", 0)), \
+                int(m["compile_count"])
+
+    for s in seqs:
+        rate_on, mfu_on, taken = attn_leg(
+            s, "blockwise", kernels.KernelConfig.all_on())
+        row[f"longctx_s{s}_tokens_per_sec_blockwise"] = round(rate_on, 1)
+        row[f"longctx_s{s}_mfu_blockwise"] = round(mfu_on, 4)
+        row[f"longctx_s{s}_flash_taken"] = taken
+        if s <= einsum_max:
+            rate_off, mfu_off, _ = attn_leg(
+                s, "einsum", kernels.KernelConfig.off())
+            row[f"longctx_s{s}_tokens_per_sec_einsum"] = round(
+                rate_off, 1)
+            row[f"longctx_s{s}_mfu_einsum"] = round(mfu_off, 4)
+            row[f"longctx_s{s}_blockwise_speedup"] = round(
+                rate_on / rate_off, 3)
+        ttft, chunks, compiles = ttft_leg(s, kernels.KernelConfig.all_on())
+        row[f"longctx_s{s}_ttft_ms"] = round(ttft, 3)
+        row[f"longctx_s{s}_prefill_chunks"] = chunks
+        row[f"longctx_s{s}_generation_compiles"] = compiles
+        if s <= einsum_max:
+            ttft_ref, _, _ = ttft_leg(s, kernels.KernelConfig.off())
+            row[f"longctx_s{s}_ttft_ms_einsum"] = round(ttft_ref, 3)
+    return row
 
 
 def _bench_data():

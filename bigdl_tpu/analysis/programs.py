@@ -2,8 +2,10 @@
 
 Builds and **lowers** (never executes) the package's representative
 compiled programs — train/eval steps, a ``steps_per_sync`` window, a
-ZeRO-2 step on the CPU mesh, a bf16-policy step, and a generation
-prefill/decode pair — into :class:`~bigdl_tpu.analysis.hlo.ProgramSpec`
+ZeRO-2 step on the CPU mesh, a bf16-policy step, a sequence-parallel
+window (where ``jax.shard_map`` exists), and the generation
+prefill/decode pairs (single-shot and chunked-prefill engines) — into
+:class:`~bigdl_tpu.analysis.hlo.ProgramSpec`
 records the check registry runs over. ``python -m bigdl_tpu.tools.check
 --programs`` is the CLI; ``tests/test_check_self.py`` is the tier-1
 gate that keeps the package's own programs clean.
@@ -328,25 +330,84 @@ def _zero_step_spec(budget=None) -> Optional[ProgramSpec]:
         extra={"kind": "zero"})
 
 
+def _seq_parallel_window_spec(budget=None) -> Optional[ProgramSpec]:
+    """A ``steps_per_sync`` window over a sequence-parallel transformer
+    step: build_train_step(seq_parallel=...) on a ["seq"] mesh, K=2.
+    This is the structural proof of the long-context composition
+    contract — the ring collectives (``collective-permute`` /
+    ``all-to-all``, both in the entry-collective check's
+    COMMUNICATION_OPS) trace inside the scan body, so the windowed
+    dispatch boundary stays collective-free. None (with a note) when
+    the process cannot run it: single device, or a jax build without
+    ``jax.shard_map``."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    import bigdl_tpu.nn as nn
+    from bigdl_tpu.optim import SGD
+    from bigdl_tpu.optim.optimizer import (build_train_step,
+                                           make_host_window)
+    from bigdl_tpu.parallel import SeqParallelConfig, make_mesh
+    from bigdl_tpu.parallel.sequence import sequence_parallel_available
+
+    ndev = min(len(jax.devices()), 8)
+    if ndev < 2 or not sequence_parallel_available():
+        return None
+    mesh = make_mesh([ndev], ["seq"], jax.devices()[:ndev])
+    model = _tiny_lm()
+    optim = SGD(learning_rate=0.1, momentum=0.9)
+    params, opt_state, mstate = _train_abstract(model, optim)
+    params = _with_sharding(params, mesh,
+                            jax.tree.map(lambda _: P(), params))
+    opt_state = _with_sharding(opt_state, mesh,
+                               jax.tree.map(lambda _: P(), opt_state))
+    mstate = _with_sharding(mstate, mesh,
+                            jax.tree.map(lambda _: P(), mstate))
+    step = build_train_step(
+        model, nn.SequenceCrossEntropyCriterion(), optim, mesh=mesh,
+        seq_parallel=SeqParallelConfig(axis="seq", mesh=mesh))
+    window = make_host_window(step)
+    key = _key_struct()
+    keys = _sds((2,) + key.shape, key.dtype)
+    lowered = window.lower(
+        params, opt_state, mstate, keys, _sds((2,), np.float32),
+        _sds((2, 4, 16), np.int32), _sds((2, 4, 16), np.int32))
+    return spec_from_lowered(
+        "train/transformer_lm/seq_parallel/window@k2", lowered,
+        window=True, scan_length=2, ndev=ndev, hbm_budget=budget,
+        extra={"kind": "window"})
+
+
 def _generation_specs(budget=None) -> List[ProgramSpec]:
     """The serving prefill/decode program pair (donated KV cache) via
     the DecodeEngine's enumeration hook — the exact jits the engine
-    compiles, lowered over abstract cache/params trees."""
+    compiles, lowered over abstract cache/params trees. A second
+    engine with ``prefill_chunk`` enumerates the CHUNKED long-prompt
+    admission programs: the prefill jit's token operand is chunk-wide
+    (never rung-wide), which is the whole point — a 128K rung admits
+    through the same fixed-width program, and the donation/boundary
+    checks hold for it like any other serving program."""
     from bigdl_tpu.generation.engine import DecodeEngine
     from bigdl_tpu.serving.compile_cache import BucketLadder, CompileCache
 
     model = _tiny_lm()
     params = abstract_tree(model.get_parameters())
     state = abstract_tree(model.get_state())
-    engine = DecodeEngine(CompileCache(), BucketLadder(16, buckets=(16,)),
-                          slots=4, prefill_rows=2)
     out = []
-    for name, jitted, args in engine.abstract_programs(
-            model, params, state, kv_dtype=np.float32):
-        lowered = jitted.lower(*args)
-        out.append(spec_from_lowered(
-            f"serving/transformer_lm/{name}", lowered,
-            hbm_budget=budget, extra={"kind": "serving"}))
+    for tag, engine in (
+            ("", DecodeEngine(CompileCache(),
+                              BucketLadder(16, buckets=(16,)),
+                              slots=4, prefill_rows=2)),
+            ("chunked/", DecodeEngine(CompileCache(),
+                                      BucketLadder(16, buckets=(8, 16)),
+                                      slots=4, prefill_rows=2,
+                                      prefill_chunk=8))):
+        for name, jitted, args in engine.abstract_programs(
+                model, params, state, kv_dtype=np.float32):
+            lowered = jitted.lower(*args)
+            out.append(spec_from_lowered(
+                f"serving/transformer_lm/{tag}{name}", lowered,
+                hbm_budget=budget, extra={"kind": "serving"}))
     return out
 
 
@@ -407,6 +468,14 @@ def enumerate_programs(hbm_budget: Optional[int] = None
         notes.append("zero leg skipped (single-device process; run "
                      "under XLA_FLAGS=--xla_force_host_platform_"
                      "device_count=8 for the mesh contract)")
+    sp = _seq_parallel_window_spec(budget)
+    if sp is not None:
+        specs.append(sp)
+    else:
+        notes.append("seq-parallel window leg skipped (needs "
+                     "jax.shard_map and a multi-device process; the "
+                     "entry-collective contract for ring/Ulysses "
+                     "collectives is verified where both exist)")
     specs.append(_serving_eval_spec(budget))
     specs.extend(_generation_specs(budget))
     return specs, notes

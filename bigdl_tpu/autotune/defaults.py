@@ -25,7 +25,8 @@ __all__ = ["DEFAULT_TRAIN_CONFIG", "DEFAULT_SERVING_CONFIG",
 #: kernels — the package's conservative out-of-the-box behavior)
 DEFAULT_TRAIN_CONFIG: Dict[str, object] = {
     "steps_per_sync": 1, "zero_stage": 0, "precision": "f32",
-    "flash": False, "batch_size": 16,
+    "flash": False, "batch_size": 16, "seq_parallel": 0,
+    "long_context": False,
 }
 
 #: the hand-picked serving defaults (one full-length bucket, 4 slots,
@@ -33,7 +34,7 @@ DEFAULT_TRAIN_CONFIG: Dict[str, object] = {
 #: smoke scale)
 DEFAULT_SERVING_CONFIG: Dict[str, object] = {
     "length_buckets": (64,), "slots": 4, "speculation_k": 0,
-    "prefix_cache_bytes": 0,
+    "prefix_cache_bytes": 0, "prefill_chunk": 0,
 }
 
 #: the CPU-smoke per-device HBM budget (1 MiB): small enough that the
@@ -79,13 +80,15 @@ def default_train_space() -> TrainSpace:
 
 def default_serving_space() -> ServingSpace:
     """The standard serving sweep: ladder shape x slots x prefix-cache
-    budget at a 64-token smoke horizon."""
+    budget x chunked-prefill width at a 64-token smoke horizon (chunk
+    16 divides every rung of both ladders; 0 is single-shot)."""
     return ServingSpace(
         max_len=64,
         length_buckets=((64,), (16, 32, 64)),
         slots=(2, 4),
         speculation_k=(0,),
-        prefix_cache_bytes=(0, 1 << 20))
+        prefix_cache_bytes=(0, 1 << 20),
+        prefill_chunk=(0, 16))
 
 
 def smoke_serving_space() -> ServingSpace:

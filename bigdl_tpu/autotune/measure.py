@@ -76,9 +76,12 @@ def _run_train(cand: Candidate, seed: int, iters: int) -> float:
     steps/sec the registry read back."""
     from bigdl_tpu import kernels
 
-    with kernels.use(kernels.KernelConfig.all_on()
-                     if cand.config.get("flash")
-                     else kernels.KernelConfig.off()):
+    if cand.config.get("flash"):
+        kcfg = kernels.KernelConfig.all_on(
+            long_context=bool(cand.config.get("long_context", False)))
+    else:
+        kcfg = kernels.KernelConfig.off()
+    with kernels.use(kcfg):
         return _train_window(cand, seed, iters)
 
 
@@ -121,13 +124,21 @@ def _train_window(cand: Candidate, seed: int, iters: int) -> float:
         if policy.needs_loss_scaling:
             opt_state[SCALER_KEY] = DynamicLossScaler().init_state()
 
-    zero_cfg = zero_mesh = None
+    zero_cfg = zero_mesh = seq_cfg = None
     if int(cfg["zero_stage"]) > 0:
         from bigdl_tpu.parallel import ZeroConfig, data_parallel_mesh
         zero_mesh = data_parallel_mesh()
         zero_cfg = ZeroConfig(stage=int(cfg["zero_stage"]))
+    sp = int(cfg.get("seq_parallel", 0) or 0)
+    if sp > 1:
+        # exclusive with zero_stage>0 here (coded space constraint):
+        # the harness builds ONE 1-D mesh per candidate
+        from bigdl_tpu.parallel import SeqParallelConfig, make_mesh
+        zero_mesh = make_mesh([sp], ["seq"], jax.devices()[:sp])
+        seq_cfg = SeqParallelConfig(axis="seq", mesh=zero_mesh)
     step = build_train_step(model, criterion, optim, zero=zero_cfg,
-                            mesh=zero_mesh, precision=policy)
+                            mesh=zero_mesh, precision=policy,
+                            seq_parallel=seq_cfg)
 
     rng = np.random.default_rng(seed)
     if use_lm:
@@ -209,10 +220,12 @@ def _run_serving(cand: Candidate, seed: int, iters: int) -> float:
     model = TransformerLM(vocab_size=64, hidden_size=32, num_layers=1,
                           num_heads=4, max_len=max_len).evaluate()
     model.ensure_initialized()
+    chunk = int(cfg.get("prefill_chunk", 0) or 0)
     svc = GenerationService(config=GenerationConfig(
         slots=slots, max_len=max_len, length_buckets=ladder,
         prefill_rows=min(2, slots), max_queue=256,
-        prefix_cache_bytes=int(cfg["prefix_cache_bytes"])))
+        prefix_cache_bytes=int(cfg["prefix_cache_bytes"]),
+        prefill_chunk=chunk if chunk > 0 else None))
     try:
         svc.load("atn", model)  # warmup compiles outside the timing
         rng = np.random.default_rng(seed)

@@ -185,13 +185,28 @@ def train_footprint(cand: Candidate, model_name: str,
     if stage >= 3:
         param_bytes = param_bytes // max(ndev, 1)
     batch_bytes = (_tree_bytes(x) + _tree_bytes(y)) * k
+    act_bytes = 0.0
+    if model_name == "transformer_lm":
+        # attention-activation lower bound — the term sequence
+        # parallelism shards: the backward keeps the per-layer f32
+        # q/k/v/out [B, S, E] tensors live, and under a degree-d SP
+        # policy each chip holds S/d of them (that division is exactly
+        # why an over-budget dense candidate can become feasible)
+        b, s = (int(dim) for dim in x.shape)
+        hidden = int(getattr(model, "hidden_size", 32))
+        layers = max(int(getattr(model, "num_layers", 1)), 1)
+        act_bytes = float(4 * b * s * hidden * 4 * layers)
+        sp = int(cfg.get("seq_parallel", 0) or 0)
+        if sp > 1:
+            act_bytes /= sp
     return {"arg_bytes": float(param_bytes + opt_bytes
                                + _tree_bytes(mstate) + batch_bytes),
             # outputs alias the donated carry in every real step/window
             # program — counting them again would over-price donation
             "out_bytes": 0.0,
             # the backward pass materializes at least one gradient tree
-            "temp_bytes": float(param_bytes)}
+            # plus the (possibly seq-sharded) attention activations
+            "temp_bytes": float(param_bytes) + act_bytes}
 
 
 def serving_footprint(cand: Candidate) -> Dict[str, float]:
@@ -242,8 +257,15 @@ def _train_spec(cand: Candidate, model_name: str, budget: Optional[int]):
     optim = SGD(learning_rate=0.1, momentum=0.9)
     policy = _policy_for(cand)
     params, opt_state, mstate = _train_abstract(model, optim, policy)
+    seq_cfg = None
+    sp = int(cfg.get("seq_parallel", 0) or 0)
+    if sp > 1:
+        from bigdl_tpu.parallel import SeqParallelConfig, make_mesh
+        seq_cfg = SeqParallelConfig(
+            axis="seq", mesh=make_mesh([sp], ["seq"],
+                                       jax.devices()[:sp]))
     step = build_train_step(model, _criterion_for(model_name), optim,
-                            precision=policy)
+                            precision=policy, seq_parallel=seq_cfg)
     k = int(cfg["steps_per_sync"])
     x, y = _train_batch_sds(model_name, int(cfg["batch_size"]))
     key = _key_struct()
@@ -279,9 +301,13 @@ def _contract_gate(cand: Candidate, model_name: str,
 
     try:
         if cand.regime == "train":
-            with kernels.use(kernels.KernelConfig.all_on()
-                             if cand.config.get("flash")
-                             else kernels.KernelConfig.off()):
+            if cand.config.get("flash"):
+                kcfg = kernels.KernelConfig.all_on(
+                    long_context=bool(
+                        cand.config.get("long_context", False)))
+            else:
+                kcfg = kernels.KernelConfig.off()
+            with kernels.use(kcfg):
                 spec = _train_spec(cand, model_name, budget)
         else:
             return None  # serving contracts are covered by the

@@ -84,23 +84,38 @@ def _require(cond: bool, msg: str) -> None:
 @dataclass(frozen=True)
 class TrainSpace:
     """The training-regime axes: ``steps_per_sync`` K x ZeRO stage x
-    precision preset x flash attention on/off x batch size, over a
+    precision preset x flash attention on/off x batch size x
+    sequence-parallel degree x blockwise long-context routing, over a
     named tiny model twin (``mlp`` | ``transformer_lm``). Bounds are
     enforced at construction; cross-axis validity (ZeRO divisibility,
-    flash needs attention) lives in :func:`enumerate_candidates`."""
+    flash needs attention, SP needs shard_map + devices, blockwise
+    needs flash) lives in :func:`enumerate_candidates`."""
 
     steps_per_sync: Tuple[int, ...] = (1, 8)
     zero_stage: Tuple[int, ...] = (0,)
     precision: Tuple[str, ...] = ("f32",)
     flash: Tuple[bool, ...] = (False,)
     batch_size: Tuple[int, ...] = (16,)
+    #: sequence-parallel degree (0 = dense attention; >= 2 installs a
+    #: SeqParallelConfig over a degree-wide "seq" mesh axis)
+    seq_parallel: Tuple[int, ...] = (0,)
+    #: blockwise long-context flash routing past the VMEM budget
+    #: (KernelConfig.long_context) — only meaningful with flash=True
+    long_context: Tuple[bool, ...] = (False,)
     model: str = "mlp"
 
     def __post_init__(self):
         for name in ("steps_per_sync", "zero_stage", "precision",
-                     "flash", "batch_size"):
+                     "flash", "batch_size", "seq_parallel",
+                     "long_context"):
             _require(len(getattr(self, name)) > 0,
                      f"TrainSpace.{name} must be non-empty")
+        _require(all(d == 0 or 2 <= d <= 64 for d in self.seq_parallel),
+                 f"seq_parallel degrees must be 0 (off) or in [2, 64], "
+                 f"got {self.seq_parallel}")
+        _require(all(isinstance(b, bool) for b in self.long_context),
+                 f"long_context values must be bools, got "
+                 f"{self.long_context}")
         _require(all(1 <= k <= 512 for k in self.steps_per_sync),
                  f"steps_per_sync values must be in [1, 512], got "
                  f"{self.steps_per_sync}")
@@ -123,7 +138,9 @@ class TrainSpace:
         """Axis name -> value tuple, enumeration order (sorted by axis
         name so candidate order is a pure function of the space)."""
         return {"batch_size": self.batch_size, "flash": self.flash,
+                "long_context": self.long_context,
                 "precision": self.precision,
+                "seq_parallel": self.seq_parallel,
                 "steps_per_sync": self.steps_per_sync,
                 "zero_stage": self.zero_stage}
 
@@ -131,21 +148,30 @@ class TrainSpace:
 @dataclass(frozen=True)
 class ServingSpace:
     """The serving-regime axes: length-bucket ladder x slots x
-    speculation depth k x prefix-cache bytes, at a fixed ``max_len``.
-    The GenerationService contract — the top ladder rung IS the cache
-    time axis — is checked per ladder at construction."""
+    speculation depth k x prefix-cache bytes x chunked-prefill width,
+    at a fixed ``max_len``. The GenerationService contract — the top
+    ladder rung IS the cache time axis — is checked per ladder at
+    construction; the chunk-divides-every-larger-rung admission rule
+    per candidate in :func:`enumerate_candidates`."""
 
     max_len: int = 64
     length_buckets: Tuple[Tuple[int, ...], ...] = ((64,),)
     slots: Tuple[int, ...] = (4,)
     speculation_k: Tuple[int, ...] = (0,)
     prefix_cache_bytes: Tuple[int, ...] = (0,)
+    #: chunked-prefill width (0 = single-shot): long prompts admit in
+    #: fixed [rows, chunk] pieces — the engine's divide-every-larger-
+    #: rung admission rule is coded per ladder in enumerate_candidates
+    prefill_chunk: Tuple[int, ...] = (0,)
 
     def __post_init__(self):
         _require(1 <= self.max_len <= 131072,
                  f"max_len must be in [1, 131072], got {self.max_len}")
+        _require(all(0 <= c <= self.max_len for c in self.prefill_chunk),
+                 f"prefill_chunk values must be in [0, max_len="
+                 f"{self.max_len}], got {self.prefill_chunk}")
         for name in ("length_buckets", "slots", "speculation_k",
-                     "prefix_cache_bytes"):
+                     "prefix_cache_bytes", "prefill_chunk"):
             _require(len(getattr(self, name)) > 0,
                      f"ServingSpace.{name} must be non-empty")
         for ladder in self.length_buckets:
@@ -169,6 +195,7 @@ class ServingSpace:
     def axes(self) -> Dict[str, Sequence]:
         """Axis name -> value tuple, enumeration order."""
         return {"length_buckets": self.length_buckets,
+                "prefill_chunk": self.prefill_chunk,
                 "prefix_cache_bytes": self.prefix_cache_bytes,
                 "slots": self.slots,
                 "speculation_k": self.speculation_k}
@@ -189,6 +216,31 @@ def _train_constraints(cfg: Dict[str, object], space: TrainSpace,
         return (f"flash=True has no attention to dispatch on "
                 f"model={space.model!r} (the toggle would silently "
                 f"measure the identical program twice)")
+    if cfg["long_context"] and not cfg["flash"]:
+        return ("long_context=True is a routing of the flash dispatch "
+                "(blockwise past the VMEM budget); with flash=False "
+                "it would measure the identical reference program "
+                "twice")
+    sp = int(cfg["seq_parallel"])
+    if sp > 0:
+        if space.model != "transformer_lm":
+            return (f"seq_parallel={sp} has no attention to shard on "
+                    f"model={space.model!r}")
+        if sp > ndev:
+            return (f"seq_parallel={sp} needs a {sp}-device sequence "
+                    f"mesh, process has {ndev}")
+        from bigdl_tpu.parallel.sequence import (
+            sequence_parallel_available)
+        if not sequence_parallel_available():
+            return (f"seq_parallel={sp} needs jax.shard_map, absent "
+                    f"in this jax build (the policy would quietly "
+                    f"no-op and measure the dense program twice)")
+        if cfg["zero_stage"] > 0:
+            return (f"seq_parallel={sp} with zero_stage="
+                    f"{cfg['zero_stage']}: the default measure "
+                    f"harness builds a 1-D mesh per candidate — "
+                    f"compose SP with ZeRO on a 2-D mesh via a custom "
+                    f"runner=")
     return None
 
 
@@ -203,6 +255,20 @@ def _serving_constraints(cfg: Dict[str, object], space: ServingSpace
         return ("speculation_k > 0 with prefix_cache_bytes > 0: the "
                 "speculative decoder manages its own cache seeding and "
                 "does not compose with the prefix cache in one service")
+    chunk = int(cfg["prefill_chunk"])
+    if chunk > 0:
+        # the engine's own admission rule (DecodeEngine raises on it):
+        # chunked rungs must split into an exact number of chunks
+        bad = [b for b in cfg["length_buckets"] if b > chunk and b % chunk]
+        if bad:
+            return (f"prefill_chunk={chunk} must divide every larger "
+                    f"ladder rung, fails on {bad} of "
+                    f"{cfg['length_buckets']}")
+        if all(b <= chunk for b in cfg["length_buckets"]):
+            return (f"prefill_chunk={chunk} >= the top rung "
+                    f"{cfg['length_buckets'][-1]}: no rung ever "
+                    f"chunks, the candidate measures the single-shot "
+                    f"program twice")
     return None
 
 

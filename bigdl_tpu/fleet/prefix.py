@@ -14,6 +14,11 @@ tokens). This module caches that result as **committed KV blocks**:
   decode loop then **seeds** the slot's cache rows by device copy
   (:meth:`seed`) and goes straight to decode: a full-prefix hit's TTFT
   approaches one decode step, because that is all that remains;
+- :meth:`PrefixCache.lookup_prefix` is the long-context PARTIAL probe:
+  under chunked prefill the loop sizes cached blocks at prefill-chunk
+  boundaries, so a long shared system prompt hits here even when the
+  full prompt differs — the entry seeds the covered chunks and the
+  engine prefills only the remainder (``start=``);
 - the cache is **reference-counted and capacity-bounded**: a lookup
   pins its entry until the reading slot is released, eviction is LRU
   over refcount-zero entries only, and an insert that cannot fit after
@@ -71,6 +76,10 @@ def register_prefix_instruments(r) -> Dict[str, object]:
             "(prefill skipped entirely)"),
         "misses": r.counter(
             "fleet/prefix/misses", "admissions that ran a cold prefill"),
+        "partial_hits": r.counter(
+            "fleet/prefix/partial_hits",
+            "admissions seeded from a chunk-boundary prefix (only the "
+            "remaining chunks prefilled)"),
         "inserts": r.counter(
             "fleet/prefix/inserts", "prefix entries committed to the cache"),
         "evictions": r.counter(
@@ -90,8 +99,11 @@ class PrefixEntry:
     (``rung`` = the prompt's ladder bucket — padded so every seeding
     copy runs at a bucketed shape), ``length`` the real prefix length,
     ``logits`` the host ``[V]`` first-token logits row the prefill
-    computed. ``refs`` counts live readers; the cache never evicts an
-    entry with ``refs > 0``."""
+    computed — or ``None`` for a chunk-BOUNDARY entry, whose tokens
+    end mid-prompt so no first-token row exists; such entries serve
+    only :meth:`PrefixCache.lookup_prefix` (the exact-match
+    :meth:`~PrefixCache.lookup` skips them). ``refs`` counts live
+    readers; the cache never evicts an entry with ``refs > 0``."""
 
     __slots__ = ("key", "version_key", "length", "rung", "k", "v",
                  "logits", "nbytes", "refs", "tick", "doomed")
@@ -103,8 +115,10 @@ class PrefixEntry:
         self.rung = int(rung)
         self.k = k
         self.v = v
-        self.logits = np.asarray(logits)
-        self.nbytes = int(k.nbytes) + int(v.nbytes) + self.logits.nbytes
+        self.logits = None if logits is None else np.asarray(logits)
+        self.nbytes = (int(k.nbytes) + int(v.nbytes)
+                       + (0 if self.logits is None
+                          else self.logits.nbytes))
         self.refs = 0
         self.tick = 0       # LRU clock (deterministic, not wall time)
         self.doomed = False  # version unloaded while pinned: drop at 0
@@ -128,6 +142,7 @@ class PrefixCache:
         inst = register_prefix_instruments(r)
         self._c_hits = inst["hits"]
         self._c_misses = inst["misses"]
+        self._c_partial_hits = inst["partial_hits"]
         self._c_inserts = inst["inserts"]
         self._c_evictions = inst["evictions"]
         self._g_bytes = inst["bytes"]
@@ -149,23 +164,49 @@ class PrefixCache:
         entry PINNED (``refs`` incremented — the caller must
         :meth:`release` when the reading slot frees); a miss returns
         None. Counts ``fleet/prefix/hits``/``misses``."""
-        key = self.key_for(version_key, tokens)
+        entry = self._probe(self.key_for(version_key, tokens),
+                            full=True)
+        if entry is None:
+            self._c_misses.inc(**labels)
+            return None
+        self._c_hits.inc(**labels)
+        return entry
+
+    def _probe(self, key: str, full: bool) -> Optional[PrefixEntry]:
+        """One pinned probe. ``full`` probes skip logits-less
+        chunk-boundary entries (they cannot provide the first
+        token)."""
         with self._lock:
             entry = self._entries.get(key)
             # capture the verdict INSIDE the lock: a concurrent
             # drop_version may doom the entry right after we pinned
             # it, and re-reading entry.doomed outside would leak the
             # pin (an unevictable entry forever)
-            hit = entry is not None and not entry.doomed
+            hit = (entry is not None and not entry.doomed
+                   and not (full and entry.logits is None))
             if hit:
                 entry.refs += 1
                 entry.tick = next(self._clock)
                 self._entries.move_to_end(key)
-        if not hit:
-            self._c_misses.inc(**labels)
-            return None
-        self._c_hits.inc(**labels)
-        return entry
+        return entry if hit else None
+
+    def lookup_prefix(self, version_key, tokens, chunk: int, **labels):
+        """The long-context partial probe: the LONGEST cached prefix
+        of ``tokens`` ending on a ``chunk`` boundary strictly inside
+        the prompt, as a pinned ``(entry, boundary)`` pair — the loop
+        seeds the covered rows and prefills only from ``boundary`` on
+        (``DecodeEngine.prefill(start=...)``). None when no boundary
+        prefix is cached. Counts ``fleet/prefix/partial_hits`` (the
+        full-prompt miss was already counted by :meth:`lookup`)."""
+        n = len(tokens)
+        for m in range((n - 1) // chunk, 0, -1):
+            entry = self._probe(
+                self.key_for(version_key, tokens[:m * chunk]),
+                full=False)
+            if entry is not None:
+                self._c_partial_hits.inc(**labels)
+                return entry, m * chunk
+        return None
 
     def release(self, entry: PrefixEntry) -> None:
         """Unpin one reader (the slot that seeded from this entry was
@@ -183,7 +224,8 @@ class PrefixCache:
     def insert(self, version_key, tokens, k_rows, v_rows, logits,
                **labels) -> Optional[PrefixEntry]:
         """Commit one prefix's KV blocks (device copies the caller
-        sliced out of the freshly prefilled slot) + first-token logits.
+        sliced out of the freshly prefilled slot) + first-token logits
+        (``None`` for a chunk-boundary entry — partial-probe only).
         Evicts LRU refcount-zero entries until the new entry fits;
         refused (returns None) when even a full sweep of unpinned
         entries cannot make room — the cache NEVER exceeds

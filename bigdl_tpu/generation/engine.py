@@ -48,11 +48,32 @@ class DecodeEngine:
     :class:`KVCache` buffers and threads them through."""
 
     def __init__(self, cache: CompileCache, ladder: BucketLadder,
-                 slots: int, prefill_rows: int):
+                 slots: int, prefill_rows: int,
+                 prefill_chunk: int = None):
         self.cache = cache
         self.ladder = ladder
         self.slots = slots
         self.prefill_rows = prefill_rows
+        # chunked prefill (long-context serving): prompts whose ladder
+        # rung exceeds ``prefill_chunk`` prefill in fixed [rows, chunk]
+        # pieces against that rung's attend window instead of one
+        # [rows, rung] shot — same ONE prefill program per rung (the
+        # chunk width is the program's token shape), so the ≤ 2/3-per-
+        # bucket compile bound is untouched and a 128K prompt never
+        # mints a 128K-wide program. Admission rule: the chunk must
+        # divide every larger rung, else chunk starts would drift off
+        # the attend window (docs/performance.md "Long context").
+        if prefill_chunk is not None:
+            prefill_chunk = int(prefill_chunk)
+            if prefill_chunk < 1:
+                raise ValueError(f"prefill_chunk={prefill_chunk} "
+                                 f"must be >= 1")
+            for rung in ladder:
+                if rung > prefill_chunk and rung % prefill_chunk:
+                    raise ValueError(
+                        f"prefill_chunk={prefill_chunk} must divide "
+                        f"every larger ladder rung (rung {rung})")
+        self.prefill_chunk = prefill_chunk
         # program keys registered per servable key, so unload can drop
         # exactly the programs this engine created; guarded — the
         # decode-loop thread registers while metrics readers iterate
@@ -89,32 +110,45 @@ class DecodeEngine:
         return prog
 
     @staticmethod
-    def _prefill_jit(model, on_trace):
+    def _prefill_jit(model, attend_len: int, on_trace):
         """The raw prefill jit (donated cache) — shared by the cached
         :meth:`prefill_program` and the :meth:`abstract_programs`
-        verification hook, so both see the identical program."""
+        verification hook, so both see the identical program.
+
+        Offset-aware: ``tokens [Bp, Sq]`` is one CHUNK of each row's
+        prompt, placed at per-row cache position ``offsets`` with
+        attention over the first ``attend_len`` cache lanes — each
+        row's earlier chunks are gathered from its slot's cache rows,
+        so chunk ``c`` attends everything chunks ``0..c-1`` wrote.
+        Single-shot prefill is the ``offsets == 0, Sq == attend_len``
+        special case: every attended lane is written by the chunk
+        itself (the causal mask covers the rest), so the gathered
+        stale lanes — exactly like the zero rows the pre-chunking
+        program fed — contribute exact zeros to the softmax."""
         import jax
         import jax.numpy as jnp
 
-        def fn(params, state, k, v, tokens, prompt_lens, slot_ids):
+        def fn(params, state, k, v, tokens, last_in_chunk, slot_ids,
+               offsets):
             on_trace()
-            bp, sb = tokens.shape
-            layers, _, heads, _, hd = k.shape
-            zero_rows = jnp.zeros((layers, bp, heads, sb, hd),
-                                  k.dtype)
-            # the prompt's cache rows start empty — attention here
-            # is causal among the prompt tokens themselves
+            ids = slot_ids.astype(jnp.int32)
+            # gather each row's slot window (OOB padding rows clamp to
+            # the last slot; their garbage output is never read and
+            # their write-back below is dropped)
+            rows_k = k[:, ids, :, :attend_len, :]
+            rows_v = v[:, ids, :, :attend_len, :]
             logits, _, rows = model.apply(
                 params, state, tokens, training=False,
-                cache={"k": zero_rows, "v": zero_rows},
-                positions=jnp.zeros((bp,), jnp.int32),
-                attend_len=sb)
+                cache={"k": rows_k, "v": rows_v},
+                positions=offsets.astype(jnp.int32),
+                attend_len=attend_len)
             last = jnp.take_along_axis(
-                logits, (prompt_lens.astype(jnp.int32) - 1)
+                logits, (last_in_chunk.astype(jnp.int32) - 1)
                 [:, None, None], axis=1)[:, 0, :]
-            ids = slot_ids.astype(jnp.int32)
-            k = k.at[:, ids, :, :sb, :].set(rows["k"], mode="drop")
-            v = v.at[:, ids, :, :sb, :].set(rows["v"], mode="drop")
+            k = k.at[:, ids, :, :attend_len, :].set(rows["k"],
+                                                    mode="drop")
+            v = v.at[:, ids, :, :attend_len, :].set(rows["v"],
+                                                    mode="drop")
             return last, k, v
 
         return jax.jit(fn, donate_argnums=(2, 3))
@@ -159,15 +193,26 @@ class DecodeEngine:
 
     def prefill_program(self, servable, bucket: int):
         """The compiled prefill for prompt bucket ``bucket``:
-        ``(params, state, k, v, tokens[Bp,S_b], prompt_lens[Bp],
-        slot_ids[Bp]) -> (logits[Bp,V], k', v')`` with the cache
-        donated. Padding rows carry ``slot_ids == slots`` (out of
-        bounds): their K/V scatter is dropped and their logits row is
-        garbage the driver never reads."""
+        ``(params, state, k, v, tokens[Bp,Sq], last_in_chunk[Bp],
+        slot_ids[Bp], offsets[Bp]) -> (logits[Bp,V], k', v')`` with the
+        cache donated. ``Sq`` is the bucket itself, or the engine's
+        ``prefill_chunk`` for larger rungs — ONE token shape per rung
+        either way, so chunking never adds a program. Padding rows
+        carry ``slot_ids == slots`` (out of bounds): their K/V scatter
+        is dropped and their logits row is garbage the driver never
+        reads."""
         model = servable.model
         return self._program(
             servable, "prefill", bucket,
-            lambda on_trace: self._prefill_jit(model, on_trace))
+            lambda on_trace: self._prefill_jit(model, bucket,
+                                               on_trace))
+
+    def chunk_for(self, bucket: int) -> int:
+        """The prefill token width for ``bucket``: the bucket itself,
+        or the fixed chunk for rungs past ``prefill_chunk``."""
+        if self.prefill_chunk is None or bucket <= self.prefill_chunk:
+            return bucket
+        return self.prefill_chunk
 
     def decode_program(self, servable, attend_len: int):
         """The compiled decode step for length bucket ``attend_len``:
@@ -243,9 +288,12 @@ class DecodeEngine:
 
         noop = lambda: None  # noqa: E731  on_trace hook, nothing to count
         return [
-            (f"prefill/{bucket}", self._prefill_jit(model, noop),
+            (f"prefill/{bucket}", self._prefill_jit(model, bucket,
+                                                    noop),
              (params, state, k_spec, v_spec,
-              sds((self.prefill_rows, bucket), np.int32),
+              sds((self.prefill_rows, self.chunk_for(bucket)),
+                  np.int32),
+              sds((self.prefill_rows,), np.int32),
               sds((self.prefill_rows,), np.int32),
               sds((self.prefill_rows,), np.int32))),
             (f"decode/{bucket}", self._decode_jit(model, bucket, noop),
@@ -264,32 +312,65 @@ class DecodeEngine:
 
     # ------------------------------------------------------ execution
     def prefill(self, servable, kv: KVCache, prompts: Sequence[np.ndarray],
-                slot_ids: Sequence[int]):
+                slot_ids: Sequence[int], start: Sequence[int] = None):
         """Run one padded-prompt prefill batch: writes each prompt's
         K/V into its slot's cache rows and returns the ``[n, V]``
-        first-token logits (host ndarray) for the ``n`` real rows.
+        last-prompt-token logits (host ndarray) for the ``n`` real
+        rows.
 
         Prompts pad to the ladder rung of the longest prompt in the
-        batch; rows pad to ``prefill_rows`` with dropped slot ids."""
+        batch; rows pad to ``prefill_rows`` with dropped slot ids.
+        Past ``prefill_chunk`` the rung is filled chunk by chunk
+        through the SAME per-rung program (chunk ``c`` gathers chunks
+        ``0..c-1`` from the cache rows); each row's logits are taken
+        from the chunk holding its last prompt token. ``start[i]``
+        (chunk-aligned; prefix-cache seeding) skips chunks a seeded
+        prefix already wrote."""
         n = len(prompts)
         if n == 0 or n > self.prefill_rows:
             raise ValueError(f"prefill batch of {n} rows "
                              f"(prefill_rows={self.prefill_rows})")
         lens = [len(p) for p in prompts]
         bucket = self.ladder.bucket_for(max(lens))
-        tokens = np.zeros((self.prefill_rows, bucket), np.int32)
-        for i, p in enumerate(prompts):
-            tokens[i, :len(p)] = np.asarray(p, np.int32)
-        prompt_lens = np.ones((self.prefill_rows,), np.int32)
-        prompt_lens[:n] = lens
-        ids = np.full((self.prefill_rows,), self.slots, np.int32)  # OOB
-        ids[:n] = np.asarray(slot_ids, np.int32)
+        sq = self.chunk_for(bucket)
+        starts = [0] * n if start is None else [int(s) for s in start]
+        for i, s0 in enumerate(starts):
+            if s0 % sq or not 0 <= s0 < lens[i]:
+                raise ValueError(
+                    f"start[{i}]={s0} must be a chunk multiple "
+                    f"(chunk {sq}) below the prompt length {lens[i]}")
         prog = self.prefill_program(servable, bucket)
-        logits, kv.k, kv.v = prog(servable.params, servable.state,
-                                  kv.k, kv.v, tokens, prompt_lens, ids)
+        out = [None] * n
+        for c in range(bucket // sq):
+            off = c * sq
+            tokens = np.zeros((self.prefill_rows, sq), np.int32)
+            last_in = np.ones((self.prefill_rows,), np.int32)
+            ids = np.full((self.prefill_rows,), self.slots,
+                          np.int32)  # OOB
+            offsets = np.zeros((self.prefill_rows,), np.int32)
+            live = False
+            for i, p in enumerate(prompts):
+                # a row rides chunk c while it still has tokens there
+                # and its seeded prefix doesn't already cover it
+                if lens[i] <= off or starts[i] > off:
+                    continue
+                live = True
+                ids[i] = slot_ids[i]
+                offsets[i] = off
+                piece = np.asarray(p[off:off + sq], np.int32)
+                tokens[i, :len(piece)] = piece
+                last_in[i] = min(lens[i] - off, sq)
+            if not live:
+                continue
+            logits, kv.k, kv.v = prog(servable.params, servable.state,
+                                      kv.k, kv.v, tokens, last_in,
+                                      ids, offsets)
+            for i in range(n):
+                if ids[i] != self.slots and (lens[i] - 1) // sq == c:
+                    out[i] = np.asarray(logits[i])
         for i, slot in enumerate(slot_ids):
             kv.lengths[slot] = lens[i]
-        return np.asarray(logits[:n]), bucket
+        return np.stack(out), bucket
 
     def decode(self, servable, kv: KVCache, tokens: np.ndarray,
                positions: np.ndarray, active: np.ndarray):
@@ -337,16 +418,22 @@ class DecodeEngine:
         before = self.compile_count(servable)
         drop_ids = np.full((self.prefill_rows,), self.slots, np.int32)
         lens1 = np.ones((self.prefill_rows,), np.int32)
+        zero_off = np.zeros((self.prefill_rows,), np.int32)
         dec_tokens = np.zeros((self.slots,), np.int32)
         dec_pos = np.zeros((self.slots,), np.int32)
         inactive = np.zeros((self.slots,), bool)
         for rung in self.ladder:
             pre = self.prefill_program(servable, rung)
-            prompts = np.zeros((self.prefill_rows, rung), np.int32)
+            # the token width serving will actually feed this rung —
+            # the chunk for rungs past prefill_chunk — so a live
+            # chunked admission never re-traces
+            prompts = np.zeros((self.prefill_rows,
+                                self.chunk_for(rung)), np.int32)
             # warmup exists to GATE on both programs of every rung
             # before the version takes traffic
             _, kv.k, kv.v = pre(servable.params, servable.state, kv.k,
-                                kv.v, prompts, lens1, drop_ids)
+                                kv.v, prompts, lens1, drop_ids,
+                                zero_off)
             dec = self.decode_program(servable, rung)
             out, kv.k, kv.v = dec(servable.params, servable.state, kv.k,
                                   kv.v, dec_tokens, dec_pos, inactive)

@@ -83,6 +83,10 @@ def register_generation_instruments(r) -> Dict[str, object]:
         "prefill_fill": r.histogram(
             "serving/generation/prefill_fill",
             "real rows / padded rows per prefill batch"),
+        "prefill_chunks": r.counter(
+            "serving/generation/prefill_chunks",
+            "prefill chunk programs dispatched (chunked long-prompt "
+            "admission; one per batch when chunking is off)"),
     }
 
 
@@ -164,6 +168,7 @@ class DecodeLoop:
         self._h_ttft = inst["ttft_ms"]
         self._h_token = inst["token_ms"]
         self._h_prefill_fill = inst["prefill_fill"]
+        self._c_prefill_chunks = inst["prefill_chunks"]
 
         self._cond = threading.Condition()
         self._seq = itertools.count(1)  # trace_id suffixes
@@ -367,33 +372,69 @@ class DecodeLoop:
                 group.gens[g.slot] = g
         # prefix/KV reuse (bigdl_tpu.fleet.prefix): a full-prompt hit
         # seeds its slot's cache rows by device copy and goes straight
-        # to decode — only the misses pay a prefill program
+        # to decode — only the misses pay a prefill program. Under
+        # chunked prefill a full-prompt miss still probes CHUNK
+        # BOUNDARIES (lookup_prefix): a partial hit seeds the covered
+        # chunks and the engine prefills only the remainder
+        # (``start=``), which is how a long shared system prompt skips
+        # most of its prefill even when the tails differ
         hits: List[_Gen] = []
         misses: List[_Gen] = list(gens)
+        starts: List[int] = [0] * len(gens)
+        chunk = self._engine.prefill_chunk
         if self._prefix is not None:
-            hits, misses = [], []
+            hits, misses, starts = [], [], []
             for g in gens:
                 g.prefix_entry = self._prefix.lookup(
                     servable.key, g.prompt, **self._labels)
-                (hits if g.prefix_entry is not None else misses).append(g)
+                if g.prefix_entry is not None:
+                    hits.append(g)
+                    continue
+                s0 = 0
+                if chunk is not None and g.prompt.shape[0] > chunk:
+                    part = self._prefix.lookup_prefix(
+                        servable.key, g.prompt, chunk, **self._labels)
+                    if part is not None:
+                        g.prefix_entry, s0 = part
+                misses.append(g)
+                starts.append(s0)
         t0 = time.monotonic()
         for g in hits:
             self._prefix.seed(group.kv, g.slot, g.prefix_entry)
         if misses:
+            for g, s0 in zip(misses, starts):
+                if s0:  # partial hit: seed the covered chunks first
+                    self._prefix.seed(group.kv, g.slot, g.prefix_entry)
             with telemetry.span("serving/prefill", model=self._name,
                                 rows=len(misses)):
-                logits, _ = self._engine.prefill(
+                logits, bucket = self._engine.prefill(
                     servable, group.kv, [g.prompt for g in misses],
-                    [g.slot for g in misses])
+                    [g.slot for g in misses],
+                    start=starts if any(starts) else None)
             self._h_prefill_fill.observe(
                 len(misses) / self._engine.prefill_rows, **self._labels)
+            self._c_prefill_chunks.inc(
+                self._chunks_dispatched(bucket, misses, starts),
+                **self._labels)
             if self._prefix is not None:
                 ladder = self._engine.ladder
                 for i, g in enumerate(misses):
-                    rung = ladder.bucket_for(int(g.prompt.shape[0]))
-                    kr, vr = self._prefix.extract(group.kv, g.slot, rung)
+                    plen = int(g.prompt.shape[0])
+                    kr, vr = self._prefix.extract(
+                        group.kv, g.slot, ladder.bucket_for(plen))
                     self._prefix.insert(servable.key, g.prompt, kr, vr,
                                         logits[i], **self._labels)
+                    if (chunk is not None and plen > chunk
+                            and g.prefix_entry is None):
+                        # boundary block: the first chunk alone, sized
+                        # so the NEXT prompt sharing this head
+                        # partial-hits (logits=None — no first token
+                        # exists mid-prompt)
+                        kr, vr = self._prefix.extract(group.kv, g.slot,
+                                                      chunk)
+                        self._prefix.insert(servable.key,
+                                            g.prompt[:chunk], kr, vr,
+                                            None, **self._labels)
         t1 = time.monotonic()
         for g in hits:
             self._emit(group, g, g.sampler.sample(g.prefix_entry.logits))
@@ -403,6 +444,19 @@ class DecodeLoop:
             self._request_tracks_prefill(gens, t0, t1,
                                          time.monotonic())
         self._g_occupancy.set(group.kv.occupancy(), **self._labels)
+
+    def _chunks_dispatched(self, bucket: int, misses: List[_Gen],
+                           starts: List[int]) -> int:
+        """How many prefill program dispatches the engine just ran for
+        this batch — mirrors :meth:`DecodeEngine.prefill`'s chunk
+        loop (a chunk runs iff some row still has tokens there that
+        its seeded prefix doesn't already cover), feeding the
+        ``prefill_chunks`` counter."""
+        sq = self._engine.chunk_for(bucket)
+        lens = [int(g.prompt.shape[0]) for g in misses]
+        return sum(1 for c in range(bucket // sq)
+                   if any(l > c * sq and s <= c * sq
+                          for l, s in zip(lens, starts)))
 
     def _request_tracks_prefill(self, gens: List[_Gen], t0: float,
                                 t1: float, t2: float) -> None:

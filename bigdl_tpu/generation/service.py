@@ -57,6 +57,12 @@ class GenerationConfig:
     max_len: int = 256
     length_buckets: Optional[Sequence[int]] = None
     prefill_rows: int = 4
+    #: chunked prefill (long context): prompts whose ladder rung
+    #: exceeds this width prefill in fixed ``[prefill_rows, chunk]``
+    #: pieces through the SAME per-rung program instead of one
+    #: rung-wide shot — a 128K prompt never mints a 128K-wide token
+    #: shape. Must divide every larger rung. None = single-shot.
+    prefill_chunk: Optional[int] = None
     max_queue: int = 256
     eos_token: Optional[int] = None
     max_new_tokens: int = 64
@@ -77,7 +83,8 @@ def apply_tuned_config(tuned, base: Optional[GenerationConfig] = None,
     ``autotune.TunedConfig``; paths are fingerprint-checked on load
     (typed ``FingerprintMismatchError`` on a foreign environment unless
     ``allow_mismatch``). The winner's ``length_buckets`` / ``slots`` /
-    ``prefix_cache_bytes`` land on a copy of ``base`` (default: a fresh
+    ``prefix_cache_bytes`` / ``prefill_chunk`` land on a copy of
+    ``base`` (default: a fresh
     :class:`GenerationConfig`), with ``max_len`` snapped to the
     winner's ladder top — the service's own top-rung-is-the-cache-axis
     invariant. A winner tuned for the speculative decoder
@@ -107,6 +114,9 @@ def apply_tuned_config(tuned, base: Optional[GenerationConfig] = None,
         updates["slots"] = int(winner["slots"])
     if "prefix_cache_bytes" in winner:
         updates["prefix_cache_bytes"] = int(winner["prefix_cache_bytes"])
+    if "prefill_chunk" in winner:
+        pc = int(winner["prefill_chunk"] or 0)
+        updates["prefill_chunk"] = pc if pc > 0 else None
     return dataclasses.replace(cfg, **updates)
 
 
@@ -141,7 +151,8 @@ class GenerationService:
         self.cache = CompileCache(metrics=self.metrics_registry)
         self.engine = DecodeEngine(self.cache, self.ladder,
                                    self.config.slots,
-                                   self.config.prefill_rows)
+                                   self.config.prefill_rows,
+                                   prefill_chunk=self.config.prefill_chunk)
         self.prefix = None
         if self.config.prefix_cache_bytes > 0:
             from bigdl_tpu.fleet.prefix import PrefixCache
@@ -323,6 +334,8 @@ class GenerationService:
                 "serving/generation/finished").value(**labels)),
             "worker_restarts": int(r.counter(
                 "serving/generation/worker_restarts").value(**labels)),
+            "prefill_chunks": int(r.counter(
+                "serving/generation/prefill_chunks").value(**labels)),
             "cache_occupancy": float(r.gauge(
                 "serving/generation/cache_occupancy").value(**labels)),
             "padding_efficiency": float(r.gauge(

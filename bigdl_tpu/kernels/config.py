@@ -59,6 +59,15 @@ class KernelConfig:
     interpret: Optional[bool] = None
     block_q: int = 128
     block_k: int = 128
+    #: compiled-mode VMEM working-set budget (MiB) for one flash
+    #: program; ``None`` reads ``BIGDL_VMEM_BUDGET_MB`` and falls back
+    #: to the measured 12 MiB default (dispatch module docstring has
+    #: the budget math)
+    vmem_budget_mb: Optional[int] = None
+    #: whether shapes past the VMEM budget route to the blockwise
+    #: long-context flash kernel (key dimension tiled through VMEM)
+    #: instead of declining to the einsum reference
+    long_context: bool = True
 
     @classmethod
     def all_on(cls, **kw) -> "KernelConfig":
@@ -109,6 +118,27 @@ class KernelConfig:
             return bool(self.interpret)
         import jax
         return jax.default_backend() != "tpu"
+
+    def resolve_vmem_budget(self) -> int:
+        """The effective flash VMEM budget in BYTES: an explicit
+        ``vmem_budget_mb`` wins, else ``BIGDL_VMEM_BUDGET_MB``, else
+        the 12 MiB default the PR 11 kernel shipped with."""
+        mb = self.vmem_budget_mb
+        if mb is None:
+            env = os.environ.get("BIGDL_VMEM_BUDGET_MB")
+            if env is not None:
+                try:
+                    mb = int(env)
+                except ValueError:
+                    raise ValueError(
+                        f"BIGDL_VMEM_BUDGET_MB={env!r} is not an "
+                        f"integer MiB count") from None
+        if mb is None:
+            mb = 12
+        if mb <= 0:
+            raise ValueError(
+                f"flash VMEM budget must be positive, got {mb} MiB")
+        return mb * 1024 * 1024
 
 
 _LOCK = threading.Lock()

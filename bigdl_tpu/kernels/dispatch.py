@@ -13,8 +13,10 @@ configuration is byte-identical to the pre-kernel tree.
 
 Dispatch decisions happen at TRACE time (config and shapes are
 static), so the per-trace counters below count compiled-program
-routing, not per-step calls: ``kernels/dispatch/pallas`` vs
-``kernels/dispatch/reference`` with an ``op=flash|decode|int8`` label.
+routing, not per-step calls: ``kernels/dispatch/pallas`` (label
+``op=flash|decode|int8``) vs ``kernels/dispatch/reference`` (labels
+``op=...`` plus ``reason=config|shape|vmem`` so a `diagnose` dump
+attributes every decline).
 """
 from __future__ import annotations
 
@@ -27,8 +29,8 @@ import bigdl_tpu.telemetry as telemetry
 from bigdl_tpu.kernels import config as _config
 from bigdl_tpu.kernels.common import fit_block
 
-__all__ = ["attention", "decode_attention", "int8_matmul",
-           "taken_in_thread"]
+__all__ = ["attention", "decode_attention", "paged_decode_attention",
+           "int8_matmul", "taken_in_thread"]
 
 # module-level registration so `tools.check --telemetry-audit` sees the
 # REAL instruments on import, not a hand-maintained name list
@@ -37,7 +39,8 @@ _C_PALLAS = telemetry.counter(
     "traces routed to a pallas kernel (label op=flash|decode|int8)")
 _C_REFERENCE = telemetry.counter(
     "kernels/dispatch/reference",
-    "traces declined by the dispatch layer to the pure-jnp reference")
+    "traces declined by the dispatch layer to the pure-jnp reference "
+    "(labels op=flash|decode|int8, reason=config|shape|vmem)")
 
 
 # trace-scoped routing evidence: tracing happens on the caller's
@@ -55,8 +58,12 @@ def taken_in_thread() -> int:
     return getattr(_TRACE, "taken", 0)
 
 
-def _declined(op: str) -> None:
-    _C_REFERENCE.inc(op=op)
+def _declined(op: str, reason: str) -> None:
+    # reason= makes declines attributable in `diagnose`: "config" (the
+    # active KernelConfig disabled the op), "shape" (ineligible dtype/
+    # rank/alignment), "vmem" (over the flash working-set budget with
+    # the blockwise long-context path switched off)
+    _C_REFERENCE.inc(op=op, reason=reason)
 
 
 def _taken(op: str) -> None:
@@ -66,14 +73,6 @@ def _taken(op: str) -> None:
 
 def _floating(*arrays) -> bool:
     return all(jnp.issubdtype(a.dtype, jnp.floating) for a in arrays)
-
-
-#: compiled-mode VMEM working-set budget for one flash program: the
-#: working set must fit comfortably under ~16 MB/core; over budget the
-#: dispatch DECLINES so nn.attention's einsum / bundled-flash routes
-#: keep the long-context escape hatch (a Mosaic OOM would be an
-#: error, not a fallback)
-_FLASH_VMEM_BUDGET = 12 * 1024 * 1024
 
 
 def _flash_vmem_bytes(q, block_q: int) -> int:
@@ -104,18 +103,35 @@ def attention(q, k, v, *, causal: bool = False, segment_ids=None,
     falls through to the einsum form, which itself still routes
     HBM-busting lengths to jax's bundled flash kernel)."""
     if not _config.enabled("flash"):
-        _declined("flash")
+        _declined("flash", "config")
         return None
     if (q.ndim != 4 or k.shape != q.shape or v.shape != q.shape
             or not _floating(q, k, v)):
-        _declined("flash")
+        _declined("flash", "shape")
         return None
     cfg = _config.get_config()
     interpret = cfg.resolve_interpret()
-    if not interpret and _flash_vmem_bytes(q, cfg.block_q) \
-            > _FLASH_VMEM_BUDGET:
-        _declined("flash")
-        return None
+    if _flash_vmem_bytes(q, cfg.block_q) > cfg.resolve_vmem_budget():
+        # past the working-set budget the full-K-row kernel would OOM
+        # Mosaic (an error, not a fallback): route to the blockwise
+        # long-context kernel — key axis tiled through VMEM with
+        # online-softmax rescaling — unless it is switched off, in
+        # which case decline so nn.attention's einsum/bundled-flash
+        # routes keep the escape hatch. The budget gate applies in
+        # interpret mode too, so CPU tier-1 exercises the same routing
+        # a TPU would take (shrink vmem_budget_mb to steer small test
+        # shapes down the blockwise path).
+        if not cfg.long_context:
+            _declined("flash", "vmem")
+            return None
+        from bigdl_tpu.kernels.flash_attention import (
+            blockwise_flash_attention)
+
+        _taken("flash")
+        return blockwise_flash_attention(
+            q, k, v, segment_ids, causal=causal, sm_scale=sm_scale,
+            block_q=cfg.block_q, block_k=cfg.block_k,
+            interpret=interpret)
     from bigdl_tpu.kernels.flash_attention import flash_attention
 
     _taken("flash")
@@ -134,11 +150,11 @@ def decode_attention(q, k, v, lengths, *,
     shapes qualify, else **None** (the caller's length-masked einsum
     path runs)."""
     if not _config.enabled("decode"):
-        _declined("decode")
+        _declined("decode", "config")
         return None
     if (k.ndim != 4 or q.shape != k.shape[:2] + k.shape[3:]
             or not _floating(q, k, v)):
-        _declined("decode")
+        _declined("decode", "shape")
         return None
     from bigdl_tpu.kernels.ragged_decode import ragged_decode_attention
 
@@ -147,6 +163,36 @@ def decode_attention(q, k, v, lengths, *,
     return ragged_decode_attention(q, k, v, lengths, sm_scale=sm_scale,
                                    block_k=cfg.block_k,
                                    interpret=cfg.resolve_interpret())
+
+
+def paged_decode_attention(q, k_pages, v_pages, page_table, lengths, *,
+                           sm_scale: Optional[float] = None):
+    """Paged ragged-decode dispatch: ``q [slots, H, D]`` one token per
+    slot, ``k_pages``/``v_pages`` ``[num_pages, H, page_size, D]``
+    pools, ``page_table [slots, pages_per_slot]`` physical page ids,
+    ``lengths`` the host ragged bound. Returns the kernel result
+    (:mod:`bigdl_tpu.kernels.paged_decode` — table-indirect page reads,
+    token-identical to contiguous decode) when ``decode`` is enabled
+    and the shapes qualify, else **None** (the caller gathers its
+    contiguous view and runs the reference path)."""
+    if not _config.enabled("decode"):
+        _declined("decode", "config")
+        return None
+    if (k_pages.ndim != 4 or v_pages.shape != k_pages.shape
+            or q.ndim != 3
+            or q.shape[1:] != (k_pages.shape[1], k_pages.shape[3])
+            or page_table.ndim != 2
+            or page_table.shape[0] != q.shape[0]
+            or not _floating(q, k_pages, v_pages)):
+        _declined("decode", "shape")
+        return None
+    from bigdl_tpu.kernels.paged_decode import (
+        paged_decode_attention as _paged)
+
+    cfg = _config.get_config()
+    _taken("decode")
+    return _paged(q, k_pages, v_pages, page_table, lengths,
+                  sm_scale=sm_scale, interpret=cfg.resolve_interpret())
 
 
 #: compiled (non-interpret) int8 tiles must fill the MXU: the same
@@ -164,7 +210,7 @@ def int8_matmul(x_q, w_q, x_scale, w_scale, bias=None):
     the shapes qualify, else **None** (the caller runs
     ``ops.quant.quantized_linear``)."""
     if not _config.enabled("int8"):
-        _declined("int8")
+        _declined("int8", "config")
         return None
     m, k = x_q.shape
     n = w_q.shape[0]
@@ -173,7 +219,7 @@ def int8_matmul(x_q, w_q, x_scale, w_scale, bias=None):
     if not interpret and not (m % _INT8_ALIGN[0] == 0
                               and n % _INT8_ALIGN[1] == 0
                               and k % _INT8_ALIGN[2] == 0):
-        _declined("int8")
+        _declined("int8", "shape")
         return None
     from bigdl_tpu.kernels.int8_gemm import pallas_quantized_matmul
 

@@ -146,6 +146,12 @@ class MultiHeadAttention(Module):
     ``sp_impl`` picks the kernel: "ring" (K/V blocks rotate via
     ppermute, parallel/ring_attention.py) or "ulysses" (all-to-all
     head re-sharding, parallel/ulysses.py).
+
+    Modules built WITHOUT ``ring_axis`` adopt the train-step policy
+    (``SeqParallelConfig``, installed for the duration of the trace by
+    ``build_train_step(seq_parallel=...)``) — same kernels, chosen by
+    the Optimizer instead of the model author; a custom mask or
+    attention dropout keeps the dense path.
     """
 
     def __init__(self, hidden_size: int, num_heads: int,
@@ -204,8 +210,10 @@ class MultiHeadAttention(Module):
         feeds the pallas flash kernel (``bigdl_tpu.kernels``) when
         enabled, so rows holding several documents never attend across
         document boundaries. Pass one or the other, never both (a
-        custom mask cannot ride the kernel). Unsupported on the
-        sequence-parallel and cached paths.
+        custom mask cannot ride the kernel). ``segments`` also rides
+        the sequence-parallel path (ring rotates the key-side ids with
+        their K/V block; Ulysses all-gathers the full id row); a custom
+        ``mask`` does not, and neither works on the cached path.
 
         ``cache`` is ``{"k": [B,H,T,D], "v": [B,H,T,D]}`` (T the
         cache's bucketed max length), ``positions`` an int32 ``[B]`` of
@@ -228,12 +236,12 @@ class MultiHeadAttention(Module):
                     "decode path (pack training slabs, not decode steps)")
             return self._forward_cached(params, input, cache, positions,
                                         attend_len)
-        if (mask is not None or segments is not None) \
-                and self.ring_axis is not None:
+        if mask is not None and self.ring_axis is not None:
             raise ValueError(
-                "segment masks are not supported on the sequence-parallel "
+                "custom masks are not supported on the sequence-parallel "
                 "path (ring/ulysses kernels shard the key axis the mask "
-                "indexes); use ring_axis=None for packed inputs")
+                "indexes); packed segments= ride the SP path, or use "
+                "ring_axis=None for arbitrary masks")
         x = input
         b, s, e = x.shape
         h, d = self.num_heads, self.head_dim
@@ -245,19 +253,33 @@ class MultiHeadAttention(Module):
         k = split(self._proj(params, x, "k"))
         v = split(self._proj(params, x, "v"))
 
+        # the module-level knob wins; without one, adopt the train-step
+        # policy (build_train_step(seq_parallel=...) installs it for the
+        # duration of the trace) — mask/dropout keep the dense path,
+        # since neither survives a sharded key axis
+        sp_axis, sp_impl, sp_mesh = self.ring_axis, self.sp_impl, self.mesh
+        if sp_axis is None and mask is None and self.dropout == 0.0:
+            from bigdl_tpu.parallel.sequence import active_sequence_parallel
+            sp = active_sequence_parallel()
+            if sp is not None:
+                sp_axis, sp_impl, sp_mesh = sp.axis, sp.impl, sp.mesh
+
         out = None
-        if self.ring_axis is not None:
-            kern = self._sp_kernel()
-            if _inside_axis(self.ring_axis):
-                out = kern(q, k, v, axis_name=self.ring_axis,
-                           causal=self.causal)
+        if sp_axis is not None:
+            kern = self._sp_kernel(sp_impl)
+            if _inside_axis(sp_axis):
+                out = kern(q, k, v, axis_name=sp_axis,
+                           causal=self.causal, segments=segments)
             else:
                 from bigdl_tpu.parallel.mesh import (resolve_axis_mesh,
                                                      seq_sharded_attention)
-                mesh = resolve_axis_mesh(self.mesh, self.ring_axis)
+                mesh = resolve_axis_mesh(sp_mesh, sp_axis)
                 if mesh is not None:
-                    out = seq_sharded_attention(
-                        kern, mesh, self.ring_axis, self.causal)(q, k, v)
+                    wrapped = seq_sharded_attention(
+                        kern, mesh, sp_axis, self.causal,
+                        segments is not None)
+                    out = (wrapped(q, k, v) if segments is None
+                           else wrapped(q, k, v, segments))
         if out is None:
             out = dot_product_attention(
                 q, k, v, causal=self.causal, mask=mask,
@@ -329,8 +351,8 @@ class MultiHeadAttention(Module):
         out = out.transpose(0, 2, 1, 3).reshape(b, s, e)
         return self._proj(params, out, "o"), {"k": ck, "v": cv}
 
-    def _sp_kernel(self):
-        if self.sp_impl == "ulysses":
+    def _sp_kernel(self, impl: Optional[str] = None):
+        if (impl or self.sp_impl) == "ulysses":
             from bigdl_tpu.parallel.ulysses import ulysses_attention
             return ulysses_attention
         from bigdl_tpu.parallel.ring_attention import ring_attention

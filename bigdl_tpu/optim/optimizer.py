@@ -242,7 +242,7 @@ def build_train_step(module: Module, criterion: Criterion,
                      aux_loss_weight: float = 0.01,
                      gradient_clip=None, zero=None, mesh=None,
                      sharding_rules=None, precision=None,
-                     loss_scaler=None):
+                     loss_scaler=None, seq_parallel=None):
     """The compiled hot path: loss + grad + update in one jit.
 
     Gradient normalization matches the reference (grads averaged over the
@@ -282,6 +282,19 @@ def build_train_step(module: Module, criterion: Criterion,
     SKIPPED: params/optimizer state keep their previous values and the
     scaler backs off — all inside the compiled step, so the state
     machine rides the windowed scan carry bit-consistently.
+
+    ``seq_parallel`` (a ``parallel.sequence.SeqParallelConfig``)
+    installs sequence parallelism as a TRAIN-STEP policy: the model
+    apply is traced under ``use_sequence_parallel``, so every
+    ``MultiHeadAttention`` without an explicit ``ring_axis`` runs the
+    ring/Ulysses kernel over the config's mesh axis. Like ``zero``,
+    the policy no-ops quietly (dense attention, degree gauge reads 1)
+    when it cannot apply — no shard_map in this jax build, no mesh, or
+    the axis missing/size-1. The SP collectives trace INSIDE the step,
+    so under ``set_steps_per_sync(K)`` they land inside the scan body
+    and the windowed dispatch boundary stays collective-free; ZeRO
+    composes orthogonally (weights shard over the data axis, attention
+    activations over the sequence axis).
     """
     if gradient_clip is not None and gradient_clip[0] not in (
             "constant", "l2norm"):
@@ -289,6 +302,16 @@ def build_train_step(module: Module, criterion: Criterion,
             f"gradient_clip kind must be 'constant' or 'l2norm', got "
             f"{gradient_clip[0]!r}")
     zero_active = zero is not None and zero.active_on(mesh)
+    import contextlib
+    sp_scope = contextlib.nullcontext
+    if seq_parallel is not None:
+        from bigdl_tpu.parallel.sequence import (record_degree,
+                                                 use_sequence_parallel)
+        if seq_parallel.active_on(mesh):
+            sp_scope = lambda: use_sequence_parallel(seq_parallel)
+            record_degree(seq_parallel.degree())
+        else:
+            record_degree(1)
     from bigdl_tpu.precision import (MASTER_KEY, SCALER_KEY,
                                      DynamicLossScaler, PrecisionPolicy)
     policy = precision if precision is not None \
@@ -326,8 +349,12 @@ def build_train_step(module: Module, criterion: Criterion,
             # f32 inside the layers; cast-on-exit hands the loss an
             # output_dtype (f32) tensor.
             x_c = policy.cast_to_compute(inputs)
-            out, new_mstate = module.apply(p_c, model_state, x_c,
-                                           training=True, rng=rng)
+            # the SP policy is installed for the TRACE of the apply —
+            # attention modules adopt it; once compiled, the routing is
+            # baked in (toggling later never mutates this program)
+            with sp_scope():
+                out, new_mstate = module.apply(p_c, model_state, x_c,
+                                               training=True, rng=rng)
             out = policy.cast_output(out)
             loss = criterion.apply(out, targets)
             reg = module.regularization_loss(p_c)
@@ -565,6 +592,9 @@ class Optimizer:
         # Engine dtype knobs (f32 unless configured)
         self._precision = None
         self._loss_scaler = None
+        # sequence-parallel training policy (set_sequence_parallel);
+        # None = dense attention
+        self._seq_parallel = None
         # gradient clipping (Optimizer.scala setConstantGradientClipping
         # / setGradientClippingByl2Norm); None = off
         self._gradient_clip = None
@@ -813,6 +843,31 @@ class Optimizer:
         # the compiled validation slot closed over the previous
         # precision regime — drop it like set_model does
         self._dc_eval = None
+        return self
+
+    def set_sequence_parallel(self, config) -> "Optimizer":
+        """Sequence-parallel attention for this run
+        (``parallel.sequence.SeqParallelConfig``, or None for dense).
+
+        The train step traces the model under the policy, so every
+        ``MultiHeadAttention`` without an explicit ``ring_axis`` runs
+        the configured ring/Ulysses kernel over the named mesh axis —
+        activation memory per chip drops to the LOCAL sequence length,
+        which is what lets S=128K train at all. Composes with
+        ``set_zero`` (weights shard over the data axis, attention over
+        the sequence axis) and ``set_steps_per_sync`` (the SP
+        collectives live inside the scan body; the windowed dispatch
+        boundary stays collective-free). Quiet no-op when the policy
+        cannot apply — the ``train/seq_parallel/degree`` gauge reports
+        the degree actually achieved."""
+        from bigdl_tpu.parallel.sequence import SeqParallelConfig
+        if config is not None and not isinstance(config,
+                                                 SeqParallelConfig):
+            raise TypeError(
+                f"set_sequence_parallel expects a "
+                f"parallel.SeqParallelConfig or None, got "
+                f"{type(config).__name__}")
+        self._seq_parallel = config
         return self
 
     def set_preflight_spec(self, input_spec) -> "Optimizer":
@@ -1513,7 +1568,8 @@ class Optimizer:
                                 gradient_clip=self._gradient_clip,
                                 zero=self._active_zero(), mesh=self.mesh,
                                 sharding_rules=self.sharding_rules,
-                                precision=policy, loss_scaler=scaler)
+                                precision=policy, loss_scaler=scaler,
+                                seq_parallel=self._seq_parallel)
         ev_sh = self._batch_sharding() if self.mesh is not None else None
         # validation runs under the policy only when the user OPTED IN
         # via set_precision — the legacy Engine dtype knobs never cast

@@ -58,19 +58,30 @@ def resolve_axis_mesh(mesh: Optional[Mesh], axis: str) -> Optional[Mesh]:
 
 
 @functools.lru_cache(maxsize=32)
-def seq_sharded_attention(kern, mesh: Mesh, seq_axis: str, causal: bool):
+def seq_sharded_attention(kern, mesh: Mesh, seq_axis: str, causal: bool,
+                          with_segments: bool = False):
     """Jitted partial-manual shard_map wrapper for a sequence-parallel
     attention kernel (``ring_attention`` / ``ulysses_attention``):
     [B,H,S,D] with S manual over ``seq_axis``, every other mesh axis
-    left auto so batch/model dims compose with DP/TP under GSPMD.
+    left auto so batch/model dims compose with DP/TP under GSPMD. With
+    ``with_segments`` the wrapper takes a fourth [B, S] packed-segment
+    argument, sharded over the same axis.
 
-    Cached per (kernel, mesh, axis, causal): callers may invoke it every
-    forward without rebuilding or retracing. jit is load-bearing —
-    partial-manual shard_map cannot run eagerly; under an outer jit it
-    inlines.
+    Cached per (kernel, mesh, axis, causal, segments): callers may
+    invoke it every forward without rebuilding or retracing. jit is
+    load-bearing — partial-manual shard_map cannot run eagerly; under
+    an outer jit it inlines.
     """
     spec = P(None, None, seq_axis, None)
     fn = functools.partial(kern, axis_name=seq_axis, causal=causal)
+    if with_segments:
+        def with_seg(q, k, v, seg):
+            return fn(q, k, v, segments=seg)
+        return jax.jit(jax.shard_map(
+            with_seg, mesh=mesh,
+            in_specs=(spec, spec, spec, P(None, seq_axis)),
+            out_specs=spec, axis_names=frozenset({seq_axis}),
+            check_vma=False))
     return jax.jit(jax.shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         axis_names=frozenset({seq_axis}), check_vma=False))

@@ -19,11 +19,15 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 
-def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = False):
+def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = False,
+                      segments=None):
     """Exact attention with sequence sharded over ``axis_name``.
 
     Per-shard q,k,v: [B, H, S_local, D] with H divisible by the axis
-    size. Returns [B, H, S_local, D].
+    size. Returns [B, H, S_local, D]. ``segments`` [B, S_local] are
+    per-shard packed segment ids: heads re-shard but the sequence goes
+    FULL per head group, so an all-gather rebuilds the global id row
+    and the dense same-segment mask applies unchanged.
     """
     n = jax.lax.psum(1, axis_name)
 
@@ -42,16 +46,23 @@ def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = False):
             f"'{axis_name}' mesh size ({n})")
     from bigdl_tpu.nn.attention import dot_product_attention
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    oh = dot_product_attention(qh, kh, vh, causal=causal)
+    seg_full = None
+    if segments is not None:
+        seg_full = jax.lax.all_gather(segments.astype(jnp.int32),
+                                      axis_name, axis=1, tiled=True)
+    oh = dot_product_attention(qh, kh, vh, causal=causal,
+                               segments=seg_full)
     return heads_to_seq(oh)
 
 
 def ulysses_attention_sharded(q, k, v, mesh: Mesh, seq_axis: str = "seq",
-                              *, causal: bool = False):
+                              *, causal: bool = False, segments=None):
     """Full-array convenience wrapper: shards S over ``seq_axis`` and
-    runs Ulysses attention under shard_map. q,k,v: [B, H, S, D]. Mesh
+    runs Ulysses attention under shard_map. q,k,v: [B, H, S, D];
+    ``segments`` [B, S] global packed ids, sharded alongside. Mesh
     axes other than ``seq_axis`` stay GSPMD-auto (composes with DP/TP);
     the wrapper is cached, so call it every forward."""
     from bigdl_tpu.parallel.mesh import seq_sharded_attention
-    return seq_sharded_attention(ulysses_attention, mesh, seq_axis,
-                                 causal)(q, k, v)
+    fn = seq_sharded_attention(ulysses_attention, mesh, seq_axis, causal,
+                               segments is not None)
+    return fn(q, k, v) if segments is None else fn(q, k, v, segments)

@@ -40,6 +40,7 @@ FAMILIES = {
                 "bigdl_tpu.kernels.dispatch",
                 "bigdl_tpu.kernels.flash_attention",
                 "bigdl_tpu.kernels.ragged_decode",
+                "bigdl_tpu.kernels.paged_decode",
                 "bigdl_tpu.kernels.int8_gemm",
                 "bigdl_tpu.kernels.common"],
     "autotune": ["bigdl_tpu.autotune", "bigdl_tpu.autotune.space",
@@ -63,7 +64,10 @@ FAMILIES = {
     "elastic": ["bigdl_tpu.elastic", "bigdl_tpu.elastic.checkpoint",
                 "bigdl_tpu.elastic.resume", "bigdl_tpu.elastic.preempt",
                 "bigdl_tpu.elastic.capability"],
-    "parallel": ["bigdl_tpu.parallel", "bigdl_tpu.parallel.zero"],
+    "parallel": ["bigdl_tpu.parallel", "bigdl_tpu.parallel.zero",
+                 "bigdl_tpu.parallel.sequence",
+                 "bigdl_tpu.parallel.ring_attention",
+                 "bigdl_tpu.parallel.ulysses"],
     "precision": ["bigdl_tpu.precision", "bigdl_tpu.precision.policy",
                   "bigdl_tpu.precision.scaler",
                   "bigdl_tpu.precision.calibrate",

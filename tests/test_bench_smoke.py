@@ -244,6 +244,62 @@ def test_bench_fleet_row_contract_and_sentinel_accepts_it():
 
 
 @pytest.mark.slow
+def test_bench_longctx_row_contract_and_regress_accepts_it(tmp_path):
+    """The LONGCTX row: per-S blockwise-flash vs einsum train-step
+    tokens/sec + MFU and chunked-prefill TTFT both ways. The 1 MiB
+    VMEM budget makes the smoke shapes over-budget by construction, so
+    flash_taken=1 proves the BLOCKWISE route ran (full-row flash is
+    not eligible past the budget; declining would have run einsum and
+    left the counter flat). The fresh line must ride tools/regress end
+    to end: judged against a trajectory of itself it exits 0."""
+    chunk, new = 64, 4
+    out = _run_bench("synthetic", {
+        "BENCH_LONGCTX": "1", "BENCH_LONGCTX_SEQS": "512,1024",
+        "BENCH_LONGCTX_BATCH": "1", "BENCH_LONGCTX_HEADS": "2",
+        "BENCH_LONGCTX_HEAD_DIM": "8",
+        "BENCH_LONGCTX_EINSUM_MAX": "1024",
+        "BENCH_LONGCTX_CHUNK": str(chunk), "BENCH_LONGCTX_VOCAB": "64",
+        "BENCH_LONGCTX_HIDDEN": "32", "BENCH_LONGCTX_LAYERS": "1",
+        "BENCH_LONGCTX_NEW": str(new), "BIGDL_VMEM_BUDGET_MB": "1"})
+    for s in (512, 1024):
+        # over the 1 MiB budget at these shapes -> blockwise, fused
+        assert out[f"longctx_s{s}_flash_taken"] == 1, out
+        for key in (f"longctx_s{s}_tokens_per_sec_blockwise",
+                    f"longctx_s{s}_tokens_per_sec_einsum",
+                    f"longctx_s{s}_blockwise_speedup",
+                    f"longctx_s{s}_ttft_ms",
+                    f"longctx_s{s}_ttft_ms_einsum"):
+            assert out[key] > 0, key
+        assert out[f"longctx_s{s}_mfu_blockwise"] >= 0
+        # every chunk the prompt needs went through the engine
+        prompt_len = s - new
+        assert out[f"longctx_s{s}_prefill_chunks"] == \
+            -(-prompt_len // chunk), out
+    # direction rules: throughput/MFU higher-is-better, TTFT lower
+    from bigdl_tpu.tools.regress import classify_key, extract_metrics
+    metrics = extract_metrics(out, "bench-line")
+    assert classify_key("longctx_s512_tokens_per_sec_blockwise") == \
+        "higher"
+    assert classify_key("longctx_s512_mfu_blockwise") == "higher"
+    assert classify_key("longctx_s512_ttft_ms") == "lower"
+    assert "longctx_s1024_blockwise_speedup" in metrics
+    # the sentinel gate itself: a 2-point trajectory of this same row
+    # plus the row as candidate judges every tracked key ok (exit 0)
+    import json as _json
+
+    from bigdl_tpu.tools.regress import main as regress_main
+    for i in (1, 2):
+        (tmp_path / f"BENCH_r{i:02d}.json").write_text(
+            _json.dumps({"parsed": out}))
+    cand = tmp_path / "candidate.json"
+    cand.write_text(_json.dumps(out))
+    rc = regress_main([str(tmp_path / "BENCH_r01.json"),
+                       str(tmp_path / "BENCH_r02.json"),
+                       "--candidate", str(cand)])
+    assert rc == 0
+
+
+@pytest.mark.slow
 def test_bench_tuned_row_contract_and_sentinel_accepts_it():
     """The TUNED row: the autotuner's winner vs the hand-picked
     defaults from ONE prune-then-measure sweep over the bounded smoke

@@ -21,7 +21,9 @@ import jax
 import jax.numpy as jnp
 
 from bigdl_tpu import kernels
-from bigdl_tpu.kernels.flash_attention import fit_block, flash_attention
+from bigdl_tpu.kernels.flash_attention import (blockwise_flash_attention,
+                                               fit_block,
+                                               flash_attention)
 from bigdl_tpu.kernels.int8_gemm import pallas_quantized_matmul
 from bigdl_tpu.kernels.ragged_decode import ragged_decode_attention
 from bigdl_tpu.models.transformer import TransformerLM
@@ -233,18 +235,67 @@ class TestFlashAttention:
             assert kernels.attention(q[:, 0], k[:, 0], v[:, 0]) is None
             assert kernels.attention(q, k, v, causal=True) is not None
 
-    def test_compiled_mode_declines_over_vmem_budget(self):
-        """The long-context escape hatch survives: in compiled
-        (non-interpret) mode a shape whose K/V + score strips bust the
-        VMEM budget is DECLINED — nn.attention's einsum/bundled-flash
-        routes handle it — instead of handing Mosaic an OOM."""
+    def test_over_vmem_budget_routes_blockwise_or_declines(self):
+        """Past the VMEM budget the dispatch routes to the BLOCKWISE
+        long-context kernel (S=32K runs fused, no einsum fallback);
+        with long_context switched off the historical decline→einsum
+        escape hatch survives — Mosaic never sees an OOM shape."""
+        from bigdl_tpu.kernels import dispatch, flash_attention as fa
         big = jax.ShapeDtypeStruct((1, 1, 32768, 128), jnp.bfloat16)
-        with kernels.use(kernels.KernelConfig.all_on(interpret=False)):
+        cfg = kernels.KernelConfig.all_on(interpret=False)
+        assert dispatch._flash_vmem_bytes(big, cfg.block_q) \
+            > cfg.resolve_vmem_budget()
+        with kernels.use(kernels.KernelConfig.all_on(
+                interpret=False, long_context=False)):
             assert kernels.attention(big, big, big,
                                      causal=True) is None
         small = _qkv(s=512, d=64, seed=13)
         with kernels.use(ON):
             assert kernels.attention(*small, causal=True) is not None
+        # a tiny budget steers a small shape down the blockwise path
+        # (the same routing an over-budget shape takes on TPU) — and
+        # the result stays tolerance-equal to the einsum reference
+        routed = []
+        real = fa.blockwise_flash_attention
+
+        def spy(*a, **kw):
+            routed.append(True)
+            return real(*a, **kw)
+
+        fa.blockwise_flash_attention = spy
+        try:
+            with kernels.use(kernels.KernelConfig.all_on(
+                    interpret=True, vmem_budget_mb=1, block_q=64,
+                    block_k=64)):
+                q, k, v = _qkv(b=1, h=1, s=1024, d=16, seed=13)
+                out = kernels.attention(q, k, v, causal=True)
+        finally:
+            fa.blockwise_flash_attention = real
+        assert routed and out is not None
+        ref = _ref_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=0)
+
+    def test_vmem_budget_env_and_bounds(self):
+        """BIGDL_VMEM_BUDGET_MB overrides the 12 MiB default; an
+        explicit vmem_budget_mb wins over the env; nonsense values are
+        loud."""
+        import os
+        cfg = kernels.KernelConfig.all_on()
+        assert cfg.resolve_vmem_budget() == 12 * 1024 * 1024
+        os.environ["BIGDL_VMEM_BUDGET_MB"] = "3"
+        try:
+            assert cfg.resolve_vmem_budget() == 3 * 1024 * 1024
+            explicit = kernels.KernelConfig.all_on(vmem_budget_mb=5)
+            assert explicit.resolve_vmem_budget() == 5 * 1024 * 1024
+            os.environ["BIGDL_VMEM_BUDGET_MB"] = "lots"
+            with pytest.raises(ValueError):
+                cfg.resolve_vmem_budget()
+        finally:
+            del os.environ["BIGDL_VMEM_BUDGET_MB"]
+        with pytest.raises(ValueError):
+            kernels.KernelConfig.all_on(
+                vmem_budget_mb=0).resolve_vmem_budget()
 
     def test_mask_and_segments_are_exclusive(self):
         """A free-form mask cannot ride the kernel, so passing both
@@ -278,6 +329,98 @@ class TestFlashAttention:
             out = np.asarray(m.apply(p, st, toks, training=False)[0])
         np.testing.assert_allclose(out, ref, atol=1e-4, rtol=0)
         assert np.array_equal(out.argmax(-1), ref.argmax(-1))
+
+
+# -------------------------------------------- blockwise (long-context)
+
+class TestBlockwiseFlashAttention:
+    """The online-softmax long-context path: VMEM working set
+    independent of S. Tolerance contract (the rescale rounds per block
+    boundary — flash_attention.py's section comment), checked against
+    the same einsum reference at several block geometries, including
+    boundaries that straddle documents and ragged tiles."""
+
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("block_k", [8, 16, 48])
+    def test_forward_matches_reference(self, causal, block_k):
+        q, k, v = _qkv(s=48, seed=30)
+        out = blockwise_flash_attention(q, k, v, causal=causal,
+                                        block_q=16, block_k=block_k,
+                                        interpret=True)
+        ref = _ref_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=0)
+
+    def test_segment_mask_matches_reference(self):
+        """Packed segment masks under the blockwise form — including
+        key tiles that are FULLY masked for some query row (the
+        all-masked-carry NaN hazard the exp guards exist for)."""
+        q, k, v = _qkv(s=48, seed=31)
+        r = np.random.default_rng(32)
+        seg = jnp.asarray(r.integers(0, 3, (2, 48)).astype(np.int32))
+        out = blockwise_flash_attention(q, k, v, seg, causal=True,
+                                        block_q=16, block_k=16,
+                                        interpret=True)
+        mask = seg[:, None, :, None] == seg[:, None, None, :]
+        ref = _ref_attention(q, k, v, causal=True, mask=mask)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=0)
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_gradient_check_vs_reference(self):
+        """The two-pass tiled backward vs jax.grad of the einsum
+        reference — plain causal and segment-masked."""
+        q, k, v = _qkv(s=32, seed=33)
+        r = np.random.default_rng(34)
+        seg = jnp.asarray(r.integers(1, 3, (2, 32)).astype(np.int32))
+        mask = seg[:, None, :, None] == seg[:, None, None, :]
+
+        for kern_loss, ref_loss in [
+            (lambda q_, k_, v_: (blockwise_flash_attention(
+                q_, k_, v_, causal=True, block_q=16, block_k=8,
+                interpret=True) ** 2).sum(),
+             lambda q_, k_, v_: (_ref_attention(
+                 q_, k_, v_, causal=True) ** 2).sum()),
+            (lambda q_, k_, v_: (blockwise_flash_attention(
+                q_, k_, v_, seg, causal=True, block_q=16, block_k=8,
+                interpret=True) ** 2).sum(),
+             lambda q_, k_, v_: (_ref_attention(
+                 q_, k_, v_, causal=True, mask=mask) ** 2).sum()),
+        ]:
+            gk = jax.grad(kern_loss, argnums=(0, 1, 2))(q, k, v)
+            gr = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+            for a, b in zip(gk, gr):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           atol=2e-4, rtol=1e-4)
+
+    def test_grad_under_jit(self):
+        """jit(grad(...)) — the train-step compile shape — over the
+        blockwise custom VJP."""
+        q, k, v = _qkv(s=32, seed=35)
+
+        @jax.jit
+        def g(q_, k_, v_):
+            return jax.grad(lambda t: (blockwise_flash_attention(
+                t, k_, v_, causal=True, block_q=16, block_k=16,
+                interpret=True) ** 2).sum())(q_)
+
+        ref = jax.grad(lambda t: (_ref_attention(
+            t, k, v, causal=True) ** 2).sum())(q)
+        np.testing.assert_allclose(np.asarray(g(q, k, v)),
+                                   np.asarray(ref), atol=2e-4,
+                                   rtol=1e-4)
+
+    def test_matches_fullrow_kernel_tolerance(self):
+        """The two kernel forms agree within float32 reduction
+        tolerance — the property that makes the budget-based routing
+        switch invisible to callers."""
+        q, k, v = _qkv(s=64, seed=36)
+        a = blockwise_flash_attention(q, k, v, causal=True, block_q=16,
+                                      block_k=16, interpret=True)
+        b = flash_attention(q, k, v, causal=True, block_q=16,
+                            interpret=True)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=0)
 
 
 # ------------------------------------------------------- ragged decode
@@ -644,10 +787,21 @@ class TestKernelProgramLabels:
             "kernels/dispatch/reference")
         q, k, v = _qkv()
         before_p = c_pallas.value(op="flash")
-        before_r = c_ref.value(op="flash")
+        before_c = c_ref.value(op="flash", reason="config")
+        before_s = c_ref.value(op="flash", reason="shape")
+        before_v = c_ref.value(op="flash", reason="vmem")
         with kernels.use(ON):
             kernels.attention(q, k, v, causal=True)
         with kernels.use(OFF):
             assert kernels.attention(q, k, v, causal=True) is None
+        with kernels.use(ON):
+            # rank-3 input: declined for shape, attributably
+            assert kernels.attention(q[:, 0], k[:, 0], v[:, 0]) is None
+        big = jax.ShapeDtypeStruct((1, 1, 32768, 128), jnp.bfloat16)
+        with kernels.use(kernels.KernelConfig.all_on(
+                interpret=False, long_context=False)):
+            assert kernels.attention(big, big, big) is None
         assert c_pallas.value(op="flash") == before_p + 1
-        assert c_ref.value(op="flash") == before_r + 1
+        assert c_ref.value(op="flash", reason="config") == before_c + 1
+        assert c_ref.value(op="flash", reason="shape") == before_s + 1
+        assert c_ref.value(op="flash", reason="vmem") == before_v + 1
