@@ -554,6 +554,14 @@ def main():
     # on CPU smoke runs unless forced.
     if _row_enabled("BENCH_LONGCTX", platform):
         result.update(_bench_longctx())
+    # fifteenth tracked row: CONTROL — the SLO-driven control plane
+    # under a load ramp (chaos --control leg, faults off): goodput and
+    # p99 TTFT while replicas scale 1->N->1, scale-up reaction time,
+    # and per-tenant shed fractions. Tracked so a regression in the
+    # autoscaler/admission path trips tools/regress like any perf
+    # number. Skipped on CPU smoke runs unless forced.
+    if _row_enabled("BENCH_CONTROL", platform):
+        result.update(_bench_control())
     print(json.dumps(result))
     _maybe_metrics_snapshot(result)
 
@@ -834,6 +842,39 @@ def _bench_slo():
             soak["goodput_tokens_per_sec"], 2),
         "slo_ttft_ms_p99": round(by.get("p99_ttft") or 0.0, 3),
         "slo_passed": int(rep.passed and soak["passed"] and not bad),
+    }
+
+
+def _bench_control():
+    """CONTROL row: the chaos ``--control`` load-ramp leg run
+    fault-free — goodput and p99 TTFT while the autoscaler takes the
+    fleet 1->N->1 under a two-tenant burst, the scale-up reaction
+    time, and each tenant's shed fraction. ``control_passed`` drops
+    to 0 when the leg's invariants (typed-only sheds, zero hangs,
+    ramp reached N, drained back to 1) break.
+
+    Key naming is deliberate for tools/regress's classifier:
+    ``*_per_sec`` higher-is-better, ``*_ms`` lower-is-better, and the
+    shed fractions use the unclassified ``_frac_`` spelling — a shed
+    fraction moving is context, not a regression by itself."""
+    from bigdl_tpu.tools.chaos import run_control
+
+    max_replicas = int(os.environ.get("BENCH_CONTROL_REPLICAS", 3))
+    leg = run_control(max_replicas=max_replicas, inject=False)
+    tenants = leg.get("tenants") or {}
+    return {
+        "control_goodput_tokens_per_sec": round(
+            leg["goodput_tokens_per_sec"], 2),
+        "control_ttft_ms_p99": round(
+            (leg.get("latency") or {}).get("ramp_ttft_ms_p99")
+            or 0.0, 3),
+        "control_scaleup_reaction_ms": round(
+            leg.get("scaleup_reaction_ms") or 0.0, 1),
+        "control_shed_frac_gold": (
+            tenants.get("gold") or {}).get("shed_fraction", 0.0),
+        "control_shed_frac_bronze": (
+            tenants.get("bronze") or {}).get("shed_fraction", 0.0),
+        "control_passed": int(leg["passed"]),
     }
 
 

@@ -75,7 +75,8 @@ QUEUE_CTORS = {"queue.Queue", "queue.LifoQueue", "queue.PriorityQueue",
 # even when the Thread(...) construction lives elsewhere
 WORKER_ENTRY_NAMES = frozenset({
     "_dispatch_loop", "_decode_loop", "_read_loop", "_stage_loop",
-    "_worker_loop", "_supervised", "_worker"})
+    "_worker_loop", "_supervised", "_worker", "_control_loop",
+    "_deploy_loop"})
 
 # container mutations that count as writes for guarded-attr inference
 MUTATORS = frozenset({

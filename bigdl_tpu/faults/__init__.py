@@ -27,17 +27,18 @@ exception classification (fatal-fast vs transient-retry) and
 exponential backoff + jitter, shared by the optimizer's
 retry-from-checkpoint loop and the IO paths.
 """
-from bigdl_tpu.faults.core import (NAMED_EXCEPTIONS, FaultRule,
-                                   FaultSchedule, InjectedFault,
-                                   active_schedule, arm, armed, disarm,
-                                   injected_total, is_armed,
-                                   parse_schedule, point)
+from bigdl_tpu.faults.core import (KNOWN_POINTS, NAMED_EXCEPTIONS,
+                                   FaultRule, FaultSchedule,
+                                   InjectedFault, active_schedule, arm,
+                                   armed, disarm, injected_total,
+                                   is_armed, parse_schedule, point)
 from bigdl_tpu.faults.retry import (FATAL_TYPES, TRANSIENT_TYPES,
                                     backoff_delay, classify, is_transient,
                                     retry_call)
 
 __all__ = [
-    "FaultRule", "FaultSchedule", "InjectedFault", "NAMED_EXCEPTIONS",
+    "FaultRule", "FaultSchedule", "InjectedFault", "KNOWN_POINTS",
+    "NAMED_EXCEPTIONS",
     "active_schedule", "arm", "armed", "disarm", "injected_total",
     "is_armed", "parse_schedule", "point",
     "FATAL_TYPES", "TRANSIENT_TYPES", "backoff_delay", "classify",

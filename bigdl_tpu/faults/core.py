@@ -69,6 +69,38 @@ NAMED_EXCEPTIONS: Dict[str, type] = {
 
 _ACTIONS = ("raise", "sigkill", "delay")
 
+#: every faultpoint name compiled into the library, with the failure
+#: it models — the named-point table. A schedule may only script
+#: points listed here (typo'd schedules silently never fire, which is
+#: the opposite of deterministic chaos), tests assert membership when
+#: adding a point, and docs/robustness.md mirrors this table.
+KNOWN_POINTS: Dict[str, str] = {
+    "ckpt/write_manifest": "between checkpoint payload and manifest "
+                           "commit (torn-write window)",
+    "train/step": "inside one optimizer step (mid-training death)",
+    "fetch/download": "inside one dataset download attempt",
+    "prefetch/stage": "inside one prefetch staging copy",
+    "datapipe/read": "inside one datapipe shard read",
+    "serving/dispatch": "on the serving dispatch thread",
+    "serving/take_batch": "taking a batch off the admission queue",
+    "serving/swap": "inside a model-version hot-swap",
+    "serving/decode": "inside one continuous-batching decode step",
+    "file_io/remote_write": "inside one remote (non-local) write",
+    "fleet/route": "at the router's placement edge",
+    "fleet/replica": "at a replica's submit path (injection here IS "
+                     "that replica's death)",
+    "fleet/verify": "inside speculative-decode verification",
+    "fleet/spawn": "at the autoscaler's spawn actuation, before the "
+                   "replica is built (aborted scale-up)",
+    "fleet/drain": "at the autoscaler's drain actuation, before the "
+                   "drain starts (aborted scale-down)",
+    "fleet/deploy": "at every deploy state-machine transition, "
+                    "before it commits",
+    "fleet/canary_swap": "at each incumbent's hot-swap during a "
+                         "fleet-wide deploy (aborted swap reverts "
+                         "the already-swapped)",
+}
+
 
 class FaultRule:
     """One scripted behavior for one faultpoint.

@@ -22,8 +22,26 @@ this package is the layer that makes it a FLEET (docs/serving.md
   sheds, and re-routing streams when a replica dies mid-flight;
 - :mod:`~bigdl_tpu.fleet.soak` — the sustained heavy-traffic soak
   asserting p99 TTFT/token latency under QueueFull pressure with a
-  replica's breaker open (also the bench FLEET row's engine).
+  replica's breaker open (also the bench FLEET row's engine);
+- :mod:`~bigdl_tpu.fleet.control` — the SLO-driven autoscaler:
+  hysteresis-banded scale decisions actuated as warm-before-join
+  spawns and drain-rebalance scale-downs (docs/robustness.md
+  "Control plane");
+- :mod:`~bigdl_tpu.fleet.admission` — multi-tenant admission: token
+  budgets, weighted-fair queueing, priority preemption; overload is
+  always a typed shed attributable per tenant;
+- :mod:`~bigdl_tpu.fleet.deploy` — the train→gate→quantize→canary→
+  swap/rollback deploy state machine (``tools.deploy`` CLI).
 """
+from bigdl_tpu.fleet.admission import (AdmissionController,
+                                       BudgetExhausted, Preempted,
+                                       Tenant, TokenBudget,
+                                       register_admission_instruments)
+from bigdl_tpu.fleet.control import (Autoscaler, ScaleDecision,
+                                     ScalePolicy,
+                                     register_control_instruments)
+from bigdl_tpu.fleet.deploy import (DeployError, DeployPipeline,
+                                    register_deploy_instruments)
 from bigdl_tpu.fleet.prefix import (PrefixCache, PrefixEntry,
                                     register_prefix_instruments)
 from bigdl_tpu.fleet.replica import ProcessReplica, Replica
@@ -36,11 +54,16 @@ from bigdl_tpu.fleet.speculative import (SpeculativeConfig,
                                          register_speculative_instruments)
 
 __all__ = [
-    "FleetRouter", "FleetStream", "MAX_SESSIONS", "PrefixCache",
-    "PrefixEntry", "ProcessReplica", "Replica", "SpeculativeConfig",
-    "SpeculativeDecoder", "build_replicas", "register_fleet_instruments",
-    "register_prefix_instruments", "register_router_instruments",
-    "register_speculative_instruments", "run_fleet_soak",
+    "AdmissionController", "Autoscaler", "BudgetExhausted",
+    "DeployError", "DeployPipeline", "FleetRouter", "FleetStream",
+    "MAX_SESSIONS", "Preempted", "PrefixCache", "PrefixEntry",
+    "ProcessReplica", "Replica", "ScaleDecision", "ScalePolicy",
+    "SpeculativeConfig", "SpeculativeDecoder", "Tenant", "TokenBudget",
+    "build_replicas", "register_admission_instruments",
+    "register_control_instruments", "register_deploy_instruments",
+    "register_fleet_instruments", "register_prefix_instruments",
+    "register_router_instruments", "register_speculative_instruments",
+    "run_fleet_soak",
 ]
 
 
@@ -52,4 +75,7 @@ def register_fleet_instruments(r):
     out.update(register_router_instruments(r))
     out.update(register_speculative_instruments(r))
     out.update(register_slo_instruments(r))
+    out.update(register_control_instruments(r))
+    out.update(register_admission_instruments(r))
+    out.update(register_deploy_instruments(r))
     return out

@@ -1,0 +1,382 @@
+"""The fleet autoscaler: SLO evaluations in, typed reversible
+actuations out — capacity driven by measured signals, not humans.
+
+The observability plane (``telemetry.agg`` + ``telemetry.slo``)
+already computes exactly what an operator watches — goodput, p99
+TTFT, occupancy, burn rate over the merged fleet registry. This
+module closes the loop: a control loop reads those evaluations plus
+the router's live load and actuates replica count through two typed,
+reversible actions:
+
+- :meth:`Autoscaler.spawn_replica` — **warm-before-join**: the
+  replica factory builds a fully loaded replica (its
+  ``GenerationService.load`` compiles the program ladder), optional
+  warm prompts run through it *before* ``router.add`` — priming its
+  decode path and prefix cache — so the router never places traffic
+  on a cold replica;
+- :meth:`Autoscaler.drain_replica` — the PR-14 drain-rebalance as the
+  safe scale-down: held streams finish, new sessions route elsewhere,
+  then the replica is removed.
+
+A noisy gauge can never flap the fleet: decisions pass a
+**hysteresis band** (scale up at ``up_load``, down only below the
+strictly lower ``down_load``), **cooldown windows** (independent up/
+down), and a **min/max replica clamp** — suppressed impulses are
+counted (``fleet/control/suppressed``), every actuation is a
+structured flight-recorder event plus a ``fleet/control/*`` counter,
+and both actuators carry faultpoints (``fleet/spawn``,
+``fleet/drain``) so the chaos ``--control`` leg can inject actuator
+failures and reconcile them counter-for-counter against the
+``*_aborted`` recovery counters (docs/robustness.md "Control
+plane").
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+import bigdl_tpu.telemetry as telemetry
+from bigdl_tpu import faults
+from bigdl_tpu.telemetry import flight
+
+__all__ = ["Autoscaler", "ScaleDecision", "ScalePolicy",
+           "register_control_instruments"]
+
+
+def register_control_instruments(r) -> Dict[str, object]:
+    """Get-or-create the ``fleet/control/*`` instrument surface in
+    registry ``r`` (audited by ``tools.check --telemetry-audit``)."""
+    return {
+        "evaluations": r.counter(
+            "fleet/control/evaluations",
+            "autoscaler control-loop evaluations"),
+        "scale_ups": r.counter(
+            "fleet/control/scale_ups",
+            "replicas spawned (warm-before-join) by the autoscaler"),
+        "scale_downs": r.counter(
+            "fleet/control/scale_downs",
+            "replicas drain-removed by the autoscaler"),
+        "holds": r.counter(
+            "fleet/control/holds",
+            "evaluations that decided to hold replica count"),
+        "suppressed": r.counter(
+            "fleet/control/suppressed",
+            "scale impulses suppressed (labelled by=cooldown|clamp)"),
+        "spawn_aborted": r.counter(
+            "fleet/control/spawn_aborted",
+            "spawn actuations aborted by a fleet/spawn fault and "
+            "retried at a later tick (chaos reconciles these against "
+            "injected faults)"),
+        "drain_aborted": r.counter(
+            "fleet/control/drain_aborted",
+            "drain actuations aborted by a fleet/drain fault and "
+            "retried at a later tick"),
+        "warm_ms": r.histogram(
+            "fleet/control/warm_ms",
+            "warm-before-join wall time per spawned replica (ms)"),
+        "target_replicas": r.gauge(
+            "fleet/control/target_replicas",
+            "replica count the last decision steered toward"),
+    }
+
+
+class ScalePolicy:
+    """The autoscaler's knobs (module docstring has the semantics).
+
+    ``up_load``/``down_load`` bound the hysteresis band on the mean
+    per-replica load (live slots + queue depth): scale up at or above
+    ``up_load``, down at or below ``down_load`` — the gap between
+    them is the dead zone a noisy gauge bounces in without flapping
+    the fleet. Cooldowns gate how often each direction may actuate;
+    ``min_replicas``/``max_replicas`` clamp the fleet size
+    absolutely. ``warm_prompts`` run through every spawned replica
+    before the router sees it (warm-before-join)."""
+
+    def __init__(self, *, min_replicas: int = 1, max_replicas: int = 4,
+                 up_load: float = 3.0, down_load: float = 1.0,
+                 up_cooldown_s: float = 1.0,
+                 down_cooldown_s: float = 2.0,
+                 warm_prompts: Optional[List] = None,
+                 warm_timeout_s: float = 60.0):
+        if min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if max_replicas < min_replicas:
+            raise ValueError("max_replicas must be >= min_replicas")
+        if down_load >= up_load:
+            raise ValueError(
+                f"hysteresis band needs down_load < up_load, got "
+                f"[{down_load}, {up_load}]")
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.up_load = float(up_load)
+        self.down_load = float(down_load)
+        self.up_cooldown_s = float(up_cooldown_s)
+        self.down_cooldown_s = float(down_cooldown_s)
+        self.warm_prompts = list(warm_prompts or [])
+        self.warm_timeout_s = float(warm_timeout_s)
+
+
+class ScaleDecision:
+    """One control-loop verdict: ``action`` in ``"up" | "down" |
+    "hold"``, the signal it judged, and the reason string the flight
+    recorder gets."""
+
+    def __init__(self, action: str, reason: str, signal: float,
+                 replicas: int, target: int):
+        self.action = action
+        self.reason = reason
+        self.signal = signal
+        self.replicas = replicas
+        self.target = target
+
+    def to_dict(self) -> dict:
+        """JSON-ready form (chaos/bench reports embed these)."""
+        return {"action": self.action, "reason": self.reason,
+                "signal": round(self.signal, 3),
+                "replicas": self.replicas, "target": self.target}
+
+    def __repr__(self) -> str:
+        return (f"ScaleDecision({self.action} {self.replicas}->"
+                f"{self.target}: {self.reason})")
+
+
+class Autoscaler:
+    """SLO-driven replica-count control over one
+    :class:`~bigdl_tpu.fleet.router.FleetRouter`.
+
+    ``factory(name)`` builds one ready-to-serve replica (model loaded,
+    programs compiled) — :func:`~bigdl_tpu.fleet.soak.build_replicas`
+    shows the shape. ``engine`` (optional) is a
+    :class:`~bigdl_tpu.telemetry.slo.SloEngine`: when its multi-window
+    burn rate says the error budget is burning, scale-up is forced
+    even inside the hysteresis dead zone (a breached SLO outranks a
+    calm load gauge). Drive it inline (:meth:`step` per tick — the
+    chaos leg and tests do, deterministically) or start the
+    ``_control_loop`` thread (:meth:`start`)."""
+
+    def __init__(self, router, factory: Callable[[str], object], *,
+                 policy: Optional[ScalePolicy] = None, engine=None,
+                 metrics=None, name_prefix: str = "auto-",
+                 clock: Callable[[], float] = time.monotonic):
+        self.router = router
+        self.factory = factory
+        self.policy = policy or ScalePolicy()
+        self.engine = engine
+        self.name_prefix = name_prefix
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._last_up = -1e18
+        self._last_down = -1e18
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._interval_s = 0.5
+        #: the last decisions, newest last (bounded — the flight
+        #: recorder holds the durable history)
+        self.decisions = deque(maxlen=256)
+        r = metrics if metrics is not None \
+            else getattr(router, "metrics_registry", None)
+        if r is None:
+            r = telemetry.registry()
+        self.metrics_registry = r
+        inst = register_control_instruments(r)
+        self._c_evals = inst["evaluations"]
+        self._c_ups = inst["scale_ups"]
+        self._c_downs = inst["scale_downs"]
+        self._c_holds = inst["holds"]
+        self._c_suppressed = inst["suppressed"]
+        self._c_spawn_aborted = inst["spawn_aborted"]
+        self._c_drain_aborted = inst["drain_aborted"]
+        self._h_warm = inst["warm_ms"]
+        self._g_target = inst["target_replicas"]
+
+    # -------------------------------------------------------- signals
+    def _serving(self) -> List:
+        return [rep for rep in self.router.replicas()
+                if rep.state == "serving"]
+
+    def signal(self) -> float:
+        """Mean load (live slots + queue depth) per serving replica —
+        the hysteresis band's input."""
+        reps = self._serving()
+        if not reps:
+            return float("inf")  # an empty fleet is infinitely loaded
+        return sum(rep.load() for rep in reps) / len(reps)
+
+    def _burning(self, observations: Optional[Dict]) -> bool:
+        if self.engine is None:
+            return False
+        snapshot = None
+        if getattr(self.router, "telemetry_dir", None):
+            snapshot = self.router.fleet_snapshot()
+        self.engine.evaluate(snapshot, observations)
+        return self.engine.burning()
+
+    # ------------------------------------------------------- decision
+    def decide(self, observations: Optional[Dict] = None
+               ) -> ScaleDecision:
+        """One evaluation: hysteresis band + SLO burn + cooldowns +
+        clamp, no actuation. ``observations`` are host-side scalars
+        forwarded to the SLO engine (the soak's report keys)."""
+        self._c_evals.inc()
+        now = self._clock()
+        with self._lock:
+            last_up, last_down = self._last_up, self._last_down
+        n = len(self._serving())
+        sig = self.signal()
+        burning = self._burning(observations)
+        pol = self.policy
+        if burning or sig >= pol.up_load:
+            why = "slo_burning" if burning else \
+                f"load {sig:.2f} >= {pol.up_load:g}"
+            if n >= pol.max_replicas:
+                self._c_suppressed.inc(by="clamp")
+                return self._hold(f"up wanted ({why}) but at "
+                                  f"max_replicas={pol.max_replicas}",
+                                  sig, n)
+            if now - last_up < pol.up_cooldown_s:
+                self._c_suppressed.inc(by="cooldown")
+                return self._hold(f"up wanted ({why}) but inside "
+                                  "up_cooldown", sig, n)
+            return ScaleDecision("up", why, sig, n, n + 1)
+        if sig <= pol.down_load and not burning:
+            why = f"load {sig:.2f} <= {pol.down_load:g}"
+            if n <= pol.min_replicas:
+                self._c_suppressed.inc(by="clamp")
+                return self._hold(f"down wanted ({why}) but at "
+                                  f"min_replicas={pol.min_replicas}",
+                                  sig, n)
+            if now - last_down < pol.down_cooldown_s:
+                self._c_suppressed.inc(by="cooldown")
+                return self._hold(f"down wanted ({why}) but inside "
+                                  "down_cooldown", sig, n)
+            return ScaleDecision("down", why, sig, n, n - 1)
+        return self._hold(
+            f"load {sig:.2f} inside band "
+            f"({pol.down_load:g}, {pol.up_load:g})", sig, n)
+
+    def _hold(self, reason: str, sig: float, n: int) -> ScaleDecision:
+        self._c_holds.inc()
+        return ScaleDecision("hold", reason, sig, n, n)
+
+    # ------------------------------------------------------- actuation
+    def step(self, observations: Optional[Dict] = None
+             ) -> ScaleDecision:
+        """One control tick: decide, then actuate. An actuator aborted
+        by an injected fault (``fleet/spawn``/``fleet/drain``) is
+        counted (``*_aborted``) and the fleet is left as it was — the
+        next tick retries, which is the recovery the chaos leg
+        reconciles. Returns the decision (recorded in
+        ``self.decisions`` and the flight recorder)."""
+        decision = self.decide(observations)
+        if decision.action == "up":
+            try:
+                name = self.spawn_replica()
+                decision.reason += f" -> spawned {name}"
+            except Exception as e:
+                self._c_spawn_aborted.inc()
+                flight.note("fleet/scale", action="spawn_aborted",
+                            error=f"{type(e).__name__}: {e}")
+                decision = ScaleDecision(
+                    "hold", f"spawn aborted ({type(e).__name__}), "
+                    "retrying next tick", decision.signal,
+                    decision.replicas, decision.replicas)
+        elif decision.action == "down":
+            try:
+                name = self.drain_replica()
+                decision.reason += f" -> drained {name}"
+            except Exception as e:
+                self._c_drain_aborted.inc()
+                flight.note("fleet/scale", action="drain_aborted",
+                            error=f"{type(e).__name__}: {e}")
+                decision = ScaleDecision(
+                    "hold", f"drain aborted ({type(e).__name__}), "
+                    "retrying next tick", decision.signal,
+                    decision.replicas, decision.replicas)
+        self._g_target.set(decision.target)
+        with self._lock:
+            self.decisions.append(decision)
+        return decision
+
+    def spawn_replica(self) -> str:
+        """The scale-up actuator, warm-before-join (module docstring).
+        The ``fleet/spawn`` faultpoint fires before anything is built:
+        an injected failure aborts the actuation with the fleet
+        untouched. Returns the joined replica's name."""
+        with self._lock:
+            self._seq += 1
+            name = f"{self.name_prefix}{self._seq}"
+        faults.point("fleet/spawn", replica=name)
+        t0 = time.monotonic()
+        replica = self.factory(name)
+        try:
+            for p in self.policy.warm_prompts:
+                # straight to the replica: the router cannot see it yet
+                replica.submit(p, max_new_tokens=1).result(
+                    timeout=self.policy.warm_timeout_s)
+            warm_ms = (time.monotonic() - t0) * 1000.0
+            self.router.add(replica)
+        except BaseException:
+            replica.shutdown(drain=False)
+            raise
+        self._h_warm.observe(warm_ms)
+        with self._lock:
+            self._last_up = self._clock()
+        self._c_ups.inc()
+        flight.note("fleet/scale", action="up", replica=name,
+                    warm_ms=round(warm_ms, 1),
+                    replicas=len(self.router.replicas()))
+        return name
+
+    def drain_replica(self, name: Optional[str] = None) -> str:
+        """The scale-down actuator: drain-rebalance, then remove. The
+        victim is the newest least-loaded serving replica (LIFO keeps
+        the original seed fleet stable) unless ``name`` picks one.
+        The ``fleet/drain`` faultpoint fires before the drain: an
+        injected failure aborts with the fleet untouched."""
+        if name is None:
+            reps = self._serving()
+            if len(reps) <= self.policy.min_replicas:
+                raise RuntimeError(
+                    f"refusing to drain below min_replicas="
+                    f"{self.policy.min_replicas}")
+            name = min(reversed(reps), key=lambda r: r.load()).name
+        faults.point("fleet/drain", replica=name)
+        self.router.drain(name)
+        self.router.remove(name, drain=True)
+        with self._lock:
+            self._last_down = self._clock()
+        self._c_downs.inc()
+        flight.note("fleet/scale", action="down", replica=name,
+                    replicas=len(self.router.replicas()))
+        return name
+
+    # ----------------------------------------------------- the thread
+    def start(self, interval_s: float = 0.5) -> None:
+        """Run :meth:`step` every ``interval_s`` on the
+        ``_control_loop`` thread until :meth:`stop`."""
+        if self._thread is not None:
+            raise RuntimeError("autoscaler already started")
+        self._stop.clear()
+        self._interval_s = float(interval_s)
+        self._thread = threading.Thread(
+            target=self._control_loop, name="fleet-control",
+            daemon=True)
+        self._thread.start()
+
+    def _control_loop(self) -> None:
+        while not self._stop.wait(self._interval_s):
+            try:
+                self.step()
+            except Exception as e:  # the loop must outlive one bad tick
+                flight.note("fleet/scale", action="tick_error",
+                            error=f"{type(e).__name__}: {e}")
+
+    def stop(self) -> None:
+        """Stop the control loop thread (idempotent)."""
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join()

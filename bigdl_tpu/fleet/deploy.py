@@ -1,0 +1,447 @@
+"""Train-to-serve: one scripted, resumable deploy state machine —
+train → gate → quantize → canary → fleet-wide swap or auto-rollback.
+
+BigDL's Spark ML pipeline heritage (``ml.estimator``) ends at a
+trained model; a serving fleet needs the other half: how a trained
+candidate *safely* replaces the incumbent under live traffic. This
+module scripts that as one state machine:
+
+1. **train** — ``train_fn()`` produces the candidate (seeded, so a
+   resumed pipeline re-training is deterministic);
+2. **gate** — the PR-9 :class:`~bigdl_tpu.precision.gate.
+   AccuracyGate` judges the candidate against the incumbent on
+   held-out rows; a refusal terminates the deploy typed, nothing
+   staged;
+3. **quantize** — optional ``quantize_fn`` (calibrate/quantize) maps
+   the candidate to its serving form, re-gated by the same gate;
+4. **canary** — one *new* replica serves the candidate
+   (warm-before-join) behind a router traffic split
+   (:meth:`~bigdl_tpu.fleet.router.FleetRouter.set_split`); a probe
+   window measures the canary against the incumbent under one
+   :class:`~bigdl_tpu.telemetry.slo.SloSpec`;
+5. **swap** — on a clean window every incumbent hot-swaps to the
+   candidate (``GenerationService.load`` warms before activating;
+   replicas already swapped are reverted if a later one fails — the
+   actuation is reversible); on an SLO breach the canary is removed,
+   the split cleared and the state machine lands ``rolled_back`` with
+   the incumbent untouched — **auto-rollback**.
+
+Every transition fires the ``fleet/deploy`` faultpoint (ctx
+``stage=``) and the swap actuator fires ``fleet/canary_swap`` per
+incumbent, so the chaos ``--control`` leg injects failures at every
+edge and reconciles them against ``fleet/deploy/swap_aborted`` /
+``fleet/deploy/rollbacks``. Progress is persisted to ``state_path``
+after each committed transition, so a died pipeline resumes at the
+first uncommitted stage (``python -m bigdl_tpu.tools.deploy`` is the
+CLI). docs/robustness.md "Control plane" has the state diagram.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+import bigdl_tpu.telemetry as telemetry
+from bigdl_tpu import faults
+from bigdl_tpu.telemetry import flight
+from bigdl_tpu.telemetry import slo as slo_mod
+
+__all__ = ["DeployError", "DeployPipeline",
+           "register_deploy_instruments"]
+
+#: stage order of one deploy (terminal states: ``done``,
+#: ``rolled_back``)
+STAGES = ("train", "gate", "quantize", "canary", "swap", "done")
+
+#: the default canary SLO window: zero typed failures on canary
+#: probes, and canary p99 TTFT within 3x the incumbent's (the ratio
+#: is what a tiny probe window can judge honestly)
+DEFAULT_CANARY_SLO = (
+    "canary_errors: canary_error_fraction <= 0.0 default 1.0;"
+    "canary_ttft: canary_vs_incumbent_ttft <= 3.0 default 1.0")
+
+
+class DeployError(RuntimeError):
+    """Typed deploy failure: the state machine stopped without
+    reaching ``done`` (gate refusal, canary breach, swap abort). The
+    persisted state names the stage; resume retries from there."""
+
+
+def register_deploy_instruments(r) -> Dict[str, object]:
+    """Get-or-create the ``fleet/deploy/*`` instrument surface in
+    registry ``r`` (audited by ``tools.check --telemetry-audit``)."""
+    return {
+        "transitions": r.counter(
+            "fleet/deploy/transitions",
+            "deploy state-machine transitions committed (labelled "
+            "stage=<name>)"),
+        "completed": r.counter(
+            "fleet/deploy/completed",
+            "deploys that reached done (fleet-wide swap committed)"),
+        "rollbacks": r.counter(
+            "fleet/deploy/rollbacks",
+            "deploys auto-rolled-back (labelled reason=<stage>)"),
+        "swaps": r.counter(
+            "fleet/deploy/swaps",
+            "incumbent replicas hot-swapped to the candidate"),
+        "swap_aborted": r.counter(
+            "fleet/deploy/swap_aborted",
+            "fleet-swap actuations aborted by a fleet/canary_swap "
+            "fault (already-swapped replicas reverted)"),
+        "gate_failures": r.counter(
+            "fleet/deploy/gate_failures",
+            "candidates refused by the accuracy gate"),
+        "canary_probes": r.counter(
+            "fleet/deploy/canary_probes",
+            "probe requests driven through the canary window"),
+    }
+
+
+class DeployPipeline:
+    """One candidate's journey from ``train_fn`` to the whole fleet
+    (module docstring has the five stages).
+
+    ``router`` — the live fleet. ``train_fn()`` → candidate model.
+    ``replica_factory(name, model)`` → a ready (loaded + warmed)
+    replica serving ``model`` — the canary host. ``gate`` — an
+    :class:`~bigdl_tpu.precision.gate.AccuracyGate` (None skips
+    gating). ``quantize_fn(model)`` → serving-form model (None keeps
+    the candidate as-is). ``canary_fraction``/``canary_requests``/
+    ``canary_prompts`` shape the probe window; ``canary_slo`` is the
+    window's :class:`~bigdl_tpu.telemetry.slo.SloSpec` (default
+    :data:`DEFAULT_CANARY_SLO`). ``state_path`` persists committed
+    transitions for resume."""
+
+    def __init__(self, router, *, train_fn: Callable[[], object],
+                 replica_factory: Callable[[str, object], object],
+                 gate=None, gate_reference=None,
+                 quantize_fn: Optional[Callable] = None,
+                 canary_fraction: float = 0.25,
+                 canary_requests: int = 8,
+                 canary_prompts: Optional[List] = None,
+                 canary_slo=None, probe_max_new: int = 2,
+                 probe_timeout_s: float = 60.0,
+                 state_path: Optional[str] = None,
+                 metrics=None, seed: int = 0):
+        self.router = router
+        self.train_fn = train_fn
+        self.replica_factory = replica_factory
+        self.gate = gate
+        self.gate_reference = gate_reference
+        self.quantize_fn = quantize_fn
+        self.canary_fraction = float(canary_fraction)
+        self.canary_requests = int(canary_requests)
+        self.canary_prompts = canary_prompts
+        self.canary_slo = canary_slo if canary_slo is not None \
+            else slo_mod.SloSpec.parse(DEFAULT_CANARY_SLO)
+        self.probe_max_new = int(probe_max_new)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.state_path = state_path
+        self.seed = int(seed)
+        self.candidate = None
+        self.canary_name: Optional[str] = None
+        self._canary_replica = None
+        self._thread: Optional[threading.Thread] = None
+        self._result: Optional[Dict] = None
+        self.state: Dict = {"stage": "init", "history": [],
+                            "window": {}, "reason": None}
+        if state_path and os.path.exists(state_path):
+            with open(state_path) as f:
+                self.state = json.load(f)
+        r = metrics if metrics is not None \
+            else getattr(router, "metrics_registry", None)
+        if r is None:
+            r = telemetry.registry()
+        self.metrics_registry = r
+        inst = register_deploy_instruments(r)
+        self._c_transitions = inst["transitions"]
+        self._c_completed = inst["completed"]
+        self._c_rollbacks = inst["rollbacks"]
+        self._c_swaps = inst["swaps"]
+        self._c_swap_aborted = inst["swap_aborted"]
+        self._c_gate_failures = inst["gate_failures"]
+        self._c_probes = inst["canary_probes"]
+
+    # --------------------------------------------------- state machine
+    def _commit(self, stage: str) -> None:
+        """Commit one transition: faultpoint first (an injected fault
+        aborts BEFORE the stage is recorded — resume retries it),
+        then persist."""
+        faults.point("fleet/deploy", stage=stage)
+        self.state["stage"] = stage
+        self.state["history"].append(stage)
+        self._c_transitions.inc(stage=stage)
+        flight.note("fleet/deploy", stage=stage)
+        if self.state_path:
+            tmp = self.state_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(self.state, f, indent=2, default=str)
+            os.replace(tmp, self.state_path)
+
+    def _pending(self) -> List[str]:
+        """Stages not yet committed, in order (resume starts here).
+        Artifact-producing stages (train/gate/quantize) re-run when
+        their in-memory product is missing — ``train_fn`` is seeded,
+        so the replay is deterministic."""
+        done = set(self.state["history"])
+        start = 0
+        for i, s in enumerate(STAGES):
+            if s in done and (self.candidate is not None
+                              or s in ("train", "gate", "quantize")):
+                start = i + 1
+        if self.candidate is None:
+            # nothing in memory: replay the artifact stages
+            start = min(start, 0)
+        return list(STAGES[start:])
+
+    def run(self) -> Dict:
+        """Drive the state machine to a terminal state; returns the
+        report (``state``: ``done`` | ``rolled_back``, plus the canary
+        window and history). Never hangs: every stage is bounded and
+        every failure lands typed in the report."""
+        t0 = time.monotonic()
+        try:
+            for stage in self._pending():
+                getattr(self, "_stage_" + stage)()
+                self._commit(stage)
+        except DeployError as e:
+            self.state["reason"] = str(e)
+        except Exception as e:
+            # an injected transition fault or unexpected stage error:
+            # roll back anything already on the fleet, keep it typed
+            self._rollback(f"{type(e).__name__}: {e}",
+                           reason_stage=self.state.get("stage", "?"))
+        report = {
+            "state": self.state["stage"],
+            "history": list(self.state["history"]),
+            "reason": self.state.get("reason"),
+            "window": dict(self.state.get("window") or {}),
+            "wall_s": round(time.monotonic() - t0, 3),
+        }
+        self._result = report
+        return report
+
+    # --------------------------------------------------------- stages
+    def _stage_train(self) -> None:
+        self.candidate = self.train_fn()
+
+    def _incumbent_model(self):
+        if self.gate_reference is not None:
+            return self.gate_reference
+        reps = [r for r in self.router.replicas()
+                if r.state == "serving"]
+        if not reps:
+            raise DeployError("no serving incumbent to gate against")
+        rep = reps[0]
+        return rep.service.registry.current(rep.name).model
+
+    def _stage_gate(self) -> None:
+        if self.gate is None:
+            return
+        from bigdl_tpu.precision.gate import AccuracyGateError
+        try:
+            self.gate.check(self._incumbent_model(), self.candidate,
+                            label="deploy-candidate")
+        except AccuracyGateError as e:
+            self._c_gate_failures.inc()
+            self.state["reason"] = str(e)
+            self.state["stage"] = "rolled_back"
+            flight.note("fleet/deploy", stage="rolled_back",
+                        reason="gate")
+            raise DeployError(f"accuracy gate refused the candidate: "
+                              f"{e}") from e
+
+    def _stage_quantize(self) -> None:
+        if self.quantize_fn is None:
+            return
+        quantized = self.quantize_fn(self.candidate)
+        if self.gate is not None:
+            # the serving form must pass the same gate as the float
+            # candidate (quantization is where accuracy quietly goes)
+            from bigdl_tpu.precision.gate import AccuracyGateError
+            try:
+                self.gate.check(self._incumbent_model(), quantized,
+                                label="deploy-quantized")
+            except AccuracyGateError as e:
+                self._c_gate_failures.inc()
+                self.state["reason"] = str(e)
+                self.state["stage"] = "rolled_back"
+                raise DeployError(
+                    f"quantized candidate refused: {e}") from e
+        self.candidate = quantized
+
+    def _stage_canary(self) -> None:
+        """Spawn the canary (warm-before-join), split traffic, run the
+        probe window, judge it with the canary SloSpec; a breach
+        auto-rolls-back (typed DeployError)."""
+        name = f"canary-{self.seed}"
+        faults.point("fleet/spawn", replica=name)
+        replica = self.replica_factory(name, self.candidate)
+        self.canary_name = name
+        self._canary_replica = replica
+        self.router.add(replica)
+        self.router.set_split(name, self.canary_fraction,
+                              seed=self.seed)
+        try:
+            window = self._probe_window(replica)
+        finally:
+            self.router.clear_split()
+        self.state["window"] = window
+        rep = slo_mod.evaluate(self.canary_slo, None, window)
+        self.state["window"]["slo"] = rep.to_dict()
+        if not rep.passed:
+            breaches = "; ".join(v.describe() for v in rep.verdicts
+                                 if not v.ok)
+            self._rollback(f"canary SLO breach: {breaches}",
+                           reason_stage="canary")
+            raise DeployError(f"canary window breached: {breaches}")
+
+    def _probe_window(self, canary) -> Dict[str, float]:
+        """Drive ``canary_requests`` probes through the split and
+        split the outcomes by placement. Returns the window's
+        observations for the SloSpec: canary/incumbent p99 TTFT, the
+        ratio, and the canary's typed-error fraction (a dead canary
+        scores 1.0 — death IS a breach)."""
+        r = np.random.default_rng(self.seed + 7)
+        prompts = self.canary_prompts
+        if prompts is None:
+            prompts = [r.integers(1, 16, size=3).astype(np.int32)
+                       for _ in range(self.canary_requests)]
+        ttft = {"canary": [], "incumbent": []}
+        errors = {"canary": 0, "incumbent": 0}
+        placed = {"canary": 0, "incumbent": 0}
+        for i in range(self.canary_requests):
+            prompt = prompts[i % len(prompts)]
+            try:
+                s = self.router.submit(prompt,
+                                       max_new_tokens=self.probe_max_new)
+            except Exception:
+                errors["incumbent"] += 1  # whole-fleet shed: not canary
+                continue
+            side = "canary" if (s._replica is not None
+                                and s._replica.name == self.canary_name
+                                ) else "incumbent"
+            placed[side] += 1
+            self._c_probes.inc()
+            try:
+                s.result(timeout=self.probe_timeout_s)
+                if s.ttft_ms is not None:
+                    ttft[side].append(s.ttft_ms)
+            except Exception:
+                errors[side] += 1
+        from bigdl_tpu.utils.profiling import percentile_summary
+        window: Dict[str, float] = {
+            "canary_requests": placed["canary"],
+            "incumbent_requests": placed["incumbent"],
+            "canary_error_fraction": (
+                errors["canary"] / placed["canary"]
+                if placed["canary"] else 1.0),
+        }
+        for side, xs in ttft.items():
+            for k, v in percentile_summary(xs, (50, 99)).items():
+                window[f"{side}_ttft_ms_{k}"] = round(v, 3)
+        c99 = window.get("canary_ttft_ms_p99")
+        i99 = window.get("incumbent_ttft_ms_p99")
+        if c99 and i99:
+            window["canary_vs_incumbent_ttft"] = round(c99 / i99, 3)
+        if canary.state != "serving":
+            # the canary died inside its own window: that IS a breach,
+            # whatever the latency numbers say
+            window["canary_error_fraction"] = 1.0
+        return window
+
+    def _stage_swap(self) -> None:
+        """Fleet-wide hot-swap: every incumbent loads the candidate
+        (warm-before-activate), reverted as a group if any one fails;
+        the canary then leaves (its job is done)."""
+        incumbents = [rep for rep in self.router.replicas()
+                      if rep.name != self.canary_name
+                      and rep.state == "serving"]
+        swapped = []  # (replica, previous current version)
+        try:
+            for rep in incumbents:
+                faults.point("fleet/canary_swap", replica=rep.name)
+                prev = rep.service.registry.current(rep.name).version
+                rep.service.load(rep.name, self.candidate)
+                swapped.append((rep, prev))
+                self._c_swaps.inc(replica=rep.name)
+        except BaseException as e:
+            self._c_swap_aborted.inc()
+            for rep, prev in swapped:
+                # reversible actuation: already-swapped incumbents
+                # return to the version they were serving
+                rep.service.swap(rep.name, prev)
+            self._rollback(f"fleet swap aborted at "
+                           f"{len(swapped)}/{len(incumbents)}: "
+                           f"{type(e).__name__}: {e}",
+                           reason_stage="swap")
+            raise DeployError(
+                f"fleet swap aborted and reverted: "
+                f"{type(e).__name__}: {e}") from e
+        self._remove_canary()
+
+    def _stage_done(self) -> None:
+        self._c_completed.inc()
+
+    # ------------------------------------------------------- rollback
+    def _remove_canary(self) -> None:
+        if self.canary_name is None:
+            return
+        self.router.clear_split()
+        try:
+            self.router.remove(self.canary_name, drain=True)
+        except Exception:
+            pass  # a dead canary may already be gone
+        self.canary_name = None
+        self._canary_replica = None
+
+    def _rollback(self, why: str, reason_stage: str) -> None:
+        """Auto-rollback: clear the split, remove the canary, leave
+        the incumbent fleet exactly as it was. Recorded typed +
+        counted (the chaos leg reconciles rollbacks against the
+        breaches/faults that caused them)."""
+        self._remove_canary()
+        self.state["stage"] = "rolled_back"
+        self.state["reason"] = why
+        self._c_rollbacks.inc(reason=reason_stage)
+        flight.note("fleet/deploy", stage="rolled_back",
+                    reason=reason_stage, why=why)
+        if self.state_path:
+            tmp = self.state_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(self.state, f, indent=2, default=str)
+            os.replace(tmp, self.state_path)
+
+    # ----------------------------------------------------- the thread
+    def start(self) -> None:
+        """Run the pipeline on the ``_deploy_loop`` thread;
+        :meth:`result` joins it."""
+        if self._thread is not None:
+            raise RuntimeError("deploy already started")
+        self._thread = threading.Thread(target=self._deploy_loop,
+                                        name="fleet-deploy",
+                                        daemon=True)
+        self._thread.start()
+
+    def _deploy_loop(self) -> None:
+        try:
+            self.run()
+        except Exception as e:  # run() is typed; belt and braces
+            self._result = {"state": "rolled_back",
+                            "reason": f"{type(e).__name__}: {e}"}
+
+    def result(self, timeout: Optional[float] = None) -> Dict:
+        """Join the ``_deploy_loop`` thread and return the report (or
+        run synchronously if :meth:`start` was never called)."""
+        if self._thread is not None:
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                raise TimeoutError("deploy still running")
+            self._thread = None
+        if self._result is None:
+            return self.run()
+        return self._result

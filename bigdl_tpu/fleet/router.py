@@ -80,6 +80,9 @@ def register_router_instruments(r) -> Dict[str, object]:
         "load": r.gauge(
             "fleet/replica/load",
             "live slots + queued requests (labelled replica=<name>)"),
+        "canary_routes": r.counter(
+            "fleet/router/canary_routes",
+            "requests the traffic split placed on the canary replica"),
     }
 
 
@@ -179,6 +182,9 @@ class FleetRouter:
         self._c_evictions = inst["evictions"]
         self._g_replicas = inst["replicas"]
         self._g_load = inst["load"]
+        self._c_canary = inst["canary_routes"]
+        #: (name, fraction, seeded rng) while a canary split is active
+        self._split = None
         for rep in replicas:
             self.add(rep)
 
@@ -224,6 +230,29 @@ class FleetRouter:
         with self._lock:
             return list(self._replicas.values())
 
+    # --------------------------------------------------- canary split
+    def set_split(self, name: str, fraction: float,
+                  seed: int = 0) -> None:
+        """Route a seeded ``fraction`` of placements to replica
+        ``name`` (the canary) and keep it OUT of everyone else's
+        candidate order — the deploy pipeline's traffic split: the
+        canary sees exactly its share, the incumbent fleet's window
+        stays unpolluted. A draw that picks a canary which cannot take
+        the request (full/shedding) falls through to the incumbents —
+        a split can narrow placement, never hang it."""
+        import random as _random
+        if not 0.0 < fraction <= 1.0:
+            raise ValueError(f"fraction must be in (0, 1], "
+                             f"got {fraction}")
+        with self._lock:
+            self._split = (name, float(fraction), _random.Random(seed))
+
+    def clear_split(self) -> None:
+        """End the canary traffic split (canary rejoins the normal
+        least-loaded order if still registered)."""
+        with self._lock:
+            self._split = None
+
     def _evict(self, replica) -> None:
         """Observe one replica death exactly once: count it, drop its
         session pins (their next requests re-place)."""
@@ -262,6 +291,19 @@ class FleetRouter:
                     ordered.remove(rep)
                     ordered.insert(0, rep)
                     break
+        with self._lock:
+            split = self._split
+        if split is not None:
+            cname, fraction, rng = split
+            canary = next((r for r in ordered if r.name == cname),
+                          None)
+            if canary is not None:
+                with self._lock:  # Random isn't thread-safe
+                    take = rng.random() < fraction
+                ordered.remove(canary)
+                if take:
+                    # canary draw leads; incumbents still back it up
+                    ordered.insert(0, canary)
         return ordered, bool(reps)
 
     def _pin(self, session: Optional[str], replica) -> None:
@@ -292,6 +334,10 @@ class FleetRouter:
             self._pin(session, rep)
             if not first:
                 self._c_reroutes.inc(replica=rep.name)
+            with self._lock:
+                split = self._split
+            if split is not None and rep.name == split[0]:
+                self._c_canary.inc(replica=rep.name)
             stream._bind(rep, inner)
             return
         if last_qfull is not None:

@@ -87,6 +87,10 @@ def register_generation_instruments(r) -> Dict[str, object]:
             "serving/generation/prefill_chunks",
             "prefill chunk programs dispatched (chunked long-prompt "
             "admission; one per batch when chunking is off)"),
+        "preempted": r.counter(
+            "serving/generation/preempted",
+            "generations failed typed by priority preemption (their "
+            "slots freed for the preemptor; partial tokens kept)"),
     }
 
 
@@ -169,8 +173,13 @@ class DecodeLoop:
         self._h_token = inst["token_ms"]
         self._h_prefill_fill = inst["prefill_fill"]
         self._c_prefill_chunks = inst["prefill_chunks"]
+        self._c_preempted = inst["preempted"]
 
         self._cond = threading.Condition()
+        #: stream-identity -> typed error for live generations marked
+        #: for preemption; the driver thread applies the mark at its
+        #: next per-slot sweep (queued generations fail immediately)
+        self._preempt_marks: Dict[int, BaseException] = {}
         self._seq = itertools.count(1)  # trace_id suffixes
         self._queue: Deque[_Gen] = deque()
         self._groups: "OrderedDict[tuple, _Group]" = OrderedDict()
@@ -229,6 +238,35 @@ class DecodeLoop:
             self._cond.notify_all()
         return stream
 
+    def preempt(self, stream: TokenStream, err: BaseException
+                ) -> Optional[str]:
+        """Fail one in-flight generation *typed* so its capacity goes
+        to a higher-priority request (the fleet admission layer's
+        decode-slot preemption). A queued generation fails immediately
+        and frees its queue slot now; a live one is marked and failed
+        by the driver thread at its next per-slot sweep (within one
+        decode step), so the KV slot is released only from the thread
+        that owns the cache. The partial tokens the stream already
+        produced stay on it, and ``err`` gets a ``tokens`` attribute
+        carrying them. Returns ``"queued"``/``"live"`` for a found
+        stream, None when it is not held here (already resolved)."""
+        with self._cond:
+            for g in self._queue:
+                if g.stream is stream:
+                    self._queue.remove(g)
+                    self._g_depth.set(len(self._queue), **self._labels)
+                    self._c_preempted.inc(**self._labels)
+                    err.tokens = stream.tokens()
+                    g.stream._fail(err)
+                    return "queued"
+            for group in self._groups.values():
+                for g in group.gens.values():
+                    if g.stream is stream:
+                        self._preempt_marks[id(stream)] = err
+                        self._cond.notify_all()
+                        return "live"
+        return None
+
     def queue_depth(self) -> int:
         """Requests waiting for a cache slot."""
         with self._cond:
@@ -264,6 +302,7 @@ class DecodeLoop:
                     # the step may have died mid-donation: the caches
                     # are unrecoverable state — rebuild on demand
                     self._groups.clear()
+                    self._preempt_marks.clear()
                     restart = not self._stopping
                     if restart:
                         # only an actual restart is a "death survived
@@ -322,6 +361,7 @@ class DecodeLoop:
         for group in self._groups.values():
             doomed.extend(group.gens.values())
         self._groups.clear()
+        self._preempt_marks.clear()
         self._g_depth.set(0, **self._labels)
         self._g_occupancy.set(0.0, **self._labels)
         for g in doomed:
@@ -542,6 +582,16 @@ class DecodeLoop:
             for slot in live:
                 g = group.gens[slot]
                 kv.lengths[slot] += 1  # g.last's K/V landed this step
+                with self._cond:
+                    perr = self._preempt_marks.pop(id(g.stream), None)
+                if perr is not None:
+                    # the preemptor's typed error carries the partial
+                    # tokens; the stream keeps them too (.tokens())
+                    perr.tokens = g.stream.tokens()
+                    self._c_preempted.inc(**self._labels)
+                    g.stream._fail(perr)
+                    self._release(group, g)
+                    continue
                 if g.deadline is not None and now > g.deadline:
                     self._c_timed_out.inc(**self._labels)
                     g.stream._fail(DeadlineExceeded(
@@ -579,6 +629,8 @@ class DecodeLoop:
         group.gens.pop(g.slot, None)
         group.kv.lengths[g.slot] = 0
         group.kv.allocator.free(g.slot)
+        with self._cond:  # RLock-backed: safe under _abort_locked too
+            self._preempt_marks.pop(id(g.stream), None)
         self._unpin(g)
 
     def _unpin(self, g: _Gen) -> None:

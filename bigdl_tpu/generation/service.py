@@ -302,6 +302,19 @@ class GenerationService:
         """Blocking convenience: the full generated token array."""
         return self.generate(name, prompt, **kw).result()
 
+    def preempt(self, name: str, stream: TokenStream,
+                err: BaseException):
+        """Fail one of ``name``'s in-flight generations *typed* so its
+        decode slot (or queue slot) goes to a higher-priority request —
+        the fleet admission layer's preemption hook (see
+        :meth:`~bigdl_tpu.generation.loop.DecodeLoop.preempt`).
+        Returns ``"queued"``/``"live"``/None."""
+        with self._lock:
+            loop = self._loops.get(name)
+        if loop is None:
+            return None
+        return loop.preempt(stream, err)
+
     # -------------------------------------------------------- metrics
     def compile_count(self, name: str,
                       version: Optional[int] = None) -> int:

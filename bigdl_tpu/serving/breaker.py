@@ -76,16 +76,23 @@ class CircuitBreaker:
                 if now - self._opened_at < self.cooldown_s:
                     return False
                 self._state = "half-open"
-                self._probing = True
-                self._probe_at = now
-                return True
+                return self._claim_probe(now)
             # half-open: one probe at a time, re-armed if the probe
             # vanished without reporting an outcome
-            if self._probing and now - self._probe_at < self.cooldown_s:
-                return False
-            self._probing = True
-            self._probe_at = now
-            return True
+            return self._claim_probe(now)
+
+    def _claim_probe(self, now: float) -> bool:
+        """Single-flight claim of THE half-open probe slot (caller
+        holds the lock). A held slot only counts as vanished once
+        STRICTLY more than a cooldown passes with no outcome — ``<=``
+        matters: with a zero (or coarse) cooldown, two submits racing
+        the same clock reading would otherwise both claim and
+        half-open would admit two concurrent probes."""
+        if self._probing and now - self._probe_at <= self.cooldown_s:
+            return False
+        self._probing = True
+        self._probe_at = now
+        return True
 
     def on_success(self) -> None:
         """A dispatch succeeded: reset to ``closed``."""

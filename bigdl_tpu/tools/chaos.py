@@ -893,6 +893,366 @@ def run_fleet(replicas: int = 3, requests: int = 18, threads: int = 3,
     return report
 
 
+# ------------------------------------------------ control-plane leg
+
+def run_control(max_replicas: int = 3, wave_size: int = 8,
+                max_new: int = 3, seed: int = 42,
+                inject: bool = True, deadline_s: float = 600.0,
+                ttft_budget_ms: float = 30000.0) -> Dict:
+    """The ``--control`` leg: a load-ramp soak of the SLO-driven
+    control plane (``fleet.control`` + ``fleet.admission`` +
+    ``fleet.deploy``) with actuator faults injected at every new
+    faultpoint.
+
+    One incumbent replica starts; a two-tenant synthetic burst ramps
+    (gold: weight 3, priority 1, unmetered; bronze: weight 1,
+    priority 0, metered budget) and the
+    :class:`~bigdl_tpu.fleet.control.Autoscaler` is ticked between
+    waves. Proven, in order:
+
+    1. **scale 1→N**: replicas reach ``max_replicas`` under the ramp
+       with the FIRST spawn actuation aborted by an injected
+       ``fleet/spawn`` fault (retried next tick — reconciled against
+       ``fleet/control/spawn_aborted``); every spawn is
+       warm-before-join; scale-up reaction time is measured;
+    2. **mid-ramp kill absorbed**: an injected ``fleet/replica``
+       fault kills one autoscaled replica under traffic — the router
+       evicts and re-routes, nothing hangs;
+    3. **N→1**: traffic stops and the scaler drains back to one
+       replica, the FIRST drain actuation aborted by an injected
+       ``fleet/drain`` fault (reconciled against
+       ``fleet/control/drain_aborted``);
+    4. **poisoned canary auto-rollback**: a full
+       :class:`~bigdl_tpu.fleet.deploy.DeployPipeline` runs with a
+       fault killing the canary replica inside its own probe window —
+       the deploy lands ``rolled_back`` with the incumbent fleet
+       untouched and still serving.
+
+    Throughout: overload is only ever a TYPED shed attributable per
+    tenant (host-side typed counts must equal the
+    ``fleet/admission/shed`` counter), zero streams hang, and every
+    injected fault reconciles counter-for-counter against its
+    recovery counter. ``inject=False`` runs the same ramp fault-free
+    (the clean control)."""
+    import numpy as np
+
+    import bigdl_tpu.telemetry as telemetry
+    from bigdl_tpu import faults
+    from bigdl_tpu.fleet import (AdmissionController, Autoscaler,
+                                 BudgetExhausted, DeployPipeline,
+                                 FleetRouter, ScalePolicy,
+                                 build_replicas)
+    from bigdl_tpu.precision.gate import AccuracyGate
+    from bigdl_tpu.serving import Degraded, QueueFull
+    from bigdl_tpu.telemetry import slo as slo_mod
+    from bigdl_tpu.tools.deploy import build_model, replica_factory
+    from bigdl_tpu.tools.synthetic import seeded_rng
+    from bigdl_tpu.utils.profiling import percentile_summary
+
+    report: Dict = {"max_replicas": max_replicas,
+                    "wave_size": wave_size, "inject": inject,
+                    "violations": []}
+    metrics = telemetry.MetricsRegistry()
+    router = FleetRouter(build_replicas(1, seed=seed, max_queue=4,
+                                        metrics=metrics),
+                         metrics=metrics)
+    r = seeded_rng(seed + 1)
+    prompts = [r.randint(1, 31, 3).astype(np.int32) for _ in range(4)]
+    policy = ScalePolicy(min_replicas=1, max_replicas=max_replicas,
+                         up_load=2.0, down_load=0.5,
+                         up_cooldown_s=0.05, down_cooldown_s=0.05,
+                         warm_prompts=[prompts[0]])
+    scaler = Autoscaler(
+        router, lambda name: replica_factory(
+            name, build_model(seed), metrics=metrics),
+        policy=policy, metrics=metrics)
+    adm = AdmissionController(router, metrics=metrics,
+                              saturation_load=2.0, fairness_slack=8.0)
+    adm.register("gold", weight=3.0, priority=1)
+    adm.register("bronze", weight=1.0, priority=0, rate=2.0, burst=6.0)
+
+    c_ups = metrics.counter("fleet/control/scale_ups")
+    c_evict = metrics.counter("fleet/replica/evictions")
+    injected = {"fleet/spawn": 0, "fleet/drain": 0, "fleet/replica": 0}
+    sheds: Dict[str, Dict[str, int]] = \
+        {"gold": {}, "bronze": {}}
+    requests = {"gold": 0, "bronze": 0}
+    resolved = {"ok": 0, "typed_errors": 0, "hung": 0}
+    ttfts: List[float] = []
+    tokens_out = 0
+    replicas_path: List[int] = [1]
+    reaction_ms = None
+    t_total = time.monotonic()
+
+    def serving() -> int:
+        return sum(1 for rep in router.replicas()
+                   if rep.state == "serving")
+
+    def ramp_to(target: int, timeout_s: float = 120.0) -> None:
+        """Sustained two-tenant burst (pump threads, soak idiom) while
+        the main thread ticks the scaler, until ``target`` replicas
+        serve or the deadline passes. Sheds stay typed per tenant;
+        every accepted stream is resolved afterwards — zero hangs."""
+        nonlocal tokens_out, reaction_ms
+        stop = threading.Event()
+        streams: List = []
+        lock = threading.Lock()
+
+        def pump(tenant: str, k: int) -> None:
+            i = k
+            while not stop.is_set():
+                i += 1
+                with lock:
+                    requests[tenant] += 1
+                try:
+                    s = adm.submit(prompts[i % len(prompts)],
+                                   tenant=tenant,
+                                   max_new_tokens=max_new)
+                    with lock:
+                        streams.append(s)
+                except (BudgetExhausted, QueueFull, Degraded) as e:
+                    kind = type(e).__name__
+                    with lock:
+                        sheds[tenant][kind] = \
+                            sheds[tenant].get(kind, 0) + 1
+                    time.sleep(0.002)  # shed fast, retry soon
+                except Exception as e:  # untyped shed = violation
+                    with lock:
+                        report["violations"].append(
+                            f"UNTYPED shed for tenant {tenant!r}: "
+                            f"{type(e).__name__}: {e}")
+
+        workers = [threading.Thread(
+            target=pump, args=(t, k), daemon=True,
+            name=f"chaos-control-{t}-{k}")
+            for t in ("gold", "bronze") for k in range(2)]
+        for w in workers:
+            w.start()
+        try:
+            end = time.monotonic() + timeout_s
+            while serving() < target and time.monotonic() < end:
+                scaler.step()
+                if reaction_ms is None and c_ups.total() > 0:
+                    reaction_ms = \
+                        (time.monotonic() - t_ramp) * 1000.0
+                time.sleep(0.02)
+        finally:
+            stop.set()
+            for w in workers:
+                w.join(timeout=30.0)
+        end = time.monotonic() + 120.0
+        for s in streams:
+            try:
+                out = s.result(timeout=max(0.0,
+                                           end - time.monotonic()))
+                resolved["ok"] += 1
+                tokens_out += len(out)
+                if s.ttft_ms is not None:
+                    ttfts.append(s.ttft_ms)
+            except FutTimeout:
+                resolved["hung"] += 1
+            except Exception:
+                resolved["typed_errors"] += 1
+        replicas_path.append(serving())
+        if serving() < target:
+            report["violations"].append(
+                f"ramp stalled at {serving()} replicas "
+                f"(target {target})")
+
+    from concurrent.futures import TimeoutError as FutTimeout
+    try:
+        # -- phase 1: ramp up, first spawn actuation sabotaged --------
+        t_ramp = time.monotonic()
+        sched = faults.arm("fleet/spawn=nth:1,raise:RuntimeError") \
+            if inject else _NO_FAULTS
+        try:
+            ramp_to(2)
+        finally:
+            injected["fleet/spawn"] += sched.fired().get(
+                "fleet/spawn", 0)
+            if inject:
+                faults.disarm()
+
+        # -- phase 2: mid-ramp kill of an autoscaled replica ----------
+        victims = [rep.name for rep in router.replicas()
+                   if rep.name.startswith("auto-")
+                   and rep.state == "serving"]
+        if victims and inject:
+            victim = victims[0]
+            sched = faults.arm(
+                f"fleet/replica=nth:1,raise:RuntimeError,"
+                f"match:replica={victim}")
+            try:
+                router._sessions["kill-sess"] = victim
+                streams = []
+                for i in range(wave_size):
+                    try:
+                        streams.append(router.submit(
+                            prompts[i % len(prompts)],
+                            session="kill-sess",
+                            max_new_tokens=max_new))
+                    except (QueueFull, Degraded):
+                        pass
+                _await_deterministic_rules(sched, ("fleet/replica",),
+                                           timeout_s=15.0)
+                end = time.monotonic() + 120.0
+                for s in streams:
+                    try:
+                        out = s.result(timeout=max(
+                            0.0, end - time.monotonic()))
+                        resolved["ok"] += 1
+                        tokens_out += len(out)
+                    except FutTimeout:
+                        resolved["hung"] += 1
+                    except Exception:
+                        resolved["typed_errors"] += 1
+            finally:
+                injected["fleet/replica"] += sched.fired().get(
+                    "fleet/replica", 0)
+                faults.disarm()
+            report["killed_replica"] = victim
+            replicas_path.append(serving())
+        elif inject:
+            report["violations"].append(
+                "ramp produced no autoscaled replica to kill")
+
+        # -- phase 3: keep ramping to max_replicas (fault-free) -------
+        if serving() < max_replicas:
+            ramp_to(max_replicas)
+        if max(replicas_path) < max_replicas:
+            report["violations"].append(
+                f"fleet never reached max_replicas={max_replicas} "
+                f"under the ramp (path: {replicas_path})")
+        if reaction_ms is None:
+            report["violations"].append(
+                "the autoscaler never scaled up under the ramp")
+
+        # -- phase 4: traffic stops; drain back down to 1, first
+        #    drain actuation sabotaged ------------------------------
+        sched = faults.arm("fleet/drain=nth:1,raise:RuntimeError") \
+            if inject else _NO_FAULTS
+        try:
+            end = time.monotonic() + 60.0
+            while serving() > 1 and time.monotonic() < end:
+                scaler.step()
+                time.sleep(0.06)
+        finally:
+            injected["fleet/drain"] += sched.fired().get(
+                "fleet/drain", 0)
+            if inject:
+                faults.disarm()
+        replicas_path.append(serving())
+        if serving() != 1:
+            report["violations"].append(
+                f"fleet did not scale back down to 1 "
+                f"(still {serving()} serving)")
+
+        # -- phase 5: poisoned canary deploy must auto-rollback -------
+        rng = np.random.default_rng(seed)
+        pipe = DeployPipeline(
+            router, train_fn=lambda: build_model(seed),
+            replica_factory=lambda n, m: replica_factory(
+                n, m, metrics=metrics),
+            gate=AccuracyGate(rng.integers(1, 16, size=(8, 4)).astype(
+                np.int32)),
+            canary_fraction=0.5, canary_requests=6, seed=seed,
+            metrics=metrics)
+        sched = faults.arm(
+            f"fleet/replica=nth:1,raise:RuntimeError,"
+            f"match:replica=canary-{seed}") if inject else _NO_FAULTS
+        try:
+            deploy_report = pipe.run()
+        finally:
+            injected["fleet/replica"] += sched.fired().get(
+                "fleet/replica", 0)
+            if inject:
+                faults.disarm()
+        report["deploy"] = {"state": deploy_report["state"],
+                            "reason": deploy_report.get("reason")}
+        if inject and deploy_report["state"] != "rolled_back":
+            report["violations"].append(
+                f"poisoned canary deploy landed "
+                f"{deploy_report['state']!r}, expected rolled_back")
+        if not inject and deploy_report["state"] != "done":
+            report["violations"].append(
+                f"clean deploy landed {deploy_report['state']!r}, "
+                f"expected done")
+        # the incumbent must still be serving after the rollback
+        try:
+            router.submit(prompts[0], max_new_tokens=2).result(60)
+        except Exception as e:
+            report["violations"].append(
+                f"incumbent not serving after canary rollback: "
+                f"{type(e).__name__}: {e}")
+
+        # -- invariants: typed-only sheds, zero hangs, reconciliation
+        if resolved["hung"]:
+            report["violations"].append(
+                f"{resolved['hung']} streams never resolved")
+        recovered = {
+            "spawn_aborted": int(metrics.counter(
+                "fleet/control/spawn_aborted").total()),
+            "drain_aborted": int(metrics.counter(
+                "fleet/control/drain_aborted").total()),
+            "evictions": int(c_evict.total()),
+        }
+        report["injected"] = dict(injected)
+        report["recovered"] = recovered
+        if injected["fleet/spawn"] != recovered["spawn_aborted"]:
+            report["violations"].append(
+                f"injected {injected['fleet/spawn']} spawn faults but "
+                f"counted {recovered['spawn_aborted']} spawn_aborted")
+        if injected["fleet/drain"] != recovered["drain_aborted"]:
+            report["violations"].append(
+                f"injected {injected['fleet/drain']} drain faults but "
+                f"counted {recovered['drain_aborted']} drain_aborted")
+        if injected["fleet/replica"] != recovered["evictions"]:
+            report["violations"].append(
+                f"injected {injected['fleet/replica']} replica kills "
+                f"but the router evicted {recovered['evictions']}")
+        shed_host = sum(sum(d.values()) for d in sheds.values())
+        shed_counted = int(metrics.counter(
+            "fleet/admission/shed").total())
+        if shed_host != shed_counted:
+            report["violations"].append(
+                f"{shed_host} typed sheds seen by callers but "
+                f"{shed_counted} counted — sheds must be attributable")
+        report["tenants"] = {
+            name: {"requests": requests[name],
+                   "sheds": dict(sheds[name]),
+                   "shed_fraction": round(
+                       sum(sheds[name].values())
+                       / max(1, requests[name]), 3)}
+            for name in sheds}
+        report["burst"] = resolved
+        report["replicas_path"] = replicas_path
+        report["scaleup_reaction_ms"] = \
+            None if reaction_ms is None else round(reaction_ms, 1)
+        wall = time.monotonic() - t_total
+        report["goodput_tokens_per_sec"] = round(
+            tokens_out / max(wall, 1e-9), 3)
+        obs = {"control_goodput_tokens_per_sec":
+               report["goodput_tokens_per_sec"]}
+        obs.update({f"ramp_ttft_ms_{k}": round(v, 3)
+                    for k, v in percentile_summary(
+                        ttfts, (50, 99)).items()})
+        report["latency"] = {k: v for k, v in obs.items()
+                            if k.startswith("ramp_")}
+        spec = slo_mod.SloSpec.parse(
+            f"p99_ttft: ramp_ttft_ms_p99 <= {ttft_budget_ms:g} "
+            f"default 0")
+        slo_report = slo_mod.evaluate(spec, None, obs)
+        report["slo"] = slo_report.to_dict()
+        report["violations"].extend(
+            "SLO breach: " + v.describe()
+            for v in slo_report.verdicts if not v.ok)
+    finally:
+        scaler.stop()
+        router.shutdown(drain=True)
+    report["passed"] = not report["violations"]
+    return report
+
+
 # ----------------------------------------------------------- the soak
 
 def _corrupt_latest(ckpt_dir: str) -> str:
@@ -1098,6 +1458,17 @@ def main(argv=None) -> int:
                          "'evictions: fleet/replica/evictions <= 0 "
                          "default 0; p99: serving/generation/"
                          "ttft_ms.p99 <= 5000 default 0'")
+    # control-plane leg: load-ramp autoscale 1->N->1 with actuator
+    # faults, mid-ramp replica kill, poisoned-canary auto-rollback
+    ap.add_argument("--control", action="store_true",
+                    help="run the control-plane chaos leg (autoscaler "
+                         "ramp + admission sheds + canary rollback)")
+    ap.add_argument("--control-max-replicas", type=int, default=3)
+    ap.add_argument("--control-wave-size", type=int, default=8,
+                    help="burst size of the mid-ramp kill wave")
+    ap.add_argument("--control-no-inject", action="store_true",
+                    help="run the same ramp fault-free (the clean "
+                         "control: expects a done deploy, no aborts)")
     # host-kill leg: SIGKILL a whole tools/launch gang host mid-window,
     # relaunch at a different world size, assert elastic recovery
     ap.add_argument("--hostkill", action="store_true",
@@ -1159,6 +1530,32 @@ def main(argv=None) -> int:
             art = report.get("artifacts") or {}
             print(f"artifacts: merged trace {art.get('trace')}  "
                   f"slo {art.get('slo')}")
+            for v in report["violations"]:
+                print(f"VIOLATION: {v}")
+            print("PASS" if report["passed"] else "FAIL")
+        return 0 if report["passed"] else 1
+    if args.control:
+        report = run_control(max_replicas=args.control_max_replicas,
+                             wave_size=args.control_wave_size,
+                             seed=args.seed,
+                             inject=not args.control_no_inject)
+        if args.json:
+            print(json.dumps(report, indent=2, default=str))
+        else:
+            print("== chaos control-plane leg ==")
+            print(f"replicas path: {report['replicas_path']}  "
+                  f"(max {report['max_replicas']})")
+            print(f"scale-up reaction: "
+                  f"{report.get('scaleup_reaction_ms')} ms  "
+                  f"goodput: {report.get('goodput_tokens_per_sec')} "
+                  f"tok/s")
+            print(f"burst:     {report.get('burst')}  "
+                  f"latency: {report.get('latency')}")
+            print(f"injected:  {report.get('injected')} "
+                  f"recovered: {report.get('recovered')}")
+            print(f"tenants:   {report.get('tenants')}")
+            print(f"kill:      {report.get('killed_replica')}  "
+                  f"deploy: {report.get('deploy')}")
             for v in report["violations"]:
                 print(f"VIOLATION: {v}")
             print("PASS" if report["passed"] else "FAIL")

@@ -1,0 +1,148 @@
+"""``python -m bigdl_tpu.tools.deploy`` — drive one train-to-serve
+deploy through :class:`~bigdl_tpu.fleet.deploy.DeployPipeline` on a
+synthetic tier-1 fleet.
+
+Builds N seeded thread-hosted replicas behind a
+:class:`~bigdl_tpu.fleet.router.FleetRouter`, "trains" a candidate
+(seeded tiny TransformerLM — deterministic, so the accuracy gate
+judges it honestly against the incumbent), then runs the full state
+machine: gate → quantize → canary traffic split → fleet-wide hot-swap
+or auto-rollback. Exit 0 when the deploy lands ``done``, 1 when it
+rolled back — CI asserts both directions with ``--poison``:
+
+- ``--poison gate`` trains a different-seed candidate the accuracy
+  gate must refuse (nothing ever reaches the fleet);
+- ``--poison canary`` arms a fault that kills the canary replica
+  inside its own probe window — the breach auto-rolls-back with the
+  incumbents still serving.
+
+``--state PATH`` persists committed transitions (re-running with the
+same path resumes); ``--json`` emits the machine-readable report.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+def build_model(seed: int = 42, *, vocab: int = 32, hidden: int = 16,
+                layers: int = 1, heads: int = 2, max_len: int = 16):
+    """One seeded tiny TransformerLM in eval mode — the same
+    construction :func:`~bigdl_tpu.fleet.soak.build_replicas` uses, so
+    a ``seed=42`` candidate is weight-identical to the incumbents."""
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.utils.random import RandomGenerator
+
+    RandomGenerator.set_seed(seed)
+    model = TransformerLM(vocab_size=vocab, hidden_size=hidden,
+                          num_layers=layers, num_heads=heads,
+                          max_len=max_len).evaluate()
+    model.ensure_initialized()
+    return model
+
+
+def replica_factory(name: str, model, *, slots: int = 2,
+                    max_len: int = 16, max_queue: int = 4,
+                    metrics=None):
+    """Host ``model`` on a fresh thread replica (loaded + warmed by
+    construction — the canary joins the router already hot)."""
+    from bigdl_tpu.fleet.replica import Replica
+    from bigdl_tpu.generation.service import GenerationConfig
+
+    return Replica(name, model,
+                   config=GenerationConfig(
+                       slots=slots, max_len=max_len,
+                       length_buckets=(max_len,),
+                       prefill_rows=min(2, slots),
+                       max_queue=max_queue),
+                   metrics=metrics)
+
+
+def run_deploy(*, replicas: int = 2, seed: int = 42,
+               canary_fraction: float = 0.5, requests: int = 8,
+               poison: str = "none", gate_delta: float = 0.02,
+               state_path: Optional[str] = None) -> dict:
+    """Build the fleet, run the pipeline, tear down; returns the
+    pipeline report plus the fleet shape."""
+    from bigdl_tpu import faults
+    from bigdl_tpu.fleet.deploy import DeployPipeline
+    from bigdl_tpu.fleet.router import FleetRouter
+    from bigdl_tpu.fleet.soak import build_replicas
+    from bigdl_tpu.precision.gate import AccuracyGate
+
+    router = FleetRouter(build_replicas(replicas, seed=seed))
+    rng = np.random.default_rng(seed)
+    gate = AccuracyGate(rng.integers(1, 16, size=(8, 4)).astype(
+        np.int32), max_delta=gate_delta)
+    train_seed = seed + 1 if poison == "gate" else seed
+    pipe = DeployPipeline(
+        router,
+        train_fn=lambda: build_model(train_seed),
+        replica_factory=lambda n, m: replica_factory(
+            n, m, metrics=router.metrics_registry),
+        gate=gate, canary_fraction=canary_fraction,
+        canary_requests=requests, state_path=state_path, seed=seed)
+    sched = None
+    if poison == "canary":
+        sched = (f"fleet/replica=nth:1,raise:RuntimeError,"
+                 f"match:replica=canary-{seed}")
+    try:
+        if sched is not None:
+            with faults.armed(sched):
+                report = pipe.run()
+        else:
+            report = pipe.run()
+    finally:
+        router.shutdown()
+    report["replicas"] = replicas
+    report["poison"] = poison
+    return report
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """CLI entry (module docstring has the contract)."""
+    ap = argparse.ArgumentParser(
+        prog="python -m bigdl_tpu.tools.deploy",
+        description="train -> gate -> canary -> swap/rollback on a "
+                    "synthetic fleet")
+    ap.add_argument("--replicas", type=int, default=2,
+                    help="incumbent replica count (default 2)")
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--canary-fraction", type=float, default=0.5,
+                    help="traffic fraction the canary draws")
+    ap.add_argument("--requests", type=int, default=8,
+                    help="probe requests in the canary window")
+    ap.add_argument("--poison", choices=("none", "gate", "canary"),
+                    default="none",
+                    help="inject a failure the pipeline must refuse "
+                         "(gate) or auto-rollback (canary)")
+    ap.add_argument("--state", default=None, metavar="PATH",
+                    help="persist transitions here (resumable)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    args = ap.parse_args(argv)
+
+    report = run_deploy(replicas=args.replicas, seed=args.seed,
+                        canary_fraction=args.canary_fraction,
+                        requests=args.requests, poison=args.poison,
+                        state_path=args.state)
+    if args.json:
+        print(json.dumps(report, indent=2, default=str))
+    else:
+        print(f"deploy: {report['state']}  "
+              f"(history: {' -> '.join(report['history'])})")
+        if report.get("reason"):
+            print(f"  reason: {report['reason']}")
+        win = report.get("window") or {}
+        for k in sorted(win):
+            if k != "slo":
+                print(f"  {k}: {win[k]}")
+    return 0 if report["state"] == "done" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

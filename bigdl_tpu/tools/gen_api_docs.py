@@ -35,7 +35,9 @@ FAMILIES = {
                    "bigdl_tpu.generation.sampling"],
     "fleet": ["bigdl_tpu.fleet", "bigdl_tpu.fleet.prefix",
               "bigdl_tpu.fleet.speculative", "bigdl_tpu.fleet.router",
-              "bigdl_tpu.fleet.replica", "bigdl_tpu.fleet.soak"],
+              "bigdl_tpu.fleet.replica", "bigdl_tpu.fleet.soak",
+              "bigdl_tpu.fleet.control", "bigdl_tpu.fleet.admission",
+              "bigdl_tpu.fleet.deploy"],
     "kernels": ["bigdl_tpu.kernels", "bigdl_tpu.kernels.config",
                 "bigdl_tpu.kernels.dispatch",
                 "bigdl_tpu.kernels.flash_attention",
@@ -59,7 +61,7 @@ FAMILIES = {
                   "bigdl_tpu.telemetry.flight",
                   "bigdl_tpu.telemetry.agg",
                   "bigdl_tpu.telemetry.slo"],
-    "tools": ["bigdl_tpu.tools.regress"],
+    "tools": ["bigdl_tpu.tools.regress", "bigdl_tpu.tools.deploy"],
     "faults": ["bigdl_tpu.faults", "bigdl_tpu.faults.retry"],
     "elastic": ["bigdl_tpu.elastic", "bigdl_tpu.elastic.checkpoint",
                 "bigdl_tpu.elastic.resume", "bigdl_tpu.elastic.preempt",

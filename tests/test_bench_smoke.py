@@ -325,3 +325,47 @@ def test_bench_tuned_row_contract_and_sentinel_accepts_it():
     for key in ("tuned_train_steps_per_sec",
                 "tuned_vs_default_train_speedup"):
         assert key in metrics
+
+
+@pytest.mark.slow
+def test_bench_control_row_contract_and_regress_accepts_it(tmp_path):
+    """The CONTROL row: the chaos ``--control`` ramp leg run
+    fault-free — goodput and p99 TTFT while the autoscaler takes the
+    fleet 1->N->1 under the two-tenant burst, scale-up reaction time,
+    and per-tenant shed fractions. control_passed carries the leg's
+    own invariants (typed-only sheds, zero hangs, ramp reached N,
+    drained back to 1). The fresh line must ride tools/regress end to
+    end: schema_version=2 accepted, goodput classified higher, the
+    latencies lower, the shed fractions deliberately unclassified
+    (_frac_ spelling — context, not a regression), and judged against
+    a trajectory of itself the sentinel exits 0."""
+    out = _run_bench("synthetic", {"BENCH_CONTROL": "1",
+                                   "BENCH_CONTROL_REPLICAS": "2"})
+    assert out["control_passed"] == 1, out
+    assert out["control_goodput_tokens_per_sec"] > 0
+    assert out["control_ttft_ms_p99"] > 0
+    assert out["control_scaleup_reaction_ms"] > 0
+    for t in ("gold", "bronze"):
+        assert 0.0 <= out[f"control_shed_frac_{t}"] <= 1.0, out
+    from bigdl_tpu.tools.regress import (KNOWN_SCHEMA_VERSIONS,
+                                         classify_key, extract_metrics)
+    assert out["schema_version"] == 2
+    assert out["schema_version"] in KNOWN_SCHEMA_VERSIONS
+    metrics = extract_metrics(out, "bench-line")
+    assert "control_goodput_tokens_per_sec" in metrics
+    assert classify_key("control_goodput_tokens_per_sec") == "higher"
+    assert classify_key("control_ttft_ms_p99") == "lower"
+    assert classify_key("control_scaleup_reaction_ms") == "lower"
+    assert classify_key("control_shed_frac_gold") is None
+    # the sentinel gate itself: a 2-point trajectory of this same row
+    # plus the row as candidate judges every tracked key ok (exit 0)
+    from bigdl_tpu.tools.regress import main as regress_main
+    for i in (1, 2):
+        (tmp_path / f"BENCH_r{i:02d}.json").write_text(
+            json.dumps({"parsed": out}))
+    cand = tmp_path / "candidate.json"
+    cand.write_text(json.dumps(out))
+    rc = regress_main([str(tmp_path / "BENCH_r01.json"),
+                       str(tmp_path / "BENCH_r02.json"),
+                       "--candidate", str(cand)])
+    assert rc == 0

@@ -1,0 +1,567 @@
+"""The fleet control plane (bigdl_tpu.fleet.control / admission /
+deploy). Pins the subsystem's load-bearing claims — the autoscaler's
+hysteresis band, cooldowns and min/max clamp suppress (and count)
+every flap, actuators aborted by injected faults leave the fleet
+untouched and retry next tick, spawn is warm-before-join, tenant
+overload is always a typed counted shed (BudgetExhausted / fair-share
+QueueFull), weighted-fair shares converge to the weight ratio under
+saturation, priority preemption returns the victim's partial tokens,
+and the deploy state machine lands done or rolled_back with the
+incumbents never left mixed — resumable from its persisted state."""
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+import bigdl_tpu.telemetry as telemetry
+from bigdl_tpu import faults
+from bigdl_tpu.fleet import (AdmissionController, Autoscaler,
+                             BudgetExhausted, DeployPipeline, Preempted,
+                             ScalePolicy)
+from bigdl_tpu.fleet.deploy import STAGES
+from bigdl_tpu.serving import QueueFull
+
+
+# ------------------------------------------------------------- fakes
+
+class _Stream:
+    """Minimal FleetStream stand-in: a completion Future, a placement
+    (`_replica`) and a TTFT — everything the control plane reads."""
+
+    def __init__(self, replica=None, ttft_ms=1.0, err=None):
+        self._replica = replica
+        self.ttft_ms = ttft_ms
+        self.completion = Future()
+        if err is not None:
+            self.completion.set_exception(err)
+        else:
+            self.completion.set_result("ok")
+
+    def done(self):
+        return self.completion.done()
+
+    def result(self, timeout=None):
+        return self.completion.result(timeout)
+
+
+class _Rep:
+    """Fake replica: name, state, a settable load, an event log."""
+
+    def __init__(self, name, load=0.0):
+        self.name = name
+        self.state = "serving"
+        self._load = load
+        self.events = []
+
+    def load(self):
+        return self._load
+
+    def accepting(self):
+        return self.state == "serving"
+
+    def submit(self, prompt, **kw):
+        self.events.append("submit")
+        return _Stream(self)
+
+    def shutdown(self, drain=True):
+        self.events.append("shutdown")
+
+
+class _Router:
+    """Fake FleetRouter: just the surface the autoscaler actuates."""
+
+    def __init__(self, reps=()):
+        self._reps = list(reps)
+        self.metrics_registry = telemetry.MetricsRegistry()
+        self.events = []
+
+    def replicas(self):
+        return list(self._reps)
+
+    def add(self, rep):
+        self.events.append(("add", rep.name))
+        self._reps.append(rep)
+
+    def drain(self, name):
+        self.events.append(("drain", name))
+        for r in self._reps:
+            if r.name == name:
+                r.state = "draining"
+
+    def remove(self, name, drain=True):
+        self.events.append(("remove", name))
+        self._reps = [r for r in self._reps if r.name != name]
+
+    def submit(self, prompt, **kw):
+        return _Stream(None)
+
+
+def _scaler(router, *, clock=None, **pol):
+    defaults = dict(min_replicas=1, max_replicas=3, up_load=3.0,
+                    down_load=1.0, up_cooldown_s=0.0,
+                    down_cooldown_s=0.0)
+    defaults.update(pol)
+    kw = {"clock": clock} if clock is not None else {}
+    return Autoscaler(router, lambda name: _Rep(name),
+                      policy=ScalePolicy(**defaults),
+                      metrics=router.metrics_registry, **kw)
+
+
+def _counter(router, name):
+    return router.metrics_registry.counter(name)
+
+
+# -------------------------------------------------------- autoscaler
+
+def test_scale_policy_validates_its_band_and_clamp():
+    with pytest.raises(ValueError, match="min_replicas"):
+        ScalePolicy(min_replicas=0)
+    with pytest.raises(ValueError, match="max_replicas"):
+        ScalePolicy(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError, match="hysteresis"):
+        ScalePolicy(up_load=2.0, down_load=2.0)
+
+
+def test_autoscaler_hysteresis_band_and_clamp():
+    """Above up_load scales up, inside the band holds, at/below
+    down_load scales down — and both clamps suppress WITH a counted
+    impulse (a quiet autoscaler must be distinguishable from a dead
+    one)."""
+    router = _Router([_Rep("seed-1", load=5.0)])
+    scaler = _scaler(router, max_replicas=2)
+    sup = _counter(router, "fleet/control/suppressed")
+
+    d = scaler.step()
+    assert d.action == "up" and len(router.replicas()) == 2
+    assert _counter(router, "fleet/control/scale_ups").total() == 1
+
+    for r in router.replicas():        # still hot, but at max: clamp
+        r._load = 5.0
+    d = scaler.step()
+    assert d.action == "hold" and "max_replicas" in d.reason
+    assert sup.value(by="clamp") == 1 and len(router.replicas()) == 2
+
+    for r in router.replicas():        # dead zone: no action at all
+        r._load = 2.0
+    d = scaler.step()
+    assert d.action == "hold" and "inside band" in d.reason
+
+    for r in router.replicas():        # idle: drain the autoscaled one
+        r._load = 0.0
+    d = scaler.step()
+    assert d.action == "down" and len(router.replicas()) == 1
+    assert router.replicas()[0].name == "seed-1"
+    assert _counter(router, "fleet/control/scale_downs").total() == 1
+
+    d = scaler.step()                  # at min: clamp, never below
+    assert d.action == "hold" and "min_replicas" in d.reason
+    assert sup.value(by="clamp") == 2 and len(router.replicas()) == 1
+
+
+def test_autoscaler_cooldowns_gate_each_direction():
+    """Per-direction cooldowns: an impulse inside the window is
+    suppressed + counted; the same impulse actuates once the window
+    elapses (driven by an injected clock — deterministic)."""
+    now = [0.0]
+    router = _Router([_Rep("seed-1", load=5.0)])
+    scaler = _scaler(router, up_cooldown_s=10.0, down_cooldown_s=10.0,
+                     clock=lambda: now[0])
+    sup = _counter(router, "fleet/control/suppressed")
+
+    assert scaler.step().action == "up"           # last_up = 0
+    for r in router.replicas():
+        r._load = 5.0
+    d = scaler.step()
+    assert d.action == "hold" and "up_cooldown" in d.reason
+    assert sup.value(by="cooldown") == 1 and len(router.replicas()) == 2
+    now[0] = 11.0
+    assert scaler.step().action == "up"           # window elapsed
+    assert len(router.replicas()) == 3
+
+    for r in router.replicas():
+        r._load = 0.0
+    assert scaler.step().action == "down"         # last_down = 11
+    d = scaler.step()
+    assert d.action == "hold" and "down_cooldown" in d.reason
+    assert sup.value(by="cooldown") == 2 and len(router.replicas()) == 2
+    now[0] = 22.0
+    assert scaler.step().action == "down"
+    assert len(router.replicas()) == 1
+
+
+def test_autoscaler_aborted_actuations_retry_next_tick():
+    """An injected fleet/spawn or fleet/drain fault aborts the
+    actuation with the fleet untouched, counts *_aborted, and the next
+    tick retries — the recovery the chaos --control leg reconciles."""
+    router = _Router([_Rep("seed-1", load=5.0)])
+    scaler = _scaler(router)
+
+    with faults.armed("fleet/spawn=nth:1,raise:RuntimeError"):
+        d = scaler.step()
+        assert d.action == "hold" and "spawn aborted" in d.reason
+        assert len(router.replicas()) == 1        # fleet untouched
+        assert _counter(
+            router, "fleet/control/spawn_aborted").total() == 1
+        assert scaler.step().action == "up"       # the retry lands
+        assert len(router.replicas()) == 2
+
+    for r in router.replicas():
+        r._load = 0.0
+    with faults.armed("fleet/drain=nth:1,raise:RuntimeError"):
+        d = scaler.step()
+        assert d.action == "hold" and "drain aborted" in d.reason
+        assert len(router.replicas()) == 2
+        assert _counter(
+            router, "fleet/control/drain_aborted").total() == 1
+        assert scaler.step().action == "down"
+        assert len(router.replicas()) == 1
+
+
+def test_spawn_is_warm_before_join_and_cleans_up_on_failure():
+    """Warm prompts run against the replica BEFORE router.add (the
+    router never sees a cold replica); a warm failure shuts the
+    orphan down and leaves the fleet unchanged."""
+    router = _Router([_Rep("seed-1", load=5.0)])
+    prompts = [np.array([1, 2], np.int32)] * 2
+    scaler = _scaler(router, warm_prompts=prompts)
+    scaler.step()
+    auto = next(r for r in router.replicas() if r.name == "auto-1")
+    assert auto.events == ["submit", "submit"]    # warmed, then joined
+    assert ("add", "auto-1") in router.events
+
+    class _ColdRep(_Rep):
+        def submit(self, prompt, **kw):
+            self.events.append("submit")
+            raise RuntimeError("warm prompt failed")
+
+    orphans = []
+    scaler.factory = lambda name: orphans.append(_ColdRep(name)) \
+        or orphans[-1]
+    for r in router.replicas():
+        r._load = 5.0
+    d = scaler.step()
+    assert d.action == "hold" and "spawn aborted" in d.reason
+    assert orphans[0].events[-1] == "shutdown"    # no orphan replica
+    assert all(r.name != "auto-2" for r in router.replicas())
+
+
+def test_empty_fleet_signals_infinite_load():
+    router = _Router([])
+    scaler = _scaler(router)
+    assert scaler.signal() == float("inf")
+    assert scaler.decide().action == "up"
+
+
+# --------------------------------------------------------- admission
+
+class _SatRouter(_Router):
+    """Fake router whose replicas sit at a fixed load (drives the
+    admission controller's saturation gate)."""
+
+    def __init__(self, load):
+        super().__init__([_Rep("r0", load=load), _Rep("r1", load=load)])
+
+
+def test_token_budget_sheds_typed_counted_and_refills():
+    now = [0.0]
+    router = _SatRouter(load=0.0)
+    adm = AdmissionController(router,
+                              metrics=router.metrics_registry,
+                              clock=lambda: now[0])
+    adm.register("bronze", rate=1.0, burst=4.0)
+    prompt = np.array([1, 2, 3], np.int32)
+
+    adm.submit(prompt, tenant="bronze", max_new_tokens=4)
+    with pytest.raises(BudgetExhausted) as ei:
+        adm.submit(prompt, tenant="bronze", max_new_tokens=4)
+    assert ei.value.tenant == "bronze"
+    assert ei.value.retry_after_s == pytest.approx(4.0)
+    shed = _counter(router, "fleet/admission/shed")
+    assert shed.value(tenant="bronze", reason="budget") == 1
+
+    now[0] = 4.0                                  # refilled: admits
+    adm.submit(prompt, tenant="bronze", max_new_tokens=4)
+    assert _counter(router, "fleet/admission/admitted").value(
+        tenant="bronze") == 2
+
+    with pytest.raises(KeyError, match="unknown tenant"):
+        adm.submit(prompt, tenant="nobody")
+
+
+def test_wfq_shares_converge_to_weight_ratio_under_saturation():
+    """gold (weight 3) vs bronze (weight 1) hammering a saturated
+    fleet: admitted shares converge to ~3:1, every bronze shed is a
+    typed fair-share QueueFull counted under its own tenant label,
+    and gold — never over its share — is never shed."""
+    router = _SatRouter(load=9.0)
+    adm = AdmissionController(router,
+                              metrics=router.metrics_registry,
+                              saturation_load=2.0, fairness_slack=2.0)
+    adm.register("gold", weight=3.0)
+    adm.register("bronze", weight=1.0)
+    prompt = np.array([1, 2], np.int32)
+    admits = {"gold": 0, "bronze": 0}
+    sheds = {"gold": 0, "bronze": 0}
+    for _ in range(300):
+        for t in ("gold", "bronze"):
+            try:
+                adm.submit(prompt, tenant=t, max_new_tokens=1)
+                admits[t] += 1
+            except QueueFull:
+                sheds[t] += 1
+    assert admits["gold"] == 300 and sheds["gold"] == 0
+    assert sheds["bronze"] > 0
+    ratio = admits["gold"] / admits["bronze"]
+    assert 2.5 <= ratio <= 3.5, (admits, sheds)
+    shed = _counter(router, "fleet/admission/shed")
+    assert shed.value(tenant="bronze",
+                      reason="fair_share") == sheds["bronze"]
+
+
+def test_wfq_is_work_conserving_below_saturation():
+    """An idle fleet admits everyone, whatever their share — the
+    fairness gate only bites under contention."""
+    router = _SatRouter(load=0.0)
+    adm = AdmissionController(router,
+                              metrics=router.metrics_registry,
+                              saturation_load=2.0, fairness_slack=2.0)
+    adm.register("gold", weight=3.0)
+    adm.register("bronze", weight=1.0)
+    prompt = np.array([1, 2], np.int32)
+    for _ in range(40):
+        for t in ("gold", "bronze"):
+            adm.submit(prompt, tenant=t, max_new_tokens=1)  # no raise
+
+
+def test_priority_preemption_keeps_the_victims_partial_tokens():
+    """A real one-replica fleet at capacity: a priority tenant's
+    arrival preempts the bronze generation mid-decode — the victim
+    resolves typed Preempted WITH the tokens it already produced
+    (work done is returned, not discarded), and the preemptor's
+    request lands in the freed capacity."""
+    from bigdl_tpu.fleet import FleetRouter, Replica
+    from bigdl_tpu.generation import GenerationConfig
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.utils.random import RandomGenerator
+
+    RandomGenerator.set_seed(42)
+    model = TransformerLM(vocab_size=32, hidden_size=16, num_layers=1,
+                          num_heads=2, max_len=64).evaluate()
+    model.ensure_initialized()
+    reg = telemetry.MetricsRegistry()
+    rep = Replica("r0", model,
+                  config=GenerationConfig(slots=1, max_len=64,
+                                          length_buckets=(64,),
+                                          prefill_rows=1, max_queue=1),
+                  metrics=reg)
+    router = FleetRouter([rep], metrics=reg)
+    try:
+        adm = AdmissionController(router, metrics=reg,
+                                  preempt_wait_s=15.0)
+        adm.register("bronze", priority=0)
+        adm.register("gold", priority=1)
+        prompt = np.array([1, 2, 3, 4], np.int32)
+
+        victim = adm.submit(prompt, tenant="bronze",
+                            max_new_tokens=48)
+        victim.first(timeout=60)       # decoding, holding THE slot
+        filler = adm.submit(prompt, tenant="bronze",
+                            max_new_tokens=2)  # fills the queue
+        gold = adm.submit(prompt, tenant="gold", max_new_tokens=2)
+
+        with pytest.raises(Preempted) as ei:
+            victim.result(timeout=30)
+        assert ei.value.tenant == "bronze" and ei.value.by == "gold"
+        assert 1 <= len(ei.value.tokens) < 48    # partial tokens KEPT
+        assert list(ei.value.tokens) == list(victim.tokens())
+        assert reg.counter("fleet/admission/preemptions").value(
+            tenant="bronze") == 1
+        assert gold.result(timeout=60) is not None
+        filler.result(timeout=60)
+    finally:
+        router.shutdown(drain=False)
+
+
+# ------------------------------------------------------------ deploy
+
+class _Servable:
+    def __init__(self, version, model):
+        self.version = version
+        self.model = model
+
+
+class _FakeService:
+    """Fake GenerationService registry: versioned current servable,
+    load() activates a new version, swap() reverts to an old one."""
+
+    def __init__(self, model):
+        self._cur = _Servable(1, model)
+        self.registry = self
+
+    def current(self, name):
+        return self._cur
+
+    def load(self, name, model):
+        self._cur = _Servable(self._cur.version + 1, model)
+
+    def swap(self, name, version):
+        self._cur = _Servable(version, self._cur.model)
+
+
+class _DeployRep:
+    def __init__(self, name, model):
+        self.name = name
+        self.state = "serving"
+        self.service = _FakeService(model)
+
+    def load(self):
+        return 0
+
+    def accepting(self):
+        return True
+
+    def shutdown(self, drain=True):
+        self.state = "dead"
+
+
+class _DeployRouter(_Router):
+    """Deterministic canary split: with a split set, every second
+    probe lands on the canary; `canary_fail=True` makes canary-placed
+    probes fail typed (the poisoned-canary scenario)."""
+
+    def __init__(self, reps):
+        super().__init__(reps)
+        self._split = None
+        self._n = 0
+        self.canary_fail = False
+
+    def set_split(self, name, fraction, seed=0):
+        self._split = name
+
+    def clear_split(self):
+        self._split = None
+
+    @property
+    def split(self):
+        return self._split
+
+    def submit(self, prompt, **kw):
+        self._n += 1
+        if self._split is not None and self._n % 2 == 0:
+            rep = next(r for r in self._reps if r.name == self._split)
+            err = RuntimeError("canary sick") if self.canary_fail \
+                else None
+            return _Stream(rep, ttft_ms=1.0, err=err)
+        rep = next(r for r in self._reps
+                   if self._split is None or r.name != self._split)
+        return _Stream(rep, ttft_ms=1.0)
+
+
+def _pipeline(router, trained, **kw):
+    defaults = dict(
+        train_fn=lambda: trained,
+        replica_factory=lambda name, model: _DeployRep(name, model),
+        canary_fraction=0.5, canary_requests=6,
+        metrics=router.metrics_registry, seed=3)
+    defaults.update(kw)
+    return DeployPipeline(router, **defaults)
+
+
+def test_deploy_happy_path_swaps_every_incumbent():
+    router = _DeployRouter([_DeployRep("r0", "m0"),
+                            _DeployRep("r1", "m0")])
+    cand = object()
+    report = _pipeline(router, cand).run()
+    assert report["state"] == "done"
+    assert report["history"] == list(STAGES)
+    for rep in router.replicas():                 # fleet-wide swap
+        assert rep.service.current(rep.name).model is cand
+        assert rep.service.current(rep.name).version == 2
+    assert len(router.replicas()) == 2            # canary retired
+    assert router.split is None
+    w = report["window"]
+    assert w["canary_requests"] == 3 and w["incumbent_requests"] == 3
+    assert w["canary_error_fraction"] == 0.0
+    assert _counter(router, "fleet/deploy/completed").total() == 1
+    assert _counter(router, "fleet/deploy/swaps").total() == 2
+
+
+def test_deploy_gate_refusal_stages_nothing():
+    from bigdl_tpu.precision.gate import AccuracyGateError
+
+    class _RefusingGate:
+        def check(self, reference, candidate, label=""):
+            raise AccuracyGateError("delta 0.5 > 0.02")
+
+    router = _DeployRouter([_DeployRep("r0", "m0")])
+    report = _pipeline(router, object(), gate=_RefusingGate(),
+                       gate_reference="m0").run()
+    assert report["state"] == "rolled_back"
+    assert "gate refused" in report["reason"]
+    assert len(router.replicas()) == 1            # no canary ever built
+    assert router.replicas()[0].service.current("r0").version == 1
+    assert _counter(router, "fleet/deploy/gate_failures").total() == 1
+
+
+def test_deploy_poisoned_canary_rolls_back_incumbent_untouched():
+    router = _DeployRouter([_DeployRep("r0", "m0")])
+    router.canary_fail = True
+    report = _pipeline(router, object()).run()
+    assert report["state"] == "rolled_back"
+    assert "canary" in report["reason"]
+    assert report["window"]["canary_error_fraction"] == 1.0
+    rep = router.replicas()[0]
+    assert rep.name == "r0"                       # canary removed
+    assert rep.service.current("r0").model == "m0"  # untouched
+    assert router.split is None
+    assert _counter(router, "fleet/deploy/rollbacks").value(
+        reason="canary") == 1
+
+
+def test_deploy_swap_abort_reverts_the_already_swapped():
+    """A fleet/canary_swap fault at the SECOND incumbent: the first —
+    already swapped — is reverted to its previous version; the fleet
+    is never left mixed."""
+    router = _DeployRouter([_DeployRep("r0", "m0"),
+                            _DeployRep("r1", "m0")])
+    with faults.armed("fleet/canary_swap=nth:2,raise:RuntimeError"):
+        report = _pipeline(router, object()).run()
+    assert report["state"] == "rolled_back"
+    assert "swap aborted" in report["reason"]
+    for rep in router.replicas():
+        assert rep.service.current(rep.name).version == 1
+    assert _counter(router, "fleet/deploy/swap_aborted").total() == 1
+
+
+def test_deploy_resumes_from_persisted_state(tmp_path):
+    """A deploy killed after committing train+gate resumes from the
+    persisted state file: committed stages are on record, artifact
+    stages replay deterministically from the seeded train_fn, and the
+    machine runs on to done. A re-run of a finished deploy is a
+    no-op — nothing swaps twice."""
+    path = str(tmp_path / "deploy.json")
+    calls = []
+    router1 = _DeployRouter([_DeployRep("r0", "m0")])
+    p1 = _pipeline(router1, None,
+                   train_fn=lambda: calls.append(1) or object(),
+                   state_path=path)
+    p1._stage_train()
+    p1._commit("train")
+    p1._stage_gate()
+    p1._commit("gate")                 # ...and the process dies here
+
+    router2 = _DeployRouter([_DeployRep("r0", "m0")])
+    p2 = _pipeline(router2, None,
+                   train_fn=lambda: calls.append(2) or object(),
+                   state_path=path)
+    assert p2.state["history"] == ["train", "gate"]  # state recovered
+    report = p2.run()
+    assert report["state"] == "done"
+    assert 2 in calls                  # the artifact stage replayed
+    assert router2.replicas()[0].service.current("r0").version == 2
+
+    swaps = _counter(router2, "fleet/deploy/swaps").total()
+    assert p2.run()["state"] == "done"               # idempotent
+    assert _counter(router2, "fleet/deploy/swaps").total() == swaps

@@ -257,3 +257,33 @@ def test_prefetch_stage_fault_propagates_to_consumer():
             for b in it:
                 got.append(b)
     assert len(got) >= 1
+
+
+def test_known_points_table_matches_call_sites_exactly():
+    """faults.KNOWN_POINTS is the registry docs/robustness.md mirrors:
+    every `faults.point("name", ...)` call site in the package must be
+    a table entry (no undeclared points), and every table entry must
+    have a live call site (no stale rows)."""
+    import os
+    import re
+
+    import bigdl_tpu
+
+    pkg = os.path.dirname(bigdl_tpu.__file__)
+    pat = re.compile(r'faults\.point\(\s*"([a-z0-9_/]+)"')
+    found = set()
+    for root, _dirs, files in os.walk(pkg):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            with open(os.path.join(root, fn)) as f:
+                found.update(pat.findall(f.read()))
+    declared = set(faults.KNOWN_POINTS)
+    assert found - declared == set(), \
+        f"faults.point call sites missing from KNOWN_POINTS: " \
+        f"{sorted(found - declared)}"
+    assert declared - found == set(), \
+        f"stale KNOWN_POINTS entries with no call site: " \
+        f"{sorted(declared - found)}"
+    for name, site in faults.KNOWN_POINTS.items():
+        assert "/" in name and site.strip(), name

@@ -706,6 +706,35 @@ def test_circuit_breaker_rearms_probe_when_outcome_never_arrives():
     assert br.state == "closed"
 
 
+def test_circuit_breaker_half_open_probe_is_single_flight():
+    """Two submits racing the open->half-open edge on the SAME clock
+    reading must admit exactly ONE probe (regression: the transition
+    used to admit without claiming the probe slot, so both racers got
+    through and half-open ran two concurrent probes). A zero cooldown
+    is the worst case — the vanished-probe re-arm check sees
+    now - probe_at == cooldown on the racing thread."""
+    from bigdl_tpu.serving import CircuitBreaker
+
+    br = CircuitBreaker(failures=1, cooldown_ms=0.0,
+                        clock=lambda: 7.0)  # frozen: a perfect race
+    for _ in range(50):
+        br.on_failure()  # open; the next allow() half-opens
+        admitted = []
+        barrier = threading.Barrier(2)
+
+        def racer():
+            barrier.wait()
+            admitted.append(br.allow())
+
+        threads = [threading.Thread(target=racer) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sum(admitted) == 1, admitted  # THE probe, exactly once
+        br.on_success()  # resolve the probe; next round re-opens
+
+
 def test_service_sheds_load_when_breaker_opens_and_recovers():
     """End to end: K consecutive dispatch failures open the breaker,
     submits fast-reject with Degraded (counted as shed), and a healthy
